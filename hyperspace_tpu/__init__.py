@@ -1,0 +1,40 @@
+"""hyperspace_tpu — a TPU-native covering-index subsystem for lake data.
+
+A ground-up rebuild of the capabilities of Microsoft Hyperspace (reference
+surveyed in SURVEY.md): users create covering indexes — bucketed, sorted,
+columnar copies of selected columns — over Parquet files, with all index data
+and metadata stored on the lake behind an optimistic-concurrency operation
+log, and a rewrite layer that transparently redirects filter and equi-join
+queries to the indexes. The control plane is Python; the data plane is
+jax/XLA/Pallas over a TPU device mesh.
+"""
+
+__version__ = "0.1.0"
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.index.index_config import IndexConfig
+
+_LAZY = {
+    "Hyperspace": ("hyperspace_tpu.facade", "Hyperspace"),
+    "HyperspaceSession": ("hyperspace_tpu.engine.session", "HyperspaceSession"),
+    "DataFrame": ("hyperspace_tpu.engine.dataframe", "DataFrame"),
+    "col": ("hyperspace_tpu.plan.expr", "col"),
+    "lit": ("hyperspace_tpu.plan.expr", "lit"),
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module 'hyperspace_tpu' has no attribute {name!r}")
+    import importlib
+    module = importlib.import_module(target[0])
+    value = getattr(module, target[1])
+    globals()[name] = value
+    return value
+
+
+__all__ = ["HyperspaceException", "HyperspaceConf", "IndexConfig",
+           "Hyperspace", "HyperspaceSession", "DataFrame", "col", "lit",
+           "__version__"]

@@ -1,0 +1,94 @@
+"""Transactional action template — the lifecycle state machine core.
+
+Parity: reference `actions/Action.scala:33-96`:
+`run() = validate(); begin(); op(); end()`. `begin()` writes log id
+`base_id+1` with a *transient* state; `end()` writes id `base_id+2` with the
+*final* state and deletes + recreates `latestStable`. `base_id` = latest log
+id or -1. A failure between begin and end strands the index in a transient
+state; only `cancel()` can recover (reference `actions/CancelAction.scala`).
+Optimistic concurrency: `write_log` refuses existing ids, so exactly one of
+two racing actions wins the `base_id+1` slot.
+"""
+
+from __future__ import annotations
+
+import logging
+from abc import ABC, abstractmethod
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.log_entry import LogEntry
+from hyperspace_tpu.index.log_manager import IndexLogManager
+
+logger = logging.getLogger(__name__)
+
+
+class Action(ABC):
+    def __init__(self, log_manager: IndexLogManager):
+        self.log_manager = log_manager
+        self._base_id: int | None = None
+        self._latest_entry = None
+
+    def latest_entry(self, verb: str):
+        """Latest IndexLogEntry, cached; raises if the log is empty or not an
+        index entry (shared by the metadata-only actions)."""
+        if self._latest_entry is None:
+            from hyperspace_tpu.index.log_entry import IndexLogEntry
+            entry = self.log_manager.get_latest_log()
+            if not isinstance(entry, IndexLogEntry):
+                raise HyperspaceException(f"No index found to {verb}.")
+            self._latest_entry = entry
+        return self._latest_entry
+
+    @property
+    def base_id(self) -> int:
+        if self._base_id is None:
+            latest = self.log_manager.get_latest_id()
+            self._base_id = latest if latest is not None else -1
+        return self._base_id
+
+    @property
+    @abstractmethod
+    def transient_state(self) -> str: ...
+
+    @property
+    @abstractmethod
+    def final_state(self) -> str: ...
+
+    @abstractmethod
+    def log_entry(self) -> LogEntry:
+        """The record to persist (with state filled in by begin/end)."""
+
+    def validate(self) -> None:
+        """Override to gate on the current lifecycle state."""
+
+    @abstractmethod
+    def op(self) -> None:
+        """The data-moving operation (may dispatch device work)."""
+
+    def begin(self) -> None:
+        entry = self.log_entry()
+        entry.state = self.transient_state
+        if not self.log_manager.write_log(self.base_id + 1, entry):
+            raise HyperspaceException(
+                "Another operation is in progress for this index "
+                f"(log id {self.base_id + 1} already exists).")
+        logger.info("Begin %s (log id %d, state %s)",
+                    type(self).__name__, self.base_id + 1, self.transient_state)
+
+    def end(self) -> None:
+        entry = self.log_entry()
+        entry.state = self.final_state
+        if not self.log_manager.write_log(self.base_id + 2, entry):
+            raise HyperspaceException(
+                "Another operation is in progress for this index "
+                f"(log id {self.base_id + 2} already exists).")
+        self.log_manager.delete_latest_stable_log()
+        self.log_manager.create_latest_stable_log(self.base_id + 2)
+        logger.info("End %s (log id %d, state %s)",
+                    type(self).__name__, self.base_id + 2, self.final_state)
+
+    def run(self) -> None:
+        self.validate()
+        self.begin()
+        self.op()
+        self.end()

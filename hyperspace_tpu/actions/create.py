@@ -1,0 +1,153 @@
+"""Create action + shared create/refresh machinery.
+
+Parity: reference `actions/CreateActionBase.scala:31-121` and
+`actions/CreateAction.scala:27-75`. The index build job — the reference's
+`df.select(indexed++included).repartition(numBuckets, indexedCols)
+.write.saveWithBuckets(...)` — becomes this framework's device build
+pipeline: hash-partition + sort kernels over columnar batches, bucketed
+parquet write (`io/builder.py`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from hyperspace_tpu import constants
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_entry import (Content, CoveringIndex, Directory,
+                                            Hdfs, IndexLogEntry,
+                                            LogicalPlanFingerprint,
+                                            NoOpFingerprint, PlanSource,
+                                            Signature, Source)
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.index.signature import FileBasedSignatureProvider
+from hyperspace_tpu.plan.nodes import Scan
+from hyperspace_tpu.plan.serde import plan_to_json
+
+
+class CreateActionBase(Action):
+    """Shared machinery for Create/Refresh (reference `CreateActionBase.scala`)."""
+
+    def __init__(self, log_manager: IndexLogManager,
+                 data_manager: IndexDataManager, conf: HyperspaceConf):
+        super().__init__(log_manager)
+        self.data_manager = data_manager
+        self.conf = conf
+
+    @property
+    def index_data_path(self) -> str:
+        """Next `v__=N` dir (reference `CreateActionBase.scala:31-36`)."""
+        latest = self.data_manager.get_latest_version_id()
+        next_version = latest + 1 if latest is not None else 0
+        return self.data_manager.get_path(next_version)
+
+    def num_buckets(self) -> int:
+        return self.conf.num_buckets
+
+    def _signature_provider(self):
+        return FileBasedSignatureProvider()
+
+    def source_files(self, df) -> List[str]:
+        """All files of every Scan leaf (reference `CreateActionBase.scala:89-97`)."""
+        files: List[str] = []
+        for leaf in df.plan.collect_leaves():
+            if isinstance(leaf, Scan):
+                files.extend(leaf.files())
+        return files
+
+    def get_index_log_entry(self, df, index_config: IndexConfig,
+                            path: str) -> IndexLogEntry:
+        """Build the full metadata record (reference `CreateActionBase.scala:38-87`):
+        numBuckets from conf, schema of indexed+included columns, serialized
+        source plan (the *logical* IR — like the reference logging the
+        unanalyzed plan), fingerprint via the signature provider, and the
+        source file list."""
+        provider = self._signature_provider()
+        signature_value = provider.signature(df.plan)
+        if signature_value is None:
+            raise HyperspaceException(
+                "Cannot fingerprint source plan: unsupported relations present.")
+        columns = index_config.indexed_columns + index_config.included_columns
+        schema = df.schema.select(columns)
+        source_file_list = self.source_files(df)
+        entry = IndexLogEntry(
+            name=index_config.index_name,
+            derived_dataset=CoveringIndex(
+                indexed_columns=list(index_config.indexed_columns),
+                included_columns=list(index_config.included_columns),
+                schema_json=schema.to_json(),
+                num_buckets=self.num_buckets()),
+            content=Content(root=path, directories=[]),
+            source=Source(
+                plan=PlanSource(
+                    raw_plan=plan_to_json(df.plan),
+                    fingerprint=LogicalPlanFingerprint(
+                        [Signature(provider.name(), signature_value)])),
+                data=[Hdfs(Content(root="", directories=[
+                    Directory(path="", files=source_file_list,
+                              fingerprint=NoOpFingerprint())]))]),
+            extra={})
+        return entry
+
+    def write(self, df, index_config: IndexConfig, path: str) -> None:
+        """THE index build job (reference `CreateActionBase.scala:99-120`).
+
+        select(indexed ++ included) -> device hash-partition into numBuckets
+        by indexed columns -> per-bucket sort by indexed columns -> bucketed
+        parquet under `path`.
+        """
+        from hyperspace_tpu.io.builder import write_index
+        write_index(df, list(index_config.indexed_columns),
+                    list(index_config.included_columns),
+                    self.num_buckets(), path)
+
+
+class CreateAction(CreateActionBase):
+    """transient CREATING -> final ACTIVE (reference `CreateAction.scala:27-75`)."""
+
+    def __init__(self, df, index_config: IndexConfig,
+                 log_manager: IndexLogManager, data_manager: IndexDataManager,
+                 conf: HyperspaceConf):
+        super().__init__(log_manager, data_manager, conf)
+        self.df = df
+        self.index_config = index_config
+        self._entry: Optional[IndexLogEntry] = None
+
+    transient_state = States.CREATING
+    final_state = States.ACTIVE
+
+    def log_entry(self) -> IndexLogEntry:
+        if self._entry is None:
+            self._entry = self.get_index_log_entry(
+                self.df, self.index_config, self.index_data_path)
+        # A fresh copy per begin/end write so state mutation doesn't alias.
+        return IndexLogEntry.from_dict(self._entry.to_dict())
+
+    def validate(self) -> None:
+        """Reference `CreateAction.scala:42-62`: source must be a plain file
+        scan (no filter/project/join on top), index columns must exist in the
+        source schema, and no non-DOESNOTEXIST index of the same name."""
+        if not isinstance(self.df.plan, Scan):
+            raise HyperspaceException(
+                "Only creating index over a plain file scan is supported.")
+        schema = self.df.schema
+        missing = [c for c in (self.index_config.indexed_columns
+                               + self.index_config.included_columns)
+                   if not schema.contains(c)]
+        if missing:
+            raise HyperspaceException(
+                "Index config is not applicable to dataframe schema; "
+                f"missing columns: {', '.join(missing)}")
+        latest = self.log_manager.get_latest_log()
+        if latest is not None and latest.state != States.DOESNOTEXIST:
+            raise HyperspaceException(
+                f"Another index with name {self.index_config.index_name} "
+                f"already exists (state {latest.state}).")
+
+    def op(self) -> None:
+        self.write(self.df, self.index_config, self.index_data_path)

@@ -1,0 +1,65 @@
+"""Optimize — merge-compaction of incremental index deltas (extension).
+
+The surveyed reference only has full rebuild (`RefreshAction`); its roadmap
+(`ROADMAP.md:66-75`) and this build's baseline ladder (BASELINE.md) require
+incremental refresh + compaction. OptimizeAction compacts the delta files
+written by incremental refresh into full per-bucket sorted runs via the
+device k-way merge kernel (`ops/merge.py`), ACTIVE -> (OPTIMIZING) -> ACTIVE
+into the next `v__=N+1`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.actions.create import CreateActionBase
+
+
+class OptimizeAction(CreateActionBase):
+    transient_state = States.OPTIMIZING
+    final_state = States.ACTIVE
+
+    def __init__(self, log_manager: IndexLogManager,
+                 data_manager: IndexDataManager, conf: HyperspaceConf):
+        super().__init__(log_manager, data_manager, conf)
+        self._previous: Optional[IndexLogEntry] = None
+        self._entry: Optional[IndexLogEntry] = None
+
+    @property
+    def previous_entry(self) -> IndexLogEntry:
+        if self._previous is None:
+            entry = self.log_manager.get_log(self.base_id)
+            if not isinstance(entry, IndexLogEntry):
+                raise HyperspaceException("No index log entry to optimize.")
+            self._previous = entry
+        return self._previous
+
+    def num_buckets(self) -> int:
+        return self.previous_entry.num_buckets
+
+    def validate(self) -> None:
+        if self.previous_entry.state != States.ACTIVE:
+            raise HyperspaceException(
+                f"Optimize is only supported in {States.ACTIVE} state; "
+                f"current state is {self.previous_entry.state}.")
+
+    def log_entry(self) -> IndexLogEntry:
+        if self._entry is None:
+            entry = IndexLogEntry.from_dict(self.previous_entry.to_dict())
+            entry.content.root = self.index_data_path
+            entry.content.directories = []
+            entry.extra = dict(entry.extra)
+            entry.extra.pop("deltaVersions", None)
+            self._entry = entry
+        return IndexLogEntry.from_dict(self._entry.to_dict())
+
+    def op(self) -> None:
+        from hyperspace_tpu.io.builder import compact_index
+        compact_index(self.previous_entry, self.data_manager,
+                      self.index_data_path)

@@ -1,0 +1,42 @@
+"""Hard delete: DELETED -> (VACUUMING) -> DOESNOTEXIST; removes all data
+version directories latest -> 0.
+
+Parity: reference `actions/VacuumAction.scala:23-52`.
+"""
+
+from __future__ import annotations
+
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.actions.base import Action
+
+
+class VacuumAction(Action):
+    transient_state = States.VACUUMING
+    final_state = States.DOESNOTEXIST
+
+    def __init__(self, log_manager: IndexLogManager,
+                 data_manager: IndexDataManager):
+        super().__init__(log_manager)
+        self.data_manager = data_manager
+
+    def validate(self) -> None:
+        state = self.latest_entry("vacuum").state
+        if state != States.DELETED:
+            raise HyperspaceException(
+                f"Vacuum is only supported in {States.DELETED} state; "
+                f"current state is {state}.")
+
+    def log_entry(self) -> IndexLogEntry:
+        return IndexLogEntry.from_dict(self.latest_entry("vacuum").to_dict())
+
+    def op(self) -> None:
+        """Delete every data version dir latest -> 0 (reference
+        `VacuumAction.scala:45-51`)."""
+        latest = self.data_manager.get_latest_version_id()
+        if latest is not None:
+            for version in range(latest, -1, -1):
+                self.data_manager.delete(version)

@@ -1,0 +1,60 @@
+"""Framework-wide constants: config keys, op-log layout, lifecycle states.
+
+Parity: reference `index/IndexConstants.scala:21-50` and
+`actions/Constants.scala:19-33`. Config keys keep the reference's
+`spark.hyperspace.*` spelling (so existing user configs translate 1:1) and the
+`hyperspace.*` short form is accepted as an alias (see `config.py`).
+"""
+
+INDEXES_DIR = "indexes"
+
+# Config keys (reference `index/IndexConstants.scala:24-35`).
+INDEX_SYSTEM_PATH = "spark.hyperspace.system.path"
+INDEX_CREATION_PATH = "spark.hyperspace.index.creation.path"
+INDEX_SEARCH_PATHS = "spark.hyperspace.index.search.paths"
+INDEX_NUM_BUCKETS = "spark.hyperspace.index.num.buckets"
+# The reference defaults numBuckets to spark.sql.shuffle.partitions (= 200).
+# On TPU the analogous width is chosen to divide evenly over typical mesh
+# sizes; 200 is kept for drop-in config parity.
+INDEX_NUM_BUCKETS_DEFAULT = 200
+
+INDEX_CACHE_EXPIRY_DURATION_SECONDS = (
+    "spark.hyperspace.index.cache.expiryDurationInSeconds")
+INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
+
+WAREHOUSE_PATH = "spark.hyperspace.warehouse.dir"
+WAREHOUSE_PATH_DEFAULT = "warehouse"
+
+# Operation log layout (reference `index/IndexConstants.scala:38-39`).
+HYPERSPACE_LOG = "_hyperspace_log"
+INDEX_VERSION_DIRECTORY_PREFIX = "v__"
+LATEST_STABLE_LOG = "latestStable"
+
+# Explain display mode (reference `index/IndexConstants.scala:42-49`).
+DISPLAY_MODE = "spark.hyperspace.explain.displayMode"
+HIGHLIGHT_BEGIN_TAG = "spark.hyperspace.explain.displayMode.highlight.beginTag"
+HIGHLIGHT_END_TAG = "spark.hyperspace.explain.displayMode.highlight.endTag"
+
+
+class DisplayModeNames:
+    CONSOLE = "console"
+    PLAIN_TEXT = "plaintext"
+    HTML = "html"
+
+
+class States:
+    """Index lifecycle states (reference `actions/Constants.scala:20-30`)."""
+
+    ACTIVE = "ACTIVE"
+    CREATING = "CREATING"
+    DELETING = "DELETING"
+    DELETED = "DELETED"
+    REFRESHING = "REFRESHING"
+    VACUUMING = "VACUUMING"
+    RESTORING = "RESTORING"
+    DOESNOTEXIST = "DOESNOTEXIST"
+    CANCELLING = "CANCELLING"
+    OPTIMIZING = "OPTIMIZING"  # extension: incremental merge-compaction
+
+
+STABLE_STATES = (States.ACTIVE, States.DELETED, States.DOESNOTEXIST)

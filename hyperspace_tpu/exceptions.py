@@ -1,0 +1,12 @@
+"""Framework exception type.
+
+Parity: reference `HyperspaceException.scala:19` (single framework exception).
+"""
+
+
+class HyperspaceException(Exception):
+    """Raised for all user-facing framework errors."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.message = message

@@ -1,0 +1,29 @@
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_entry import (
+    Content,
+    CoveringIndex,
+    Directory,
+    Hdfs,
+    IndexLogEntry,
+    LogEntry,
+    LogicalPlanFingerprint,
+    NoOpFingerprint,
+    PlanSource,
+    Signature,
+    Source,
+)
+
+__all__ = [
+    "IndexConfig",
+    "Content",
+    "CoveringIndex",
+    "Directory",
+    "Hdfs",
+    "IndexLogEntry",
+    "LogEntry",
+    "LogicalPlanFingerprint",
+    "NoOpFingerprint",
+    "PlanSource",
+    "Signature",
+    "Source",
+]

@@ -1,0 +1,57 @@
+"""Versioned index data directories.
+
+Parity: reference `index/IndexDataManager.scala:24-73` — index data lives in
+`<indexRoot>/v__=<N>/` (Hive-partition-style naming); refresh writes N+1,
+vacuum deletes all versions. Layout doc: reference
+`docs/_docs/14-toh-indexes-on-the-lake.md:16-27`.
+"""
+
+from __future__ import annotations
+
+import os
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+from hyperspace_tpu import constants
+from hyperspace_tpu.utils import file_utils
+
+
+class IndexDataManager(ABC):
+    """Trait parity: reference `index/IndexDataManager.scala:38-44`."""
+
+    @abstractmethod
+    def get_latest_version_id(self) -> Optional[int]: ...
+
+    @abstractmethod
+    def get_path(self, version_id: int) -> str: ...
+
+    @abstractmethod
+    def delete(self, version_id: int) -> None: ...
+
+
+class IndexDataManagerImpl(IndexDataManager):
+    def __init__(self, index_path: str):
+        self.index_path = index_path
+
+    def _version_dirs(self) -> List[int]:
+        if not os.path.isdir(self.index_path):
+            return []
+        prefix = constants.INDEX_VERSION_DIRECTORY_PREFIX + "="
+        out = []
+        for name in os.listdir(self.index_path):
+            if name.startswith(prefix) and name[len(prefix):].isdigit():
+                out.append(int(name[len(prefix):]))
+        return sorted(out)
+
+    def get_latest_version_id(self) -> Optional[int]:
+        """Scan `v__=N` dir names (reference `IndexDataManager.scala:55-66`)."""
+        versions = self._version_dirs()
+        return versions[-1] if versions else None
+
+    def get_path(self, version_id: int) -> str:
+        return os.path.join(
+            self.index_path,
+            f"{constants.INDEX_VERSION_DIRECTORY_PREFIX}={version_id}")
+
+    def delete(self, version_id: int) -> None:
+        file_utils.delete(self.get_path(version_id))

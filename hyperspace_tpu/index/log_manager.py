@@ -1,0 +1,131 @@
+"""Per-index operation log with optimistic concurrency.
+
+Parity: reference `index/IndexLogManager.scala:32-157` — log lives at
+`<indexRoot>/_hyperspace_log/<id>` (monotonically increasing integer
+filenames) plus a `latestStable` copy. `write_log(id, entry)` fails if `<id>`
+exists, else publishes atomically — exactly one concurrent writer wins an id
+(the reference's temp-file + atomic-rename OCC, `IndexLogManager.scala:139-156`;
+here `atomic_write_if_absent` in `util/file_utils.py`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from abc import ABC, abstractmethod
+from typing import Optional
+
+from hyperspace_tpu import constants
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.log_entry import LogEntry
+from hyperspace_tpu.utils import file_utils
+
+
+class IndexLogManager(ABC):
+    """Trait parity: reference `index/IndexLogManager.scala:32-54`."""
+
+    @abstractmethod
+    def get_log(self, log_id: int) -> Optional[LogEntry]: ...
+
+    @abstractmethod
+    def get_latest_id(self) -> Optional[int]: ...
+
+    @abstractmethod
+    def get_latest_log(self) -> Optional[LogEntry]: ...
+
+    @abstractmethod
+    def get_latest_stable_log(self) -> Optional[LogEntry]: ...
+
+    @abstractmethod
+    def create_latest_stable_log(self, log_id: int) -> bool: ...
+
+    @abstractmethod
+    def delete_latest_stable_log(self) -> bool: ...
+
+    @abstractmethod
+    def write_log(self, log_id: int, entry: LogEntry) -> bool: ...
+
+
+class IndexLogManagerImpl(IndexLogManager):
+    """Filesystem-backed impl (reference `index/IndexLogManager.scala:56-157`)."""
+
+    def __init__(self, index_path: str):
+        self.index_path = index_path
+        self.log_dir = os.path.join(index_path, constants.HYPERSPACE_LOG)
+
+    def _path_for(self, log_id: int) -> str:
+        return os.path.join(self.log_dir, str(log_id))
+
+    def get_log(self, log_id: int) -> Optional[LogEntry]:
+        path = self._path_for(log_id)
+        if not os.path.exists(path):
+            return None
+        # Retry briefly on a torn read: on no-hardlink filesystems the OCC
+        # fallback publishes the filename before its contents (see
+        # file_utils.atomic_write_if_absent).
+        last_error: Exception | None = None
+        for _ in range(5):
+            try:
+                return LogEntry.from_json(file_utils.read_contents(path))
+            except (json.JSONDecodeError, ValueError) as exc:
+                last_error = exc
+                time.sleep(0.02)
+        raise HyperspaceException(
+            f"Corrupt log entry at {path}: {last_error}")
+
+    def get_latest_id(self) -> Optional[int]:
+        """Max numeric filename (reference `IndexLogManager.scala:80-89`)."""
+        if not os.path.isdir(self.log_dir):
+            return None
+        ids = [int(name) for name in os.listdir(self.log_dir) if name.isdigit()]
+        return max(ids) if ids else None
+
+    def get_latest_log(self) -> Optional[LogEntry]:
+        latest = self.get_latest_id()
+        return self.get_log(latest) if latest is not None else None
+
+    def get_latest_stable_log(self) -> Optional[LogEntry]:
+        """Read `latestStable`, else scan ids downward for a stable state
+        (reference `IndexLogManager.scala:91-110`)."""
+        stable_path = os.path.join(self.log_dir, constants.LATEST_STABLE_LOG)
+        if os.path.exists(stable_path):
+            return LogEntry.from_json(file_utils.read_contents(stable_path))
+        latest = self.get_latest_id()
+        if latest is None:
+            return None
+        for log_id in range(latest, -1, -1):
+            entry = self.get_log(log_id)
+            if entry is not None and entry.state in constants.STABLE_STATES:
+                return entry
+        return None
+
+    def create_latest_stable_log(self, log_id: int) -> bool:
+        """Copy `<id>` -> `latestStable` (reference `IndexLogManager.scala:112-122`)."""
+        source = self._path_for(log_id)
+        if not os.path.exists(source):
+            return False
+        entry = LogEntry.from_json(file_utils.read_contents(source))
+        if entry.state not in constants.STABLE_STATES:
+            return False
+        file_utils.create_file(os.path.join(self.log_dir, constants.LATEST_STABLE_LOG),
+                               file_utils.read_contents(source))
+        return True
+
+    def delete_latest_stable_log(self) -> bool:
+        """Reference `IndexLogManager.scala:124-137`."""
+        path = os.path.join(self.log_dir, constants.LATEST_STABLE_LOG)
+        if not os.path.exists(path):
+            return True
+        try:
+            os.remove(path)
+            return True
+        except OSError:
+            return False
+
+    def write_log(self, log_id: int, entry: LogEntry) -> bool:
+        if os.path.exists(self._path_for(log_id)):
+            return False
+        entry.id = log_id
+        return file_utils.atomic_write_if_absent(self._path_for(log_id),
+                                                 entry.to_json(indent=2))

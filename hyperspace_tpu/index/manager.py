@@ -1,0 +1,231 @@
+"""Index catalog management: binds the lifecycle verbs to actions.
+
+Parity: reference `index/IndexManager.scala:24-81` (trait),
+`index/IndexCollectionManager.scala:26-173` (binding + catalog listing +
+IndexSummary rows), `index/CachingIndexCollectionManager.scala:37-99`
+(read-path caching; every mutating API clears the cache).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from hyperspace_tpu import constants
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.cache import Cache, IndexCacheFactory
+from hyperspace_tpu.index.factories import (IndexDataManagerFactory,
+                                            IndexLogManagerFactory)
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.index.path_resolver import PathResolver
+from hyperspace_tpu.actions.cancel import CancelAction
+from hyperspace_tpu.actions.create import CreateAction
+from hyperspace_tpu.actions.delete import DeleteAction
+from hyperspace_tpu.actions.optimize import OptimizeAction
+from hyperspace_tpu.actions.refresh import RefreshAction
+from hyperspace_tpu.actions.restore import RestoreAction
+from hyperspace_tpu.actions.vacuum import VacuumAction
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class IndexSummary:
+    """Catalog row (reference `IndexCollectionManager.scala:151-173`)."""
+
+    name: str
+    indexed_columns: List[str]
+    included_columns: List[str]
+    num_buckets: int
+    schema_json: str
+    index_location: str
+    state: str
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "indexedColumns": list(self.indexed_columns),
+            "includedColumns": list(self.included_columns),
+            "numBuckets": self.num_buckets,
+            "schema": self.schema_json,
+            "indexLocation": self.index_location,
+            "state": self.state,
+        }
+
+
+class IndexManager(ABC):
+    """Trait parity: reference `index/IndexManager.scala:24-81`."""
+
+    @abstractmethod
+    def indexes(self) -> List[IndexSummary]: ...
+
+    @abstractmethod
+    def create(self, df, index_config: IndexConfig) -> None: ...
+
+    @abstractmethod
+    def delete(self, index_name: str) -> None: ...
+
+    @abstractmethod
+    def restore(self, index_name: str) -> None: ...
+
+    @abstractmethod
+    def vacuum(self, index_name: str) -> None: ...
+
+    @abstractmethod
+    def refresh(self, index_name: str) -> None: ...
+
+    @abstractmethod
+    def optimize(self, index_name: str) -> None: ...
+
+    @abstractmethod
+    def cancel(self, index_name: str) -> None: ...
+
+    @abstractmethod
+    def get_indexes(self, states: Optional[Sequence[str]] = None) -> List[IndexLogEntry]: ...
+
+
+class IndexCollectionManager(IndexManager):
+    def __init__(self, conf: HyperspaceConf,
+                 log_manager_factory: Optional[IndexLogManagerFactory] = None,
+                 data_manager_factory: Optional[IndexDataManagerFactory] = None,
+                 path_resolver: Optional[PathResolver] = None):
+        self.conf = conf
+        self.log_manager_factory = log_manager_factory or IndexLogManagerFactory()
+        self.data_manager_factory = data_manager_factory or IndexDataManagerFactory()
+        self.path_resolver = path_resolver or PathResolver(conf)
+
+    def _managers(self, index_name: str):
+        path = self.path_resolver.get_index_path(index_name)
+        return (self.log_manager_factory.create(path),
+                self.data_manager_factory.create(path))
+
+    def create(self, df, index_config: IndexConfig) -> None:
+        log_manager, data_manager = self._managers(index_config.index_name)
+        CreateAction(df, index_config, log_manager, data_manager, self.conf).run()
+
+    def delete(self, index_name: str) -> None:
+        log_manager, _ = self._managers(index_name)
+        DeleteAction(log_manager).run()
+
+    def restore(self, index_name: str) -> None:
+        log_manager, _ = self._managers(index_name)
+        RestoreAction(log_manager).run()
+
+    def vacuum(self, index_name: str) -> None:
+        log_manager, data_manager = self._managers(index_name)
+        VacuumAction(log_manager, data_manager).run()
+
+    def refresh(self, index_name: str) -> None:
+        log_manager, data_manager = self._managers(index_name)
+        RefreshAction(log_manager, data_manager, self.conf).run()
+
+    def optimize(self, index_name: str) -> None:
+        log_manager, data_manager = self._managers(index_name)
+        OptimizeAction(log_manager, data_manager, self.conf).run()
+
+    def cancel(self, index_name: str) -> None:
+        log_manager, _ = self._managers(index_name)
+        CancelAction(log_manager).run()
+
+    def indexes(self) -> List[IndexSummary]:
+        """All indexes not in DOESNOTEXIST, as summary rows (reference
+        `IndexCollectionManager.scala:79-85`)."""
+        out = []
+        for entry in self.get_indexes():
+            if entry.state == States.DOESNOTEXIST:
+                continue
+            out.append(IndexSummary(
+                name=entry.name,
+                indexed_columns=entry.indexed_columns,
+                included_columns=entry.included_columns,
+                num_buckets=entry.num_buckets,
+                schema_json=entry.schema_json,
+                index_location=entry.content.root,
+                state=entry.state))
+        return out
+
+    def indexes_df(self):
+        """Catalog as a pandas DataFrame (the reference returns a Spark
+        DataFrame from `hs.indexes`)."""
+        import pandas as pd
+        return pd.DataFrame([s.to_dict() for s in self.indexes()])
+
+    def get_indexes(self, states: Optional[Sequence[str]] = None) -> List[IndexLogEntry]:
+        """List every index dir under the system path, read each latest log,
+        filter by state (reference `IndexCollectionManager.scala:87-105`)."""
+        root = self.path_resolver.system_path
+        if not os.path.isdir(root):
+            return []
+        entries: List[IndexLogEntry] = []
+        for name in sorted(os.listdir(root)):
+            index_path = os.path.join(root, name)
+            if not os.path.isdir(index_path):
+                continue
+            log_manager = self.log_manager_factory.create(index_path)
+            try:
+                entry = log_manager.get_latest_log()
+            except HyperspaceException as exc:
+                # One corrupt index must not take down the whole catalog.
+                logger.warning("Skipping unreadable index at %s: %s",
+                               index_path, exc)
+                continue
+            if isinstance(entry, IndexLogEntry):
+                if states is None or entry.state in states:
+                    entries.append(entry)
+        return entries
+
+
+class CachingIndexCollectionManager(IndexCollectionManager):
+    """Caches `get_indexes`; mutating APIs clear the cache (reference
+    `CachingIndexCollectionManager.scala:37-99`)."""
+
+    def __init__(self, conf: HyperspaceConf, **kwargs):
+        super().__init__(conf, **kwargs)
+        self._cache: Cache = IndexCacheFactory().create(conf)
+
+    def clear_cache(self) -> None:
+        self._cache.clear()
+
+    def get_indexes(self, states: Optional[Sequence[str]] = None) -> List[IndexLogEntry]:
+        if states is None:
+            cached = self._cache.get()
+            if cached is not None:
+                return cached
+            entries = super().get_indexes()
+            self._cache.set(entries)
+            return entries
+        return [e for e in self.get_indexes() if e.state in states]
+
+    def create(self, df, index_config: IndexConfig) -> None:
+        self.clear_cache()
+        super().create(df, index_config)
+
+    def delete(self, index_name: str) -> None:
+        self.clear_cache()
+        super().delete(index_name)
+
+    def restore(self, index_name: str) -> None:
+        self.clear_cache()
+        super().restore(index_name)
+
+    def vacuum(self, index_name: str) -> None:
+        self.clear_cache()
+        super().vacuum(index_name)
+
+    def refresh(self, index_name: str) -> None:
+        self.clear_cache()
+        super().refresh(index_name)
+
+    def optimize(self, index_name: str) -> None:
+        self.clear_cache()
+        super().optimize(index_name)
+
+    def cancel(self, index_name: str) -> None:
+        self.clear_cache()
+        super().cancel(index_name)

@@ -1,0 +1,290 @@
+"""Expression trees for the relational IR.
+
+The reference leans on Catalyst expressions; this framework owns a small
+expression language sufficient for the covering-index workloads (filters and
+equi-join conditions over scalar columns): column refs, literals,
+comparisons, boolean algebra, arithmetic, IN, NULL tests. Expressions are
+JSON-serializable (replacing the reference's Kryo serde of Catalyst trees,
+`index/serde/LogicalPlanSerDeUtils.scala:40-67`) and are compiled to jax
+ops by the engine (`engine/compiler.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Set
+
+from hyperspace_tpu.exceptions import HyperspaceException
+
+
+class Expression:
+    """Base expression node."""
+
+    @property
+    def children(self) -> List["Expression"]:
+        return []
+
+    def references(self) -> Set[str]:
+        out: Set[str] = set()
+        for c in self.children:
+            out |= c.references()
+        return out
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d: dict) -> "Expression":
+        op = d["op"]
+        cls = _REGISTRY.get(op)
+        if cls is None:
+            raise HyperspaceException(f"Unknown expression op: {op}")
+        return cls._from_dict(d)
+
+    # Operator sugar so users can write `col("a") == lit(1)` style predicates.
+    def __eq__(self, other):  # type: ignore[override]
+        return EqualTo(self, _wrap(other))
+
+    def __ne__(self, other):  # type: ignore[override]
+        return NotEqualTo(self, _wrap(other))
+
+    def __lt__(self, other):
+        return LessThan(self, _wrap(other))
+
+    def __le__(self, other):
+        return LessThanOrEqual(self, _wrap(other))
+
+    def __gt__(self, other):
+        return GreaterThan(self, _wrap(other))
+
+    def __ge__(self, other):
+        return GreaterThanOrEqual(self, _wrap(other))
+
+    def __and__(self, other):
+        return And(self, _wrap(other))
+
+    def __or__(self, other):
+        return Or(self, _wrap(other))
+
+    def __invert__(self):
+        return Not(self)
+
+    def __add__(self, other):
+        return Add(self, _wrap(other))
+
+    def __sub__(self, other):
+        return Sub(self, _wrap(other))
+
+    def __mul__(self, other):
+        return Mul(self, _wrap(other))
+
+    def __truediv__(self, other):
+        return Div(self, _wrap(other))
+
+    def __hash__(self):
+        return hash(repr(self))
+
+    def isin(self, *values) -> "In":
+        return In(self, [(_wrap(v)) for v in values])
+
+    def is_null(self) -> "IsNull":
+        return IsNull(self)
+
+    def is_not_null(self) -> "IsNotNull":
+        return IsNotNull(self)
+
+
+def _wrap(value) -> "Expression":
+    if isinstance(value, Expression):
+        return value
+    return Literal(value)
+
+
+class Column(Expression):
+    def __init__(self, name: str):
+        self.name = name
+
+    def references(self) -> Set[str]:
+        return {self.name}
+
+    def to_dict(self) -> dict:
+        return {"op": "column", "name": self.name}
+
+    @staticmethod
+    def _from_dict(d: dict) -> "Column":
+        return Column(d["name"])
+
+    def __repr__(self):
+        return f"col({self.name})"
+
+
+class Literal(Expression):
+    def __init__(self, value: Any):
+        if value is not None and not isinstance(value, (bool, int, float, str)):
+            raise HyperspaceException(f"Unsupported literal: {value!r}")
+        self.value = value
+
+    def to_dict(self) -> dict:
+        return {"op": "literal", "value": self.value}
+
+    @staticmethod
+    def _from_dict(d: dict) -> "Literal":
+        return Literal(d["value"])
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+class _Binary(Expression):
+    op: str = ""
+    symbol: str = ""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.left = left
+        self.right = right
+
+    @property
+    def children(self) -> List[Expression]:
+        return [self.left, self.right]
+
+    def to_dict(self) -> dict:
+        return {"op": self.op, "left": self.left.to_dict(),
+                "right": self.right.to_dict()}
+
+    @classmethod
+    def _from_dict(cls, d: dict):
+        return cls(Expression.from_dict(d["left"]), Expression.from_dict(d["right"]))
+
+    def __repr__(self):
+        return f"({self.left!r} {self.symbol} {self.right!r})"
+
+
+class EqualTo(_Binary):
+    op, symbol = "eq", "="
+
+
+class NotEqualTo(_Binary):
+    op, symbol = "ne", "!="
+
+
+class LessThan(_Binary):
+    op, symbol = "lt", "<"
+
+
+class LessThanOrEqual(_Binary):
+    op, symbol = "le", "<="
+
+
+class GreaterThan(_Binary):
+    op, symbol = "gt", ">"
+
+
+class GreaterThanOrEqual(_Binary):
+    op, symbol = "ge", ">="
+
+
+class And(_Binary):
+    op, symbol = "and", "AND"
+
+
+class Or(_Binary):
+    op, symbol = "or", "OR"
+
+
+class Add(_Binary):
+    op, symbol = "add", "+"
+
+
+class Sub(_Binary):
+    op, symbol = "sub", "-"
+
+
+class Mul(_Binary):
+    op, symbol = "mul", "*"
+
+
+class Div(_Binary):
+    op, symbol = "div", "/"
+
+
+class _Unary(Expression):
+    op: str = ""
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    @property
+    def children(self) -> List[Expression]:
+        return [self.child]
+
+    def to_dict(self) -> dict:
+        return {"op": self.op, "child": self.child.to_dict()}
+
+    @classmethod
+    def _from_dict(cls, d: dict):
+        return cls(Expression.from_dict(d["child"]))
+
+    def __repr__(self):
+        return f"{self.op}({self.child!r})"
+
+
+class Not(_Unary):
+    op = "not"
+
+
+class IsNull(_Unary):
+    op = "is_null"
+
+
+class IsNotNull(_Unary):
+    op = "is_not_null"
+
+
+class In(Expression):
+    def __init__(self, child: Expression, values: Sequence[Expression]):
+        self.child = child
+        self.values = list(values)
+        for v in self.values:
+            if not isinstance(v, Literal):
+                raise HyperspaceException("IN list must contain literals only.")
+
+    @property
+    def children(self) -> List[Expression]:
+        return [self.child, *self.values]
+
+    def to_dict(self) -> dict:
+        return {"op": "in", "child": self.child.to_dict(),
+                "values": [v.to_dict() for v in self.values]}
+
+    @staticmethod
+    def _from_dict(d: dict) -> "In":
+        return In(Expression.from_dict(d["child"]),
+                  [Expression.from_dict(v) for v in d["values"]])
+
+    def __repr__(self):
+        return f"{self.child!r} IN {[v.value for v in self.values]}"
+
+
+_REGISTRY: Dict[str, Any] = {
+    "column": Column, "literal": Literal,
+    "eq": EqualTo, "ne": NotEqualTo, "lt": LessThan, "le": LessThanOrEqual,
+    "gt": GreaterThan, "ge": GreaterThanOrEqual,
+    "and": And, "or": Or, "not": Not,
+    "add": Add, "sub": Sub, "mul": Mul, "div": Div,
+    "is_null": IsNull, "is_not_null": IsNotNull, "in": In,
+}
+
+
+def col(name: str) -> Column:
+    return Column(name)
+
+
+def lit(value) -> Literal:
+    return Literal(value)
+
+
+def split_conjunctive(expr: Expression) -> List[Expression]:
+    """Flatten an AND tree into its conjuncts (used by the join rule's
+    equi-CNF check, reference `index/rules/JoinIndexRule.scala:179-185`)."""
+    if isinstance(expr, And):
+        return split_conjunctive(expr.left) + split_conjunctive(expr.right)
+    return [expr]

@@ -1,0 +1,240 @@
+"""Logical plan nodes for the relational IR.
+
+The reference matches/rewrites Catalyst trees
+(`Project(Filter(LogicalRelation))`, `Join(l, r, cond)`); this framework owns
+an equivalent minimal node set: Scan (= LogicalRelation over lake files),
+Filter, Project, Join. Nodes are immutable, JSON-serializable (see
+`plan/serde.py`), and carry enough metadata (root paths, bucket spec) for the
+rewrite rules to swap base-table scans for index scans exactly as the
+reference's rules do (`index/rules/FilterIndexRule.scala:109-131`,
+`index/rules/JoinIndexRule.scala:124-153`).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.expr import Expression
+from hyperspace_tpu.plan.schema import Schema
+
+
+@dataclass(frozen=True)
+class BucketSpec:
+    """Bucketing metadata: the key enabler of shuffle-free joins.
+
+    Parity: Spark `BucketSpec(numBuckets, bucketedBy, sortedBy)` as used at
+    reference `index/DataFrameWriterExtensions.scala:49-66` (write side) and
+    `index/rules/JoinIndexRule.scala:124-153` (read side).
+    """
+
+    num_buckets: int
+    bucket_columns: tuple
+    sort_columns: tuple
+
+    def to_dict(self) -> dict:
+        return {"numBuckets": self.num_buckets,
+                "bucketColumns": list(self.bucket_columns),
+                "sortColumns": list(self.sort_columns)}
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> Optional["BucketSpec"]:
+        if d is None:
+            return None
+        return BucketSpec(int(d["numBuckets"]), tuple(d["bucketColumns"]),
+                          tuple(d["sortColumns"]))
+
+
+class LogicalPlan:
+    """Base plan node."""
+
+    @property
+    def children(self) -> List["LogicalPlan"]:
+        return []
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def with_children(self, children: List["LogicalPlan"]) -> "LogicalPlan":
+        raise NotImplementedError
+
+    def transform_up(self, fn: Callable[["LogicalPlan"], "LogicalPlan"]) -> "LogicalPlan":
+        new_children = [c.transform_up(fn) for c in self.children]
+        node = self if new_children == self.children else self.with_children(new_children)
+        return fn(node)
+
+    def transform_down(self, fn: Callable[["LogicalPlan"], "LogicalPlan"]) -> "LogicalPlan":
+        node = fn(self)
+        new_children = [c.transform_down(fn) for c in node.children]
+        return node if new_children == node.children else node.with_children(new_children)
+
+    def collect_leaves(self) -> List["LogicalPlan"]:
+        if not self.children:
+            return [self]
+        out: List[LogicalPlan] = []
+        for c in self.children:
+            out.extend(c.collect_leaves())
+        return out
+
+    def is_linear(self) -> bool:
+        """True iff every node has at most one child — the join rule's guard
+        against signature collisions (reference `JoinIndexRule.scala:210-211`)."""
+        if len(self.children) > 1:
+            return False
+        return all(c.is_linear() for c in self.children)
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    def simple_string(self) -> str:
+        raise NotImplementedError
+
+    def tree_string(self, depth: int = 0) -> str:
+        lines = [("  " * depth) + ("+- " if depth else "") + self.simple_string()]
+        for c in self.children:
+            lines.append(c.tree_string(depth + 1))
+        return "\n".join(lines)
+
+    def __eq__(self, other) -> bool:
+        if type(self) is not type(other):
+            return NotImplemented
+        return self.to_dict() == other.to_dict()
+
+    def __hash__(self):
+        return hash(self.simple_string())
+
+
+class Scan(LogicalPlan):
+    """Leaf relation over lake files (= reference `LogicalRelation` over
+    `HadoopFsRelation`). Carries root paths, schema, format, and an optional
+    bucket spec; `files()` resolves the concrete file listing (= the
+    reference's `location.allFiles`, `actions/CreateActionBase.scala:89-97`).
+    """
+
+    def __init__(self, root_paths: Sequence[str], schema: Schema,
+                 file_format: str = "parquet",
+                 bucket_spec: Optional[BucketSpec] = None,
+                 files: Optional[Sequence[str]] = None):
+        self.root_paths = [os.path.abspath(p) for p in root_paths]
+        self._schema = schema
+        self.file_format = file_format
+        self.bucket_spec = bucket_spec
+        self._files = list(files) if files is not None else None
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def with_children(self, children):
+        if children:
+            raise HyperspaceException("Scan is a leaf node.")
+        return self
+
+    def files(self) -> List[str]:
+        """Enumerate data files under the root paths (cached per node)."""
+        if self._files is None:
+            found: List[str] = []
+            for root in self.root_paths:
+                if os.path.isfile(root):
+                    found.append(root)
+                else:
+                    pattern = os.path.join(root, "**", f"*.{self.file_format}")
+                    found.extend(glob.glob(pattern, recursive=True))
+            self._files = sorted(found)
+        return self._files
+
+    def to_dict(self) -> dict:
+        return {"node": "scan", "rootPaths": list(self.root_paths),
+                "format": self.file_format,
+                "schema": [f.to_dict() for f in self._schema.fields],
+                "bucketSpec": self.bucket_spec.to_dict() if self.bucket_spec else None}
+
+    def simple_string(self) -> str:
+        bucket = f", buckets={self.bucket_spec.num_buckets}" if self.bucket_spec else ""
+        return (f"Scan {self.file_format} [{', '.join(self._schema.names)}] "
+                f"roots={self.root_paths}{bucket}")
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.condition = condition
+        self.child = child
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def with_children(self, children):
+        (child,) = children
+        return Filter(self.condition, child)
+
+    def to_dict(self) -> dict:
+        return {"node": "filter", "condition": self.condition.to_dict(),
+                "child": self.child.to_dict()}
+
+    def simple_string(self) -> str:
+        return f"Filter ({self.condition!r})"
+
+
+class Project(LogicalPlan):
+    def __init__(self, columns: Sequence[str], child: LogicalPlan):
+        self.columns = list(columns)
+        self.child = child
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema.select(self.columns)
+
+    def with_children(self, children):
+        (child,) = children
+        return Project(self.columns, child)
+
+    def to_dict(self) -> dict:
+        return {"node": "project", "columns": list(self.columns),
+                "child": self.child.to_dict()}
+
+    def simple_string(self) -> str:
+        return f"Project [{', '.join(self.columns)}]"
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 condition: Expression, join_type: str = "inner"):
+        if join_type not in ("inner", "left_outer", "right_outer", "full_outer"):
+            raise HyperspaceException(f"Unsupported join type: {join_type}")
+        self.left = left
+        self.right = right
+        self.condition = condition
+        self.join_type = join_type
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.left, self.right]
+
+    @property
+    def schema(self) -> Schema:
+        return Schema(list(self.left.schema.fields) + list(self.right.schema.fields))
+
+    def with_children(self, children):
+        left, right = children
+        return Join(left, right, self.condition, self.join_type)
+
+    def to_dict(self) -> dict:
+        return {"node": "join", "type": self.join_type,
+                "condition": self.condition.to_dict(),
+                "left": self.left.to_dict(), "right": self.right.to_dict()}
+
+    def simple_string(self) -> str:
+        return f"Join {self.join_type} ({self.condition!r})"

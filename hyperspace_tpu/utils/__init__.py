@@ -1,0 +1,4 @@
+from hyperspace_tpu.utils.hashing import md5_hex
+from hyperspace_tpu.utils.name_utils import normalize_index_name
+
+__all__ = ["md5_hex", "normalize_index_name"]

@@ -1,0 +1,100 @@
+"""Filesystem utilities over the local/posix filesystem.
+
+Parity: reference `util/FileUtils.scala:37-116` (createFile, readContents,
+getDirectorySize, createDirectory, delete, save/loadByteArray) — the
+reference goes through the Hadoop FileSystem API; this build targets
+posix-visible paths (local disk, FUSE-mounted object stores). Atomicity
+helpers used by the op log's optimistic concurrency live here too.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import uuid
+
+
+def create_file(path: str, contents: str) -> None:
+    create_directory(os.path.dirname(path))
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(contents)
+
+
+def read_contents(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def get_directory_size(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            total += os.path.getsize(os.path.join(root, name))
+    return total
+
+
+def create_directory(path: str) -> None:
+    if path:
+        os.makedirs(path, exist_ok=True)
+
+
+def delete(path: str) -> None:
+    if os.path.isdir(path):
+        shutil.rmtree(path, ignore_errors=True)
+    elif os.path.exists(path):
+        os.remove(path)
+
+
+def save_byte_array(path: str, data: bytes) -> None:
+    create_directory(os.path.dirname(path))
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def load_byte_array(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def atomic_write_if_absent(path: str, contents: str) -> bool:
+    """Write `contents` to `path` only if `path` does not already exist.
+
+    This is the op log's optimistic-concurrency primitive: the reference
+    writes a `temp<UUID>` file and atomically renames it, treating rename
+    failure as "a concurrent writer won" (`index/IndexLogManager.scala:139-156`).
+    POSIX rename overwrites, so the atomic publish here is `os.link` (hard
+    link creation fails with EEXIST if the target exists) with an
+    O_CREAT|O_EXCL fallback for filesystems without hard links.
+    Returns True iff this caller won the write.
+    """
+    create_directory(os.path.dirname(path))
+    tmp = path + ".temp" + uuid.uuid4().hex
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(contents)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.link(tmp, path)
+        return True
+    except FileExistsError:
+        return False
+    except OSError:
+        # Filesystem without hard-link support: fall back to exclusive
+        # create. This publishes the filename before its contents are
+        # visible, so readers must tolerate a torn read (see
+        # IndexLogManagerImpl.get_log's retry); contents are fsynced before
+        # the winner returns.
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(contents)
+            f.flush()
+            os.fsync(f.fileno())
+        return True
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass

@@ -1,0 +1,16 @@
+"""Hashing utilities.
+
+Parity: reference `util/HashingUtils.scala:32` (`md5Hex`). A fast 64-bit
+mixing hash is also provided for device-side bucket assignment seeds.
+"""
+
+import hashlib
+
+
+def md5_hex(value: str) -> str:
+    return hashlib.md5(value.encode("utf-8")).hexdigest()
+
+
+def fingerprint64(value: bytes) -> int:
+    """Stable 64-bit fingerprint of a byte string (first 8 bytes of md5)."""
+    return int.from_bytes(hashlib.md5(value).digest()[:8], "little")

@@ -1,0 +1,54 @@
+"""Test bootstrap.
+
+Distribution is tested the way the reference tests it — a real local
+multi-way runtime in one process (`local[4]` SparkSession in
+`SparkInvolvedSuite.scala:29-35`): here, an 8-device virtual CPU mesh via
+XLA's host-platform device-count flag. Env vars must be set before jax is
+first imported.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+
+
+@pytest.fixture
+def conf(tmp_path):
+    """A HyperspaceConf rooted in a fresh tmp warehouse."""
+    return HyperspaceConf({
+        "spark.hyperspace.warehouse.dir": str(tmp_path / "warehouse"),
+    })
+
+
+@pytest.fixture
+def sample_parquet(tmp_path):
+    """Deterministic sample dataset written to parquet (parity with the
+    reference's `SampleData` fixture, `SampleData.scala:22-34`)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(42)
+    n = 1000
+    table = pa.table({
+        "id": np.arange(n, dtype=np.int64),
+        "clicks": rng.integers(0, 100, n).astype(np.int32),
+        "score": rng.random(n).astype(np.float64),
+        "imprs": rng.integers(0, 10, n).astype(np.int64),
+        "query": pa.array([f"q{int(v)}" for v in rng.integers(0, 50, n)]),
+    })
+    path = tmp_path / "sample_data"
+    path.mkdir(parents=True, exist_ok=True)
+    pq.write_table(table, str(path / "part-0.parquet"))
+    return str(path)

@@ -1,0 +1,101 @@
+"""In-memory fakes for FSM/manager unit tests.
+
+Parity with the reference's test strategy layer 2 (SURVEY.md §4): mockito
+mocks of IndexLogManager/IndexDataManager verifying state transitions; here,
+recording in-memory fakes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from hyperspace_tpu import constants
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.log_entry import (Content, CoveringIndex, Directory,
+                                            Hdfs, IndexLogEntry,
+                                            LogicalPlanFingerprint,
+                                            NoOpFingerprint, PlanSource,
+                                            Signature, Source)
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.plan.schema import Field, Schema
+
+
+def make_entry(name: str = "idx", state: str = "ACTIVE",
+               indexed=("clicks",), included=("score",),
+               num_buckets: int = 8, root: str = "/tmp/idx/v__=0",
+               raw_plan: str = "{}",
+               signature_provider: str = "test.Provider",
+               signature_value: str = "sig") -> IndexLogEntry:
+    schema = Schema([Field(c, "int64") for c in (*indexed, *included)])
+    entry = IndexLogEntry(
+        name=name,
+        derived_dataset=CoveringIndex(list(indexed), list(included),
+                                      schema.to_json(), num_buckets),
+        content=Content(root=root, directories=[]),
+        source=Source(
+            plan=PlanSource(raw_plan, LogicalPlanFingerprint(
+                [Signature(signature_provider, signature_value)])),
+            data=[Hdfs(Content("", [Directory("", ["f1", "f2"],
+                                              NoOpFingerprint())]))]),
+        extra={})
+    entry.state = state
+    return entry
+
+
+class FakeLogManager(IndexLogManager):
+    def __init__(self):
+        self.logs: Dict[int, IndexLogEntry] = {}
+        self.stable_id: Optional[int] = None
+        self.writes: List[Tuple[int, str]] = []  # (id, state) audit trail
+
+    def get_log(self, log_id):
+        return self.logs.get(log_id)
+
+    def get_latest_id(self):
+        return max(self.logs) if self.logs else None
+
+    def get_latest_log(self):
+        latest = self.get_latest_id()
+        return self.logs[latest] if latest is not None else None
+
+    def get_latest_stable_log(self):
+        if self.stable_id is not None:
+            return self.logs.get(self.stable_id)
+        for log_id in sorted(self.logs, reverse=True):
+            if self.logs[log_id].state in constants.STABLE_STATES:
+                return self.logs[log_id]
+        return None
+
+    def create_latest_stable_log(self, log_id):
+        if log_id in self.logs and self.logs[log_id].state in constants.STABLE_STATES:
+            self.stable_id = log_id
+            return True
+        return False
+
+    def delete_latest_stable_log(self):
+        self.stable_id = None
+        return True
+
+    def write_log(self, log_id, entry):
+        if log_id in self.logs:
+            return False
+        entry.id = log_id
+        self.logs[log_id] = entry
+        self.writes.append((log_id, entry.state))
+        return True
+
+
+class FakeDataManager(IndexDataManager):
+    def __init__(self, versions=()):
+        self.versions = set(versions)
+        self.deleted: List[int] = []
+
+    def get_latest_version_id(self):
+        return max(self.versions) if self.versions else None
+
+    def get_path(self, version_id):
+        return f"/fake/v__={version_id}"
+
+    def delete(self, version_id):
+        self.versions.discard(version_id)
+        self.deleted.append(version_id)

@@ -1,0 +1,168 @@
+"""Action FSM tests against in-memory fakes (reference test layer 2:
+`ActionTest`, `CreateActionTest`, Delete/Restore/Vacuum/Cancel tests)."""
+
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.actions.base import Action
+from hyperspace_tpu.actions.cancel import CancelAction
+from hyperspace_tpu.actions.delete import DeleteAction
+from hyperspace_tpu.actions.restore import RestoreAction
+from hyperspace_tpu.actions.vacuum import VacuumAction
+
+from fakes import FakeDataManager, FakeLogManager, make_entry
+
+
+class NoOpAction(Action):
+    """Minimal concrete action to test the template method."""
+
+    transient_state = States.CREATING
+    final_state = States.ACTIVE
+
+    def __init__(self, log_manager):
+        super().__init__(log_manager)
+        self.op_ran = False
+
+    def log_entry(self):
+        return make_entry(state="")
+
+    def op(self):
+        self.op_ran = True
+
+
+def test_action_writes_begin_then_end():
+    """Parity with reference `ActionTest.scala:51-59`: with an empty log,
+    begin writes id 0 (transient) and end writes id 1 (final) + latestStable."""
+    mgr = FakeLogManager()
+    action = NoOpAction(mgr)
+    action.run()
+    assert action.op_ran
+    assert mgr.writes == [(0, States.CREATING), (1, States.ACTIVE)]
+    assert mgr.stable_id == 1
+
+
+def test_action_ids_continue_from_base():
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.CREATING))
+    mgr.write_log(1, make_entry(state=States.ACTIVE))
+    mgr.writes.clear()
+    NoOpAction(mgr).run()
+    assert mgr.writes == [(2, States.CREATING), (3, States.ACTIVE)]
+
+
+def test_action_begin_conflict_raises():
+    """Losing the OCC race on begin raises — exactly one concurrent actor
+    can win log id base+1."""
+    mgr = FakeLogManager()
+    action = NoOpAction(mgr)
+    # Simulate a concurrent writer taking id 0 after base_id was computed.
+    _ = action.base_id
+    mgr.write_log(0, make_entry(state=States.REFRESHING))
+    with pytest.raises(HyperspaceException):
+        action.run()
+
+
+def test_delete_from_active():
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.CREATING))
+    mgr.write_log(1, make_entry(state=States.ACTIVE))
+    mgr.writes.clear()
+    DeleteAction(mgr).run()
+    assert mgr.writes == [(2, States.DELETING), (3, States.DELETED)]
+
+
+@pytest.mark.parametrize("state", [States.CREATING, States.DELETED,
+                                   States.DOESNOTEXIST])
+def test_delete_invalid_states(state):
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=state))
+    with pytest.raises(HyperspaceException):
+        DeleteAction(mgr).run()
+
+
+def test_restore_from_deleted():
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.DELETED))
+    mgr.writes.clear()
+    RestoreAction(mgr).run()
+    assert mgr.writes == [(1, States.RESTORING), (2, States.ACTIVE)]
+
+
+def test_restore_invalid_from_active():
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.ACTIVE))
+    with pytest.raises(HyperspaceException):
+        RestoreAction(mgr).run()
+
+
+def test_vacuum_deletes_all_versions_latest_first():
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.DELETED))
+    mgr.writes.clear()
+    data = FakeDataManager(versions=[0, 1, 2])
+    VacuumAction(mgr, data).run()
+    assert mgr.writes == [(1, States.VACUUMING), (2, States.DOESNOTEXIST)]
+    assert data.deleted == [2, 1, 0]
+
+
+def test_vacuum_requires_deleted():
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.ACTIVE))
+    with pytest.raises(HyperspaceException):
+        VacuumAction(mgr, FakeDataManager()).run()
+
+
+def test_cancel_restores_last_stable_state():
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.CREATING))
+    mgr.write_log(1, make_entry(state=States.ACTIVE))
+    mgr.write_log(2, make_entry(state=States.REFRESHING))
+    mgr.writes.clear()
+    CancelAction(mgr).run()
+    assert mgr.writes == [(3, States.CANCELLING), (4, States.ACTIVE)]
+
+
+def test_cancel_without_stable_goes_doesnotexist():
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.CREATING))
+    mgr.writes.clear()
+    CancelAction(mgr).run()
+    assert mgr.writes == [(1, States.CANCELLING), (2, States.DOESNOTEXIST)]
+
+
+def test_cancel_after_vacuuming_goes_doesnotexist():
+    """Reference `CancelAction.scala:43-52`: VACUUMING -> DOESNOTEXIST since
+    data may be partially deleted."""
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.VACUUMING))
+    mgr.stable_id = 0
+    # Force the stable log itself to be the VACUUMING record.
+    mgr.writes.clear()
+    CancelAction(mgr).run()
+    assert mgr.writes == [(1, States.CANCELLING), (2, States.DOESNOTEXIST)]
+
+
+@pytest.mark.parametrize("state", [States.ACTIVE, States.DELETED,
+                                   States.DOESNOTEXIST])
+def test_cancel_invalid_from_stable(state):
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=state))
+    with pytest.raises(HyperspaceException):
+        CancelAction(mgr).run()
+
+
+def test_cancel_restores_stable_entry_content():
+    """A cancelled refresh must republish the *stable* entry's metadata —
+    content.root must not point at the partially-written new version dir."""
+    mgr = FakeLogManager()
+    active = make_entry(state=States.ACTIVE, root="/idx/v__=0")
+    mgr.write_log(0, active)
+    mgr.stable_id = 0
+    refreshing = make_entry(state=States.REFRESHING, root="/idx/v__=1")
+    mgr.write_log(1, refreshing)
+    CancelAction(mgr).run()
+    final = mgr.get_latest_log()
+    assert final.state == States.ACTIVE
+    assert final.content.root == "/idx/v__=0"

@@ -1,0 +1,71 @@
+"""Versioned data dirs + path resolver tests."""
+
+import os
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.index.data_manager import IndexDataManagerImpl
+from hyperspace_tpu.index.path_resolver import PathResolver
+
+
+def test_version_scan(tmp_path):
+    root = str(tmp_path / "idx")
+    mgr = IndexDataManagerImpl(root)
+    assert mgr.get_latest_version_id() is None
+    os.makedirs(os.path.join(root, "v__=0"))
+    os.makedirs(os.path.join(root, "v__=3"))
+    os.makedirs(os.path.join(root, "_hyperspace_log"))
+    os.makedirs(os.path.join(root, "v__=bogus"))
+    assert mgr.get_latest_version_id() == 3
+    assert mgr.get_path(4) == os.path.join(root, "v__=4")
+
+
+def test_delete_version(tmp_path):
+    root = str(tmp_path / "idx")
+    mgr = IndexDataManagerImpl(root)
+    os.makedirs(os.path.join(root, "v__=0"))
+    mgr.delete(0)
+    assert not os.path.exists(os.path.join(root, "v__=0"))
+
+
+def test_path_resolver_defaults(tmp_path):
+    conf = HyperspaceConf({"hyperspace.warehouse.dir": str(tmp_path / "wh")})
+    resolver = PathResolver(conf)
+    assert resolver.system_path == str(tmp_path / "wh" / "indexes")
+    assert resolver.get_index_path("My Index") == str(
+        tmp_path / "wh" / "indexes" / "My_Index")
+
+
+def test_path_resolver_case_insensitive_match(tmp_path):
+    conf = HyperspaceConf(
+        {"spark.hyperspace.system.path": str(tmp_path / "sys")})
+    os.makedirs(str(tmp_path / "sys" / "MyIndex"))
+    resolver = PathResolver(conf)
+    assert resolver.get_index_path("myindex") == str(tmp_path / "sys" / "MyIndex")
+
+
+def test_conf_key_aliasing(tmp_path):
+    conf = HyperspaceConf()
+    conf.set("hyperspace.index.num.buckets", 16)
+    assert conf.num_buckets == 16
+    assert conf.get("spark.hyperspace.index.num.buckets") == "16"
+    assert HyperspaceConf().num_buckets == 200
+
+
+def test_catalog_skips_corrupt_index(tmp_path):
+    """One unreadable index must not take down the whole catalog listing."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fakes import make_entry
+    from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+    from hyperspace_tpu.index.manager import IndexCollectionManager
+
+    conf = HyperspaceConf(
+        {"spark.hyperspace.system.path": str(tmp_path / "sys")})
+    good = IndexLogManagerImpl(str(tmp_path / "sys" / "good"))
+    good.write_log(0, make_entry(name="good", state="ACTIVE"))
+    bad_dir = tmp_path / "sys" / "bad" / "_hyperspace_log"
+    bad_dir.mkdir(parents=True)
+    (bad_dir / "0").write_text("{torn")
+    mgr = IndexCollectionManager(conf)
+    names = [s.name for s in mgr.indexes()]
+    assert names == ["good"]

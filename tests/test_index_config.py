@@ -1,0 +1,57 @@
+"""IndexConfig validation + builder (reference `IndexConfigTests`)."""
+
+import pytest
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.index_config import IndexConfig
+
+
+def test_basic_construction():
+    cfg = IndexConfig("idx", ["a", "b"], ["c"])
+    assert cfg.index_name == "idx"
+    assert cfg.indexed_columns == ["a", "b"]
+    assert cfg.included_columns == ["c"]
+
+
+@pytest.mark.parametrize("name,indexed,included", [
+    ("", ["a"], []),
+    ("  ", ["a"], []),
+    ("idx", [], []),
+    ("idx", ["a", "A"], []),          # duplicate indexed (case-insensitive)
+    ("idx", ["a"], ["b", "B"]),       # duplicate included
+    ("idx", ["a"], ["A"]),            # overlap indexed/included
+])
+def test_invalid_configs(name, indexed, included):
+    with pytest.raises(HyperspaceException):
+        IndexConfig(name, indexed, included)
+
+
+def test_case_insensitive_equality():
+    assert IndexConfig("IDX", ["A"], ["b"]) == IndexConfig("idx", ["a"], ["B"])
+    assert IndexConfig("idx", ["a"], ["b", "c"]) == IndexConfig("idx", ["a"], ["c", "b"])
+    assert IndexConfig("idx", ["a", "b"], []) != IndexConfig("idx", ["b", "a"], [])
+
+
+def test_builder():
+    cfg = (IndexConfig.builder()
+           .index_name("idx")
+           .index_by("a", "b")
+           .include("c")
+           .create())
+    assert cfg == IndexConfig("idx", ["a", "b"], ["c"])
+
+
+def test_builder_rejects_double_set():
+    b = IndexConfig.builder().index_name("idx")
+    with pytest.raises(HyperspaceException):
+        b.index_name("other")
+    b.index_by("a")
+    with pytest.raises(HyperspaceException):
+        b.index_by("b")
+
+
+def test_builder_requires_name_and_columns():
+    with pytest.raises(HyperspaceException):
+        IndexConfig.builder().index_by("a").create()
+    with pytest.raises(HyperspaceException):
+        IndexConfig.builder().index_name("x").create()

@@ -1,0 +1,110 @@
+"""Pin the exact serialized log-entry shape.
+
+Parity: the reference pins its JSON spec in `IndexLogEntryTest.scala:33-91`;
+this test plays the same role for this framework's wire format — changing the
+shape must fail here.
+"""
+
+import json
+
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.index.log_entry import (Content, CoveringIndex, Directory,
+                                            Hdfs, IndexLogEntry, LogEntry,
+                                            LogicalPlanFingerprint,
+                                            NoOpFingerprint, PlanSource,
+                                            Signature, Source)
+
+SPEC = {
+    "name": "indexName",
+    "derivedDataset": {
+        "kind": "CoveringIndex",
+        "properties": {
+            "columns": {"indexed": ["col1"], "included": ["col2", "col3"]},
+            "schemaString": "{\"type\": \"struct\", \"fields\": []}",
+            "numBuckets": 200,
+        },
+    },
+    "content": {"root": "rootContentPath", "directories": []},
+    "source": {
+        "plan": {
+            "kind": "Plan",
+            "properties": {
+                "rawPlan": "planString",
+                "fingerprint": {
+                    "kind": "LogicalPlan",
+                    "properties": {
+                        "signatures": [
+                            {"provider": "provider", "value": "signatureValue"}
+                        ]
+                    },
+                },
+            },
+        },
+        "data": [{
+            "kind": "HDFS",
+            "properties": {
+                "content": {
+                    "root": "",
+                    "directories": [{
+                        "path": "",
+                        "files": ["f1", "f2"],
+                        "fingerprint": {"kind": "NoOp", "properties": {}},
+                    }],
+                }
+            },
+        }],
+    },
+    "extra": {},
+    "version": "0.1",
+    "id": 0,
+    "state": "ACTIVE",
+    "timestamp": 1578818514080,
+    "enabled": True,
+}
+
+
+def build_expected() -> IndexLogEntry:
+    entry = IndexLogEntry(
+        name="indexName",
+        derived_dataset=CoveringIndex(
+            ["col1"], ["col2", "col3"],
+            "{\"type\": \"struct\", \"fields\": []}", 200),
+        content=Content("rootContentPath", []),
+        source=Source(
+            plan=PlanSource("planString", LogicalPlanFingerprint(
+                [Signature("provider", "signatureValue")])),
+            data=[Hdfs(Content("", [Directory("", ["f1", "f2"],
+                                              NoOpFingerprint())]))]),
+        extra={})
+    entry.state = States.ACTIVE
+    entry.timestamp = 1578818514080
+    return entry
+
+
+def test_from_json_matches_expected():
+    actual = LogEntry.from_json(json.dumps(SPEC))
+    assert isinstance(actual, IndexLogEntry)
+    assert actual == build_expected()
+
+
+def test_to_json_roundtrip_is_exact():
+    entry = build_expected()
+    assert json.loads(entry.to_json()) == SPEC
+
+
+def test_helpers():
+    entry = build_expected()
+    assert entry.indexed_columns == ["col1"]
+    assert entry.included_columns == ["col2", "col3"]
+    assert entry.num_buckets == 200
+    assert entry.created
+    assert entry.signature() == Signature("provider", "signatureValue")
+    assert entry.source_file_list() == ["f1", "f2"]
+
+
+def test_copy_with_state():
+    entry = build_expected()
+    clone = entry.copy_with_state(States.DELETED)
+    assert clone.state == States.DELETED
+    assert entry.state == States.ACTIVE
+    assert clone.name == entry.name

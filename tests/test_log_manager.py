@@ -1,0 +1,97 @@
+"""Filesystem op-log manager tests (reference `IndexLogManagerImplTest`)."""
+
+import os
+import threading
+
+from hyperspace_tpu import constants
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+
+from fakes import make_entry
+
+
+def test_write_and_get_log(tmp_path):
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    entry = make_entry(state="CREATING")
+    assert mgr.write_log(0, entry)
+    read = mgr.get_log(0)
+    assert read is not None
+    assert read.state == "CREATING"
+    assert read.id == 0
+    assert mgr.get_log(5) is None
+
+
+def test_write_log_refuses_existing_id(tmp_path):
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    assert mgr.write_log(0, make_entry(state="CREATING"))
+    assert not mgr.write_log(0, make_entry(state="ACTIVE"))
+    assert mgr.get_log(0).state == "CREATING"
+
+
+def test_occ_single_winner_concurrent(tmp_path):
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    outcomes = []
+
+    def attempt(i):
+        outcomes.append(mgr.write_log(7, make_entry(state=f"S{i}")))
+
+    threads = [threading.Thread(target=attempt, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(outcomes) == 1
+
+
+def test_latest_id_and_log(tmp_path):
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    assert mgr.get_latest_id() is None
+    assert mgr.get_latest_log() is None
+    for i, state in enumerate(["CREATING", "ACTIVE", "REFRESHING"]):
+        mgr.write_log(i, make_entry(state=state))
+    assert mgr.get_latest_id() == 2
+    assert mgr.get_latest_log().state == "REFRESHING"
+
+
+def test_latest_stable_log_scan_fallback(tmp_path):
+    """Without a latestStable file, scan ids downward for a stable state
+    (reference `IndexLogManager.scala:91-110`)."""
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    mgr.write_log(0, make_entry(state="CREATING"))
+    mgr.write_log(1, make_entry(state="ACTIVE"))
+    mgr.write_log(2, make_entry(state="REFRESHING"))
+    stable = mgr.get_latest_stable_log()
+    assert stable is not None
+    assert stable.state == "ACTIVE"
+    assert stable.id == 1
+
+
+def test_create_and_delete_latest_stable(tmp_path):
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    mgr.write_log(0, make_entry(state="ACTIVE"))
+    assert mgr.create_latest_stable_log(0)
+    stable_path = os.path.join(str(tmp_path / "idx"), constants.HYPERSPACE_LOG,
+                               constants.LATEST_STABLE_LOG)
+    assert os.path.exists(stable_path)
+    assert mgr.get_latest_stable_log().state == "ACTIVE"
+    assert mgr.delete_latest_stable_log()
+    assert not os.path.exists(stable_path)
+    # Deleting again still succeeds (idempotent).
+    assert mgr.delete_latest_stable_log()
+
+
+def test_create_latest_stable_rejects_transient(tmp_path):
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    mgr.write_log(0, make_entry(state="CREATING"))
+    assert not mgr.create_latest_stable_log(0)
+    assert not mgr.create_latest_stable_log(99)
+
+
+def test_get_log_raises_on_corrupt_entry(tmp_path):
+    import pytest
+    from hyperspace_tpu.exceptions import HyperspaceException
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    os.makedirs(mgr.log_dir)
+    with open(os.path.join(mgr.log_dir, "0"), "w") as f:
+        f.write("{not json")
+    with pytest.raises(HyperspaceException):
+        mgr.get_log(0)

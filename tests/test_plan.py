@@ -1,0 +1,99 @@
+"""Relational IR tests: expression serde, plan serde, traversal helpers."""
+
+import json
+
+import pytest
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.expr import (And, Column, EqualTo, Expression, In,
+                                      Literal, col, lit, split_conjunctive)
+from hyperspace_tpu.plan.nodes import (BucketSpec, Filter, Join, Project, Scan)
+from hyperspace_tpu.plan.schema import Field, Schema
+from hyperspace_tpu.plan.serde import plan_from_json, plan_to_json
+
+
+def sample_schema():
+    return Schema([Field("id", "int64"), Field("clicks", "int32"),
+                   Field("score", "float64"), Field("query", "string")])
+
+
+def test_schema_json_roundtrip():
+    s = sample_schema()
+    assert Schema.from_json(s.to_json()) == s
+
+
+def test_schema_case_insensitive_lookup():
+    s = sample_schema()
+    assert s.field("CLICKS").name == "clicks"
+    assert s.contains("Id")
+    with pytest.raises(HyperspaceException):
+        s.field("missing")
+
+
+def test_expression_sugar_and_references():
+    e = (col("a") > 5) & (col("b") == "x")
+    assert isinstance(e, And)
+    assert e.references() == {"a", "b"}
+
+
+def test_expression_serde_roundtrip():
+    exprs = [
+        (col("a") > 5) & ~(col("b") == lit("x")),
+        col("c").isin(1, 2, 3),
+        col("d").is_null() | col("e").is_not_null(),
+        (col("f") + 1) * (col("g") - 2) / lit(4),
+    ]
+    for e in exprs:
+        round_tripped = Expression.from_dict(json.loads(json.dumps(e.to_dict())))
+        assert round_tripped.to_dict() == e.to_dict()
+
+
+def test_split_conjunctive():
+    e = (col("a") == 1) & (col("b") == 2) & (col("c") == 3)
+    parts = split_conjunctive(e)
+    assert len(parts) == 3
+    assert all(isinstance(p, EqualTo) for p in parts)
+
+
+def test_plan_serde_roundtrip(tmp_path):
+    scan = Scan([str(tmp_path)], sample_schema(),
+                bucket_spec=BucketSpec(8, ("clicks",), ("clicks",)))
+    plan = Project(["id", "clicks"], Filter(col("clicks") > 10, scan))
+    restored = plan_from_json(plan_to_json(plan))
+    assert restored.to_dict() == plan.to_dict()
+    assert isinstance(restored, Project)
+    assert restored.schema.names == ["id", "clicks"]
+
+
+def test_join_plan_serde(tmp_path):
+    left = Scan([str(tmp_path / "l")], sample_schema())
+    right = Scan([str(tmp_path / "r")],
+                 Schema([Field("clicks", "int32"), Field("other", "int64")]))
+    plan = Join(left, right, col("clicks") == col("clicks"))
+    restored = plan_from_json(plan_to_json(plan))
+    assert restored.to_dict() == plan.to_dict()
+
+
+def test_linearity(tmp_path):
+    scan = Scan([str(tmp_path)], sample_schema())
+    assert Filter(col("clicks") > 1, scan).is_linear()
+    join = Join(scan, Scan([str(tmp_path)], sample_schema()),
+                col("id") == col("id"))
+    assert not join.is_linear()
+
+
+def test_transform_up_replaces_scan(tmp_path):
+    scan = Scan([str(tmp_path / "base")], sample_schema())
+    new_scan = Scan([str(tmp_path / "index")], sample_schema())
+    plan = Project(["id"], Filter(col("clicks") > 1, scan))
+
+    def swap(node):
+        if isinstance(node, Scan):
+            return new_scan
+        return node
+
+    out = plan.transform_up(swap)
+    leaf = out.collect_leaves()[0]
+    assert leaf.root_paths == new_scan.root_paths
+    # Original untouched (immutability).
+    assert plan.collect_leaves()[0].root_paths == scan.root_paths

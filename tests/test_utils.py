@@ -1,0 +1,59 @@
+"""Pure unit tests for utilities (reference test layer 1: HashingUtilsTests,
+IndexNameUtilsTests, JsonUtilsTests)."""
+
+import os
+import threading
+
+from hyperspace_tpu.utils import file_utils
+from hyperspace_tpu.utils.hashing import md5_hex
+from hyperspace_tpu.utils.name_utils import normalize_index_name
+
+
+def test_md5_hex_stable():
+    assert md5_hex("hyperspace") == md5_hex("hyperspace")
+    assert md5_hex("a") != md5_hex("b")
+    assert len(md5_hex("x")) == 32
+
+
+def test_normalize_index_name():
+    assert normalize_index_name("  my index ") == "my_index"
+    assert normalize_index_name("plain") == "plain"
+
+
+def test_file_roundtrip(tmp_path):
+    path = str(tmp_path / "a" / "b.txt")
+    file_utils.create_file(path, "hello")
+    assert file_utils.read_contents(path) == "hello"
+    file_utils.delete(path)
+    assert not os.path.exists(path)
+
+
+def test_directory_size(tmp_path):
+    file_utils.create_file(str(tmp_path / "d" / "x"), "12345")
+    file_utils.create_file(str(tmp_path / "d" / "y"), "123")
+    assert file_utils.get_directory_size(str(tmp_path / "d")) == 8
+
+
+def test_atomic_write_if_absent_single_winner(tmp_path):
+    target = str(tmp_path / "log" / "0")
+    results = []
+
+    def attempt(tag):
+        results.append((tag, file_utils.atomic_write_if_absent(target, tag)))
+
+    threads = [threading.Thread(target=attempt, args=(f"w{i}",)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    winners = [tag for tag, won in results if won]
+    assert len(winners) == 1
+    # The file holds exactly the winner's contents.
+    assert file_utils.read_contents(target) == winners[0]
+
+
+def test_atomic_write_if_absent_existing(tmp_path):
+    target = str(tmp_path / "f")
+    assert file_utils.atomic_write_if_absent(target, "first")
+    assert not file_utils.atomic_write_if_absent(target, "second")
+    assert file_utils.read_contents(target) == "first"

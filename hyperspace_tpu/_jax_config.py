@@ -1,0 +1,12 @@
+"""Central JAX configuration, imported by every module that touches jax.
+
+x64 is mandatory for data correctness: lake data routinely carries int64
+keys and float64 measures, and jax's default 32-bit mode would silently
+truncate them. The perf-critical kernels (hashing, sort keys) operate on
+32-bit lanes internally (`ops/hash_partition.py`), so the TPU fast path is
+not sacrificed.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,10 @@
+"""Execution engine: XLA-compiled columnar query execution.
+
+x64 is enabled at engine import: lake data routinely carries int64 keys and
+float64 measures, and silent 32-bit truncation would corrupt results. The
+perf-critical kernels (hashing, sort keys) deliberately operate on 32-bit
+lanes internally (see `ops/hash_partition.py`), so the TPU fast path is not
+sacrificed.
+"""
+
+import hyperspace_tpu._jax_config  # noqa: F401

@@ -1,0 +1,187 @@
+"""Expression -> XLA compiler.
+
+Compiles IR expression trees (`plan/expr.py`) into jax computations over a
+ColumnBatch. This replaces the reference's reliance on Spark's
+WholeStageCodegen for predicate evaluation: XLA fuses the whole predicate
+into one vectorized kernel over HBM-resident columns.
+
+Null semantics follow SQL as the reference inherits them from Spark:
+comparisons involving null are not-true (rows filtered out), IS [NOT] NULL
+consults validity.
+
+String comparisons against literals are translated to *code-space*
+comparisons: because dictionaries are sorted (`io/columnar.py`), value
+predicates become integer range tests on codes — `x > "m"` is
+`code >= searchsorted(dict, "m", right)` — so string filters run at integer
+scan speed on device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import ColumnBatch, DeviceColumn
+from hyperspace_tpu.plan import expr as E
+
+
+def _col_and_validity(batch: ColumnBatch, name: str):
+    col = batch.column(name)
+    return col, col.validity
+
+
+def _string_literal_compare(op: str, col: DeviceColumn, value: str):
+    import jax.numpy as jnp
+
+    d = col.dictionary
+    left = int(np.searchsorted(d, value, side="left"))
+    right = int(np.searchsorted(d, value, side="right"))
+    present = left < right
+    code = col.data
+    if op == "eq":
+        return (code == left) if present else jnp.zeros(code.shape, bool)
+    if op == "ne":
+        return (code != left) if present else jnp.ones(code.shape, bool)
+    if op == "lt":
+        return code < left
+    if op == "le":
+        return code < right
+    if op == "gt":
+        return code >= right
+    if op == "ge":
+        return code >= left
+    raise HyperspaceException(f"Unsupported string comparison: {op}")
+
+
+_CMP = {"eq": "__eq__", "ne": "__ne__", "lt": "__lt__", "le": "__le__",
+        "gt": "__gt__", "ge": "__ge__"}
+
+
+class ExpressionCompiler:
+    def __init__(self, batch: ColumnBatch):
+        self.batch = batch
+
+    # -- value expressions ------------------------------------------------
+
+    def value(self, e: E.Expression) -> Tuple[object, Optional[object]]:
+        """Compile to (array, validity|None). Strings yield their codes and
+        may only feed comparisons handled in `predicate`."""
+        import jax.numpy as jnp
+
+        if isinstance(e, E.Column):
+            col, validity = _col_and_validity(self.batch, e.name)
+            return col.data, validity
+        if isinstance(e, E.Literal):
+            return e.value, None
+        if isinstance(e, (E.Add, E.Sub, E.Mul, E.Div)):
+            lv, lval = self.value(e.left)
+            rv, rval = self.value(e.right)
+            ops = {"add": jnp.add, "sub": jnp.subtract,
+                   "mul": jnp.multiply, "div": jnp.divide}
+            out = ops[type(e).op](lv, rv)
+            return out, self._merge_validity(lval, rval)
+        raise HyperspaceException(f"Unsupported value expression: {e!r}")
+
+    @staticmethod
+    def _merge_validity(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a & b
+
+    def _column_of(self, e: E.Expression) -> Optional[DeviceColumn]:
+        if isinstance(e, E.Column):
+            return self.batch.column(e.name)
+        return None
+
+    # -- predicates -------------------------------------------------------
+
+    def predicate(self, e: E.Expression):
+        """Compile to a bool mask (True = row passes)."""
+        import jax.numpy as jnp
+
+        n = self.batch.num_rows
+        if isinstance(e, E.And):
+            return self.predicate(e.left) & self.predicate(e.right)
+        if isinstance(e, E.Or):
+            return self.predicate(e.left) | self.predicate(e.right)
+        if isinstance(e, E.Not):
+            return ~self.predicate(e.child)
+        if isinstance(e, E.IsNull):
+            col = self._column_of(e.child)
+            if col is None:
+                raise HyperspaceException("IS NULL requires a column.")
+            if col.validity is None:
+                return jnp.zeros(n, bool)
+            return ~col.validity
+        if isinstance(e, E.IsNotNull):
+            col = self._column_of(e.child)
+            if col is None:
+                raise HyperspaceException("IS NOT NULL requires a column.")
+            if col.validity is None:
+                return jnp.ones(n, bool)
+            return col.validity
+        if isinstance(e, E.In):
+            folded = None
+            for v in e.values:
+                term = self.predicate(E.EqualTo(e.child, v))
+                folded = term if folded is None else (folded | term)
+            return folded if folded is not None else jnp.zeros(n, bool)
+        if isinstance(e, (E.EqualTo, E.NotEqualTo, E.LessThan,
+                          E.LessThanOrEqual, E.GreaterThan,
+                          E.GreaterThanOrEqual)):
+            return self._comparison(e)
+        if isinstance(e, E.Literal):
+            if isinstance(e.value, bool):
+                return jnp.full(n, e.value, dtype=bool)
+            raise HyperspaceException(f"Non-boolean literal predicate: {e!r}")
+        raise HyperspaceException(f"Unsupported predicate: {e!r}")
+
+    def _comparison(self, e):
+        import jax.numpy as jnp
+
+        op = type(e).op
+        lcol = self._column_of(e.left)
+        rcol = self._column_of(e.right)
+        # string column vs string literal -> code-space range test
+        if lcol is not None and lcol.is_string and isinstance(e.right, E.Literal):
+            mask = _string_literal_compare(op, lcol, str(e.right.value))
+            return self._mask_nulls(mask, lcol.validity, None)
+        if rcol is not None and rcol.is_string and isinstance(e.left, E.Literal):
+            flipped = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                       "eq": "eq", "ne": "ne"}[op]
+            mask = _string_literal_compare(flipped, rcol, str(e.left.value))
+            return self._mask_nulls(mask, rcol.validity, None)
+        if (lcol is not None and lcol.is_string) or (rcol is not None and rcol.is_string):
+            raise HyperspaceException(
+                "String column-to-column comparison is not supported in "
+                "filters; use a join.")
+        lv, lval = self.value(e.left)
+        rv, rval = self.value(e.right)
+        mask = getattr(jnp.asarray(lv), _CMP[op])(rv)
+        return self._mask_nulls(mask, lval, rval)
+
+    @staticmethod
+    def _mask_nulls(mask, lval, rval):
+        validity = ExpressionCompiler._merge_validity(lval, rval)
+        if validity is None:
+            return mask
+        return mask & validity
+
+
+def compile_predicate(expression: E.Expression, batch: ColumnBatch):
+    return ExpressionCompiler(batch).predicate(expression)
+
+
+def apply_filter(batch: ColumnBatch, expression: E.Expression) -> ColumnBatch:
+    """Filter a batch: fused mask eval + one compaction gather. The row
+    count is the single host sync (it sizes the result)."""
+    import jax.numpy as jnp
+
+    mask = compile_predicate(expression, batch)
+    count = int(jnp.sum(mask))  # host sync — sizes the output
+    (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
+    return batch.take(indices)

@@ -1,0 +1,88 @@
+"""User-facing DataFrame: a logical plan + session.
+
+The equivalent of the Spark DataFrame surface the reference operates on.
+Transformations are lazy plan builders; `collect`/`to_pandas`/`count` run
+the optimizer (rewrite rules, when enabled) and execute on device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan import expr as E
+from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project
+from hyperspace_tpu.plan.schema import Schema
+
+
+class DataFrame:
+    def __init__(self, plan: LogicalPlan, session=None):
+        self.plan = plan
+        self.session = session
+
+    @property
+    def schema(self) -> Schema:
+        return self.plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.names
+
+    # -- transformations (lazy) ------------------------------------------
+
+    def filter(self, condition: E.Expression) -> "DataFrame":
+        if not isinstance(condition, E.Expression):
+            raise HyperspaceException("filter() takes an Expression predicate.")
+        return DataFrame(Filter(condition, self.plan), self.session)
+
+    where = filter
+
+    def select(self, *columns: str) -> "DataFrame":
+        names = [c for col in columns
+                 for c in (col if isinstance(col, (list, tuple)) else [col])]
+        return DataFrame(Project(names, self.plan), self.session)
+
+    def join(self, other: "DataFrame",
+             on: Union[E.Expression, str, Sequence[str]],
+             how: str = "inner") -> "DataFrame":
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)):
+            condition: Optional[E.Expression] = None
+            for name in on:
+                term = E.EqualTo(E.Column(name), E.Column(name))
+                condition = term if condition is None else E.And(condition, term)
+            if condition is None:
+                raise HyperspaceException("join requires at least one key.")
+        else:
+            condition = on
+        return DataFrame(Join(self.plan, other.plan, condition, how),
+                         self.session)
+
+    # -- actions (execute) ------------------------------------------------
+
+    def _optimized_plan(self) -> LogicalPlan:
+        if self.session is not None:
+            return self.session.optimize(self.plan)
+        return self.plan
+
+    def collect(self):
+        """Execute and return an Arrow table."""
+        from hyperspace_tpu.engine.executor import execute_plan
+        from hyperspace_tpu.io.columnar import to_arrow
+        return to_arrow(execute_plan(self._optimized_plan()))
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    def explain_plans(self):
+        """(logical, optimized, physical) — used by plananalysis."""
+        from hyperspace_tpu.engine.executor import compile_plan
+        optimized = self._optimized_plan()
+        return self.plan, optimized, compile_plan(optimized)
+
+    def __repr__(self):
+        return f"DataFrame[{', '.join(self.schema.names)}]"

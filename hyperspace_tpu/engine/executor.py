@@ -1,0 +1,25 @@
+"""Plan execution entry points."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import hyperspace_tpu.engine  # noqa: F401  (x64 config)
+from hyperspace_tpu.engine.physical import PhysicalNode, plan_physical
+from hyperspace_tpu.io.columnar import ColumnBatch
+from hyperspace_tpu.plan.nodes import LogicalPlan
+
+
+def compile_plan(plan: LogicalPlan,
+                 projection: Optional[Sequence[str]] = None) -> PhysicalNode:
+    required = set(projection) if projection is not None else None
+    physical = plan_physical(plan, required)
+    if projection is not None:
+        from hyperspace_tpu.engine.physical import ProjectExec
+        physical = ProjectExec(list(projection), physical)
+    return physical
+
+
+def execute_plan(plan: LogicalPlan,
+                 projection: Optional[Sequence[str]] = None) -> ColumnBatch:
+    return compile_plan(plan, projection).execute()

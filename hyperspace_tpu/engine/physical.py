@@ -1,0 +1,345 @@
+"""Physical plan: executable operator tree.
+
+The reference's observable win is Spark's physical planner *not* inserting
+ShuffleExchange/Sort under a SortMergeJoin when both sides are bucketed
+(`index/rules/JoinIndexRule.scala:41-43`; verified via operator-occurrence
+diff, `plananalysis/PhysicalOperatorAnalyzer.scala:44-57`). This framework
+owns that planning step: Join compiles to SortMergeJoinExec, with
+ExchangeExec (hash repartition) + SortExec inserted only when a side is not
+already bucketed+sorted on the join keys — so explain() can show the same
+Exchange/Sort elision, and execution actually skips the work.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set, Tuple
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io import columnar, parquet
+from hyperspace_tpu.plan import expr as E
+from hyperspace_tpu.plan.nodes import (BucketSpec, Filter, Join, LogicalPlan,
+                                       Project, Scan)
+from hyperspace_tpu.plan.schema import Schema
+
+
+class PhysicalNode:
+    name: str = "Physical"
+
+    @property
+    def children(self) -> List["PhysicalNode"]:
+        return []
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        raise NotImplementedError
+
+    def simple_string(self) -> str:
+        return self.name
+
+    def tree_string(self, depth: int = 0) -> str:
+        lines = [("  " * depth) + ("+- " if depth else "") + self.simple_string()]
+        for c in self.children:
+            lines.append(c.tree_string(depth + 1))
+        return "\n".join(lines)
+
+    def collect(self) -> List["PhysicalNode"]:
+        out = [self]
+        for c in self.children:
+            out.extend(c.collect())
+        return out
+
+
+def _empty_batch(schema: Schema) -> columnar.ColumnBatch:
+    import pyarrow as pa
+    return columnar.from_arrow(
+        pa.table({f.name: pa.array([], type=t.type)
+                  for f, t in zip(schema.fields, schema.to_arrow())}), schema)
+
+
+class ScanExec(PhysicalNode):
+    name = "Scan"
+
+    def __init__(self, scan: Scan, columns: Sequence[str]):
+        self.scan = scan
+        self.columns = list(columns)
+        self.out_schema = scan.schema.select(columns)
+
+    def simple_string(self) -> str:
+        bucket = (f", buckets={self.scan.bucket_spec.num_buckets}"
+                  if self.scan.bucket_spec else "")
+        return (f"Scan parquet [{', '.join(self.columns)}] "
+                f"{self.scan.root_paths}{bucket}")
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        if bucket is not None:
+            if self.scan.bucket_spec is None:
+                raise HyperspaceException("Bucket read on unbucketed scan.")
+            files: List[str] = []
+            for root in self.scan.root_paths:
+                files.extend(parquet.bucket_files(root).get(bucket, []))
+        else:
+            files = self.scan.files()
+        if not files:
+            return _empty_batch(self.out_schema)
+        table = parquet.read_table(files, columns=self.columns)
+        batch = columnar.from_arrow(table, self.out_schema)
+        if bucket is not None and len(files) > 1:
+            # Multiple sorted runs in one bucket (incremental deltas): the
+            # concat is not globally sorted — restore order on device.
+            from hyperspace_tpu.ops.sort import sort_batch
+            sort_cols = [c for c in self.scan.bucket_spec.sort_columns
+                         if self.out_schema.contains(c)]
+            if sort_cols:
+                batch = sort_batch(batch, sort_cols)
+        return batch
+
+
+class FilterExec(PhysicalNode):
+    name = "Filter"
+
+    def __init__(self, condition: E.Expression, child: PhysicalNode):
+        self.condition = condition
+        self.child = child
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def simple_string(self) -> str:
+        return f"Filter ({self.condition!r})"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        from hyperspace_tpu.engine.compiler import apply_filter
+        batch = self.child.execute(bucket)
+        if batch.num_rows == 0:
+            return batch
+        return apply_filter(batch, self.condition)
+
+
+class ProjectExec(PhysicalNode):
+    name = "Project"
+
+    def __init__(self, columns: Sequence[str], child: PhysicalNode):
+        self.columns = list(columns)
+        self.child = child
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def simple_string(self) -> str:
+        return f"Project [{', '.join(self.columns)}]"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        return self.child.execute(bucket).select(self.columns)
+
+
+class ExchangeExec(PhysicalNode):
+    """Hash-repartition marker. On one chip it is a pass-through; on a mesh
+    it lowers to the all-to-all in `parallel/build.py`. Its presence/absence
+    in the plan is the explain() observable, exactly like ShuffleExchange in
+    the reference's plan diffs."""
+
+    name = "Exchange"
+
+    def __init__(self, keys: Sequence[str], num_partitions: int,
+                 child: PhysicalNode):
+        self.keys = list(keys)
+        self.num_partitions = num_partitions
+        self.child = child
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def simple_string(self) -> str:
+        return f"Exchange hashpartitioning({', '.join(self.keys)}, {self.num_partitions})"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        return self.child.execute(bucket)
+
+
+class SortExec(PhysicalNode):
+    name = "Sort"
+
+    def __init__(self, keys: Sequence[str], child: PhysicalNode):
+        self.keys = list(keys)
+        self.child = child
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def simple_string(self) -> str:
+        return f"Sort [{', '.join(self.keys)}]"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        from hyperspace_tpu.ops.sort import sort_batch
+        batch = self.child.execute(bucket)
+        if batch.num_rows == 0:
+            return batch
+        return sort_batch(batch, self.keys)
+
+
+class SortMergeJoinExec(PhysicalNode):
+    name = "SortMergeJoin"
+
+    def __init__(self, left: PhysicalNode, right: PhysicalNode,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 bucketed: bool, num_buckets: int = 0,
+                 out_schema: Optional[Schema] = None):
+        self.left = left
+        self.right = right
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.bucketed = bucketed
+        self.num_buckets = num_buckets
+        self.out_schema = out_schema
+
+    @property
+    def children(self):
+        return [self.left, self.right]
+
+    def simple_string(self) -> str:
+        keys = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
+        mode = f"bucketed({self.num_buckets})" if self.bucketed else "global"
+        return f"SortMergeJoin [{keys}] {mode}"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        from hyperspace_tpu.ops.join import sort_merge_join
+        if self.bucketed:
+            # Co-partitioned per-bucket merge joins: zero shuffle, zero
+            # global sort. Buckets are independent -> mesh-parallel in
+            # `parallel/join.py`.
+            results = []
+            for b in range(self.num_buckets):
+                lbatch = self.left.execute(bucket=b)
+                rbatch = self.right.execute(bucket=b)
+                if lbatch.num_rows == 0 or rbatch.num_rows == 0:
+                    continue
+                results.append(sort_merge_join(
+                    lbatch, rbatch, self.left_keys, self.right_keys,
+                    presorted=True))
+            if not results:
+                lempty = self.left.execute(bucket=0)
+                rempty = self.right.execute(bucket=0)
+                return sort_merge_join(lempty, rempty, self.left_keys,
+                                       self.right_keys, presorted=True)
+            return columnar.concat_batches(results)
+        lbatch = self.left.execute(bucket)
+        rbatch = self.right.execute(bucket)
+        # Children end in SortExec, so sides arrive key-sorted.
+        return sort_merge_join(lbatch, rbatch, self.left_keys,
+                               self.right_keys, presorted=True)
+
+
+# ---------------------------------------------------------------------------
+# Planner
+# ---------------------------------------------------------------------------
+
+
+def _join_keys(condition: E.Expression, left_schema: Schema,
+               right_schema: Schema) -> Tuple[List[str], List[str]]:
+    """Extract equi-join key pairs from an AND-of-equalities condition
+    (reference applicability: `JoinIndexRule.scala:179-185,278-317`)."""
+    left_keys: List[str] = []
+    right_keys: List[str] = []
+    for conjunct in E.split_conjunctive(condition):
+        if not isinstance(conjunct, E.EqualTo):
+            raise HyperspaceException(
+                f"Only equi-join conditions are supported; got {conjunct!r}")
+        a, b = conjunct.left, conjunct.right
+        if not isinstance(a, E.Column) or not isinstance(b, E.Column):
+            raise HyperspaceException(
+                "Join condition must compare columns directly.")
+        if left_schema.contains(a.name) and right_schema.contains(b.name):
+            left_keys.append(a.name)
+            right_keys.append(b.name)
+        elif left_schema.contains(b.name) and right_schema.contains(a.name):
+            left_keys.append(b.name)
+            right_keys.append(a.name)
+        else:
+            raise HyperspaceException(
+                f"Join columns not found on both sides: {conjunct!r}")
+    return left_keys, right_keys
+
+
+def _underlying_bucket_spec(plan: LogicalPlan) -> Optional[BucketSpec]:
+    """The bucket spec of the scan feeding a linear Filter/Project chain —
+    filters and projections preserve bucketing and intra-bucket order."""
+    node = plan
+    while True:
+        if isinstance(node, Scan):
+            return node.bucket_spec
+        if isinstance(node, (Filter, Project)) :
+            node = node.child
+            continue
+        return None
+
+
+def _required_for(plan: LogicalPlan, required: Set[str]) -> List[str]:
+    """required column names resolved against plan schema, in schema order."""
+    schema = plan.schema
+    lowered = {r.lower() for r in required}
+    return [f.name for f in schema.fields if f.name.lower() in lowered]
+
+
+def plan_physical(plan: LogicalPlan,
+                  required: Optional[Set[str]] = None) -> PhysicalNode:
+    """Logical -> physical with projection pushdown into scans."""
+    if required is None:
+        required = set(plan.schema.names)
+
+    if isinstance(plan, Scan):
+        return ScanExec(plan, _required_for(plan, required))
+
+    if isinstance(plan, Filter):
+        child_required = set(required) | plan.condition.references()
+        return FilterExec(plan.condition,
+                          plan_physical(plan.child, child_required))
+
+    if isinstance(plan, Project):
+        child = plan_physical(plan.child, set(plan.columns))
+        # Resolve names against the child schema but KEEP the declared order.
+        resolved = [plan.child.schema.field(c).name for c in plan.columns]
+        return ProjectExec(resolved, child)
+
+    if isinstance(plan, Join):
+        if plan.join_type != "inner":
+            raise HyperspaceException(
+                f"Join type {plan.join_type} not yet supported by the executor.")
+        left_keys, right_keys = _join_keys(plan.condition, plan.left.schema,
+                                           plan.right.schema)
+        left_required = ({n for n in required if plan.left.schema.contains(n)}
+                         | set(left_keys))
+        right_required = ({n for n in required if plan.right.schema.contains(n)}
+                          | set(right_keys))
+        left_phys = plan_physical(plan.left, left_required)
+        right_phys = plan_physical(plan.right, right_required)
+
+        lspec = _underlying_bucket_spec(plan.left)
+        rspec = _underlying_bucket_spec(plan.right)
+
+        def _covers(spec: Optional[BucketSpec], keys: List[str]) -> bool:
+            return (spec is not None
+                    and [c.lower() for c in spec.bucket_columns]
+                    == [k.lower() for k in keys])
+
+        if (_covers(lspec, left_keys) and _covers(rspec, right_keys)
+                and lspec.num_buckets == rspec.num_buckets):
+            # Shuffle-free, sort-free bucketed SMJ — the indexed fast path.
+            return SortMergeJoinExec(left_phys, right_phys, left_keys,
+                                     right_keys, bucketed=True,
+                                     num_buckets=lspec.num_buckets)
+        # General path: hash exchange + sort on each side.
+        num_partitions = max(lspec.num_buckets if lspec else 0,
+                             rspec.num_buckets if rspec else 0, 200)
+        left_sorted = SortExec(left_keys, ExchangeExec(left_keys,
+                                                       num_partitions,
+                                                       left_phys))
+        right_sorted = SortExec(right_keys, ExchangeExec(right_keys,
+                                                         num_partitions,
+                                                         right_phys))
+        return SortMergeJoinExec(left_sorted, right_sorted, left_keys,
+                                 right_keys, bucketed=False)
+
+    raise HyperspaceException(f"Cannot plan node: {plan!r}")

@@ -1,0 +1,86 @@
+"""Session: the user's entry point to the engine + optimizer hook.
+
+Parity: the reference plugs its rules into Spark's
+`sessionState.experimentalMethods.extraOptimizations` via
+`enableHyperspace()` (`package.scala:46-51`); here the session owns its
+optimizer rule list directly. Rule ORDER matters and matches the reference
+(`package.scala:23-34`): JoinIndexRule before FilterIndexRule, because once
+a rule fires on a relation no second rule may fire on it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import hyperspace_tpu.engine  # noqa: F401  (x64 config)
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.plan.schema import Schema
+
+
+class HyperspaceSession:
+    def __init__(self, conf: Optional[HyperspaceConf] = None):
+        self.conf = conf or HyperspaceConf()
+        self._rules: List = []
+        self._hyperspace_enabled = False
+
+    # -- data sources -----------------------------------------------------
+
+    def read_parquet(self, *paths: str, schema: Optional[Schema] = None):
+        from hyperspace_tpu.engine.dataframe import DataFrame
+        if not paths:
+            raise HyperspaceException("read_parquet requires at least one path.")
+        if schema is None:
+            import pyarrow.parquet as pq
+            import glob as _glob
+            probe = paths[0]
+            if os.path.isdir(probe):
+                candidates = sorted(
+                    _glob.glob(os.path.join(probe, "**", "*.parquet"),
+                               recursive=True))
+                if not candidates:
+                    raise HyperspaceException(f"No parquet files under {probe}")
+                probe = candidates[0]
+            schema = Schema.from_arrow(pq.read_schema(probe))
+        return DataFrame(Scan(list(paths), schema), self)
+
+    def create_dataframe(self, table):
+        """Arrow table / pandas DataFrame -> DataFrame backed by a temp
+        parquet spill (all scans are file-backed, like the reference's
+        relations)."""
+        import tempfile
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        if not isinstance(table, pa.Table):
+            table = pa.Table.from_pandas(table, preserve_index=False)
+        tmpdir = tempfile.mkdtemp(prefix="hyperspace_df_")
+        pq.write_table(table, os.path.join(tmpdir, "part-0.parquet"))
+        return self.read_parquet(tmpdir)
+
+    # -- optimizer plumbing ----------------------------------------------
+
+    def enable_hyperspace(self) -> "HyperspaceSession":
+        """Plug the rewrite rule batch (reference `package.scala:46-51`)."""
+        from hyperspace_tpu.plan.rules.join_index import JoinIndexRule
+        from hyperspace_tpu.plan.rules.filter_index import FilterIndexRule
+        if not self._hyperspace_enabled:
+            self._rules = [JoinIndexRule(self), FilterIndexRule(self)]
+            self._hyperspace_enabled = True
+        return self
+
+    def disable_hyperspace(self) -> "HyperspaceSession":
+        """Reference `package.scala:58-63`."""
+        self._rules = []
+        self._hyperspace_enabled = False
+        return self
+
+    @property
+    def is_hyperspace_enabled(self) -> bool:
+        return self._hyperspace_enabled
+
+    def optimize(self, plan: LogicalPlan) -> LogicalPlan:
+        for rule in self._rules:
+            plan = rule.apply(plan)
+        return plan

@@ -1,0 +1,89 @@
+"""The Hyperspace user facade.
+
+Parity: reference `Hyperspace.scala:24-133` — lifecycle verbs delegated to
+the index collection manager, `indexes` catalog view, `explain`, plus the
+session-keyed context holding a CachingIndexCollectionManager
+(`Hyperspace.scala:107-133`).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Optional
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.manager import CachingIndexCollectionManager
+
+
+class HyperspaceContext:
+    """Per-session context (reference `Hyperspace.scala:131-133`).
+
+    Holds no strong reference back to the session (it is the weak key in
+    `Hyperspace._contexts`); only the conf-derived manager lives here.
+    """
+
+    def __init__(self, session: HyperspaceSession):
+        self.index_collection_manager = CachingIndexCollectionManager(session.conf)
+
+
+class Hyperspace:
+    # Weak keys: a dropped session must not be pinned by its context.
+    _contexts: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+    _lock = threading.Lock()
+
+    def __init__(self, session: Optional[HyperspaceSession] = None):
+        self.session = session or HyperspaceSession()
+        self._context = Hyperspace.get_context(self.session)
+
+    @staticmethod
+    def get_context(session: HyperspaceSession) -> HyperspaceContext:
+        """Session-keyed context cache (reference `Hyperspace.scala:107-129`
+        uses a thread-local keyed on the active session)."""
+        with Hyperspace._lock:
+            ctx = Hyperspace._contexts.get(session)
+            if ctx is None:
+                ctx = HyperspaceContext(session)
+                Hyperspace._contexts[session] = ctx
+            return ctx
+
+    @property
+    def _manager(self) -> CachingIndexCollectionManager:
+        return self._context.index_collection_manager
+
+    # -- lifecycle verbs (reference `Hyperspace.scala:33-92`) -------------
+
+    def create_index(self, df, index_config: IndexConfig) -> None:
+        self._manager.create(df, index_config)
+
+    def delete_index(self, index_name: str) -> None:
+        self._manager.delete(index_name)
+
+    def restore_index(self, index_name: str) -> None:
+        self._manager.restore(index_name)
+
+    def vacuum_index(self, index_name: str) -> None:
+        self._manager.vacuum(index_name)
+
+    def refresh_index(self, index_name: str) -> None:
+        self._manager.refresh(index_name)
+
+    def optimize_index(self, index_name: str) -> None:
+        """Merge-compact incremental deltas (extension; reference roadmap)."""
+        self._manager.optimize(index_name)
+
+    def cancel(self, index_name: str) -> None:
+        self._manager.cancel(index_name)
+
+    def indexes(self):
+        """Catalog as a pandas DataFrame (reference `Hyperspace.scala:33-36`)."""
+        return self._manager.indexes_df()
+
+    def explain(self, df, verbose: bool = False, redirect=None) -> None:
+        """Plan diff with rules on vs off (reference `Hyperspace.scala:101-104`)."""
+        from hyperspace_tpu.plananalysis.analyzer import PlanAnalyzer
+        out = PlanAnalyzer.explain_string(df, self.session,
+                                          self._manager.indexes(), verbose)
+        (redirect or print)(out)

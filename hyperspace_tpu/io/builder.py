@@ -1,0 +1,112 @@
+"""The index build pipeline — the framework's hot data path.
+
+Reference equivalent: `CreateActionBase.write` =
+`df.select(indexed++included).repartition(numBuckets, indexedCols)
+.write.saveWithBuckets(...)` (`actions/CreateActionBase.scala:99-120`,
+`index/DataFrameWriterExtensions.scala:49-78`) — a distributed JVM shuffle +
+per-bucket sort + parquet encode.
+
+TPU-native pipeline (single device; the mesh-sharded variant lives in
+`parallel/build.py`):
+1. execute the source plan projected to indexed+included columns ->
+   HBM-resident ColumnBatch;
+2. murmur-mix bucket ids on device (`ops/hash_partition.py`);
+3. ONE stable `lax.sort` keyed (bucket_id, *indexed columns) — this both
+   groups rows by bucket and sorts within buckets in a single XLA sort
+   (the reference needs a shuffle THEN a per-bucket sort);
+4. bucket boundaries via two searchsorted calls;
+5. slice per bucket -> Arrow -> one parquet file per bucket.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import hyperspace_tpu.engine  # noqa: F401  (x64 config)
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io import columnar, parquet
+from hyperspace_tpu.ops.hash_partition import bucket_ids
+from hyperspace_tpu.ops.sort import bucket_boundaries, sort_permutation
+from hyperspace_tpu.plan.nodes import BucketSpec
+
+
+def write_bucketed_batch(batch: columnar.ColumnBatch,
+                         indexed_columns: Sequence[str],
+                         num_buckets: int, path: str,
+                         file_suffix: Optional[str] = None) -> List[str]:
+    """Steps 2-5: bucket + sort a device batch, write one file per bucket.
+    Returns the written file paths."""
+    ids = bucket_ids(batch, indexed_columns, num_buckets)
+    perm = sort_permutation(batch, indexed_columns, leading_keys=[ids])
+    sorted_batch = batch.take(perm)
+    import jax.numpy as jnp
+    sorted_ids = jnp.take(ids, perm)
+    starts, ends = bucket_boundaries(sorted_ids, num_buckets)
+    starts = np.asarray(starts)
+    ends = np.asarray(ends)
+
+    table = columnar.to_arrow(sorted_batch)  # one device->host transfer
+    written: List[str] = []
+    os.makedirs(path, exist_ok=True)
+    for b in range(num_buckets):
+        if ends[b] <= starts[b]:
+            continue  # empty bucket -> no file, like Spark bucketed output
+        out = os.path.join(path, parquet.bucket_file_name(b, file_suffix))
+        parquet.write_table(table.slice(int(starts[b]),
+                                        int(ends[b] - starts[b])), out)
+        written.append(out)
+    return written
+
+
+def write_index(df, indexed_columns: Sequence[str],
+                included_columns: Sequence[str], num_buckets: int,
+                path: str) -> List[str]:
+    """THE index build job (reference `CreateActionBase.scala:99-120`)."""
+    from hyperspace_tpu.engine.executor import execute_plan
+
+    columns = list(indexed_columns) + list(included_columns)
+    batch = execute_plan(df.plan, projection=columns)
+    written = write_bucketed_batch(batch, indexed_columns, num_buckets, path)
+    spec = BucketSpec(num_buckets, tuple(indexed_columns),
+                      tuple(indexed_columns))
+    parquet.write_bucket_spec(path, spec, batch.schema)
+    return written
+
+
+def compact_index(prev_entry, data_manager, out_path: str) -> List[str]:
+    """Merge-compact all current data versions (base + incremental deltas)
+    into one fully-sorted bucketed layout at `out_path` (OptimizeAction's
+    op; the reference has no compaction — its roadmap item, exceeded here).
+    Per bucket: read every run, concat on device, one stable sort by the
+    indexed columns, write a single file."""
+    from hyperspace_tpu.ops.sort import sort_batch
+
+    indexed = prev_entry.indexed_columns
+    num_buckets = prev_entry.num_buckets
+    roots = [prev_entry.content.root]
+    for extra_root in prev_entry.extra.get("deltaRoots", []):
+        if extra_root not in roots:
+            roots.append(extra_root)
+    per_bucket = {}
+    for root in roots:
+        for bucket, files in parquet.bucket_files(root).items():
+            per_bucket.setdefault(bucket, []).extend(files)
+    if not per_bucket:
+        raise HyperspaceException("No index data files found to compact.")
+    schema = None
+    written: List[str] = []
+    os.makedirs(out_path, exist_ok=True)
+    for bucket in sorted(per_bucket):
+        table = parquet.read_table(per_bucket[bucket])
+        batch = columnar.from_arrow(table)
+        schema = batch.schema
+        merged = sort_batch(batch, indexed)
+        out = os.path.join(out_path, parquet.bucket_file_name(bucket))
+        parquet.write_table(columnar.to_arrow(merged), out)
+        written.append(out)
+    spec = BucketSpec(num_buckets, tuple(indexed), tuple(indexed))
+    parquet.write_bucket_spec(out_path, spec, schema)
+    return written

@@ -1,0 +1,258 @@
+"""Columnar substrate: Arrow tables <-> device-resident column batches.
+
+The reference's data plane rides Spark's JVM row/columnar batches; here the
+on-device representation is one jax array per column (HBM-resident), which is
+what XLA fuses predicate scans over and what the Pallas kernels consume.
+
+Strings are dictionary-encoded on the host with a *sorted* dictionary so
+device-side int32 codes are order-preserving (sort/compare on codes ==
+lexicographic on values), and each dictionary entry carries a precomputed
+64-bit value hash placed on device, so bucket assignment hashes the *value*
+(stable across files/batches with different dictionaries), never the code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import hyperspace_tpu._jax_config  # noqa: F401  (enables x64)
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.schema import Field as SchemaField, Schema
+
+_NUMERIC_NP = {
+    "bool": np.bool_,
+    "int8": np.int8, "int16": np.int16, "int32": np.int32, "int64": np.int64,
+    "float32": np.float32, "float64": np.float64,
+    "date32": np.int32, "timestamp": np.int64,
+}
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _string_hash64(values: np.ndarray) -> np.ndarray:
+    """Vectorized FNV-1a 64-bit over utf-8 bytes of each value (host side,
+    once per dictionary entry — O(dictionary), not O(rows))."""
+    out = np.empty(len(values), dtype=np.uint64)
+    fnv_offset = np.uint64(0xCBF29CE484222325)
+    fnv_prime = np.uint64(0x100000001B3)
+    for i, v in enumerate(values):
+        h = fnv_offset
+        for b in str(v).encode("utf-8"):
+            h = np.uint64((int(h) ^ b) * int(fnv_prime) & 0xFFFFFFFFFFFFFFFF)
+        out[i] = h
+    return out
+
+
+def _split_hashes(hashes: np.ndarray):
+    """uint64 value hashes -> device (hi, lo) uint32 pair."""
+    import jax.numpy as jnp
+    hi = jnp.asarray((hashes >> np.uint64(32)).astype(np.uint32))
+    lo = jnp.asarray((hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    return hi, lo
+
+
+def _merged_dictionary(dictionaries):
+    """Merge sorted dictionaries and build remap tables + value hashes.
+    Returns (merged, [device remap array per input], (hi, lo))."""
+    import jax.numpy as jnp
+    merged = np.unique(np.concatenate(list(dictionaries)))
+    remaps = [jnp.asarray(np.searchsorted(merged, d).astype(np.int32))
+              for d in dictionaries]
+    return merged, remaps, _split_hashes(_string_hash64(merged))
+
+
+@dataclass
+class DeviceColumn:
+    """One column on device.
+
+    `data`: jax array — numeric payload, or int32 dictionary codes for
+    strings. `validity`: optional bool jax array (True = present).
+    `dictionary`: host numpy array of unique values, sorted ascending, for
+    string columns. `dict_hashes`: device uint32x2 (hi, lo) per dictionary
+    entry — value hashes for bucket assignment.
+    """
+
+    data: object
+    dtype: str
+    validity: Optional[object] = None
+    dictionary: Optional[np.ndarray] = None
+    dict_hashes: Optional[object] = None
+
+    @property
+    def is_string(self) -> bool:
+        return self.dictionary is not None
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+
+@dataclass
+class ColumnBatch:
+    """A batch of columns (same length) on device, with its logical schema."""
+
+    schema: Schema
+    columns: Dict[str, DeviceColumn]
+
+    @property
+    def num_rows(self) -> int:
+        if not self.columns:
+            return 0
+        return len(next(iter(self.columns.values())))
+
+    def column(self, name: str) -> DeviceColumn:
+        f = self.schema.field(name)  # case-insensitive resolve + validation
+        return self.columns[f.name]
+
+    def select(self, names: Sequence[str]) -> "ColumnBatch":
+        schema = self.schema.select(names)
+        return ColumnBatch(schema, {f.name: self.columns[f.name]
+                                    for f in schema.fields})
+
+    def take(self, indices) -> "ColumnBatch":
+        """Row gather by device index array."""
+        jnp = _jnp()
+        out = {}
+        for name, col in self.columns.items():
+            out[name] = DeviceColumn(
+                data=jnp.take(col.data, indices, axis=0),
+                dtype=col.dtype,
+                validity=(jnp.take(col.validity, indices, axis=0)
+                          if col.validity is not None else None),
+                dictionary=col.dictionary,
+                dict_hashes=col.dict_hashes)
+        return ColumnBatch(self.schema, out)
+
+
+def _encode_strings(values: np.ndarray):
+    """Sorted-unique dictionary encode; returns (codes int32, dictionary,
+    hashes uint64)."""
+    import pandas as pd
+    mask = ~np.asarray(pd.isna(values))
+    filled = np.where(mask, values, "")
+    dictionary, codes = np.unique(filled.astype(str), return_inverse=True)
+    return codes.astype(np.int32), dictionary, _string_hash64(dictionary), mask
+
+
+def from_arrow(table, schema: Optional[Schema] = None) -> ColumnBatch:
+    """Arrow table -> device ColumnBatch. Nulls become validity masks with
+    sentinel-filled payloads (0 / empty string)."""
+    import jax.numpy as jnp
+
+    if schema is None:
+        schema = Schema.from_arrow(table.schema)
+    columns: Dict[str, DeviceColumn] = {}
+    for f in schema.fields:
+        arr = table.column(f.name)
+        if f.dtype == "string":
+            np_vals = arr.to_pandas().to_numpy(dtype=object)
+            codes, dictionary, hashes, mask = _encode_strings(np_vals)
+            columns[f.name] = DeviceColumn(
+                data=jnp.asarray(codes), dtype="string",
+                validity=(jnp.asarray(mask) if not bool(mask.all()) else None),
+                dictionary=dictionary,
+                dict_hashes=_split_hashes(hashes))
+        else:
+            np_dtype = _NUMERIC_NP.get(f.dtype)
+            if np_dtype is None:
+                raise HyperspaceException(f"Unsupported dtype: {f.dtype}")
+            chunk = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+            has_nulls = chunk.null_count > 0
+            if f.dtype == "timestamp":
+                np_vals = chunk.cast("int64").to_numpy(zero_copy_only=False)
+            elif f.dtype == "date32":
+                np_vals = chunk.cast("int32").to_numpy(zero_copy_only=False)
+            else:
+                np_vals = chunk.to_numpy(zero_copy_only=False)
+            if has_nulls:
+                mask = ~np.asarray(chunk.is_null())
+                np_vals = np.where(mask, np.nan_to_num(np_vals), 0)
+            np_vals = np.asarray(np_vals).astype(np_dtype)
+            columns[f.name] = DeviceColumn(
+                data=jnp.asarray(np_vals), dtype=f.dtype,
+                validity=(jnp.asarray(mask) if has_nulls else None))
+    return ColumnBatch(schema, columns)
+
+
+def to_arrow(batch: ColumnBatch):
+    """Device ColumnBatch -> Arrow table (decodes dictionary codes)."""
+    import pyarrow as pa
+
+    arrays = []
+    names = []
+    for f in batch.schema.fields:
+        col = batch.columns[f.name]
+        data = np.asarray(col.data)
+        validity = np.asarray(col.validity) if col.validity is not None else None
+        if col.is_string:
+            values = col.dictionary[data]
+            arr = pa.array(values, type=pa.string(),
+                           mask=(~validity if validity is not None else None))
+        else:
+            pa_type = Schema([f]).to_arrow().field(0).type
+            if f.dtype == "timestamp":
+                arr = pa.array(data.astype("int64"),
+                               mask=(~validity if validity is not None else None)
+                               ).cast(pa_type)
+            elif f.dtype == "date32":
+                arr = pa.array(data.astype("int32"),
+                               mask=(~validity if validity is not None else None)
+                               ).cast(pa_type)
+            else:
+                arr = pa.array(data,
+                               mask=(~validity if validity is not None else None))
+        arrays.append(arr)
+        names.append(f.name)
+    return pa.table(dict(zip(names, arrays)))
+
+
+def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
+    """Concatenate batches row-wise. String columns are re-unified through a
+    merged sorted dictionary so codes stay order-preserving and comparable."""
+    import jax.numpy as jnp
+
+    if not batches:
+        raise HyperspaceException("Cannot concat zero batches.")
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    out: Dict[str, DeviceColumn] = {}
+    for f in schema.fields:
+        cols = [b.columns[f.name] for b in batches]
+        any_validity = any(c.validity is not None for c in cols)
+        validity = None
+        if any_validity:
+            validity = jnp.concatenate([
+                c.validity if c.validity is not None
+                else jnp.ones(len(c), dtype=bool) for c in cols])
+        if f.dtype == "string":
+            merged, remaps, hashes = _merged_dictionary(
+                [c.dictionary for c in cols])
+            remapped = [jnp.take(remap, c.data)
+                        for remap, c in zip(remaps, cols)]
+            out[f.name] = DeviceColumn(jnp.concatenate(remapped), "string",
+                                       validity, merged, hashes)
+        else:
+            out[f.name] = DeviceColumn(
+                jnp.concatenate([c.data for c in cols]), f.dtype, validity)
+    return ColumnBatch(schema, out)
+
+
+def unify_string_columns(a: DeviceColumn, b: DeviceColumn):
+    """Re-map two string columns onto one merged sorted dictionary so their
+    codes are mutually comparable (used by the join path)."""
+    import jax.numpy as jnp
+
+    merged, (remap_a, remap_b), hashes = _merged_dictionary(
+        [a.dictionary, b.dictionary])
+
+    def remap(col: DeviceColumn, table) -> DeviceColumn:
+        return DeviceColumn(jnp.take(table, col.data), "string",
+                            col.validity, merged, hashes)
+
+    return remap(a, remap_a), remap(b, remap_b)

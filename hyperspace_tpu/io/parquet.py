@@ -1,0 +1,82 @@
+"""Parquet IO, including the bucketed index-data layout.
+
+Layout parity with the reference's bucketed write
+(`index/DataFrameWriterExtensions.scala:49-78`): one parquet file (set) per
+bucket, hash-partitioned by the indexed columns and sorted within buckets.
+Bucket id is encoded in the file name (`part-<bucket 5 digits>.parquet`) —
+the read side maps file -> bucket from the name, like Spark's bucketed
+tables — and a `_bucket_spec.json` sidecar makes index data dirs
+self-describing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.nodes import BucketSpec
+from hyperspace_tpu.plan.schema import Schema
+
+BUCKET_FILE_RE = re.compile(r"part-(\d{5})(?:-[A-Za-z0-9]+)?\.parquet$")
+BUCKET_SPEC_FILE = "_bucket_spec.json"
+
+
+def bucket_file_name(bucket: int, suffix: Optional[str] = None) -> str:
+    tag = f"-{suffix}" if suffix else ""
+    return f"part-{bucket:05d}{tag}.parquet"
+
+
+def bucket_of_file(path: str) -> Optional[int]:
+    m = BUCKET_FILE_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
+    """Read one or more parquet files/dirs into a single Arrow table."""
+    import pyarrow.parquet as pq
+    import pyarrow as pa
+
+    tables = []
+    for path in paths:
+        tables.append(pq.read_table(path, columns=list(columns) if columns else None))
+    if not tables:
+        raise HyperspaceException("No parquet inputs to read.")
+    return pa.concat_tables(tables, promote_options="default")
+
+
+def write_table(table, path: str) -> None:
+    import pyarrow.parquet as pq
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    pq.write_table(table, path)
+
+
+def write_bucket_spec(directory: str, spec: BucketSpec, schema: Schema) -> None:
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, BUCKET_SPEC_FILE), "w") as f:
+        json.dump({"bucketSpec": spec.to_dict(),
+                   "schema": [fld.to_dict() for fld in schema.fields]}, f,
+                  indent=2)
+
+
+def read_bucket_spec(directory: str) -> Optional[BucketSpec]:
+    path = os.path.join(directory, BUCKET_SPEC_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return BucketSpec.from_dict(json.load(f)["bucketSpec"])
+
+
+def bucket_files(directory: str) -> Dict[int, List[str]]:
+    """Map bucket id -> parquet files in a bucketed data dir (empty buckets
+    have no files)."""
+    out: Dict[int, List[str]] = {}
+    if not os.path.isdir(directory):
+        return out
+    for name in sorted(os.listdir(directory)):
+        bucket = bucket_of_file(name)
+        if bucket is not None:
+            out.setdefault(bucket, []).append(os.path.join(directory, name))
+    return out

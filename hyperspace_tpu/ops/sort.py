@@ -1,0 +1,60 @@
+"""Device sort kernels: stable multi-key (lexicographic) sort.
+
+The reference delegates per-bucket sorting to Spark's bucketed write
+(`index/DataFrameWriterExtensions.scala:49-66`); here sorting is a single
+XLA `lax.sort` over all key columns at once (`num_keys` gives lexicographic
+order; `is_stable` preserves input order for ties), with an iota operand to
+extract the permutation that is then gathered across every payload column.
+XLA lowers this to its bitonic/radix sorter tiled for the TPU VPU.
+
+Order semantics: ascending, nulls first (validity participates as the
+leading sub-key for nullable columns; False < True places nulls ahead).
+String columns sort by dictionary code, which is order-preserving because
+dictionaries are sorted at encode time (`io/columnar.py`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from hyperspace_tpu.io.columnar import ColumnBatch
+
+
+def _key_operands(batch: ColumnBatch, by: Sequence[str]) -> List:
+    operands = []
+    for name in by:
+        col = batch.column(name)
+        if col.validity is not None:
+            operands.append(col.validity)  # False (null) sorts first
+        operands.append(col.data)
+    return operands
+
+
+def sort_permutation(batch: ColumnBatch, by: Sequence[str],
+                     leading_keys: Optional[Sequence] = None):
+    """Stable lexicographic sort permutation by `by` columns; optional
+    `leading_keys` (e.g. bucket ids) sort before them."""
+    import jax
+    import jax.numpy as jnp
+
+    operands = list(leading_keys or []) + _key_operands(batch, by)
+    iota = jnp.arange(batch.num_rows, dtype=jnp.int32)
+    results = jax.lax.sort([*operands, iota], num_keys=len(operands),
+                           is_stable=True)
+    return results[-1]
+
+
+def sort_batch(batch: ColumnBatch, by: Sequence[str],
+               leading_keys: Optional[Sequence] = None) -> ColumnBatch:
+    return batch.take(sort_permutation(batch, by, leading_keys))
+
+
+def bucket_boundaries(sorted_bucket_ids, num_buckets: int) -> Tuple:
+    """(starts, ends) of each bucket's contiguous row range in a batch sorted
+    by bucket id. starts[b] == ends[b] for empty buckets."""
+    import jax.numpy as jnp
+
+    buckets = jnp.arange(num_buckets, dtype=sorted_bucket_ids.dtype)
+    starts = jnp.searchsorted(sorted_bucket_ids, buckets, side="left")
+    ends = jnp.searchsorted(sorted_bucket_ids, buckets, side="right")
+    return starts, ends

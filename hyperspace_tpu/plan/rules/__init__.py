@@ -1,0 +1,5 @@
+from hyperspace_tpu.plan.rules.filter_index import FilterIndexRule
+from hyperspace_tpu.plan.rules.join_index import JoinIndexRule
+from hyperspace_tpu.plan.rules.ranker import JoinIndexRanker
+
+__all__ = ["FilterIndexRule", "JoinIndexRule", "JoinIndexRanker"]

@@ -1,0 +1,71 @@
+"""Shared rule machinery."""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.index.signature import SignatureProviderFactory
+from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
+from hyperspace_tpu.plan.schema import Schema
+
+logger = logging.getLogger(__name__)
+
+
+class Rule:
+    """A logical plan rewrite rule (the reference's Catalyst
+    `Rule[LogicalPlan]` analog)."""
+
+    def __init__(self, session):
+        self.session = session
+        # (provider name, plan identity) -> signature, valid within one
+        # apply(); avoids re-stat'ing every source file once per candidate
+        # index.
+        self._sig_cache = {}
+
+    def _active_indexes(self) -> List[IndexLogEntry]:
+        """ACTIVE catalog entries via the session context's caching manager
+        (reference reads `Hyperspace.getContext(spark).indexCollectionManager
+        .getIndexes(ACTIVE)`, `JoinIndexRule.scala:90-93`)."""
+        from hyperspace_tpu.facade import Hyperspace
+        manager = Hyperspace.get_context(self.session).index_collection_manager
+        return manager.get_indexes([States.ACTIVE])
+
+    def signature_matches(self, entry: IndexLogEntry, plan: LogicalPlan) -> bool:
+        """Recompute the plan's signature with the provider recorded in the
+        index metadata and compare (reference `FilterIndexRule.scala:155-168`).
+        Cached per (provider, plan) within one rule application."""
+        stored = entry.signature()
+        cache_key = (stored.provider, id(plan))
+        if cache_key not in self._sig_cache:
+            try:
+                provider = SignatureProviderFactory.create(stored.provider)
+                self._sig_cache[cache_key] = provider.signature(plan)
+            except Exception as exc:  # provider failure -> no match, not a crash
+                logger.warning("Signature provider %s failed: %s",
+                               stored.provider, exc)
+                self._sig_cache[cache_key] = None
+        current = self._sig_cache[cache_key]
+        return current is not None and current == stored.value
+
+    @staticmethod
+    def index_scan(entry: IndexLogEntry, bucketed: bool) -> Scan:
+        """Build the replacement relation over the index data. Filter
+        rewrites pass bucketed=False — a plain scan keeps full read
+        parallelism (reference `FilterIndexRule.scala:112-120`); join
+        rewrites pass bucketed=True so the planner can elide Exchange+Sort
+        (reference `JoinIndexRule.scala:124-153`)."""
+        from hyperspace_tpu.plan.nodes import BucketSpec
+
+        schema = Schema.from_json(entry.schema_json)
+        bucket_spec = None
+        if bucketed:
+            bucket_spec = BucketSpec(entry.num_buckets,
+                                     tuple(entry.indexed_columns),
+                                     tuple(entry.indexed_columns))
+        return Scan([entry.content.root], schema, bucket_spec=bucket_spec)
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        raise NotImplementedError

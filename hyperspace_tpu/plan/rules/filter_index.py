@@ -1,0 +1,95 @@
+"""FilterIndexRule: redirect filter queries to covering indexes.
+
+Parity: reference `index/rules/FilterIndexRule.scala:41-229`.
+- Matches `Project(Filter(Scan))` and bare `Filter(Scan)`.
+- Candidate = ACTIVE index whose signature matches the plan AND that covers
+  it: the filter must reference the index's FIRST indexed column, and
+  project+filter columns must be a subset of indexed+included columns
+  (reference `:203-215`).
+- Ranking is first-wins (reference's placeholder, `:222-228`).
+- Replacement keeps Project+Filter but swaps the relation for a scan over
+  the index data root with NO bucket spec — a plain scan keeps full read
+  parallelism (reference `:109-131`).
+- Any exception makes the rule a no-op with a warning (reference `:76-80`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan
+from hyperspace_tpu.plan.rules.base import Rule
+
+logger = logging.getLogger(__name__)
+
+
+class FilterIndexRule(Rule):
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        self._sig_cache = {}
+        try:
+            return plan.transform_up(self._rewrite)
+        except Exception as exc:
+            logger.warning("FilterIndexRule failed; skipping: %s", exc)
+            return plan
+
+    def _rewrite(self, node: LogicalPlan) -> LogicalPlan:
+        # Project(Filter(Scan)) or Filter(Scan)
+        if isinstance(node, Project) and isinstance(node.child, Filter) \
+                and isinstance(node.child.child, Scan):
+            project, filt, scan = node, node.child, node.child.child
+        elif isinstance(node, Filter) and isinstance(node.child, Scan):
+            project, filt, scan = None, node, node.child
+        else:
+            return node
+        if scan.bucket_spec is not None:
+            return node  # already an index scan
+
+        filter_columns = sorted(filt.condition.references())
+        project_columns = (list(project.columns) if project is not None
+                           else scan.schema.names)
+
+        index = self._find_covering_index(filt, scan, project_columns,
+                                          filter_columns)
+        if index is None:
+            return node
+
+        new_scan = self.index_scan(index, bucketed=False)
+        rewritten: LogicalPlan = Filter(filt.condition, new_scan)
+        if project is not None:
+            rewritten = Project(project.columns, rewritten)
+        else:
+            # Bare Filter(Scan): restore the base relation's column order —
+            # enabling indexes must not change result shape.
+            rewritten = Project(scan.schema.names, rewritten)
+        logger.info("FilterIndexRule: applying index %s", index.name)
+        return rewritten
+
+    def _find_covering_index(self, filt: Filter, scan: Scan,
+                             project_columns: Sequence[str],
+                             filter_columns: Sequence[str]) -> Optional[IndexLogEntry]:
+        """Reference `FilterIndexRule.scala:146-228`."""
+        candidates: List[IndexLogEntry] = []
+        for entry in self._active_indexes():
+            if not self._covers(entry, project_columns, filter_columns):
+                continue
+            if not self.signature_matches(entry, filt):
+                continue
+            candidates.append(entry)
+        # First-wins ranking (reference placeholder `:222-228`).
+        return candidates[0] if candidates else None
+
+    @staticmethod
+    def _covers(entry: IndexLogEntry, project_columns: Sequence[str],
+                filter_columns: Sequence[str]) -> bool:
+        """Filter columns must include the index's first indexed column and
+        all referenced columns must be covered (reference `:203-215`)."""
+        first_indexed = entry.indexed_columns[0].lower()
+        filter_lower = {c.lower() for c in filter_columns}
+        if first_indexed not in filter_lower:
+            return False
+        covered = {c.lower() for c in
+                   (entry.indexed_columns + entry.included_columns)}
+        referenced = filter_lower | {c.lower() for c in project_columns}
+        return referenced <= covered

@@ -1,0 +1,178 @@
+"""JoinIndexRule: redirect equi-joins to bucketed covering indexes.
+
+Parity: reference `index/rules/JoinIndexRule.scala:54-595`.
+Applicability (reference `:163-166`):
+- equi-join condition in AND-only CNF of column equalities (`:179-185`);
+- both subplans *linear* (<=1 child per node) — guards against signature
+  collisions since the file-based signature ignores plan structure
+  (`:194-205, 210-211`);
+- join attributes resolve directly to base relations with a strict
+  one-to-one left<->right column mapping (`:278-317`).
+Index selection (reference `:328-594`):
+- per-side candidates by signature match;
+- an index is usable iff its indexed columns are SET-equal to that side's
+  join columns and it covers every column the side needs;
+- left/right indexes are compatible iff their indexed-column ORDER agrees
+  under the left<->right mapping;
+- best pair chosen by JoinIndexRanker.
+Replacement swaps each side's scan for the index scan WITH its bucket spec
+so the physical planner elides Exchange+Sort (reference `:124-153`).
+Errors degrade to a no-op with a warning (reference `:66-69`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.plan import expr as E
+from hyperspace_tpu.plan.nodes import Join, LogicalPlan, Scan
+from hyperspace_tpu.plan.rules.base import Rule
+from hyperspace_tpu.plan.rules.ranker import JoinIndexRanker
+
+logger = logging.getLogger(__name__)
+
+
+class JoinIndexRule(Rule):
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        self._sig_cache = {}
+        try:
+            return plan.transform_up(self._rewrite)
+        except Exception as exc:
+            logger.warning("JoinIndexRule failed; skipping: %s", exc)
+            return plan
+
+    def _rewrite(self, node: LogicalPlan) -> LogicalPlan:
+        if not isinstance(node, Join) or node.join_type != "inner":
+            return node
+        join = node
+        mapping = self._column_mapping(join)
+        if mapping is None:
+            return node
+        if not (join.left.is_linear() and join.right.is_linear()):
+            return node
+        left_scan = self._base_scan(join.left)
+        right_scan = self._base_scan(join.right)
+        if left_scan is None or right_scan is None:
+            return node
+        if left_scan.bucket_spec is not None or right_scan.bucket_spec is not None:
+            return node  # already rewritten
+
+        pair = self._best_index_pair(join, mapping)
+        if pair is None:
+            return node
+        left_index, right_index = pair
+        logger.info("JoinIndexRule: applying indexes %s, %s",
+                    left_index.name, right_index.name)
+
+        def swap(side_plan: LogicalPlan, entry: IndexLogEntry) -> LogicalPlan:
+            replacement = self.index_scan(entry, bucketed=True)
+
+            def f(n: LogicalPlan) -> LogicalPlan:
+                return replacement if isinstance(n, Scan) else n
+
+            return side_plan.transform_up(f)
+
+        return Join(swap(join.left, left_index),
+                    swap(join.right, right_index),
+                    join.condition, join.join_type)
+
+    # -- applicability ----------------------------------------------------
+
+    @staticmethod
+    def _base_scan(plan: LogicalPlan) -> Optional[Scan]:
+        leaves = plan.collect_leaves()
+        if len(leaves) == 1 and isinstance(leaves[0], Scan):
+            return leaves[0]
+        return None
+
+    def _column_mapping(self, join: Join) -> Optional[Dict[str, str]]:
+        """Strict one-to-one left->right join column mapping from an
+        AND-only CNF of column equalities (reference `:179-185, 278-317`)."""
+        left_schema, right_schema = join.left.schema, join.right.schema
+        mapping: Dict[str, str] = {}
+        reverse: Dict[str, str] = {}
+        for conjunct in E.split_conjunctive(join.condition):
+            if not isinstance(conjunct, E.EqualTo):
+                return None
+            a, b = conjunct.left, conjunct.right
+            if not isinstance(a, E.Column) or not isinstance(b, E.Column):
+                return None
+            if left_schema.contains(a.name) and right_schema.contains(b.name):
+                l, r = a.name.lower(), b.name.lower()
+            elif left_schema.contains(b.name) and right_schema.contains(a.name):
+                l, r = b.name.lower(), a.name.lower()
+            else:
+                return None
+            if mapping.get(l, r) != r or reverse.get(r, l) != l:
+                return None  # one-to-many mapping
+            mapping[l] = r
+            reverse[r] = l
+        return mapping or None
+
+    # -- index selection --------------------------------------------------
+
+    @staticmethod
+    def _referenced_columns(plan: LogicalPlan) -> List[str]:
+        """All columns the side needs: its output plus every expression
+        reference inside (reference `:446-457`)."""
+        needed = {n.lower() for n in plan.schema.names}
+
+        def visit(node: LogicalPlan) -> LogicalPlan:
+            from hyperspace_tpu.plan.nodes import Filter as FilterNode
+            if isinstance(node, FilterNode):
+                needed.update(c.lower() for c in node.condition.references())
+            return node
+
+        plan.transform_up(visit)
+        return sorted(needed)
+
+    def _usable_indexes(self, plan: LogicalPlan, join_cols: Sequence[str]
+                        ) -> List[IndexLogEntry]:
+        """Signature-matching ACTIVE indexes whose indexed columns are
+        set-equal to the join columns and that cover the side's referenced
+        columns (reference `:328-353, 399-409, 515-524`)."""
+        referenced = set(self._referenced_columns(plan))
+        join_set = {c.lower() for c in join_cols}
+        out = []
+        for entry in self._active_indexes():
+            indexed = [c.lower() for c in entry.indexed_columns]
+            if set(indexed) != join_set:
+                continue
+            covered = {c.lower() for c in
+                       (entry.indexed_columns + entry.included_columns)}
+            if not referenced <= covered:
+                continue
+            if not self.signature_matches(entry, plan):
+                continue
+            out.append(entry)
+        return out
+
+    def _best_index_pair(self, join: Join, mapping: Dict[str, str]
+                         ) -> Optional[Tuple[IndexLogEntry, IndexLogEntry]]:
+        left_join_cols = list(mapping.keys())
+        right_join_cols = [mapping[c] for c in left_join_cols]
+        left_candidates = self._usable_indexes(join.left, left_join_cols)
+        right_candidates = self._usable_indexes(join.right, right_join_cols)
+        if not left_candidates or not right_candidates:
+            return None
+        compatible = []
+        for li in left_candidates:
+            for ri in right_candidates:
+                if self._compatible(li, ri, mapping):
+                    compatible.append((li, ri))
+        if not compatible:
+            return None
+        return JoinIndexRanker.rank(compatible)[0]
+
+    @staticmethod
+    def _compatible(left_index: IndexLogEntry, right_index: IndexLogEntry,
+                    mapping: Dict[str, str]) -> bool:
+        """Indexed-column ORDER must agree under the left<->right mapping —
+        bucket b of each side must hold the same key hashes (reference
+        `:547-594`)."""
+        left_order = [c.lower() for c in left_index.indexed_columns]
+        right_order = [c.lower() for c in right_index.indexed_columns]
+        mapped = [mapping.get(c) for c in left_order]
+        return mapped == right_order

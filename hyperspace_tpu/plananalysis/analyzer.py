@@ -1,0 +1,104 @@
+"""Explain: physical-plan diff with rules enabled vs disabled.
+
+Parity: reference `index/plananalysis/PlanAnalyzer.scala:45-360` — plans the
+query twice (rules on / rules off, saving and restoring the enabled state),
+highlights differing subtrees, emits "Plan with indexes / Plan without
+indexes / Indexes used" sections, and in verbose mode appends the operator
+occurrence diff table.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from hyperspace_tpu.engine.physical import PhysicalNode, ScanExec
+from hyperspace_tpu.plananalysis import op_analyzer
+from hyperspace_tpu.plananalysis.buffer_stream import BufferStream
+from hyperspace_tpu.plananalysis.display_mode import get_display_mode
+
+
+class PlanAnalyzer:
+    @staticmethod
+    def explain_string(df, session, index_summaries: Sequence,
+                       verbose: bool = False) -> str:
+        """Reference `PlanAnalyzer.scala:45-126`."""
+        was_enabled = session.is_hyperspace_enabled
+        try:
+            session.enable_hyperspace()
+            _, _, plan_with = df.explain_plans()
+            session.disable_hyperspace()
+            _, _, plan_without = df.explain_plans()
+        finally:
+            if was_enabled:
+                session.enable_hyperspace()
+            else:
+                session.disable_hyperspace()
+
+        mode = get_display_mode(session.conf)
+        buffer = BufferStream(mode)
+
+        with_lines = plan_with.tree_string().splitlines()
+        without_lines = plan_without.tree_string().splitlines()
+        # Highlight lines unique to each side (differing subtrees).
+        with_set, without_set = set(with_lines), set(without_lines)
+
+        buffer.write_line("=============================================================")
+        buffer.write_line("Plan with indexes:")
+        buffer.write_line("=============================================================")
+        for line in with_lines:
+            if line in without_set:
+                buffer.write_line(line)
+            else:
+                buffer.highlight_line(line)
+        buffer.write_line()
+
+        buffer.write_line("=============================================================")
+        buffer.write_line("Plan without indexes:")
+        buffer.write_line("=============================================================")
+        for line in without_lines:
+            if line in with_set:
+                buffer.write_line(line)
+            else:
+                buffer.highlight_line(line)
+        buffer.write_line()
+
+        buffer.write_line("=============================================================")
+        buffer.write_line("Indexes used:")
+        buffer.write_line("=============================================================")
+        for name, location in PlanAnalyzer._indexes_used(plan_with,
+                                                         index_summaries):
+            buffer.write_line(f"{name}:{location}")
+        buffer.write_line()
+
+        if verbose:
+            buffer.write_line("=============================================================")
+            buffer.write_line("Physical operator stats:")
+            buffer.write_line("=============================================================")
+            for line in op_analyzer.stats_table(plan_with,
+                                                plan_without).splitlines():
+                buffer.write_line(line)
+            buffer.write_line()
+
+        return buffer.to_string()
+
+    @staticmethod
+    def _indexes_used(plan: PhysicalNode, index_summaries: Sequence
+                      ) -> List[tuple]:
+        """Match scan root paths against the index catalog (reference
+        `PlanAnalyzer.scala:209-221`, scan equality = root path equality)."""
+        import os
+
+        def contains(parent: str, child: str) -> bool:
+            parent = os.path.normpath(parent)
+            child = os.path.normpath(child)
+            return child == parent or child.startswith(parent + os.sep)
+
+        used = []
+        roots = [root for node in plan.collect() if isinstance(node, ScanExec)
+                 for root in node.scan.root_paths]
+        for summary in index_summaries:
+            if any(contains(summary.index_location, root)
+                   or contains(root, summary.index_location)
+                   for root in roots):
+                used.append((summary.name, summary.index_location))
+        return used

@@ -10,13 +10,19 @@ first imported.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The environment's site hook pins jax_platforms to the axon TPU plugin,
+# overriding JAX_PLATFORMS; force the virtual 8-device CPU mesh for tests.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -99,3 +99,22 @@ class FakeDataManager(IndexDataManager):
     def delete(self, version_id):
         self.versions.discard(version_id)
         self.deleted.append(version_id)
+
+
+from hyperspace_tpu.index.signature import LogicalPlanSignatureProvider
+
+
+class TestSignatureProvider(LogicalPlanSignatureProvider):
+    """Root-path-based signature, injectable by reflection like the
+    reference's RuleTestHelper.TestSignatureProvider
+    (`index/rules/RuleTestHelper.scala:26-35`): lets rule tests fabricate
+    matching indexes without building real ones."""
+
+    def signature(self, plan):
+        from hyperspace_tpu.plan.nodes import Scan
+        roots = []
+        for leaf in plan.collect_leaves():
+            if not isinstance(leaf, Scan):
+                return None
+            roots.extend(leaf.root_paths)
+        return "|".join(sorted(roots))

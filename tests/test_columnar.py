@@ -1,0 +1,95 @@
+"""Columnar substrate tests: arrow <-> device round trips, dictionary
+encoding invariants, nulls, batch concat with dictionary unification."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from hyperspace_tpu.io import columnar
+from hyperspace_tpu.plan.schema import Schema
+
+
+def sample_table():
+    return pa.table({
+        "i64": np.array([3, 1, 2], dtype=np.int64),
+        "i32": np.array([30, 10, 20], dtype=np.int32),
+        "f64": np.array([0.3, 0.1, 0.2]),
+        "s": pa.array(["banana", "apple", "cherry"]),
+        "b": pa.array([True, False, True]),
+    })
+
+
+def test_roundtrip():
+    table = sample_table()
+    batch = columnar.from_arrow(table)
+    assert batch.num_rows == 3
+    out = columnar.to_arrow(batch)
+    assert out.equals(table)
+
+
+def test_string_codes_order_preserving():
+    batch = columnar.from_arrow(sample_table())
+    col = batch.column("s")
+    codes = np.asarray(col.data)
+    values = col.dictionary[codes]
+    # codes compare exactly like values
+    assert list(np.argsort(codes)) == list(np.argsort(values))
+    assert list(col.dictionary) == sorted(col.dictionary)
+
+
+def test_dict_hashes_value_identity():
+    """Same value in different batches (different dictionaries) must carry
+    the same hash — the bucket-stability invariant."""
+    t1 = pa.table({"s": pa.array(["x", "y"])})
+    t2 = pa.table({"s": pa.array(["a", "y", "z"])})
+    b1 = columnar.from_arrow(t1)
+    b2 = columnar.from_arrow(t2)
+    h1 = dict(zip(b1.column("s").dictionary,
+                  zip(np.asarray(b1.column("s").dict_hashes[0]),
+                      np.asarray(b1.column("s").dict_hashes[1]))))
+    h2 = dict(zip(b2.column("s").dictionary,
+                  zip(np.asarray(b2.column("s").dict_hashes[0]),
+                      np.asarray(b2.column("s").dict_hashes[1]))))
+    assert h1["y"] == h2["y"]
+
+
+def test_nulls_roundtrip():
+    table = pa.table({
+        "x": pa.array([1, None, 3], type=pa.int64()),
+        "s": pa.array(["a", None, "c"]),
+    })
+    batch = columnar.from_arrow(table)
+    assert batch.column("x").validity is not None
+    out = columnar.to_arrow(batch)
+    assert out.column("x").null_count == 1
+    assert out.column("s").null_count == 1
+    assert out.column("x").to_pylist() == [1, None, 3]
+    assert out.column("s").to_pylist() == ["a", None, "c"]
+
+
+def test_take():
+    import jax.numpy as jnp
+    batch = columnar.from_arrow(sample_table())
+    taken = batch.take(jnp.asarray([2, 0]))
+    out = columnar.to_arrow(taken)
+    assert out.column("i64").to_pylist() == [2, 3]
+    assert out.column("s").to_pylist() == ["cherry", "banana"]
+
+
+def test_concat_unifies_dictionaries():
+    t1 = pa.table({"s": pa.array(["m", "a"]), "v": np.array([1, 2], dtype=np.int64)})
+    t2 = pa.table({"s": pa.array(["z", "m"]), "v": np.array([3, 4], dtype=np.int64)})
+    merged = columnar.concat_batches(
+        [columnar.from_arrow(t1), columnar.from_arrow(t2)])
+    out = columnar.to_arrow(merged)
+    assert out.column("s").to_pylist() == ["m", "a", "z", "m"]
+    col = merged.column("s")
+    codes = np.asarray(col.data)
+    # codes still order-preserving after unification
+    assert (col.dictionary[codes] == np.array(["m", "a", "z", "m"])).all()
+
+
+def test_select_case_insensitive():
+    batch = columnar.from_arrow(sample_table())
+    sub = batch.select(["I64", "S"])
+    assert sub.schema.names == ["i64", "s"]

@@ -1,0 +1,220 @@
+"""End-to-end tests (reference test layer 5: `E2EHyperspaceRulesTests`,
+`IndexManagerTests`): real indexes over real parquet, real queries with
+rules toggled, asserting (a) scan root paths point at `v__=N` index dirs and
+(b) sorted-result equality between index and no-index runs."""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.engine.physical import SortMergeJoinExec
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.facade import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col
+
+
+@pytest.fixture
+def env(tmp_path, sample_parquet):
+    conf = HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": 4,
+    })
+    session = HyperspaceSession(conf)
+    return session, Hyperspace(session), sample_parquet
+
+
+def run_with_and_without(session, query_df, sort_cols):
+    session.disable_hyperspace()
+    plain = query_df.to_pandas().sort_values(sort_cols).reset_index(drop=True)
+    session.enable_hyperspace()
+    indexed = query_df.to_pandas().sort_values(sort_cols).reset_index(drop=True)
+    session.disable_hyperspace()
+    return plain, indexed
+
+
+def scan_roots(query_df, session, enabled=True):
+    if enabled:
+        session.enable_hyperspace()
+    _, optimized, _ = query_df.explain_plans()
+    session.disable_hyperspace()
+    return [root for leaf in optimized.collect_leaves()
+            for root in leaf.root_paths]
+
+
+def test_e2e_filter_query(env):
+    """Parity: reference 'E2E test for filter query'
+    (`E2EHyperspaceRulesTests.scala:87-96`)."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("filterIdx", ["clicks"], ["id", "score"]))
+
+    query = df.filter(col("clicks") == 42).select("id", "score")
+    plain, indexed = run_with_and_without(session, query, ["id"])
+    assert len(plain) > 0
+    pd.testing.assert_frame_equal(plain, indexed)
+    roots = scan_roots(query, session)
+    assert len(roots) == 1 and "filterIdx" in roots[0] and "v__=0" in roots[0]
+
+
+def test_e2e_filter_string_key(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("strIdx", ["query"], ["id"]))
+    query = df.filter(col("query") == "q7").select("id", "query")
+    plain, indexed = run_with_and_without(session, query, ["id"])
+    assert len(plain) > 0
+    pd.testing.assert_frame_equal(plain, indexed)
+    assert "strIdx" in scan_roots(query, session)[0]
+
+
+def test_e2e_join_query(env):
+    """Parity: reference join e2e — bucketed SMJ with no Exchange/Sort."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("jl", ["imprs"], ["id", "clicks"]))
+    hs.create_index(df, IndexConfig("jr", ["imprs"], ["score"]))
+
+    left = df.select("imprs", "id", "clicks")
+    right = df.select("imprs", "score")
+    query = left.join(right, on="imprs")
+
+    plain, indexed = run_with_and_without(
+        session, query, ["imprs", "id", "score"])
+    assert len(plain) > 0
+    pd.testing.assert_frame_equal(plain, indexed)
+
+    session.enable_hyperspace()
+    _, optimized, physical = query.explain_plans()
+    session.disable_hyperspace()
+    names = [type(n).__name__ for n in physical.collect()]
+    assert names.count("ExchangeExec") == 0
+    assert names.count("SortExec") == 0
+    smj = [n for n in physical.collect() if isinstance(n, SortMergeJoinExec)]
+    assert smj[0].bucketed and smj[0].num_buckets == 4
+    roots = [r for leaf in optimized.collect_leaves() for r in leaf.root_paths]
+    assert any("jl" in r for r in roots) and any("jr" in r for r in roots)
+
+
+def test_e2e_filter_under_join(env):
+    """Mixed shape: filters over scans below a join (reference covers
+    mixed filter-under-join plans)."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("fl", ["imprs"], ["id", "clicks"]))
+    hs.create_index(df, IndexConfig("fr", ["imprs"], ["score"]))
+    left = df.select("imprs", "id", "clicks").filter(col("clicks") > 50)
+    right = df.select("imprs", "score")
+    query = left.join(right, on="imprs")
+    plain, indexed = run_with_and_without(
+        session, query, ["imprs", "id", "score"])
+    pd.testing.assert_frame_equal(plain, indexed)
+
+
+def test_index_lifecycle_and_catalog(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("lc", ["clicks"], ["id"]))
+    cat = hs.indexes()
+    assert list(cat["name"]) == ["lc"] and list(cat["state"]) == ["ACTIVE"]
+
+    hs.delete_index("lc")
+    assert list(hs.indexes()["state"]) == ["DELETED"]
+    hs.restore_index("lc")
+    assert list(hs.indexes()["state"]) == ["ACTIVE"]
+    hs.delete_index("lc")
+    hs.vacuum_index("lc")
+    assert len(hs.indexes()) == 0
+    index_dir = os.path.join(session.conf.system_path, "lc")
+    assert not glob.glob(os.path.join(index_dir, "v__=*"))
+
+    # create again after vacuum (DOESNOTEXIST allows re-create)
+    hs.create_index(df, IndexConfig("lc", ["clicks"], ["id"]))
+    assert list(hs.indexes()["state"]) == ["ACTIVE"]
+
+
+def test_create_validations(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    with pytest.raises(HyperspaceException):
+        hs.create_index(df.filter(col("clicks") > 1),
+                        IndexConfig("bad", ["clicks"], []))
+    with pytest.raises(HyperspaceException):
+        hs.create_index(df, IndexConfig("bad2", ["missing_col"], []))
+    hs.create_index(df, IndexConfig("dup", ["clicks"], []))
+    with pytest.raises(HyperspaceException):
+        hs.create_index(df, IndexConfig("dup", ["imprs"], []))
+
+
+def test_refresh_picks_up_appended_data(env):
+    """Parity: reference `IndexManagerTests.scala:189-224` — refresh writes
+    v__=1 reflecting new source data; queries then use it."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("rf", ["clicks"], ["id"]))
+
+    # Append rows with a brand-new clicks value (200).
+    rng = np.random.default_rng(9)
+    extra = pa.table({
+        "id": np.arange(10_000, 10_100, dtype=np.int64),
+        "clicks": np.full(100, 200, dtype=np.int32),
+        "score": rng.random(100),
+        "imprs": rng.integers(0, 10, 100),
+        "query": pa.array(["qNEW"] * 100),
+    })
+    pq.write_table(extra, os.path.join(src, "part-1.parquet"))
+
+    # Stale index: signature mismatch -> rule must NOT fire.
+    query = session.read_parquet(src).filter(col("clicks") == 200).select("id")
+    roots = scan_roots(query, session)
+    assert all("rf" not in r for r in roots)
+    session.disable_hyperspace()
+    assert query.count() == 100
+
+    hs.refresh_index("rf")
+    index_dir = os.path.join(session.conf.system_path, "rf")
+    assert os.path.isdir(os.path.join(index_dir, "v__=0"))
+    assert os.path.isdir(os.path.join(index_dir, "v__=1"))
+
+    fresh = session.read_parquet(src).filter(col("clicks") == 200).select("id")
+    roots = scan_roots(fresh, session)
+    assert len(roots) == 1 and "v__=1" in roots[0]
+    session.enable_hyperspace()
+    assert fresh.count() == 100
+    session.disable_hyperspace()
+
+
+def test_bucketed_layout_on_disk(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("bk", ["clicks"], ["id"]))
+    data_dir = os.path.join(session.conf.system_path, "bk", "v__=0")
+    files = sorted(glob.glob(os.path.join(data_dir, "part-*.parquet")))
+    assert 1 <= len(files) <= 4
+    # within-bucket sortedness
+    for f in files:
+        clicks = pq.read_table(f).column("clicks").to_pylist()
+        assert clicks == sorted(clicks)
+    # total rows preserved
+    total = sum(pq.read_table(f).num_rows for f in files)
+    assert total == df.count()
+
+
+def test_filter_rewrite_preserves_column_order(env):
+    """Enabling indexes must not change result column order, even for a
+    bare Filter(Scan) with no Project on top."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("ord", ["clicks"],
+                                    ["id", "score", "imprs", "query"]))
+    query = df.filter(col("clicks") == 2)
+    plain, indexed = run_with_and_without(session, query, ["id"])
+    assert list(plain.columns) == list(indexed.columns) == df.columns
+    pd.testing.assert_frame_equal(plain, indexed)

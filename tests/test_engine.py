@@ -1,0 +1,111 @@
+"""Engine tests: predicate compilation vs pandas, projection pushdown,
+physical planning (Exchange/Sort insertion and elision)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.engine.physical import (ExchangeExec, ScanExec,
+                                            SortMergeJoinExec)
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.plan.expr import col, lit
+
+
+@pytest.fixture
+def session():
+    return HyperspaceSession(HyperspaceConf())
+
+
+@pytest.fixture
+def df(session, sample_parquet):
+    return session.read_parquet(sample_parquet)
+
+
+@pytest.fixture
+def pdf(sample_parquet):
+    import glob, os
+    files = glob.glob(os.path.join(sample_parquet, "*.parquet"))
+    return pq.read_table(files[0]).to_pandas()
+
+
+@pytest.mark.parametrize("predicate,pandas_query", [
+    (col("clicks") == 42, lambda d: d[d.clicks == 42]),
+    (col("clicks") != 42, lambda d: d[d.clicks != 42]),
+    (col("clicks") > 90, lambda d: d[d.clicks > 90]),
+    (col("clicks") >= 90, lambda d: d[d.clicks >= 90]),
+    (col("clicks") < 5, lambda d: d[d.clicks < 5]),
+    (col("score") <= 0.1, lambda d: d[d.score <= 0.1]),
+    ((col("clicks") > 50) & (col("score") < 0.5),
+     lambda d: d[(d.clicks > 50) & (d.score < 0.5)]),
+    ((col("clicks") < 5) | (col("clicks") > 95),
+     lambda d: d[(d.clicks < 5) | (d.clicks > 95)]),
+    (~(col("clicks") > 10), lambda d: d[~(d.clicks > 10)]),
+    (col("clicks").isin(1, 2, 3), lambda d: d[d.clicks.isin([1, 2, 3])]),
+    ((col("clicks") + 1) * 2 > 150, lambda d: d[(d.clicks + 1) * 2 > 150]),
+    (col("query") == "q7", lambda d: d[d["query"] == "q7"]),
+    (col("query") > "q40", lambda d: d[d["query"] > "q40"]),
+    (col("query") <= "q11", lambda d: d[d["query"] <= "q11"]),
+    (col("query") >= "nonexistent", lambda d: d[d["query"] >= "nonexistent"]),
+])
+def test_filter_parity_with_pandas(df, pdf, predicate, pandas_query):
+    out = df.filter(predicate).to_pandas().sort_values("id").reset_index(drop=True)
+    ref = pandas_query(pdf).sort_values("id").reset_index(drop=True)
+    assert len(out) == len(ref)
+    pd.testing.assert_frame_equal(out, ref[out.columns])
+
+
+def test_filter_on_nullable_column(session, tmp_path):
+    table = pa.table({"x": pa.array([1, None, 3, None, 5], type=pa.int64()),
+                      "y": pa.array([10, 20, 30, 40, 50], type=pa.int64())})
+    d = tmp_path / "nulls"
+    d.mkdir()
+    pq.write_table(table, str(d / "part-0.parquet"))
+    df = session.read_parquet(str(d))
+    # SQL semantics: null fails comparisons
+    assert df.filter(col("x") > 0).count() == 3
+    assert df.filter(col("x").is_null()).count() == 2
+    assert df.filter(col("x").is_not_null()).count() == 3
+
+
+def test_select_and_projection_pushdown(df):
+    q = df.filter(col("clicks") > 50).select("id", "score")
+    _, _, physical = q.explain_plans()
+    scans = [n for n in physical.collect() if isinstance(n, ScanExec)]
+    assert len(scans) == 1
+    # Only the needed columns are read from parquet.
+    assert set(scans[0].columns) == {"id", "score", "clicks"}
+    out = q.to_pandas()
+    assert list(out.columns) == ["id", "score"]
+
+
+def test_unbucketed_join_plans_exchange_and_sort(session, sample_parquet):
+    df = session.read_parquet(sample_parquet)
+    q = df.select("id", "clicks").join(df.select("id", "score"), on="id")
+    _, _, physical = q.explain_plans()
+    names = [type(n).__name__ for n in physical.collect()]
+    assert names.count("ExchangeExec") == 2
+    assert names.count("SortExec") == 2
+    smj = [n for n in physical.collect() if isinstance(n, SortMergeJoinExec)]
+    assert len(smj) == 1 and not smj[0].bucketed
+
+
+def test_join_requires_equi_condition(session, sample_parquet):
+    df = session.read_parquet(sample_parquet)
+    q = df.join(df, on=col("clicks") > col("imprs"))
+    with pytest.raises(HyperspaceException):
+        q.collect()
+
+
+def test_count_and_collect(df, pdf):
+    assert df.count() == len(pdf)
+    table = df.collect()
+    assert table.num_rows == len(pdf)
+
+
+def test_empty_filter_result(df):
+    out = df.filter(col("clicks") > 1000).to_pandas()
+    assert len(out) == 0

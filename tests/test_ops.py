@@ -1,0 +1,151 @@
+"""Device kernel tests: hashing, sort, merge join (reference test layer 3 —
+kernel tests on single-device arrays)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from hyperspace_tpu.io import columnar
+from hyperspace_tpu.ops import hash_partition, join, sort
+
+
+def batch_of(**cols):
+    return columnar.from_arrow(pa.table(cols))
+
+
+def test_bucket_ids_deterministic_and_in_range():
+    b = batch_of(k=np.arange(1000, dtype=np.int64))
+    ids1 = np.asarray(hash_partition.bucket_ids(b, ["k"], 8))
+    ids2 = np.asarray(hash_partition.bucket_ids(b, ["k"], 8))
+    assert (ids1 == ids2).all()
+    assert ids1.min() >= 0 and ids1.max() < 8
+    # reasonable balance: no empty bucket at n=1000, B=8
+    assert len(np.unique(ids1)) == 8
+
+
+def test_bucket_ids_value_stability_across_batches():
+    """Same key value must land in the same bucket regardless of batch
+    composition — required for co-bucketed joins."""
+    b1 = batch_of(k=np.array([5, 100, 7], dtype=np.int64))
+    b2 = batch_of(k=np.array([100, 9999], dtype=np.int64))
+    ids1 = np.asarray(hash_partition.bucket_ids(b1, ["k"], 16))
+    ids2 = np.asarray(hash_partition.bucket_ids(b2, ["k"], 16))
+    assert ids1[1] == ids2[0]
+
+
+def test_string_bucket_stability():
+    b1 = batch_of(s=pa.array(["apple", "pear"]))
+    b2 = batch_of(s=pa.array(["zebra", "pear", "kiwi"]))
+    ids1 = np.asarray(hash_partition.bucket_ids(b1, ["s"], 32))
+    ids2 = np.asarray(hash_partition.bucket_ids(b2, ["s"], 32))
+    assert ids1[1] == ids2[1]
+
+
+def test_multicolumn_hash_differs_by_order():
+    b = batch_of(a=np.array([1, 2], dtype=np.int64),
+                 c=np.array([2, 1], dtype=np.int64))
+    h_ac = np.asarray(hash_partition.batch_hash32(b, ["a", "c"]))
+    h_ca = np.asarray(hash_partition.batch_hash32(b, ["c", "a"]))
+    assert not (h_ac == h_ca).all()
+
+
+def test_sort_lexicographic_multi_key():
+    b = batch_of(a=np.array([2, 1, 2, 1], dtype=np.int64),
+                 c=np.array([0.1, 0.9, 0.0, 0.5]))
+    out = columnar.to_arrow(sort.sort_batch(b, ["a", "c"]))
+    assert out.column("a").to_pylist() == [1, 1, 2, 2]
+    assert out.column("c").to_pylist() == [0.5, 0.9, 0.0, 0.1]
+
+
+def test_sort_strings():
+    b = batch_of(s=pa.array(["pear", "apple", "kiwi"]),
+                 v=np.array([1, 2, 3], dtype=np.int64))
+    out = columnar.to_arrow(sort.sort_batch(b, ["s"]))
+    assert out.column("s").to_pylist() == ["apple", "kiwi", "pear"]
+    assert out.column("v").to_pylist() == [2, 3, 1]
+
+
+def test_sort_nulls_first():
+    b = columnar.from_arrow(pa.table({"x": pa.array([3, None, 1], type=pa.int64())}))
+    out = columnar.to_arrow(sort.sort_batch(b, ["x"]))
+    assert out.column("x").to_pylist() == [None, 1, 3]
+
+
+def test_bucket_boundaries():
+    import jax.numpy as jnp
+    sorted_ids = jnp.asarray(np.array([0, 0, 2, 2, 2, 3], dtype=np.int32))
+    starts, ends = sort.bucket_boundaries(sorted_ids, 4)
+    assert list(np.asarray(starts)) == [0, 2, 2, 5]
+    assert list(np.asarray(ends)) == [2, 2, 5, 6]
+
+
+def test_merge_join_indices_duplicates():
+    import jax.numpy as jnp
+    left = jnp.asarray(np.array([1, 1, 2, 5], dtype=np.int32))
+    right = jnp.asarray(np.array([1, 2, 2, 7], dtype=np.int32))
+    li, ri = join.merge_join_indices(left, right)
+    pairs = sorted(zip(np.asarray(li).tolist(), np.asarray(ri).tolist()))
+    assert pairs == [(0, 0), (1, 0), (2, 1), (2, 2)]
+
+
+def test_merge_join_no_matches():
+    import jax.numpy as jnp
+    li, ri = join.merge_join_indices(jnp.asarray(np.array([1, 2], np.int32)),
+                                     jnp.asarray(np.array([3, 4], np.int32)))
+    assert len(np.asarray(li)) == 0
+
+
+def test_sort_merge_join_matches_numpy(sample_parquet):
+    rng = np.random.default_rng(7)
+    lk = rng.integers(0, 20, 200).astype(np.int64)
+    rk = rng.integers(0, 20, 80).astype(np.int64)
+    left = batch_of(k=lk, v1=np.arange(200, dtype=np.int64))
+    right = batch_of(k=rk, v2=np.arange(80, dtype=np.int64))
+    out = columnar.to_arrow(join.sort_merge_join(left, right, ["k"], ["k"]))
+    df = out.to_pandas()
+    import pandas as pd
+    ref = pd.DataFrame({"k": lk, "v1": np.arange(200)}).merge(
+        pd.DataFrame({"k": rk, "v2": np.arange(80)}), on="k")
+    cols = ["k", "v1", "v2"]
+    a = df[cols].sort_values(cols).reset_index(drop=True)
+    b_ = ref[cols].sort_values(cols).reset_index(drop=True)
+    assert len(a) == len(b_)
+    assert (a.to_numpy() == b_.to_numpy()).all()
+
+
+def test_sort_merge_join_string_keys_cross_dictionary():
+    left = batch_of(s=pa.array(["a", "m", "z"]), x=np.array([1, 2, 3], np.int64))
+    right = batch_of(s=pa.array(["m", "q"]), y=np.array([10, 20], np.int64))
+    out = columnar.to_arrow(join.sort_merge_join(left, right, ["s"], ["s"]))
+    assert out.column("s").to_pylist() == ["m"]
+    assert out.column("x").to_pylist() == [2]
+    assert out.column("y").to_pylist() == [10]
+
+
+def test_join_duplicate_output_names_get_suffix():
+    left = batch_of(k=np.array([1], np.int64), v=np.array([1], np.int64))
+    right = batch_of(k=np.array([1], np.int64), v=np.array([9], np.int64))
+    out = columnar.to_arrow(join.sort_merge_join(left, right, ["k"], ["k"]))
+    assert out.column_names == ["k", "v", "k_r", "v_r"]
+
+
+def test_join_null_keys_match_nothing():
+    """SQL semantics: NULL join keys never match — not even each other, and
+    never the null sentinel payload (0 / empty string)."""
+    left = columnar.from_arrow(pa.table({
+        "k": pa.array([None, -5, 3, 0], type=pa.int64()),
+        "x": pa.array([1, 2, 3, 4], type=pa.int64())}))
+    right = columnar.from_arrow(pa.table({
+        "k": pa.array([0, None, -5], type=pa.int64()),
+        "y": pa.array([10, 20, 30], type=pa.int64())}))
+    out = columnar.to_arrow(join.sort_merge_join(left, right, ["k"], ["k"]))
+    pairs = sorted(zip(out.column("x").to_pylist(), out.column("y").to_pylist()))
+    assert pairs == [(2, 30), (4, 10)]
+
+
+def test_join_null_string_keys():
+    left = batch_of(s=pa.array(["a", None, ""]), x=np.array([1, 2, 3], np.int64))
+    right = batch_of(s=pa.array([None, "", "a"]), y=np.array([10, 20, 30], np.int64))
+    out = columnar.to_arrow(join.sort_merge_join(left, right, ["s"], ["s"]))
+    pairs = sorted(zip(out.column("x").to_pylist(), out.column("y").to_pylist()))
+    assert pairs == [(1, 30), (3, 20)]

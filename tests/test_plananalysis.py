@@ -1,0 +1,95 @@
+"""Explain tests (reference `ExplainTest`, `BufferStreamTest`,
+`DisplayModeTest`)."""
+
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.facade import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plananalysis.buffer_stream import BufferStream
+from hyperspace_tpu.plananalysis.display_mode import (ConsoleMode, HTMLMode,
+                                                      PlainTextMode,
+                                                      get_display_mode)
+
+
+def test_display_modes_and_custom_tags():
+    assert PlainTextMode().highlight("x") == "<----x---->"
+    assert "[32m" in ConsoleMode().highlight("x")
+    assert HTMLMode().highlight("x").startswith("<b ")
+    conf = HyperspaceConf({
+        "spark.hyperspace.explain.displayMode": "html",
+        "spark.hyperspace.explain.displayMode.highlight.beginTag": "<mark>",
+        "spark.hyperspace.explain.displayMode.highlight.endTag": "</mark>",
+    })
+    mode = get_display_mode(conf)
+    assert isinstance(mode, HTMLMode)
+    assert mode.highlight("x") == "<mark>x</mark>"
+    assert mode.newline == "<br>"
+
+
+def test_buffer_stream():
+    stream = BufferStream(PlainTextMode())
+    stream.write("a").write_line("b").highlight("c").write_line()
+    assert stream.to_string() == "ab\n<----c---->\n"
+
+
+@pytest.fixture
+def env(tmp_path, sample_parquet):
+    conf = HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": 4,
+    })
+    session = HyperspaceSession(conf)
+    return session, Hyperspace(session), sample_parquet
+
+
+def test_explain_filter_query(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("exIdx", ["clicks"], ["id"]))
+    query = df.filter(col("clicks") == 2).select("id")
+
+    out = []
+    hs.explain(query, verbose=True, redirect=out.append)
+    text = out[0]
+    assert "Plan with indexes:" in text
+    assert "Plan without indexes:" in text
+    assert "Indexes used:" in text
+    assert "exIdx" in text
+    # differing scans highlighted
+    assert "<----" in text
+    # verbose operator stats table present
+    assert "Physical operator stats:" in text
+    assert "Scan" in text
+
+
+def test_explain_join_shows_exchange_elision(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("el", ["imprs"], ["id"]))
+    hs.create_index(df, IndexConfig("er", ["imprs"], ["score"]))
+    query = (df.select("imprs", "id")
+             .join(df.select("imprs", "score"), on="imprs"))
+    out = []
+    hs.explain(query, verbose=True, redirect=out.append)
+    text = out[0]
+    # The stats table must show Exchange going from 2 to 0.
+    exchange_rows = [line for line in text.splitlines() if "Exchange" in line]
+    assert any("-2" in line for line in exchange_rows)
+    sort_rows = [line for line in text.splitlines()
+                 if line.startswith("| Sort")]
+    assert any("-2" in line for line in sort_rows)
+
+
+def test_explain_leaves_session_state(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    query = df.filter(col("clicks") == 2)
+    session.enable_hyperspace()
+    hs.explain(query, redirect=lambda s: None)
+    assert session.is_hyperspace_enabled
+    session.disable_hyperspace()
+    hs.explain(query, redirect=lambda s: None)
+    assert not session.is_hyperspace_enabled

@@ -1,0 +1,237 @@
+"""Rewrite-rule tests on hand-built plans with fabricated index entries
+(reference test layer 4: `FilterIndexRuleTest`, `JoinIndexRuleTest`,
+`JoinIndexRankerTest` — fabricated `IndexLogEntry`s written via a real log
+manager, injectable signature provider)."""
+
+import os
+
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.facade import Hyperspace
+from hyperspace_tpu.index.log_entry import (Content, CoveringIndex,
+                                            IndexLogEntry, Hdfs, Directory,
+                                            LogicalPlanFingerprint,
+                                            NoOpFingerprint, PlanSource,
+                                            Signature, Source)
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.plan.nodes import Filter, Join, Project, Scan
+from hyperspace_tpu.plan.rules.filter_index import FilterIndexRule
+from hyperspace_tpu.plan.rules.join_index import JoinIndexRule
+from hyperspace_tpu.plan.rules.ranker import JoinIndexRanker
+from hyperspace_tpu.plan.schema import Field, Schema
+
+from fakes import TestSignatureProvider, make_entry
+
+
+SCHEMA = Schema([Field("c1", "int64"), Field("c2", "int64"),
+                 Field("c3", "string"), Field("c4", "int64")])
+
+
+@pytest.fixture
+def session(tmp_path):
+    conf = HyperspaceConf({"hyperspace.warehouse.dir": str(tmp_path / "wh")})
+    return HyperspaceSession(conf)
+
+
+def fabricate_index(session, name, indexed, included, source_plan,
+                    num_buckets=10, state=States.ACTIVE):
+    """Write a fabricated ACTIVE IndexLogEntry through a real log manager
+    (like the reference rule tests)."""
+    manager = Hyperspace.get_context(session).index_collection_manager
+    index_path = manager.path_resolver.get_index_path(name)
+    provider = TestSignatureProvider()
+    sig = provider.signature(source_plan)
+    schema = source_plan.schema.select(indexed + included)
+    entry = IndexLogEntry(
+        name=name,
+        derived_dataset=CoveringIndex(indexed, included, schema.to_json(),
+                                      num_buckets),
+        content=Content(os.path.join(index_path, "v__=0"), []),
+        source=Source(PlanSource("{}", LogicalPlanFingerprint(
+            [Signature(provider.name(), sig)])),
+            [Hdfs(Content("", [Directory("", [], NoOpFingerprint())]))]),
+        extra={})
+    entry.state = state
+    log_manager = IndexLogManagerImpl(index_path)
+    log_id = (log_manager.get_latest_id() or -1) + 1
+    assert log_manager.write_log(log_id, entry)
+    manager.clear_cache()
+    return entry
+
+
+def base_scan(tmp_path, name="t1", schema=SCHEMA):
+    root = tmp_path / name
+    root.mkdir(exist_ok=True)
+    (root / "f.parquet").write_text("")
+    return Scan([str(root)], schema)
+
+
+# -- FilterIndexRule ------------------------------------------------------
+
+
+def test_filter_rule_rewrites_covered_query(session, tmp_path):
+    scan = base_scan(tmp_path)
+    fabricate_index(session, "fidx", ["c1"], ["c2"], scan)
+    plan = Project(["c2"], Filter(col("c1") == 10, scan))
+    out = FilterIndexRule(session).apply(plan)
+    leaf = out.collect_leaves()[0]
+    assert "fidx" in leaf.root_paths[0]
+    assert leaf.bucket_spec is None  # filter rewrite keeps plain scan
+    assert isinstance(out, Project) and out.columns == ["c2"]
+
+
+def test_filter_rule_bare_filter(session, tmp_path):
+    scan = base_scan(tmp_path)
+    fabricate_index(session, "fidx", ["c1"], ["c2", "c3", "c4"], scan)
+    out = FilterIndexRule(session).apply(Filter(col("c1") == 10, scan))
+    assert "fidx" in out.collect_leaves()[0].root_paths[0]
+
+
+def test_filter_rule_requires_first_indexed_column(session, tmp_path):
+    scan = base_scan(tmp_path)
+    fabricate_index(session, "fidx", ["c1", "c2"], ["c3"], scan)
+    # filter on c2 only: first indexed column c1 not referenced -> no rewrite
+    plan = Project(["c3"], Filter(col("c2") == 10, scan))
+    out = FilterIndexRule(session).apply(plan)
+    assert out.collect_leaves()[0].root_paths == scan.root_paths
+
+
+def test_filter_rule_requires_coverage(session, tmp_path):
+    scan = base_scan(tmp_path)
+    fabricate_index(session, "fidx", ["c1"], ["c2"], scan)
+    # c4 not covered -> no rewrite
+    plan = Project(["c4"], Filter(col("c1") == 10, scan))
+    out = FilterIndexRule(session).apply(plan)
+    assert out.collect_leaves()[0].root_paths == scan.root_paths
+
+
+def test_filter_rule_signature_mismatch(session, tmp_path):
+    scan = base_scan(tmp_path, "t1")
+    other = base_scan(tmp_path, "other")
+    fabricate_index(session, "fidx", ["c1"], ["c2"], other)
+    plan = Project(["c2"], Filter(col("c1") == 10, scan))
+    out = FilterIndexRule(session).apply(plan)
+    assert out.collect_leaves()[0].root_paths == scan.root_paths
+
+
+def test_filter_rule_ignores_non_active(session, tmp_path):
+    scan = base_scan(tmp_path)
+    fabricate_index(session, "fidx", ["c1"], ["c2"], scan,
+                    state=States.DELETED)
+    plan = Project(["c2"], Filter(col("c1") == 10, scan))
+    out = FilterIndexRule(session).apply(plan)
+    assert out.collect_leaves()[0].root_paths == scan.root_paths
+
+
+# -- JoinIndexRule --------------------------------------------------------
+
+
+def join_plan(tmp_path, cond=None):
+    left = base_scan(tmp_path, "tl")
+    right = base_scan(tmp_path, "tr",
+                      Schema([Field("d1", "int64"), Field("d2", "int64")]))
+    return Join(left, right, cond or (col("c1") == col("d1")))
+
+
+def test_join_rule_rewrites_both_sides(session, tmp_path):
+    plan = join_plan(tmp_path)
+    fabricate_index(session, "lidx", ["c1"],
+                    ["c2", "c3", "c4"], plan.left, num_buckets=10)
+    fabricate_index(session, "ridx", ["d1"], ["d2"], plan.right,
+                    num_buckets=10)
+    out = JoinIndexRule(session).apply(plan)
+    leaves = out.collect_leaves()
+    assert "lidx" in leaves[0].root_paths[0]
+    assert "ridx" in leaves[1].root_paths[0]
+    # join rewrite sets the bucket spec -> planner elides exchange+sort
+    assert leaves[0].bucket_spec is not None
+    assert leaves[0].bucket_spec.num_buckets == 10
+    assert leaves[1].bucket_spec.bucket_columns == ("d1",)
+
+
+def test_join_rule_requires_indexes_on_both_sides(session, tmp_path):
+    plan = join_plan(tmp_path)
+    fabricate_index(session, "lidx", ["c1"], ["c2", "c3", "c4"], plan.left)
+    out = JoinIndexRule(session).apply(plan)
+    assert out.collect_leaves()[0].root_paths == plan.left.root_paths
+
+
+def test_join_rule_requires_set_equal_join_cols(session, tmp_path):
+    plan = join_plan(tmp_path)
+    # index on (c1, c2) but join only on c1 -> indexed cols not set-equal
+    fabricate_index(session, "lidx", ["c1", "c2"], ["c3", "c4"], plan.left)
+    fabricate_index(session, "ridx", ["d1"], ["d2"], plan.right)
+    out = JoinIndexRule(session).apply(plan)
+    assert out.collect_leaves()[0].root_paths == plan.left.root_paths
+
+
+def test_join_rule_rejects_non_equi(session, tmp_path):
+    plan = join_plan(tmp_path, cond=(col("c1") > col("d1")))
+    fabricate_index(session, "lidx", ["c1"], ["c2", "c3", "c4"], plan.left)
+    fabricate_index(session, "ridx", ["d1"], ["d2"], plan.right)
+    out = JoinIndexRule(session).apply(plan)
+    assert out.collect_leaves()[0].root_paths == plan.left.root_paths
+
+
+def test_join_rule_multi_key_order_compatibility(session, tmp_path):
+    left = base_scan(tmp_path, "tl")
+    right = base_scan(tmp_path, "tr",
+                      Schema([Field("d1", "int64"), Field("d2", "int64")]))
+    cond = (col("c1") == col("d1")) & (col("c2") == col("d2"))
+    plan = Join(left, right, cond)
+    # right index has REVERSED key order -> incompatible bucket layout
+    fabricate_index(session, "lidx", ["c1", "c2"], ["c3", "c4"], left)
+    fabricate_index(session, "ridx", ["d2", "d1"], [], right)
+    out = JoinIndexRule(session).apply(plan)
+    assert out.collect_leaves()[0].root_paths == left.root_paths
+    # matching order -> rewrite fires
+    fabricate_index(session, "ridx2", ["d1", "d2"], [], right)
+    out2 = JoinIndexRule(session).apply(plan)
+    assert "lidx" in out2.collect_leaves()[0].root_paths[0]
+    assert "ridx2" in out2.collect_leaves()[1].root_paths[0]
+
+
+def test_join_rule_nonlinear_side_rejected(session, tmp_path):
+    inner = join_plan(tmp_path)
+    right2 = base_scan(tmp_path, "t3", Schema([Field("e1", "int64")]))
+    outer = Join(inner, right2, col("c1") == col("e1"))
+    fabricate_index(session, "lidx", ["c1"], ["c2", "c3", "c4"], inner.left)
+    fabricate_index(session, "eidx", ["e1"], [], right2)
+    out = JoinIndexRule(session).apply(outer)
+    # the non-linear left side blocks the outer rewrite; the INNER join may
+    # still be rewritten independently (it is linear), so just assert the
+    # outer right side (linear, indexed) wasn't paired with the bad left
+    assert isinstance(out, Join)
+
+
+# -- Ranker ---------------------------------------------------------------
+
+
+def test_ranker_prefers_equal_buckets_then_larger():
+    a100, b100 = make_entry(num_buckets=100), make_entry(num_buckets=100)
+    a200, b200 = make_entry(num_buckets=200), make_entry(num_buckets=200)
+    a50 = make_entry(num_buckets=50)
+    ranked = JoinIndexRanker.rank([(a100, a50), (a100, b100),
+                                   (a200, b200), (a100, a200)])
+    assert (ranked[0][0].num_buckets, ranked[0][1].num_buckets) == (200, 200)
+    assert (ranked[1][0].num_buckets, ranked[1][1].num_buckets) == (100, 100)
+    # non-equal pairs last, larger total first
+    assert ranked[2][0].num_buckets + ranked[2][1].num_buckets >= \
+        ranked[3][0].num_buckets + ranked[3][1].num_buckets
+
+
+def test_rule_order_join_before_filter(session, tmp_path):
+    """Session plugs JoinIndexRule before FilterIndexRule (reference
+    `package.scala:23-34`)."""
+    session.enable_hyperspace()
+    from hyperspace_tpu.plan.rules.join_index import JoinIndexRule as J
+    from hyperspace_tpu.plan.rules.filter_index import FilterIndexRule as F
+    assert isinstance(session._rules[0], J)
+    assert isinstance(session._rules[1], F)
+    session.disable_hyperspace()
+    assert session._rules == []
+    assert not session.is_hyperspace_enabled

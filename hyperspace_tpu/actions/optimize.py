@@ -55,7 +55,6 @@ class OptimizeAction(CreateActionBase):
             entry.content.root = self.index_data_path
             entry.content.directories = []
             entry.extra = dict(entry.extra)
-            entry.extra.pop("deltaRoots", None)
             self._entry = entry
         return IndexLogEntry.from_dict(self._entry.to_dict())
 

@@ -1,0 +1,116 @@
+"""Incremental refresh — index only the appended source files.
+
+The surveyed reference has full rebuild only (`RefreshAction`); incremental
+refresh is its roadmap (`ROADMAP.md:66-75`) and this build's baseline
+ladder requires it. Semantics:
+
+- validate: state ACTIVE, and the stored source file set must be a SUBSET
+  of the current listing (appends only; deletions/rewrites need a full
+  refresh — surfaced in the error).
+- op: the new `v__=N+1` dir hard-links every bucket file of the previous
+  version (zero-copy on posix; falls back to copy), then the device build
+  pipeline indexes ONLY the appended files, writing per-bucket delta runs
+  with a `-delta` suffix into the same dir. Versions stay immutable +
+  self-contained; readers handle multi-run buckets natively (the batched
+  join sorts per-bucket ids, bucketed scans re-sort multi-run buckets).
+- `OptimizeAction` merge-compacts the runs back to one file per bucket.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.index.data_manager import IndexDataManager
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.actions.refresh import RefreshAction
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    try:
+        os.link(src, dst)
+    except OSError:
+        shutil.copy2(src, dst)
+
+
+class RefreshIncrementalAction(RefreshAction):
+    """REFRESHING -> ACTIVE, writing only an appended-data delta."""
+
+    def appended_files(self) -> List[str]:
+        """Current source listing minus the files captured at build time."""
+        previous = set(self.previous_entry.source_file_list())
+        current = []
+        from hyperspace_tpu.plan.nodes import Scan
+        for leaf in self.df.plan.collect_leaves():
+            if isinstance(leaf, Scan):
+                current.extend(leaf.files())
+        missing = previous - set(current)
+        if missing:
+            raise HyperspaceException(
+                "Incremental refresh supports appended data only; "
+                f"{len(missing)} indexed file(s) were deleted or rewritten "
+                "— run a full refresh. Missing: "
+                + ", ".join(sorted(missing)[:3]))
+        return [f for f in current if f not in previous]
+
+    def validate(self) -> None:
+        super().validate()
+        self.appended_files()  # raises on deletions
+        # A file rewritten in place keeps its path: verify the previously
+        # indexed files are byte-identical by recomputing the signature over
+        # exactly the stored file set.
+        from hyperspace_tpu.index.signature import SignatureProviderFactory
+        from hyperspace_tpu.plan.nodes import Scan
+        stored_sig = self.previous_entry.signature()
+        source_scan = None
+        for leaf in self.df.plan.collect_leaves():
+            if isinstance(leaf, Scan):
+                source_scan = leaf
+        restricted = Scan(source_scan.root_paths, source_scan.schema,
+                          files=sorted(self.previous_entry.source_file_list()))
+        provider = SignatureProviderFactory.create(stored_sig.provider)
+        if provider.signature(restricted) != stored_sig.value:
+            raise HyperspaceException(
+                "Incremental refresh supports appended data only; previously "
+                "indexed files were modified in place — run a full refresh.")
+
+    def op(self) -> None:
+        from hyperspace_tpu.engine.dataframe import DataFrame
+        from hyperspace_tpu.io import parquet
+        from hyperspace_tpu.io.builder import write_bucketed_batch
+        from hyperspace_tpu.engine.executor import execute_plan
+        from hyperspace_tpu.plan.nodes import Scan
+
+        out_dir = self.index_data_path
+        prev_root = self.previous_entry.content.root
+        os.makedirs(out_dir, exist_ok=True)
+        # Carry the previous version's runs forward (zero-copy links).
+        for _bucket, files in sorted(parquet.bucket_files(prev_root).items()):
+            for f in files:
+                _link_or_copy(f, os.path.join(out_dir, os.path.basename(f)))
+        spec_path = os.path.join(prev_root, parquet.BUCKET_SPEC_FILE)
+        if os.path.exists(spec_path):
+            _link_or_copy(spec_path,
+                          os.path.join(out_dir, parquet.BUCKET_SPEC_FILE))
+
+        appended = self.appended_files()
+        if not appended:
+            return  # metadata-only refresh (signature catches up)
+        cfg = self.index_config
+        source_scan = None
+        for leaf in self.df.plan.collect_leaves():
+            if isinstance(leaf, Scan):
+                source_scan = leaf
+        delta_scan = Scan(source_scan.root_paths, source_scan.schema,
+                          files=appended)
+        columns = cfg.indexed_columns + cfg.included_columns
+        batch = execute_plan(delta_scan, projection=columns)
+        delta_version = os.path.basename(out_dir).split("=")[-1]
+        write_bucketed_batch(batch, cfg.indexed_columns, self.num_buckets(),
+                             out_dir, file_suffix=f"delta{delta_version}")

@@ -22,6 +22,8 @@ INDEX_CACHE_EXPIRY_DURATION_SECONDS = (
     "spark.hyperspace.index.cache.expiryDurationInSeconds")
 INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
 
+HYBRID_SCAN_ENABLED = "spark.hyperspace.index.hybridscan.enabled"
+
 WAREHOUSE_PATH = "spark.hyperspace.warehouse.dir"
 WAREHOUSE_PATH_DEFAULT = "warehouse"
 

@@ -18,7 +18,7 @@ from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io import columnar, parquet
 from hyperspace_tpu.plan import expr as E
 from hyperspace_tpu.plan.nodes import (BucketSpec, Filter, Join, LogicalPlan,
-                                       Project, Scan)
+                                       Project, Scan, Union)
 from hyperspace_tpu.plan.schema import Schema
 
 
@@ -31,6 +31,13 @@ class PhysicalNode:
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         raise NotImplementedError
+
+    def execute_bucketed(self, num_buckets: int):
+        """Produce (batch concat'd in bucket order, per-bucket lengths) for
+        the batched bucketed join. Only meaningful on chains over a
+        bucketed scan."""
+        raise HyperspaceException(
+            f"{type(self).__name__} does not support bucketed execution.")
 
     def simple_string(self) -> str:
         return self.name
@@ -92,6 +99,32 @@ class ScanExec(PhysicalNode):
                 batch = sort_batch(batch, sort_cols)
         return batch
 
+    def execute_bucketed(self, num_buckets: int):
+        """Read all bucket files in bucket order; lengths come from parquet
+        metadata — no device work. (The batched join sorts per-bucket ids
+        itself, so multi-run buckets need no pre-sort here.)"""
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        if self.scan.bucket_spec is None:
+            raise HyperspaceException("Bucketed read on unbucketed scan.")
+        per_bucket = {}
+        for root in self.scan.root_paths:
+            for b, files in parquet.bucket_files(root).items():
+                per_bucket.setdefault(b, []).extend(files)
+        tables = []
+        lengths = np.zeros(num_buckets, dtype=np.int64)
+        for b in range(num_buckets):
+            for f in per_bucket.get(b, []):
+                t = pq.read_table(f, columns=self.columns)
+                lengths[b] += t.num_rows
+                tables.append(t)
+        if not tables:
+            return _empty_batch(self.out_schema), lengths
+        table = pa.concat_tables(tables, promote_options="default")
+        return columnar.from_arrow(table, self.out_schema), lengths
+
 
 class FilterExec(PhysicalNode):
     name = "Filter"
@@ -114,6 +147,27 @@ class FilterExec(PhysicalNode):
             return batch
         return apply_filter(batch, self.condition)
 
+    def execute_bucketed(self, num_buckets: int):
+        """Filter preserves bucket grouping: the compaction gather is
+        stable-ascending, so surviving rows stay in bucket order; new
+        per-bucket lengths are segment sums of the mask."""
+        import jax.numpy as jnp
+        import numpy as np
+        from hyperspace_tpu.engine.compiler import compile_predicate
+
+        batch, lengths = self.child.execute_bucketed(num_buckets)
+        if batch.num_rows == 0:
+            return batch, lengths
+        mask = compile_predicate(self.condition, batch)
+        host_mask = np.asarray(mask)
+        count = int(host_mask.sum())
+        (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
+        boundaries = np.concatenate([[0], np.cumsum(lengths)]).astype(int)
+        new_lengths = np.asarray(
+            [host_mask[boundaries[b]:boundaries[b + 1]].sum()
+             for b in range(num_buckets)], dtype=np.int64)
+        return batch.take(indices), new_lengths
+
 
 class ProjectExec(PhysicalNode):
     name = "Project"
@@ -131,6 +185,10 @@ class ProjectExec(PhysicalNode):
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         return self.child.execute(bucket).select(self.columns)
+
+    def execute_bucketed(self, num_buckets: int):
+        batch, lengths = self.child.execute_bucketed(num_buckets)
+        return batch.select(self.columns), lengths
 
 
 class ExchangeExec(PhysicalNode):
@@ -180,6 +238,29 @@ class SortExec(PhysicalNode):
         return sort_batch(batch, self.keys)
 
 
+class UnionExec(PhysicalNode):
+    name = "Union"
+
+    def __init__(self, children: Sequence[PhysicalNode]):
+        self._children = list(children)
+
+    @property
+    def children(self):
+        return list(self._children)
+
+    def simple_string(self) -> str:
+        return f"Union ({len(self._children)})"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        batches = [c.execute(bucket) for c in self._children]
+        non_empty = [b for b in batches if b.num_rows > 0]
+        if not non_empty:
+            return batches[0]
+        if len(non_empty) == 1:
+            return non_empty[0]
+        return columnar.concat_batches(non_empty)
+
+
 class SortMergeJoinExec(PhysicalNode):
     name = "SortMergeJoin"
 
@@ -207,24 +288,16 @@ class SortMergeJoinExec(PhysicalNode):
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         from hyperspace_tpu.ops.join import sort_merge_join
         if self.bucketed:
-            # Co-partitioned per-bucket merge joins: zero shuffle, zero
-            # global sort. Buckets are independent -> mesh-parallel in
-            # `parallel/join.py`.
-            results = []
-            for b in range(self.num_buckets):
-                lbatch = self.left.execute(bucket=b)
-                rbatch = self.right.execute(bucket=b)
-                if lbatch.num_rows == 0 or rbatch.num_rows == 0:
-                    continue
-                results.append(sort_merge_join(
-                    lbatch, rbatch, self.left_keys, self.right_keys,
-                    presorted=True))
-            if not results:
-                lempty = self.left.execute(bucket=0)
-                rempty = self.right.execute(bucket=0)
-                return sort_merge_join(lempty, rempty, self.left_keys,
-                                       self.right_keys, presorted=True)
-            return columnar.concat_batches(results)
+            # Co-partitioned bucket joins, batched into ONE compiled program
+            # (`ops/bucketed_join.py`): zero shuffle, zero global sort, no
+            # per-bucket compile explosion. Buckets are independent ->
+            # mesh-parallel in `parallel/join.py`.
+            from hyperspace_tpu.ops.bucketed_join import bucketed_sort_merge_join
+            lbatch, l_lengths = self.left.execute_bucketed(self.num_buckets)
+            rbatch, r_lengths = self.right.execute_bucketed(self.num_buckets)
+            return bucketed_sort_merge_join(lbatch, rbatch, l_lengths,
+                                            r_lengths, self.left_keys,
+                                            self.right_keys)
         lbatch = self.left.execute(bucket)
         rbatch = self.right.execute(bucket)
         # Children end in SortExec, so sides arrive key-sorted.
@@ -302,6 +375,15 @@ def plan_physical(plan: LogicalPlan,
         # Resolve names against the child schema but KEEP the declared order.
         resolved = [plan.child.schema.field(c).name for c in plan.columns]
         return ProjectExec(resolved, child)
+
+    if isinstance(plan, Union):
+        # Children may expose different column orders for the same names
+        # (index schema vs source schema): normalize through a Project.
+        wanted = _required_for(plan, required)
+        return UnionExec([
+            ProjectExec([c.schema.field(n).name for n in wanted],
+                        plan_physical(c, set(wanted)))
+            for c in plan.children])
 
     if isinstance(plan, Join):
         if plan.join_type != "inner":

@@ -67,8 +67,10 @@ class Hyperspace:
     def vacuum_index(self, index_name: str) -> None:
         self._manager.vacuum(index_name)
 
-    def refresh_index(self, index_name: str) -> None:
-        self._manager.refresh(index_name)
+    def refresh_index(self, index_name: str, mode: str = "full") -> None:
+        """mode='full' rebuilds (reference behavior); mode='incremental'
+        indexes only appended source files (reference roadmap, exceeded)."""
+        self._manager.refresh(index_name, mode)
 
     def optimize_index(self, index_name: str) -> None:
         """Merge-compact incremental deltas (extension; reference roadmap)."""

@@ -121,9 +121,18 @@ class IndexCollectionManager(IndexManager):
         log_manager, data_manager = self._managers(index_name)
         VacuumAction(log_manager, data_manager).run()
 
-    def refresh(self, index_name: str) -> None:
+    def refresh(self, index_name: str, mode: str = "full") -> None:
         log_manager, data_manager = self._managers(index_name)
-        RefreshAction(log_manager, data_manager, self.conf).run()
+        if mode == "full":
+            RefreshAction(log_manager, data_manager, self.conf).run()
+        elif mode == "incremental":
+            from hyperspace_tpu.actions.refresh_incremental import (
+                RefreshIncrementalAction)
+            RefreshIncrementalAction(log_manager, data_manager,
+                                     self.conf).run()
+        else:
+            raise HyperspaceException(
+                f"Unknown refresh mode: {mode} (use 'full' or 'incremental').")
 
     def optimize(self, index_name: str) -> None:
         log_manager, data_manager = self._managers(index_name)
@@ -218,9 +227,9 @@ class CachingIndexCollectionManager(IndexCollectionManager):
         self.clear_cache()
         super().vacuum(index_name)
 
-    def refresh(self, index_name: str) -> None:
+    def refresh(self, index_name: str, mode: str = "full") -> None:
         self.clear_cache()
-        super().refresh(index_name)
+        super().refresh(index_name, mode)
 
     def optimize(self, index_name: str) -> None:
         self.clear_cache()

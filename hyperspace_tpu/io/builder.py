@@ -28,8 +28,6 @@ import numpy as np
 import hyperspace_tpu.engine  # noqa: F401  (x64 config)
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io import columnar, parquet
-from hyperspace_tpu.ops.hash_partition import bucket_ids
-from hyperspace_tpu.ops.sort import bucket_boundaries, sort_permutation
 from hyperspace_tpu.plan.nodes import BucketSpec
 
 
@@ -38,13 +36,11 @@ def write_bucketed_batch(batch: columnar.ColumnBatch,
                          num_buckets: int, path: str,
                          file_suffix: Optional[str] = None) -> List[str]:
     """Steps 2-5: bucket + sort a device batch, write one file per bucket.
-    Returns the written file paths."""
-    ids = bucket_ids(batch, indexed_columns, num_buckets)
-    perm = sort_permutation(batch, indexed_columns, leading_keys=[ids])
-    sorted_batch = batch.take(perm)
-    import jax.numpy as jnp
-    sorted_ids = jnp.take(ids, perm)
-    starts, ends = bucket_boundaries(sorted_ids, num_buckets)
+    The hash/sort/gather pipeline runs as ONE jitted XLA program
+    (`ops/build.py`). Returns the written file paths."""
+    from hyperspace_tpu.ops.build import build_sorted
+    sorted_batch, starts, ends = build_sorted(batch, indexed_columns,
+                                              num_buckets)
     starts = np.asarray(starts)
     ends = np.asarray(ends)
 
@@ -77,23 +73,15 @@ def write_index(df, indexed_columns: Sequence[str],
 
 
 def compact_index(prev_entry, data_manager, out_path: str) -> List[str]:
-    """Merge-compact all current data versions (base + incremental deltas)
-    into one fully-sorted bucketed layout at `out_path` (OptimizeAction's
-    op; the reference has no compaction — its roadmap item, exceeded here).
-    Per bucket: read every run, concat on device, one stable sort by the
-    indexed columns, write a single file."""
+    """Merge-compact the current data version's runs (base + incremental
+    delta runs living side by side in one `v__=N` dir) into one
+    fully-sorted file per bucket at `out_path` (OptimizeAction's op; the
+    reference has no compaction — its roadmap item, exceeded here)."""
     from hyperspace_tpu.ops.sort import sort_batch
 
     indexed = prev_entry.indexed_columns
     num_buckets = prev_entry.num_buckets
-    roots = [prev_entry.content.root]
-    for extra_root in prev_entry.extra.get("deltaRoots", []):
-        if extra_root not in roots:
-            roots.append(extra_root)
-    per_bucket = {}
-    for root in roots:
-        for bucket, files in parquet.bucket_files(root).items():
-            per_bucket.setdefault(bucket, []).extend(files)
+    per_bucket = dict(parquet.bucket_files(prev_entry.content.root))
     if not per_bucket:
         raise HyperspaceException("No index data files found to compact.")
     schema = None

@@ -180,8 +180,21 @@ def from_arrow(table, schema: Optional[Schema] = None) -> ColumnBatch:
 
 
 def to_arrow(batch: ColumnBatch):
-    """Device ColumnBatch -> Arrow table (decodes dictionary codes)."""
+    """Device ColumnBatch -> Arrow table (decodes dictionary codes).
+
+    All device->host copies are issued asynchronously first so the
+    per-column transfers overlap (d2h latency dominates on tunneled
+    devices); the per-column np.asarray below then hits the ready copies.
+    """
     import pyarrow as pa
+
+    for col in batch.columns.values():
+        for arr in (col.data, col.validity):
+            if arr is not None and hasattr(arr, "copy_to_host_async"):
+                try:
+                    arr.copy_to_host_async()
+                except Exception:
+                    pass  # best-effort prefetch only
 
     arrays = []
     names = []
@@ -256,3 +269,39 @@ def unify_string_columns(a: DeviceColumn, b: DeviceColumn):
                             col.validity, merged, hashes)
 
     return remap(a, remap_a), remap(b, remap_b)
+
+
+def batch_to_tree(batch: ColumnBatch):
+    """ColumnBatch -> (jit-traversable pytree of device arrays, host aux).
+
+    The tree holds per-column {"data", "validity", "hash_hi", "hash_lo"}
+    (absent entries omitted so jit caching keys on structure); aux carries
+    the host-side dictionaries needed to rebuild the batch.
+    """
+    tree = {}
+    aux = {}
+    for f in batch.schema.fields:
+        col = batch.columns[f.name]
+        entry = {"data": col.data}
+        if col.validity is not None:
+            entry["validity"] = col.validity
+        if col.is_string:
+            entry["hash_hi"], entry["hash_lo"] = col.dict_hashes
+        tree[f.name] = entry
+        aux[f.name] = col.dictionary
+    return tree, aux
+
+
+def tree_to_batch(tree, schema: Schema, aux) -> ColumnBatch:
+    columns = {}
+    for f in schema.fields:
+        entry = tree[f.name]
+        dict_hashes = None
+        if "hash_hi" in entry:
+            dict_hashes = (entry["hash_hi"], entry["hash_lo"])
+        columns[f.name] = DeviceColumn(
+            data=entry["data"], dtype=f.dtype,
+            validity=entry.get("validity"),
+            dictionary=aux.get(f.name),
+            dict_hashes=dict_hashes)
+    return ColumnBatch(schema, columns)

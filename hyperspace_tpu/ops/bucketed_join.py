@@ -1,0 +1,213 @@
+"""Bucketed sort-merge join as ONE batched XLA program.
+
+The naive per-bucket Python loop dispatches a separately-compiled join per
+bucket — on a TPU each unique bucket shape is a fresh XLA compile. Here all
+buckets are joined in a single compiled program:
+
+1. key tuples of both sides are globally group-encoded to order-preserving
+   int32 ids (one joint `lax.sort` over 32-bit key lanes, `ops/keys.py`);
+2. each side is laid out as a padded [B, L] matrix (L = next power of two of
+   the largest bucket, so repeated queries reuse compiles), padding slots
+   carry id INT32_MAX;
+3. one batched `lax.sort` per side orders every bucket's ids (robust to
+   multi-run buckets from incremental refresh — no reliance on file order);
+4. a vmapped double `searchsorted` finds per-row match ranges; counts are
+   clamped to each bucket's valid length;
+5. after ONE host sync for the total match count, a second jitted program
+   expands (bucket, row, offset) -> original row index pairs.
+
+SQL null semantics ride the same sentinels as `ops/join.py`: left-null id
+-1, right-null id -2, padding +INT32_MAX — none ever equal.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import hyperspace_tpu._jax_config  # noqa: F401
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import (ColumnBatch, DeviceColumn,
+                                        unify_string_columns)
+from hyperspace_tpu.ops import keys as keymod
+
+_I32_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(4, (int(n) - 1).bit_length())
+
+
+def encode_group_ids(left: ColumnBatch, right: ColumnBatch,
+                     left_keys: Sequence[str], right_keys: Sequence[str]):
+    """Global order-preserving group ids over both sides' key tuples, with
+    null sentinels (-1 left / -2 right). Key columns are decomposed into
+    32-bit lanes so int64/float64 keys sort TPU-natively."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(left_keys) != len(right_keys) or not left_keys:
+        raise HyperspaceException("Join requires matching key column lists.")
+    n, m = left.num_rows, right.num_rows
+    lane_operands: List = []
+    l_valid = jnp.ones(n, dtype=bool)
+    r_valid = jnp.ones(m, dtype=bool)
+    for lk, rk in zip(left_keys, right_keys):
+        lcol, rcol = left.column(lk), right.column(rk)
+        if lcol.is_string != rcol.is_string:
+            raise HyperspaceException(f"Join key type mismatch: {lk} vs {rk}")
+        if lcol.is_string:
+            lcol, rcol = unify_string_columns(lcol, rcol)
+        if lcol.validity is not None:
+            l_valid = l_valid & lcol.validity
+        if rcol.validity is not None:
+            r_valid = r_valid & rcol.validity
+        ldata, rdata = lcol.data, rcol.data
+        if ldata.dtype != rdata.dtype:
+            common = jnp.promote_types(ldata.dtype, rdata.dtype)
+            ldata = ldata.astype(common)
+            rdata = rdata.astype(common)
+        llanes = keymod.key_lanes(ldata)
+        rlanes = keymod.key_lanes(rdata)
+        for ll, rl in zip(llanes, rlanes):
+            lane_operands.append(jnp.concatenate([ll, rl]))
+    return _encode_core(tuple(lane_operands), l_valid, r_valid, n)
+
+
+@partial(__import__("jax").jit, static_argnames=("n",))
+def _encode_core(lane_operands, l_valid, r_valid, n: int):
+    import jax
+    import jax.numpy as jnp
+
+    total = lane_operands[0].shape[0]
+    validity_key = jnp.concatenate([l_valid, r_valid])
+    iota = jnp.arange(total, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort([validity_key, *lane_operands, iota],
+                              num_keys=1 + len(lane_operands), is_stable=True)
+    perm = sorted_ops[-1]
+    keys_sorted = sorted_ops[:-1]
+    differs = jnp.zeros(total, dtype=jnp.int32)
+    for k in keys_sorted:
+        differs = differs | jnp.concatenate(
+            [jnp.zeros(1, dtype=jnp.int32),
+             (k[1:] != k[:-1]).astype(jnp.int32)])
+    group_sorted = jnp.cumsum(differs, dtype=jnp.int32)
+    groups = jnp.zeros(total, dtype=jnp.int32).at[perm].set(group_sorted)
+    l_ids = jnp.where(l_valid, groups[:n], jnp.int32(-1))
+    r_ids = jnp.where(r_valid, groups[n:], jnp.int32(-2))
+    return l_ids, r_ids
+
+
+def _padded_layout(lengths: np.ndarray, width: int):
+    """Host-side [B, width] gather matrix into a concat-in-bucket-order
+    array, plus validity. Padding slots point at row 0 (safe gather)."""
+    starts = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+    j = np.arange(width)[None, :]
+    valid = j < lengths[:, None]
+    idx = np.where(valid, starts[:, None] + np.minimum(j, np.maximum(
+        lengths[:, None] - 1, 0)), 0)
+    return idx.astype(np.int32), valid
+
+
+@partial(__import__("jax").jit, static_argnames=())
+def _match_core(l_ids, r_ids, l_idx, l_valid, r_idx, r_valid):
+    """Batched per-bucket match-range computation.
+
+    l_idx/l_valid: [B, Ll] gather matrix + mask; likewise right. Returns
+    (counts [B*Ll], starts [B*Ll], lo [B, Ll], l_pos [B, Ll], r_pos [B, Lr])
+    where l_pos/r_pos give, per bucket, the original padded-slot position of
+    each id-sorted element.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, Ll = l_idx.shape
+    Lr = r_idx.shape[1]
+    lid = jnp.where(l_valid, jnp.take(l_ids, l_idx), _I32_MAX)
+    rid = jnp.where(r_valid, jnp.take(r_ids, r_idx), _I32_MAX)
+
+    pos_l = jnp.broadcast_to(jnp.arange(Ll, dtype=jnp.int32), (B, Ll))
+    pos_r = jnp.broadcast_to(jnp.arange(Lr, dtype=jnp.int32), (B, Lr))
+    lid_s, l_pos = jax.lax.sort([lid, pos_l], num_keys=1, is_stable=True,
+                                dimension=1)
+    rid_s, r_pos = jax.lax.sort([rid, pos_r], num_keys=1, is_stable=True,
+                                dimension=1)
+
+    lo = jax.vmap(lambda r, l: jnp.searchsorted(r, l, side="left"))(rid_s, lid_s)
+    hi = jax.vmap(lambda r, l: jnp.searchsorted(r, l, side="right"))(rid_s, lid_s)
+    r_len = jnp.sum(r_valid, axis=1).astype(lo.dtype)  # valid (incl. null-id) rows sort before pads
+    lo_c = jnp.minimum(lo, r_len[:, None])
+    hi_c = jnp.minimum(hi, r_len[:, None])
+    counts = jnp.maximum(hi_c - lo_c, 0)
+    counts = jnp.where(lid_s == _I32_MAX, 0, counts)  # padding left rows
+    flat = counts.reshape(-1)
+    starts = jnp.cumsum(flat) - flat
+    return flat, starts, lo_c, l_pos, r_pos
+
+
+@partial(__import__("jax").jit, static_argnames=("total", "Ll"))
+def _expand_core(starts, lo_c, l_pos, r_pos, l_idx, r_idx,
+                 total: int, Ll: int):
+    import jax.numpy as jnp
+
+    slots = jnp.arange(total, dtype=starts.dtype)
+    row = jnp.searchsorted(starts, slots, side="right") - 1
+    b = (row // Ll).astype(jnp.int32)
+    i = (row % Ll).astype(jnp.int32)
+    offset = (slots - jnp.take(starts, row)).astype(jnp.int32)
+    l_slot = l_pos[b, i]
+    r_slot = r_pos[b, lo_c[b, i] + offset]
+    return l_idx[b, l_slot], r_idx[b, r_slot]
+
+
+def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
+                          l_lengths: np.ndarray, r_lengths: np.ndarray,
+                          left_keys: Sequence[str],
+                          right_keys: Sequence[str]) -> Tuple:
+    """Join row-index pairs for two sides stored concat-in-bucket-order with
+    the given per-bucket lengths. One host sync total."""
+    import jax.numpy as jnp
+
+    if left.num_rows == 0 or right.num_rows == 0:
+        empty = jnp.zeros(0, dtype=jnp.int32)
+        return empty, empty
+    l_ids, r_ids = encode_group_ids(left, right, left_keys, right_keys)
+    Ll = next_pow2(max(1, int(l_lengths.max(initial=0))))
+    Lr = next_pow2(max(1, int(r_lengths.max(initial=0))))
+    l_idx, l_valid = _padded_layout(np.asarray(l_lengths), Ll)
+    r_idx, r_valid = _padded_layout(np.asarray(r_lengths), Lr)
+    l_idx, l_valid = jnp.asarray(l_idx), jnp.asarray(l_valid)
+    r_idx, r_valid = jnp.asarray(r_idx), jnp.asarray(r_valid)
+
+    counts, starts, lo_c, l_pos, r_pos = _match_core(
+        l_ids, r_ids, l_idx, l_valid, r_idx, r_valid)
+    total = int(jnp.sum(counts))  # the one host sync
+    if total == 0:
+        empty = jnp.zeros(0, dtype=jnp.int32)
+        return empty, empty
+    return _expand_core(starts, lo_c, l_pos, r_pos, l_idx, r_idx,
+                        total, int(l_pos.shape[1]))
+
+
+def bucketed_sort_merge_join(left: ColumnBatch, right: ColumnBatch,
+                             l_lengths: np.ndarray, r_lengths: np.ndarray,
+                             left_keys: Sequence[str],
+                             right_keys: Sequence[str]) -> ColumnBatch:
+    """Full bucketed inner join over concat-in-bucket-order sides."""
+    from hyperspace_tpu.plan.schema import Field, Schema
+
+    li, ri = bucketed_join_indices(left, right, np.asarray(l_lengths),
+                                   np.asarray(r_lengths), left_keys,
+                                   right_keys)
+    left_out = left.take(li)
+    right_out = right.take(ri)
+    fields = list(left.schema.fields)
+    columns = dict(left_out.columns)
+    left_names = {f.name.lower() for f in fields}
+    for f in right.schema.fields:
+        name = f.name if f.name.lower() not in left_names else f.name + "_r"
+        fields.append(Field(name, f.dtype, f.nullable))
+        columns[name] = right_out.columns[f.name]
+    return ColumnBatch(Schema(fields), columns)

@@ -1,0 +1,97 @@
+"""Jitted index-build core: hash + bucket + sort + gather in ONE XLA program.
+
+The eager pipeline dispatches ~a dozen separately-compiled ops; on a TPU
+with remote compilation each unique (op, shape) costs a compile round-trip.
+Fusing the whole build into one `jax.jit` program makes the build one
+compile per (schema structure, row count) — and lets XLA fuse the hash mix,
+key-lane decomposition, sort, and payload gathers.
+
+Sort keys ride 32-bit lanes (`ops/keys.py`): int64/float64 keys become two
+native 32-bit operands instead of emulated 64-bit compares on the VPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import hyperspace_tpu._jax_config  # noqa: F401
+from hyperspace_tpu.io.columnar import (ColumnBatch, batch_to_tree,
+                                        tree_to_batch)
+from hyperspace_tpu.ops import keys as keymod
+
+
+def _tree_hash32(entry):
+    """uint32 value hash of one column tree entry (mirrors
+    `ops/hash_partition.column_hash32` on raw arrays)."""
+    import jax.numpy as jnp
+    from hyperspace_tpu.ops.hash_partition import _combine, _fmix32
+
+    data = entry["data"]
+    if "hash_hi" in entry:  # string: gather per-dictionary-entry hashes
+        h = _combine(_fmix32(jnp.take(entry["hash_hi"], data)),
+                     _fmix32(jnp.take(entry["hash_lo"], data)))
+    else:
+        lanes = keymod.key_lanes(data)
+        h = _fmix32(lanes[0].astype(jnp.uint32))
+        for lane in lanes[1:]:
+            h = _combine(h, _fmix32(lane.astype(jnp.uint32)))
+    if "validity" in entry:
+        h = jnp.where(entry["validity"], h, jnp.uint32(0))
+    return h
+
+
+def _entry_sort_lanes(entry):
+    lanes = []
+    if "validity" in entry:
+        lanes.append(entry["validity"])
+    lanes.extend(keymod.key_lanes(entry["data"]))
+    return lanes
+
+
+@partial(__import__("jax").jit,
+         static_argnames=("key_names", "num_buckets"))
+def _build_core(tree, key_names: Tuple[str, ...], num_buckets: int):
+    import jax
+    import jax.numpy as jnp
+
+    h = _tree_hash32(tree[key_names[0]])
+    for name in key_names[1:]:
+        from hyperspace_tpu.ops.hash_partition import _combine
+        h = _combine(h, _tree_hash32(tree[name]))
+    bucket = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+    n = bucket.shape[0]
+    operands = [bucket]
+    for name in key_names:
+        operands.extend(_entry_sort_lanes(tree[name]))
+    iota = jnp.arange(n, dtype=jnp.int32)
+    results = jax.lax.sort([*operands, iota], num_keys=len(operands),
+                           is_stable=True)
+    perm = results[-1]
+    sorted_bucket = results[0]
+
+    sorted_tree = {}
+    for name, entry in tree.items():
+        out = dict(entry)  # hash tables are dictionary-indexed: pass through
+        out["data"] = jnp.take(entry["data"], perm, axis=0)
+        if "validity" in entry:
+            out["validity"] = jnp.take(entry["validity"], perm, axis=0)
+        sorted_tree[name] = out
+
+    buckets = jnp.arange(num_buckets, dtype=jnp.int32)
+    starts = jnp.searchsorted(sorted_bucket, buckets, side="left")
+    ends = jnp.searchsorted(sorted_bucket, buckets, side="right")
+    return sorted_tree, sorted_bucket, starts, ends
+
+
+def build_sorted(batch: ColumnBatch, key_columns: Sequence[str],
+                 num_buckets: int):
+    """Bucket + lexicographically sort a batch by (bucket, *keys) in one
+    compiled program. Returns (sorted batch, starts, ends) with starts/ends
+    the per-bucket row ranges."""
+    key_names = tuple(batch.schema.field(c).name for c in key_columns)
+    tree, aux = batch_to_tree(batch)
+    sorted_tree, _sorted_bucket, starts, ends = _build_core(
+        tree, key_names, num_buckets)
+    return tree_to_batch(sorted_tree, batch.schema, aux), starts, ends

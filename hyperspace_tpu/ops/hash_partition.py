@@ -42,28 +42,27 @@ def _combine(h1, h2):
 
 
 def column_hash32(col: DeviceColumn):
-    """Per-row uint32 value hash of one column."""
-    import jax
+    """Per-row uint32 value hash of one column.
+
+    THE hash identity: fmix32 over the column's order-preserving 32-bit
+    lanes (`ops/keys.py`), combined left-to-right. Every path that assigns
+    buckets (this eager kernel, the jitted build core `ops/build.py`, the
+    mesh build `parallel/build.py`) MUST share it — on-disk bucket layout
+    depends on it.
+    """
     import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.keys import key_lanes
 
     if col.is_string:
         hi, lo = col.dict_hashes
         h = _combine(_fmix32(jnp.take(hi, col.data)),
                      _fmix32(jnp.take(lo, col.data)))
     else:
-        data = col.data
-        if data.dtype == jnp.float64:
-            data = jax.lax.bitcast_convert_type(data, jnp.int64)
-        elif data.dtype == jnp.float32:
-            data = jax.lax.bitcast_convert_type(data, jnp.int32)
-        if data.dtype == jnp.int64:
-            hi = (data >> 32).astype(jnp.uint32)
-            lo = (data & 0xFFFFFFFF).astype(jnp.uint32)
-            h = _combine(_fmix32(hi), _fmix32(lo))
-        elif data.dtype == jnp.bool_:
-            h = _fmix32(data.astype(jnp.uint32))
-        else:
-            h = _fmix32(data.astype(jnp.uint32))
+        lanes = key_lanes(col.data)
+        h = _fmix32(lanes[0].astype(jnp.uint32))
+        for lane in lanes[1:]:
+            h = _combine(h, _fmix32(lane.astype(jnp.uint32)))
     if col.validity is not None:
         h = jnp.where(col.validity, h, jnp.uint32(0))
     return h

@@ -1,0 +1,69 @@
+"""Sort-key transforms: everything becomes 32-bit lanes, order preserved.
+
+TPU VPU lanes are 32-bit; int64/float64 arithmetic is emulated. Sorting and
+hashing therefore decompose every key column into one or two 32-bit arrays
+whose lexicographic order equals the source order:
+
+- int64  -> (hi: int32 arithmetic-shift — sign order preserved,
+             lo: uint32 — unsigned order of the low word)
+- float64 -> order-preserving bit transform (negatives: all bits flipped;
+             positives: sign bit set) -> uint64 -> (hi, lo) uint32
+- float32 -> same transform -> one uint32
+- int32/int16/int8/bool/date32 -> one int32
+- string -> dictionary code (int32; order-preserving by construction)
+
+`ops/hash_partition.py` mixes the same lanes, so hashing and sorting share
+one decomposition.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import hyperspace_tpu._jax_config  # noqa: F401
+from hyperspace_tpu.io.columnar import DeviceColumn
+
+
+def _float_order_bits(data, int_dtype, uint_dtype, sign_bit):
+    """IEEE total-order transform: monotone map float -> unsigned int
+    (negatives flip all bits; positives set the sign bit)."""
+    import jax
+    import jax.numpy as jnp
+    bits = jax.lax.bitcast_convert_type(data, int_dtype).astype(uint_dtype)
+    sign = (bits >> (sign_bit - 1)) & uint_dtype(1)
+    mask = jnp.where(sign == 1, ~uint_dtype(0), uint_dtype(1) << (sign_bit - 1))
+    return bits ^ mask
+
+
+def key_lanes(data) -> List:
+    """Decompose one key array into order-preserving 32-bit lanes."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = data.dtype
+    if dtype == jnp.int64:
+        hi = (data >> 32).astype(jnp.int32)
+        lo = (data & 0xFFFFFFFF).astype(jnp.uint32)
+        return [hi, lo]
+    if dtype == jnp.float64:
+        bits = _float_order_bits(data, jnp.int64, jnp.uint64, 64)
+        return [(bits >> 32).astype(jnp.uint32),
+                (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)]
+    if dtype == jnp.float32:
+        return [_float_order_bits(data, jnp.int32, jnp.uint32, 32)]
+    if dtype == jnp.bool_:
+        return [data.astype(jnp.int32)]
+    if dtype in (jnp.int8, jnp.int16, jnp.int32):
+        return [data.astype(jnp.int32)]
+    if dtype == jnp.uint32:
+        return [data]
+    return [data]
+
+
+def column_sort_lanes(col: DeviceColumn) -> List:
+    """32-bit sort lanes for a column; validity (nulls-first) leads."""
+    lanes: List = []
+    if col.validity is not None:
+        lanes.append(col.validity)
+    lanes.extend(key_lanes(col.data))
+    return lanes

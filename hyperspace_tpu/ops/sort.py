@@ -21,12 +21,11 @@ from hyperspace_tpu.io.columnar import ColumnBatch
 
 
 def _key_operands(batch: ColumnBatch, by: Sequence[str]) -> List:
+    from hyperspace_tpu.ops.keys import column_sort_lanes
     operands = []
     for name in by:
-        col = batch.column(name)
-        if col.validity is not None:
-            operands.append(col.validity)  # False (null) sorts first
-        operands.append(col.data)
+        # 32-bit order-preserving lanes (validity first: nulls-first order).
+        operands.extend(column_sort_lanes(batch.column(name)))
     return operands
 
 

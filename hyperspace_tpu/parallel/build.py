@@ -1,0 +1,214 @@
+"""Mesh-sharded index build: the TPU-native replacement for the build-time
+shuffle.
+
+Reference equivalent: `df.repartition(numBuckets, indexedCols)` — a Spark
+block-shuffle exchange (`actions/CreateActionBase.scala:110-111`). Here the
+exchange is ONE `lax.all_to_all` over the mesh's ICI links inside
+`shard_map`, with MoE-style fixed per-peer capacity (XLA needs static
+shapes; ragged routing is expressed as capacity + validity masks, and
+overflow is detected exactly and retried with a larger capacity factor):
+
+per shard (local rows [Ls]):
+1. bucket id = murmur-mix(keys) % num_buckets       (32-bit lanes)
+2. dest shard = bucket % n_shards                   (bucket<->shard map)
+3. one local stable sort by dest groups rows per peer
+4. rows scatter into a [n_shards, capacity] send buffer; overflow beyond
+   capacity is counted (never silently dropped: the host retries)
+5. lax.all_to_all swaps peer slabs across the mesh -> each shard holds
+   exactly the rows of its buckets
+6. one local stable sort by (bucket, keys) orders every bucket run
+
+The host then writes each shard's buckets as bucketed parquet, identical
+layout to the single-chip path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+import hyperspace_tpu._jax_config  # noqa: F401
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import (ColumnBatch, batch_to_tree,
+                                        tree_to_batch)
+from hyperspace_tpu.ops import keys as keymod
+from hyperspace_tpu.ops.build import _entry_sort_lanes, _tree_hash32
+from hyperspace_tpu.parallel.mesh import SHARD_AXIS
+
+
+def _shard_step(tree, key_names: Tuple[str, ...], num_buckets: int,
+                n_shards: int, capacity: int):
+    """The per-shard body (runs under shard_map; local shapes)."""
+    import jax
+    import jax.numpy as jnp
+    from hyperspace_tpu.ops.hash_partition import _combine
+
+    row_valid = tree["__valid__"]
+    h = _tree_hash32(tree[key_names[0]])
+    for name in key_names[1:]:
+        h = _combine(h, _tree_hash32(tree[name]))
+    bucket = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+    dest = jnp.where(row_valid, bucket % n_shards, jnp.int32(n_shards))
+
+    n_local = dest.shape[0]
+    iota = jnp.arange(n_local, dtype=jnp.int32)
+    dest_sorted, perm = jax.lax.sort([dest, iota], num_keys=1, is_stable=True)
+
+    # Slot within the destination segment.
+    seg_start = jnp.searchsorted(
+        dest_sorted, jnp.arange(n_shards + 1, dtype=jnp.int32), side="left")
+    offset = jnp.arange(n_local, dtype=jnp.int32) - jnp.take(
+        seg_start, jnp.clip(dest_sorted, 0, n_shards))
+    keep = (offset < capacity) & (dest_sorted < n_shards)
+    overflow = jnp.sum((offset >= capacity) & (dest_sorted < n_shards))
+    slot = jnp.where(keep, dest_sorted * capacity + offset, n_shards * capacity)
+
+    def route(arr):
+        src = jnp.take(arr, perm, axis=0)
+        buf_shape = (n_shards * capacity + 1,) + src.shape[1:]
+        buf = jnp.zeros(buf_shape, dtype=src.dtype)
+        buf = buf.at[slot].set(src, mode="drop")
+        send = buf[:n_shards * capacity].reshape(
+            (n_shards, capacity) + src.shape[1:])
+        return jax.lax.all_to_all(send, SHARD_AXIS, split_axis=0,
+                                  concat_axis=0, tiled=False)
+
+    routed = {}
+    for name, entry in tree.items():
+        if name == "__valid__":
+            continue
+        out = dict(entry)
+        out["data"] = route(entry["data"]).reshape(-1, *entry["data"].shape[1:])
+        if "validity" in entry:
+            out["validity"] = route(entry["validity"]).reshape(-1)
+        routed[name] = out
+    # Unwritten send slots keep their zero-init => validity defaults False,
+    # so routing the raw validity/bucket arrays is sufficient (route()
+    # applies the dest-sort permutation internally).
+    recv_valid = route(row_valid).reshape(-1)
+    recv_bucket = route(bucket).reshape(-1)
+    recv_bucket = jnp.where(recv_valid, recv_bucket, num_buckets)
+
+    # Local order: (bucket, keys); invalid rows (bucket=num_buckets) last.
+    operands = [recv_bucket]
+    for name in key_names:
+        operands.extend(_entry_sort_lanes(routed[name]))
+    m = recv_bucket.shape[0]
+    iota2 = jnp.arange(m, dtype=jnp.int32)
+    results = jax.lax.sort([*operands, iota2], num_keys=len(operands),
+                           is_stable=True)
+    perm2 = results[-1]
+    sorted_bucket = results[0]
+    out_tree = {}
+    for name, entry in routed.items():
+        out = dict(entry)
+        out["data"] = jnp.take(entry["data"], perm2, axis=0)
+        if "validity" in entry:
+            out["validity"] = jnp.take(entry["validity"], perm2, axis=0)
+        out_tree[name] = out
+    out_tree["__valid__"] = {"data": jnp.take(recv_valid, perm2)}
+    out_tree["__bucket__"] = {"data": sorted_bucket}
+    out_tree["__overflow__"] = {"data": overflow.reshape(1)}
+    return out_tree
+
+
+def make_distributed_build_step(mesh, key_names: Tuple[str, ...],
+                                num_buckets: int, capacity: int):
+    """Compile the full mesh-sharded build step (jit of shard_map)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    n_shards = mesh.shape[SHARD_AXIS]
+
+    def spec_like(tree):
+        return jax.tree_util.tree_map(lambda _: P(SHARD_AXIS), tree)
+
+    def step(tree):
+        body = partial(_shard_step, key_names=key_names,
+                       num_buckets=num_buckets, n_shards=n_shards,
+                       capacity=capacity)
+        sharded = shard_map(body, mesh=mesh, in_specs=(spec_like(tree),),
+                            out_specs=P(SHARD_AXIS),
+                            check_vma=False)
+        return sharded(tree)
+
+    return jax.jit(step)
+
+
+def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
+                      num_buckets: int, mesh,
+                      capacity_factor: float = 2.0):
+    """Run the mesh-sharded build. Returns (sorted ColumnBatch of valid rows
+    in (shard, bucket, keys) order, per-bucket lengths np[num_buckets]).
+
+    Hash tables / dictionaries are replicated; row data is sharded on entry
+    (XLA moves the host arrays to the right chips). Exact overflow recovery:
+    if any shard overflowed its per-peer capacity, retry with 2x capacity.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_shards = mesh.shape[SHARD_AXIS]
+    key_names = tuple(batch.schema.field(c).name for c in key_columns)
+    n = batch.num_rows
+    local = -(-n // n_shards)  # ceil
+    padded = local * n_shards
+
+    tree, aux = batch_to_tree(batch)
+    # Pad rows to a multiple of the shard count; padding rows are invalid.
+    def pad(arr):
+        pad_width = [(0, padded - n)] + [(0, 0)] * (arr.ndim - 1)
+        return jnp.pad(arr, pad_width)
+
+    in_tree: Dict = {}
+    for name, entry in tree.items():
+        out = dict(entry)
+        out["data"] = pad(entry["data"])
+        if "validity" in entry:
+            out["validity"] = pad(entry["validity"])
+        # hash tables stay replicated: broadcast to per-shard copies
+        if "hash_hi" in entry:
+            out["hash_hi"] = jnp.tile(entry["hash_hi"], (n_shards, 1)).reshape(
+                n_shards * entry["hash_hi"].shape[0])
+            out["hash_lo"] = jnp.tile(entry["hash_lo"], (n_shards, 1)).reshape(
+                n_shards * entry["hash_lo"].shape[0])
+        in_tree[name] = out
+    in_tree["__valid__"] = jnp.concatenate(
+        [jnp.ones(n, dtype=bool), jnp.zeros(padded - n, dtype=bool)])
+
+    capacity = max(16, int(local / n_shards * capacity_factor))
+    while True:
+        step = make_distributed_build_step(mesh, key_names, num_buckets,
+                                           capacity)
+        out = step(in_tree)
+        overflow = int(jnp.sum(out["__overflow__"]["data"]))
+        if overflow == 0:
+            break
+        capacity *= 2  # exact recovery: nothing was lost, rerun wider
+
+    valid = np.asarray(out["__valid__"]["data"])
+    buckets = np.asarray(out["__bucket__"]["data"])
+    result_tree = {}
+    for name, entry in out.items():
+        if name.startswith("__"):
+            continue
+        cleaned = dict(entry)
+        if "hash_hi" in cleaned:
+            # restore single replicated hash tables
+            cleaned["hash_hi"] = tree[name]["hash_hi"]
+            cleaned["hash_lo"] = tree[name]["hash_lo"]
+        result_tree[name] = cleaned
+    full = tree_to_batch(result_tree, batch.schema, aux)
+
+    # Compact to valid rows on host indices (valid rows are contiguous per
+    # shard segment, ordered by bucket).
+    keep_idx = np.nonzero(valid)[0]
+    compacted = full.take(jnp.asarray(keep_idx))
+    kept_buckets = buckets[keep_idx]
+    lengths = np.bincount(kept_buckets, minlength=num_buckets).astype(np.int64)
+    order = np.argsort(kept_buckets, kind="stable")
+    final = compacted.take(jnp.asarray(order))
+    return final, lengths

@@ -1,0 +1,83 @@
+"""Mesh-sharded co-bucketed join.
+
+The single-chip batched bucket join (`ops/bucketed_join.py`) is already
+expressed over a leading bucket axis [B, L]; distributing it is a matter of
+SHARDING THAT AXIS over the mesh and letting XLA's SPMD partitioner place
+the per-bucket sorts and searchsorted lookups chip-locally — the jax-native
+"annotate shardings, let XLA insert collectives" recipe. Because bucket b of
+both sides lives on the same shard (bucket % n_shards), the match phase
+runs with ZERO inter-chip traffic; only the final ragged expansion
+all-gathers its (small) counts — the claim the JoinIndexRanker's
+equal-bucket preference encodes (reference
+`index/rankers/JoinIndexRanker.scala:40-55`).
+
+When bucket counts differ (the ranker's fallback), `rebucket` routes the
+smaller side through the build pipeline's all_to_all to the larger side's
+bucket count first — the "one-sided re-bucket" cost model.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import hyperspace_tpu._jax_config  # noqa: F401
+from hyperspace_tpu.io.columnar import ColumnBatch
+from hyperspace_tpu.ops.bucketed_join import (_match_core, _expand_core,
+                                              _padded_layout, encode_group_ids,
+                                              next_pow2)
+from hyperspace_tpu.parallel.mesh import SHARD_AXIS, replicated, shard_rows
+
+
+def distributed_bucketed_join_indices(
+        left: ColumnBatch, right: ColumnBatch,
+        l_lengths: np.ndarray, r_lengths: np.ndarray,
+        left_keys: Sequence[str], right_keys: Sequence[str], mesh) -> Tuple:
+    """As `ops.bucketed_join.bucketed_join_indices`, but with the padded
+    [B, L] forms sharded over the mesh's bucket axis. Requires num_buckets
+    divisible by the mesh size (the bucket<->shard map)."""
+    import jax
+    import jax.numpy as jnp
+
+    num_buckets = len(l_lengths)
+    n_shards = mesh.shape[SHARD_AXIS]
+    if num_buckets % n_shards != 0:
+        raise ValueError(
+            f"num_buckets ({num_buckets}) must be divisible by mesh size "
+            f"({n_shards}).")
+
+    l_ids, r_ids = encode_group_ids(left, right, left_keys, right_keys)
+    Ll = next_pow2(max(1, int(np.asarray(l_lengths).max(initial=0))))
+    Lr = next_pow2(max(1, int(np.asarray(r_lengths).max(initial=0))))
+    l_idx, l_valid = _padded_layout(np.asarray(l_lengths), Ll)
+    r_idx, r_valid = _padded_layout(np.asarray(r_lengths), Lr)
+
+    bucket_sharding = shard_rows(mesh)   # shard the bucket axis
+    repl = replicated(mesh)
+    put = jax.device_put
+    l_idx = put(jnp.asarray(l_idx), bucket_sharding)
+    l_valid = put(jnp.asarray(l_valid), bucket_sharding)
+    r_idx = put(jnp.asarray(r_idx), bucket_sharding)
+    r_valid = put(jnp.asarray(r_valid), bucket_sharding)
+    l_ids = put(l_ids, repl)
+    r_ids = put(r_ids, repl)
+
+    counts, starts, lo_c, l_pos, r_pos = _match_core(
+        l_ids, r_ids, l_idx, l_valid, r_idx, r_valid)
+    total = int(jnp.sum(counts))
+    if total == 0:
+        empty = jnp.zeros(0, dtype=jnp.int32)
+        return empty, empty
+    return _expand_core(starts, lo_c, l_pos, r_pos, l_idx, r_idx,
+                        total, Ll)
+
+
+def rebucket(batch: ColumnBatch, key_columns: Sequence[str],
+             target_buckets: int, mesh, capacity_factor: float = 2.0):
+    """One-sided re-bucket (mismatched bucket counts): route a batch to
+    `target_buckets` via the build pipeline's all_to_all. Returns
+    (batch in bucket order, lengths)."""
+    from hyperspace_tpu.parallel.build import distributed_build
+    return distributed_build(batch, key_columns, target_buckets, mesh,
+                             capacity_factor)

@@ -123,6 +123,9 @@ class Scan(LogicalPlan):
         self._schema = schema
         self.file_format = file_format
         self.bucket_spec = bucket_spec
+        # An EXPLICIT file list (hybrid scan / incremental deltas) restricts
+        # the scan and is part of its identity; a lazily-cached glob is not.
+        self._explicit_files = files is not None
         self._files = list(files) if files is not None else None
 
     @property
@@ -148,15 +151,19 @@ class Scan(LogicalPlan):
         return self._files
 
     def to_dict(self) -> dict:
-        return {"node": "scan", "rootPaths": list(self.root_paths),
-                "format": self.file_format,
-                "schema": [f.to_dict() for f in self._schema.fields],
-                "bucketSpec": self.bucket_spec.to_dict() if self.bucket_spec else None}
+        d = {"node": "scan", "rootPaths": list(self.root_paths),
+             "format": self.file_format,
+             "schema": [f.to_dict() for f in self._schema.fields],
+             "bucketSpec": self.bucket_spec.to_dict() if self.bucket_spec else None}
+        if self._explicit_files:
+            d["files"] = list(self._files)
+        return d
 
     def simple_string(self) -> str:
         bucket = f", buckets={self.bucket_spec.num_buckets}" if self.bucket_spec else ""
+        restrict = (f", files={len(self._files)}" if self._explicit_files else "")
         return (f"Scan {self.file_format} [{', '.join(self._schema.names)}] "
-                f"roots={self.root_paths}{bucket}")
+                f"roots={self.root_paths}{bucket}{restrict}")
 
 
 class Filter(LogicalPlan):
@@ -207,6 +214,39 @@ class Project(LogicalPlan):
 
     def simple_string(self) -> str:
         return f"Project [{', '.join(self.columns)}]"
+
+
+class Union(LogicalPlan):
+    """Row-wise union of same-schema children (column names must align).
+    Exists for Hybrid Scan: index data UNION appended source files."""
+
+    def __init__(self, children: Sequence[LogicalPlan]):
+        if not children:
+            raise HyperspaceException("Union requires at least one child.")
+        self._children = list(children)
+        names0 = [n.lower() for n in self._children[0].schema.names]
+        for c in self._children[1:]:
+            if [n.lower() for n in c.schema.names] != names0:
+                raise HyperspaceException(
+                    "Union children must share column names/order.")
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return list(self._children)
+
+    @property
+    def schema(self) -> Schema:
+        return self._children[0].schema
+
+    def with_children(self, children):
+        return Union(children)
+
+    def to_dict(self) -> dict:
+        return {"node": "union",
+                "children": [c.to_dict() for c in self._children]}
+
+    def simple_string(self) -> str:
+        return f"Union ({len(self._children)} children)"
 
 
 class Join(LogicalPlan):

@@ -52,19 +52,66 @@ class FilterIndexRule(Rule):
 
         index = self._find_covering_index(filt, scan, project_columns,
                                           filter_columns)
-        if index is None:
-            return node
+        if index is not None:
+            source: LogicalPlan = self.index_scan(index, bucketed=False)
+            logger.info("FilterIndexRule: applying index %s", index.name)
+        else:
+            source = self._hybrid_scan_source(filt, scan, project_columns,
+                                              filter_columns)
+            if source is None:
+                return node
 
-        new_scan = self.index_scan(index, bucketed=False)
-        rewritten: LogicalPlan = Filter(filt.condition, new_scan)
+        rewritten: LogicalPlan = Filter(filt.condition, source)
         if project is not None:
             rewritten = Project(project.columns, rewritten)
         else:
             # Bare Filter(Scan): restore the base relation's column order —
             # enabling indexes must not change result shape.
             rewritten = Project(scan.schema.names, rewritten)
-        logger.info("FilterIndexRule: applying index %s", index.name)
         return rewritten
+
+    def _hybrid_scan_source(self, filt: Filter, scan: Scan,
+                            project_columns: Sequence[str],
+                            filter_columns: Sequence[str]):
+        """Hybrid Scan (extension; reference roadmap): when the index covers
+        the columns but the source has grown since build time (stored file
+        set is a strict subset of the current listing), serve the query from
+        index data UNION the appended files — no refresh required. Gated on
+        `spark.hyperspace.index.hybridscan.enabled`."""
+        from hyperspace_tpu import constants
+        from hyperspace_tpu.plan.nodes import Union
+
+        if self.session.conf.get(constants.HYBRID_SCAN_ENABLED,
+                                 "false").lower() != "true":
+            return None
+        current = set(scan.files())
+        needed = ({c for c in filter_columns}
+                  | {c for c in project_columns})
+        for entry in self._active_indexes():
+            if not self._covers(entry, project_columns, filter_columns):
+                continue
+            stored = set(entry.source_file_list())
+            if not stored or not stored < current:
+                continue
+            # Path-set subset is not enough: a file rewritten IN PLACE keeps
+            # its path but changes content. Recompute the signature over a
+            # scan restricted to the stored files — it must equal the one
+            # captured at build time, proving those files are untouched.
+            restricted = Scan(scan.root_paths, scan.schema,
+                              files=sorted(stored))
+            if not self.signature_matches(entry, restricted):
+                continue
+            appended = sorted(current - stored)
+            index_scan = self.index_scan(entry, bucketed=False)
+            appended_scan = Scan(scan.root_paths, scan.schema,
+                                 files=appended)
+            needed_cols = [f.name for f in index_scan.schema.fields
+                           if f.name.lower() in {c.lower() for c in needed}]
+            logger.info("FilterIndexRule: hybrid scan with index %s "
+                        "(+%d appended files)", entry.name, len(appended))
+            return Union([Project(needed_cols, index_scan),
+                          Project(needed_cols, appended_scan)])
+        return None
 
     def _find_covering_index(self, filt: Filter, scan: Scan,
                              project_columns: Sequence[str],

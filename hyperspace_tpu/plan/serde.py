@@ -17,7 +17,7 @@ import json
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.plan.expr import Expression
 from hyperspace_tpu.plan.nodes import (BucketSpec, Filter, Join, LogicalPlan,
-                                       Project, Scan)
+                                       Project, Scan, Union)
 from hyperspace_tpu.plan.schema import Field, Schema
 
 
@@ -28,17 +28,21 @@ def plan_to_json(plan: LogicalPlan) -> str:
 def plan_from_dict(d: dict) -> LogicalPlan:
     node = d.get("node")
     if node == "scan":
-        # Root paths only; file listing is re-resolved lazily (fresh
-        # enumeration = refresh sees new data).
+        # Root paths only by default; the file listing is re-resolved lazily
+        # (fresh enumeration = refresh sees new data). An explicit "files"
+        # restriction (hybrid scan / delta scans) is preserved verbatim.
         return Scan(root_paths=d["rootPaths"],
                     schema=Schema([Field.from_dict(f) for f in d["schema"]]),
                     file_format=d.get("format", "parquet"),
-                    bucket_spec=BucketSpec.from_dict(d.get("bucketSpec")))
+                    bucket_spec=BucketSpec.from_dict(d.get("bucketSpec")),
+                    files=d.get("files"))
     if node == "filter":
         return Filter(Expression.from_dict(d["condition"]),
                       plan_from_dict(d["child"]))
     if node == "project":
         return Project(d["columns"], plan_from_dict(d["child"]))
+    if node == "union":
+        return Union([plan_from_dict(c) for c in d["children"]])
     if node == "join":
         return Join(plan_from_dict(d["left"]), plan_from_dict(d["right"]),
                     Expression.from_dict(d["condition"]),

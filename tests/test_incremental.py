@@ -1,0 +1,222 @@
+"""Incremental refresh, optimize (merge-compaction), and hybrid scan —
+the beyond-reference ladder items (BASELINE.md configs 4-5)."""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.facade import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col
+
+
+@pytest.fixture
+def env(tmp_path, sample_parquet):
+    conf = HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": 4,
+    })
+    session = HyperspaceSession(conf)
+    return session, Hyperspace(session), sample_parquet
+
+
+def append_rows(src, clicks_value=200, n=50, id_start=10_000):
+    rng = np.random.default_rng(11)
+    extra = pa.table({
+        "id": np.arange(id_start, id_start + n, dtype=np.int64),
+        "clicks": np.full(n, clicks_value, dtype=np.int32),
+        "score": rng.random(n),
+        "imprs": rng.integers(0, 10, n),
+        "query": pa.array(["qNEW"] * n),
+    })
+    pq.write_table(extra, os.path.join(
+        src, f"part-extra-{id_start}.parquet"))
+
+
+def test_incremental_refresh_links_and_deltas(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("inc", ["clicks"], ["id"]))
+    base_files = set(os.listdir(os.path.join(session.conf.system_path,
+                                             "inc", "v__=0")))
+
+    append_rows(src)
+    hs.refresh_index("inc", mode="incremental")
+
+    v1 = os.path.join(session.conf.system_path, "inc", "v__=1")
+    assert os.path.isdir(v1)
+    v1_files = set(os.listdir(v1))
+    # previous runs carried forward + delta runs added
+    assert base_files <= v1_files
+    assert any("delta1" in f for f in v1_files)
+
+    # queries over the new data served from the index
+    query = session.read_parquet(src).filter(col("clicks") == 200).select("id")
+    session.enable_hyperspace()
+    _, optimized, _ = query.explain_plans()
+    roots = [r for leaf in optimized.collect_leaves() for r in leaf.root_paths]
+    assert len(roots) == 1 and "v__=1" in roots[0]
+    assert query.count() == 50
+    session.disable_hyperspace()
+    assert query.count() == 50
+
+
+def test_incremental_refresh_join_still_correct(env):
+    """Multi-run buckets (base + delta) must join correctly — the batched
+    join re-sorts per-bucket ids, so file order must not matter."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("ja", ["imprs"], ["id"]))
+    hs.create_index(df, IndexConfig("jb", ["imprs"], ["score"]))
+    append_rows(src, clicks_value=7)
+    hs.refresh_index("ja", mode="incremental")
+    hs.refresh_index("jb", mode="incremental")
+
+    df2 = session.read_parquet(src)
+    query = df2.select("imprs", "id").join(df2.select("imprs", "score"),
+                                           on="imprs")
+    session.disable_hyperspace()
+    plain = query.to_pandas().sort_values(["imprs", "id", "score"]).reset_index(drop=True)
+    session.enable_hyperspace()
+    _, optimized, physical = query.explain_plans()
+    indexed = query.to_pandas().sort_values(["imprs", "id", "score"]).reset_index(drop=True)
+    session.disable_hyperspace()
+    names = [type(n).__name__ for n in physical.collect()]
+    assert names.count("ExchangeExec") == 0
+    pd.testing.assert_frame_equal(plain, indexed)
+
+
+def test_incremental_refresh_rejects_deletion(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("del", ["clicks"], ["id"]))
+    os.remove(sorted(glob.glob(os.path.join(src, "*.parquet")))[0])
+    with pytest.raises(HyperspaceException, match="full refresh"):
+        hs.refresh_index("del", mode="incremental")
+    # index remains usable state-wise (validation failed before begin)
+    assert list(hs.indexes()["state"]) == ["ACTIVE"]
+
+
+def test_optimize_compacts_delta_runs(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("opt", ["clicks"], ["id"]))
+    append_rows(src)
+    hs.refresh_index("opt", mode="incremental")
+    v1 = os.path.join(session.conf.system_path, "opt", "v__=1")
+    assert any("delta" in f for f in os.listdir(v1))
+
+    hs.optimize_index("opt")
+    v2 = os.path.join(session.conf.system_path, "opt", "v__=2")
+    assert os.path.isdir(v2)
+    files = [f for f in os.listdir(v2) if f.endswith(".parquet")]
+    assert files and not any("delta" in f for f in files)
+    # one file per non-empty bucket, each sorted
+    for f in files:
+        clicks = pq.read_table(os.path.join(v2, f)).column("clicks").to_pylist()
+        assert clicks == sorted(clicks)
+    # row totals preserved
+    total = sum(pq.read_table(os.path.join(v2, f)).num_rows for f in files)
+    assert total == session.read_parquet(src).count()
+    # queries use v__=2
+    query = session.read_parquet(src).filter(col("clicks") == 200).select("id")
+    session.enable_hyperspace()
+    _, optimized, _ = query.explain_plans()
+    session.disable_hyperspace()
+    roots = [r for leaf in optimized.collect_leaves() for r in leaf.root_paths]
+    assert "v__=2" in roots[0]
+
+
+def test_hybrid_scan(env):
+    """Stale index + appended files: with hybridscan enabled the filter is
+    served from index UNION appended — correct rows, no refresh."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("hyb", ["clicks"], ["id"]))
+    append_rows(src, clicks_value=42, n=30, id_start=20_000)
+
+    query = session.read_parquet(src).filter(col("clicks") == 42).select("id")
+    session.disable_hyperspace()
+    expected = query.to_pandas().sort_values("id").reset_index(drop=True)
+
+    index_loc = os.path.join(session.conf.system_path, "hyb")
+
+    # hybrid disabled: stale signature -> no rewrite
+    session.enable_hyperspace()
+    _, optimized, _ = query.explain_plans()
+    assert all(not r.startswith(index_loc)
+               for leaf in optimized.collect_leaves()
+               for r in leaf.root_paths)
+
+    session.conf.set("hyperspace.index.hybridscan.enabled", "true")
+    _, optimized, _ = query.explain_plans()
+    roots = [r for leaf in optimized.collect_leaves() for r in leaf.root_paths]
+    assert any(r.startswith(index_loc) for r in roots)       # index side
+    assert any(not r.startswith(index_loc) for r in roots)   # appended side
+    got = query.to_pandas().sort_values("id").reset_index(drop=True)
+    session.disable_hyperspace()
+    pd.testing.assert_frame_equal(expected, got)
+    assert (got["id"] >= 20_000).sum() == 30  # appended rows present
+
+
+def test_refresh_unknown_mode(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("m", ["clicks"], []))
+    with pytest.raises(HyperspaceException, match="mode"):
+        hs.refresh_index("m", mode="bogus")
+
+
+def test_hybrid_scan_rejects_inplace_rewrite(env):
+    """A source file rewritten in place (same path, new content) must NOT be
+    served from stale index data, even with hybrid scan enabled."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("hw", ["clicks"], ["id"]))
+    # rewrite part-0 in place AND append a new file
+    first = sorted(glob.glob(os.path.join(src, "*.parquet")))[0]
+    t = pq.read_table(first)
+    pq.write_table(t.slice(0, t.num_rows // 2), first)
+    append_rows(src, clicks_value=42, n=10, id_start=30_000)
+
+    session.conf.set("hyperspace.index.hybridscan.enabled", "true")
+    session.enable_hyperspace()
+    query = session.read_parquet(src).filter(col("clicks") == 42).select("id")
+    _, optimized, _ = query.explain_plans()
+    index_loc = os.path.join(session.conf.system_path, "hw")
+    assert all(not r.startswith(index_loc)
+               for leaf in optimized.collect_leaves()
+               for r in leaf.root_paths)
+    session.disable_hyperspace()
+
+
+def test_incremental_refresh_rejects_inplace_rewrite(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("iw", ["clicks"], ["id"]))
+    first = sorted(glob.glob(os.path.join(src, "*.parquet")))[0]
+    t = pq.read_table(first)
+    pq.write_table(t.slice(0, t.num_rows // 2), first)
+    append_rows(src, clicks_value=7, n=10, id_start=40_000)
+    with pytest.raises(HyperspaceException, match="full refresh"):
+        hs.refresh_index("iw", mode="incremental")
+
+
+def test_hybrid_plan_roundtrips_file_restriction(env):
+    """Scan file restrictions must survive plan serde (hybrid correctness)."""
+    from hyperspace_tpu.plan.nodes import Scan
+    from hyperspace_tpu.plan.schema import Field, Schema
+    from hyperspace_tpu.plan.serde import plan_from_json, plan_to_json
+    _session, _hs, src = env
+    files = sorted(glob.glob(os.path.join(src, "*.parquet")))[:1]
+    scan = Scan([src], Schema([Field("id", "int64")]), files=files)
+    restored = plan_from_json(plan_to_json(scan))
+    assert restored.files() == files

@@ -149,3 +149,30 @@ def test_join_null_string_keys():
     out = columnar.to_arrow(join.sort_merge_join(left, right, ["s"], ["s"]))
     pairs = sorted(zip(out.column("x").to_pylist(), out.column("y").to_pylist()))
     assert pairs == [(1, 30), (3, 20)]
+
+
+def test_bucketed_join_empty_side():
+    """An empty side must yield an empty join, not a crash."""
+    from hyperspace_tpu.ops.bucketed_join import bucketed_sort_merge_join
+    import pyarrow as _pa
+    left = columnar.from_arrow(_pa.table({
+        "k": _pa.array([], type=_pa.int64()),
+        "x": _pa.array([], type=_pa.int64())}))
+    right = batch_of(k=np.array([1, 2], np.int64), y=np.array([5, 6], np.int64))
+    out = bucketed_sort_merge_join(left, right, np.zeros(4, np.int64),
+                                   np.array([1, 1, 0, 0], np.int64),
+                                   ["k"], ["k"])
+    assert out.num_rows == 0
+    assert columnar.to_arrow(out).column_names == ["k", "x", "k_r", "y"]
+
+
+def test_float_hash_identity_shared_between_paths():
+    """Eager column_hash32 and the jitted build core must agree on float
+    keys — on-disk bucket layout depends on one shared hash identity."""
+    from hyperspace_tpu.ops.build import _tree_hash32
+    from hyperspace_tpu.io.columnar import batch_to_tree
+    b = batch_of(f=np.array([-1.5, 0.0, 2.25, 1e300], dtype=np.float64))
+    eager = np.asarray(hash_partition.column_hash32(b.column("f")))
+    tree, _ = batch_to_tree(b)
+    jitted = np.asarray(_tree_hash32(tree["f"]))
+    assert (eager == jitted).all()

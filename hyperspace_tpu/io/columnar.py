@@ -36,8 +36,16 @@ def _jnp():
 
 
 def _string_hash64(values: np.ndarray) -> np.ndarray:
-    """Vectorized FNV-1a 64-bit over utf-8 bytes of each value (host side,
-    once per dictionary entry — O(dictionary), not O(rows))."""
+    """FNV-1a 64-bit over utf-8 bytes of each value (host side, once per
+    dictionary entry — O(dictionary), not O(rows)). Uses the native C++
+    batch kernel when available (`hyperspace_tpu/native`); the Python loop
+    below is the reference implementation and fallback — both MUST produce
+    identical hashes (device bucket layout depends on them)."""
+    if len(values) >= 64:
+        from hyperspace_tpu import native
+        hashed = native.string_hash64(values)
+        if hashed is not None:
+            return hashed
     out = np.empty(len(values), dtype=np.uint64)
     fnv_offset = np.uint64(0xCBF29CE484222325)
     fnv_prime = np.uint64(0x100000001B3)
@@ -130,13 +138,53 @@ class ColumnBatch:
 
 
 def _encode_strings(values: np.ndarray):
-    """Sorted-unique dictionary encode; returns (codes int32, dictionary,
-    hashes uint64)."""
+    """Reference implementation of sorted-unique dictionary encoding over a
+    numpy array; `_encode_strings_arrow` is the production path and
+    `tests/test_columnar.py` asserts they agree (codes, dictionary, hashes).
+    Returns (codes int32, dictionary, hashes uint64, mask)."""
     import pandas as pd
     mask = ~np.asarray(pd.isna(values))
     filled = np.where(mask, values, "")
     dictionary, codes = np.unique(filled.astype(str), return_inverse=True)
     return codes.astype(np.int32), dictionary, _string_hash64(dictionary), mask
+
+
+def _encode_strings_arrow(arr):
+    """Arrow-native sorted-dictionary encode: dictionary_encode + dictionary
+    sort + code remap all run in Arrow C++; per-value hashing runs on the
+    packed Arrow buffers in the native library. Returns
+    (codes int32, dictionary np[str], hashes uint64, validity|None)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    if hasattr(arr, "combine_chunks"):
+        arr = arr.combine_chunks()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.chunk(0) if arr.num_chunks == 1 else pa.concat_arrays(
+            arr.chunks)
+    if pa.types.is_dictionary(arr.type):
+        # Incoming dictionaries may hold duplicates or nulls; decode and
+        # re-encode so the sorted-unique invariants hold.
+        arr = arr.cast(pa.string())
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+        arr = arr.fill_null("")
+    encoded = pc.dictionary_encode(arr)
+    raw_dict = encoded.dictionary
+    indices = encoded.indices.to_numpy(zero_copy_only=False).astype(np.int32)
+    sort_idx = pc.sort_indices(raw_dict).to_numpy().astype(np.int32)
+    rank = np.empty(len(raw_dict), dtype=np.int32)
+    rank[sort_idx] = np.arange(len(raw_dict), dtype=np.int32)
+    codes = rank[indices]
+    sorted_dict = raw_dict.take(pa.array(sort_idx))
+    from hyperspace_tpu import native
+    hashes = native.arrow_string_hash64(sorted_dict)
+    dictionary = np.asarray(sorted_dict.to_numpy(zero_copy_only=False),
+                            dtype=str)
+    if hashes is None:
+        hashes = _string_hash64(dictionary)
+    return codes, dictionary, hashes, validity
 
 
 def from_arrow(table, schema: Optional[Schema] = None) -> ColumnBatch:
@@ -150,11 +198,10 @@ def from_arrow(table, schema: Optional[Schema] = None) -> ColumnBatch:
     for f in schema.fields:
         arr = table.column(f.name)
         if f.dtype == "string":
-            np_vals = arr.to_pandas().to_numpy(dtype=object)
-            codes, dictionary, hashes, mask = _encode_strings(np_vals)
+            codes, dictionary, hashes, validity = _encode_strings_arrow(arr)
             columns[f.name] = DeviceColumn(
                 data=jnp.asarray(codes), dtype="string",
-                validity=(jnp.asarray(mask) if not bool(mask.all()) else None),
+                validity=(jnp.asarray(validity) if validity is not None else None),
                 dictionary=dictionary,
                 dict_hashes=_split_hashes(hashes))
         else:

@@ -1,0 +1,105 @@
+"""Loader for the native host library (ctypes, no pybind11).
+
+The shared library is built from `hyperspace_host.cpp` on first use (g++ is
+part of the toolchain); every native entry point has a pure-Python fallback,
+so a missing compiler only costs performance, never correctness.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "libhyperspace_host.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    src = os.path.join(_HERE, "hyperspace_host.cpp")
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+             "-o", _SO_PATH, src],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as exc:
+        logger.warning("Native host library build failed (falling back to "
+                       "Python): %s", exc)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            for suffix, off_t in (("i32", ctypes.c_int32),
+                                  ("i64", ctypes.c_int64)):
+                fn = getattr(lib, f"fnv1a64_batch_{suffix}")
+                fn.restype = None
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_int64, ctypes.c_void_p]
+            _lib = lib
+        except OSError as exc:
+            logger.warning("Native host library load failed: %s", exc)
+        return _lib
+
+
+def arrow_string_hash64(arr) -> Optional["numpy.ndarray"]:
+    """FNV-1a 64 over each element of an Arrow string array, operating
+    directly on its packed offset/data buffers (zero per-value Python).
+    Returns None if the library is unavailable or the array has nulls."""
+    import numpy as np
+    import pyarrow as pa
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    if hasattr(arr, "combine_chunks"):
+        arr = arr.combine_chunks()
+    if arr.null_count:
+        return None
+    large = pa.types.is_large_string(arr.type)
+    buffers = arr.buffers()  # [validity, offsets, data]
+    offsets_buf, data_buf = buffers[1], buffers[2]
+    off_dtype = np.int64 if large else np.int32
+    # Offset values index the shared data buffer absolutely, so a sliced
+    # array only shifts where we START reading the offsets buffer.
+    offsets = np.frombuffer(offsets_buf, dtype=off_dtype, count=len(arr) + 1,
+                            offset=arr.offset * np.dtype(off_dtype).itemsize)
+    out = np.empty(len(arr), dtype=np.uint64)
+    data_ptr = data_buf.address if data_buf is not None else 0
+    fn = lib.fnv1a64_batch_i64 if large else lib.fnv1a64_batch_i32
+    fn(ctypes.c_void_p(data_ptr),
+       offsets.ctypes.data_as(ctypes.c_void_p),
+       ctypes.c_int64(len(arr)),
+       out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def string_hash64(values) -> Optional["numpy.ndarray"]:
+    """FNV-1a 64 over a numpy array of strings (U-dtype fast path avoids
+    per-value Python objects). None when the native library is missing."""
+    import numpy as np
+    import pyarrow as pa
+
+    if get_lib() is None:
+        return None
+    values = np.asarray(values)
+    if values.dtype.kind != "U":
+        values = values.astype(object)
+    return arrow_string_hash64(pa.array(values, type=pa.string()))

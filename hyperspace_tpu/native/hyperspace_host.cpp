@@ -1,0 +1,46 @@
+// Native host glue for hyperspace_tpu.
+//
+// The reference delegates host-side heavy lifting to Spark's JVM engine;
+// this framework's host path is Python + pyarrow, with the per-value
+// dictionary hashing (the one O(values * bytes) pure-Python loop) done
+// here. Exposed via a plain C ABI and loaded with ctypes — no pybind11
+// dependency.
+//
+// Functions operate on Arrow string-array layout: a contiguous UTF-8 data
+// buffer plus (n+1) int offsets.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// FNV-1a 64-bit over each of n strings; identical to the Python
+// implementation in io/columnar.py (_string_hash64) — the device bucket
+// layout depends on this exact hash.
+void fnv1a64_batch_i32(const uint8_t* data, const int32_t* offsets,
+                       int64_t n, uint64_t* out) {
+    const uint64_t kOffset = 0xCBF29CE484222325ULL;
+    const uint64_t kPrime = 0x100000001B3ULL;
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t h = kOffset;
+        for (int32_t j = offsets[i]; j < offsets[i + 1]; ++j) {
+            h = (h ^ data[j]) * kPrime;
+        }
+        out[i] = h;
+    }
+}
+
+void fnv1a64_batch_i64(const uint8_t* data, const int64_t* offsets,
+                       int64_t n, uint64_t* out) {
+    const uint64_t kOffset = 0xCBF29CE484222325ULL;
+    const uint64_t kPrime = 0x100000001B3ULL;
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t h = kOffset;
+        for (int64_t j = offsets[i]; j < offsets[i + 1]; ++j) {
+            h = (h ^ data[j]) * kPrime;
+        }
+        out[i] = h;
+    }
+}
+
+}  // extern "C"

@@ -21,24 +21,24 @@ from hyperspace_tpu.io.columnar import (ColumnBatch, batch_to_tree,
 from hyperspace_tpu.ops import keys as keymod
 
 
-def _tree_hash32(entry):
-    """uint32 value hash of one column tree entry (mirrors
-    `ops/hash_partition.column_hash32` on raw arrays)."""
+def _tree_hash_lanes(entry):
+    """Hash-input lanes of one column tree entry (mirrors
+    `ops/hash_partition.column_hash_lanes` on raw arrays): strings gather
+    their dictionary value hashes; numerics decompose into 32-bit key
+    lanes; null rows contribute all-zero lanes."""
     import jax.numpy as jnp
-    from hyperspace_tpu.ops.hash_partition import _combine, _fmix32
 
     data = entry["data"]
-    if "hash_hi" in entry:  # string: gather per-dictionary-entry hashes
-        h = _combine(_fmix32(jnp.take(entry["hash_hi"], data)),
-                     _fmix32(jnp.take(entry["hash_lo"], data)))
+    if "hash_hi" in entry:
+        lanes = [jnp.take(entry["hash_hi"], data),
+                 jnp.take(entry["hash_lo"], data)]
     else:
-        lanes = keymod.key_lanes(data)
-        h = _fmix32(lanes[0].astype(jnp.uint32))
-        for lane in lanes[1:]:
-            h = _combine(h, _fmix32(lane.astype(jnp.uint32)))
+        lanes = [lane.astype(jnp.uint32)
+                 for lane in keymod.key_lanes(data)]
     if "validity" in entry:
-        h = jnp.where(entry["validity"], h, jnp.uint32(0))
-    return h
+        lanes = [jnp.where(entry["validity"], lane, jnp.uint32(0))
+                 for lane in lanes]
+    return lanes
 
 
 def _entry_sort_lanes(entry):
@@ -49,17 +49,41 @@ def _entry_sort_lanes(entry):
     return lanes
 
 
+def _tree_bucket_ids(tree, key_names: Tuple[str, ...], num_buckets: int,
+                     use_pallas: bool):
+    """Per-row bucket ids over the FLAT lane chain (the one shared hash
+    identity, `ops/hash_partition.flat_hash32`) — the Pallas kernel and the
+    jnp fold are bit-identical by construction."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.hash_partition import flat_hash32
+    from hyperspace_tpu.ops.pallas.hash_kernel import hash_lanes_to_buckets
+
+    lanes = []
+    for name in key_names:
+        lanes.extend(_tree_hash_lanes(tree[name]))
+    if use_pallas:
+        return hash_lanes_to_buckets(lanes, num_buckets)
+    h = flat_hash32(lanes)
+    return (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+
+def _pallas_enabled() -> bool:
+    import os
+
+    from hyperspace_tpu.ops.pallas.hash_kernel import pallas_available
+    return (os.environ.get("HYPERSPACE_PALLAS", "1") == "1"
+            and pallas_available())
+
+
 @partial(__import__("jax").jit,
-         static_argnames=("key_names", "num_buckets"))
-def _build_core(tree, key_names: Tuple[str, ...], num_buckets: int):
+         static_argnames=("key_names", "num_buckets", "use_pallas"))
+def _build_core(tree, key_names: Tuple[str, ...], num_buckets: int,
+                use_pallas: bool = False):
     import jax
     import jax.numpy as jnp
 
-    h = _tree_hash32(tree[key_names[0]])
-    for name in key_names[1:]:
-        from hyperspace_tpu.ops.hash_partition import _combine
-        h = _combine(h, _tree_hash32(tree[name]))
-    bucket = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+    bucket = _tree_bucket_ids(tree, key_names, num_buckets, use_pallas)
 
     n = bucket.shape[0]
     operands = [bucket]
@@ -92,6 +116,8 @@ def build_sorted(batch: ColumnBatch, key_columns: Sequence[str],
     the per-bucket row ranges."""
     key_names = tuple(batch.schema.field(c).name for c in key_columns)
     tree, aux = batch_to_tree(batch)
+    # The flag is a STATIC jit arg: toggling HYPERSPACE_PALLAS between
+    # calls selects a different cached executable instead of being baked in.
     sorted_tree, _sorted_bucket, starts, ends = _build_core(
-        tree, key_names, num_buckets)
+        tree, key_names, num_buckets, use_pallas=_pallas_enabled())
     return tree_to_batch(sorted_tree, batch.schema, aux), starts, ends

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import List, Sequence
 
+import hyperspace_tpu._jax_config  # noqa: F401
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io.columnar import ColumnBatch, DeviceColumn
 
@@ -41,41 +42,54 @@ def _combine(h1, h2):
     return h1 ^ (h2 + jnp.uint32(0x9E3779B9) + (h1 << 6) + (h1 >> 2))
 
 
-def column_hash32(col: DeviceColumn):
-    """Per-row uint32 value hash of one column.
-
-    THE hash identity: fmix32 over the column's order-preserving 32-bit
-    lanes (`ops/keys.py`), combined left-to-right. Every path that assigns
-    buckets (this eager kernel, the jitted build core `ops/build.py`, the
-    mesh build `parallel/build.py`) MUST share it — on-disk bucket layout
-    depends on it.
-    """
+def column_hash_lanes(col: DeviceColumn) -> List:
+    """The column's hash-input lanes: uint32 arrays, one value hash input
+    per lane. Strings contribute their gathered per-dictionary-entry value
+    hashes (hi, lo); numerics their order-preserving 32-bit key lanes.
+    Null rows contribute all-zero lanes."""
     import jax.numpy as jnp
 
     from hyperspace_tpu.ops.keys import key_lanes
 
     if col.is_string:
         hi, lo = col.dict_hashes
-        h = _combine(_fmix32(jnp.take(hi, col.data)),
-                     _fmix32(jnp.take(lo, col.data)))
+        lanes = [jnp.take(hi, col.data), jnp.take(lo, col.data)]
     else:
-        lanes = key_lanes(col.data)
-        h = _fmix32(lanes[0].astype(jnp.uint32))
-        for lane in lanes[1:]:
-            h = _combine(h, _fmix32(lane.astype(jnp.uint32)))
+        lanes = [lane.astype(jnp.uint32) for lane in key_lanes(col.data)]
     if col.validity is not None:
-        h = jnp.where(col.validity, h, jnp.uint32(0))
+        lanes = [jnp.where(col.validity, lane, jnp.uint32(0))
+                 for lane in lanes]
+    return lanes
+
+
+def flat_hash32(lanes: Sequence):
+    """THE hash identity: fmix32 of the first lane, then hash-combine of
+    each further lane's fmix32, over the FLAT concatenation of all key
+    columns' lanes in key order. Every path that assigns buckets (this
+    eager kernel, the jitted build core `ops/build.py`, the Pallas kernel
+    `ops/pallas/hash_kernel.py`, the mesh build `parallel/build.py`) MUST
+    share it — on-disk bucket layout depends on it."""
+    import jax.numpy as jnp
+
+    h = _fmix32(lanes[0].astype(jnp.uint32))
+    for lane in lanes[1:]:
+        h = _combine(h, _fmix32(lane.astype(jnp.uint32)))
     return h
+
+
+def column_hash32(col: DeviceColumn):
+    """Per-row uint32 value hash of one column (flat identity)."""
+    return flat_hash32(column_hash_lanes(col))
 
 
 def batch_hash32(batch: ColumnBatch, key_columns: Sequence[str]):
     """Combined per-row uint32 hash over the key columns, in order."""
     if not key_columns:
         raise HyperspaceException("Hash partitioning requires key columns.")
-    h = column_hash32(batch.column(key_columns[0]))
-    for name in key_columns[1:]:
-        h = _combine(h, column_hash32(batch.column(name)))
-    return h
+    lanes: List = []
+    for name in key_columns:
+        lanes.extend(column_hash_lanes(batch.column(name)))
+    return flat_hash32(lanes)
 
 
 def bucket_ids(batch: ColumnBatch, key_columns: Sequence[str],

@@ -1,0 +1,4 @@
+from hyperspace_tpu.ops.pallas.hash_kernel import (hash_lanes_to_buckets,
+                                                   pallas_available)
+
+__all__ = ["hash_lanes_to_buckets", "pallas_available"]

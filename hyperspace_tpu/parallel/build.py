@@ -34,7 +34,7 @@ from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io.columnar import (ColumnBatch, batch_to_tree,
                                         tree_to_batch)
 from hyperspace_tpu.ops import keys as keymod
-from hyperspace_tpu.ops.build import _entry_sort_lanes, _tree_hash32
+from hyperspace_tpu.ops.build import _entry_sort_lanes, _tree_hash_lanes
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS
 
 
@@ -43,12 +43,13 @@ def _shard_step(tree, key_names: Tuple[str, ...], num_buckets: int,
     """The per-shard body (runs under shard_map; local shapes)."""
     import jax
     import jax.numpy as jnp
-    from hyperspace_tpu.ops.hash_partition import _combine
+    from hyperspace_tpu.ops.hash_partition import flat_hash32
 
     row_valid = tree["__valid__"]
-    h = _tree_hash32(tree[key_names[0]])
-    for name in key_names[1:]:
-        h = _combine(h, _tree_hash32(tree[name]))
+    lanes = []
+    for name in key_names:
+        lanes.extend(_tree_hash_lanes(tree[name]))
+    h = flat_hash32(lanes)  # the one shared hash identity
     bucket = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
     dest = jnp.where(row_valid, bucket % n_shards, jnp.int32(n_shards))
 

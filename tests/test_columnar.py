@@ -93,3 +93,62 @@ def test_select_case_insensitive():
     batch = columnar.from_arrow(sample_table())
     sub = batch.select(["I64", "S"])
     assert sub.schema.names == ["i64", "s"]
+
+
+def test_arrow_encode_matches_reference_impl():
+    """Production arrow-native encoding must agree with the numpy reference
+    implementation on codes, dictionary order, and hashes."""
+    from hyperspace_tpu.io.columnar import (_encode_strings,
+                                            _encode_strings_arrow)
+    values = ["pear", "apple", None, "pear", "", "zebra", "apple"]
+    arr = pa.array(values, type=pa.string())
+    codes_a, dict_a, hashes_a, validity_a = _encode_strings_arrow(arr)
+    codes_r, dict_r, hashes_r, mask_r = _encode_strings(
+        np.array(values, dtype=object))
+    assert list(dict_a) == list(dict_r)
+    assert list(codes_a) == list(codes_r)
+    assert list(hashes_a) == list(hashes_r)
+    assert list(validity_a) == list(mask_r)
+
+
+def test_dictionary_typed_input_with_duplicates_and_nulls():
+    """Dictionary-typed arrow columns with duplicate or null dictionary
+    entries must be normalized (equal values -> equal codes)."""
+    dict_arr = pa.DictionaryArray.from_arrays(
+        pa.array([0, 1, 2, 3], type=pa.int32()),
+        pa.array(["x", "x", None, "y"]))
+    batch = columnar.from_arrow(pa.table({"s": dict_arr}))
+    col = batch.column("s")
+    codes = np.asarray(col.data)
+    assert codes[0] == codes[1]  # both "x"
+    assert col.validity is not None
+    assert list(np.asarray(col.validity)) == [True, True, False, True]
+    out = columnar.to_arrow(batch)
+    assert out.column("s").to_pylist() == ["x", "x", None, "y"]
+
+
+def test_multicolumn_two_lane_hash_consistency():
+    """All bucket-assignment paths must agree for multi-column keys where a
+    non-first column has two lanes (int64/string) — the flat-lane identity."""
+    from hyperspace_tpu.io.columnar import batch_to_tree
+    from hyperspace_tpu.ops.build import _tree_bucket_ids
+    from hyperspace_tpu.ops.hash_partition import bucket_ids
+    from hyperspace_tpu.ops.pallas.hash_kernel import hash_lanes_to_buckets
+    from hyperspace_tpu.ops.build import _tree_hash_lanes
+
+    rng = np.random.default_rng(3)
+    table = pa.table({
+        "a": rng.integers(0, 100, 500).astype(np.int32),
+        "b": rng.integers(-2**60, 2**60, 500).astype(np.int64),
+        "s": pa.array([f"v{int(x)}" for x in rng.integers(0, 30, 500)]),
+    })
+    batch = columnar.from_arrow(table)
+    keys = ["a", "b", "s"]
+    eager = np.asarray(bucket_ids(batch, keys, 16))
+    tree, _ = batch_to_tree(batch)
+    jnp_path = np.asarray(_tree_bucket_ids(tree, tuple(keys), 16,
+                                           use_pallas=False))
+    lanes = [lane for k in keys for lane in _tree_hash_lanes(tree[k])]
+    pallas_path = np.asarray(hash_lanes_to_buckets(lanes, 16, interpret=True))
+    assert (eager == jnp_path).all()
+    assert (eager == pallas_path).all()

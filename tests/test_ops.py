@@ -169,10 +169,11 @@ def test_bucketed_join_empty_side():
 def test_float_hash_identity_shared_between_paths():
     """Eager column_hash32 and the jitted build core must agree on float
     keys — on-disk bucket layout depends on one shared hash identity."""
-    from hyperspace_tpu.ops.build import _tree_hash32
+    from hyperspace_tpu.ops.build import _tree_hash_lanes
+    from hyperspace_tpu.ops.hash_partition import flat_hash32
     from hyperspace_tpu.io.columnar import batch_to_tree
     b = batch_of(f=np.array([-1.5, 0.0, 2.25, 1e300], dtype=np.float64))
     eager = np.asarray(hash_partition.column_hash32(b.column("f")))
     tree, _ = batch_to_tree(b)
-    jitted = np.asarray(_tree_hash32(tree["f"]))
+    jitted = np.asarray(flat_hash32(_tree_hash_lanes(tree["f"])))
     assert (eager == jitted).all()

@@ -11,7 +11,8 @@ from typing import List, Optional, Sequence, Union
 
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.plan import expr as E
-from hyperspace_tpu.plan.nodes import Filter, Join, LogicalPlan, Project
+from hyperspace_tpu.plan.nodes import (Aggregate, AggSpec, Filter, Join,
+                                       Limit, LogicalPlan, Project, Sort)
 from hyperspace_tpu.plan.schema import Schema
 
 
@@ -59,6 +60,21 @@ class DataFrame:
         return DataFrame(Join(self.plan, other.plan, condition, how),
                          self.session)
 
+    def sort(self, *columns: str) -> "DataFrame":
+        return DataFrame(Sort(list(columns), self.plan), self.session)
+
+    order_by = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(Limit(n, self.plan), self.session)
+
+    def group_by(self, *columns: str) -> "GroupedData":
+        return GroupedData(self, list(columns))
+
+    def agg(self, *specs, **named) -> "DataFrame":
+        """Global aggregation (no grouping); see GroupedData.agg."""
+        return GroupedData(self, []).agg(*specs, **named)
+
     # -- actions (execute) ------------------------------------------------
 
     def _optimized_plan(self) -> LogicalPlan:
@@ -86,3 +102,52 @@ class DataFrame:
 
     def __repr__(self):
         return f"DataFrame[{', '.join(self.schema.names)}]"
+
+
+class GroupedData:
+    """`df.group_by(cols).agg(...)` builder.
+
+    Aggregations are given as tuples `(func, column[, alias])` or keyword
+    form `alias=(func, column)`; funcs: sum, count, min, max, avg; column
+    "*" with count counts rows.
+
+        df.group_by("k").agg(("sum", "x", "total"), cnt=("count", "*"))
+    """
+
+    def __init__(self, df: DataFrame, group_columns: Sequence[str]):
+        self._df = df
+        self._group_columns = list(group_columns)
+
+    def agg(self, *specs, **named) -> DataFrame:
+        parsed = []
+        for spec in specs:
+            if not isinstance(spec, (tuple, list)) or len(spec) not in (2, 3):
+                raise HyperspaceException(
+                    "Aggregation spec must be (func, column[, alias]).")
+            func, column = spec[0], spec[1]
+            alias = spec[2] if len(spec) == 3 else (
+                f"{func}_{column}" if column != "*" else func)
+            parsed.append(AggSpec(func, column, alias))
+        for alias, spec in named.items():
+            if not isinstance(spec, (tuple, list)) or len(spec) != 2:
+                raise HyperspaceException(
+                    "Keyword aggregation must be alias=(func, column).")
+            parsed.append(AggSpec(spec[0], spec[1], alias))
+        return DataFrame(Aggregate(self._group_columns, parsed,
+                                   self._df.plan), self._df.session)
+
+    # Convenience verbs.
+    def count(self) -> DataFrame:
+        return self.agg(("count", "*", "count"))
+
+    def sum(self, *columns: str) -> DataFrame:
+        return self.agg(*[("sum", c) for c in columns])
+
+    def avg(self, *columns: str) -> DataFrame:
+        return self.agg(*[("avg", c) for c in columns])
+
+    def min(self, *columns: str) -> DataFrame:
+        return self.agg(*[("min", c) for c in columns])
+
+    def max(self, *columns: str) -> DataFrame:
+        return self.agg(*[("max", c) for c in columns])

@@ -17,8 +17,9 @@ from typing import List, Optional, Sequence, Set, Tuple
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io import columnar, parquet
 from hyperspace_tpu.plan import expr as E
-from hyperspace_tpu.plan.nodes import (BucketSpec, Filter, Join, LogicalPlan,
-                                       Project, Scan, Union)
+from hyperspace_tpu.plan.nodes import (Aggregate, BucketSpec, Filter, Join,
+                                       Limit, LogicalPlan, Project, Scan,
+                                       Sort, Union)
 from hyperspace_tpu.plan.schema import Schema
 
 
@@ -238,6 +239,53 @@ class SortExec(PhysicalNode):
         return sort_batch(batch, self.keys)
 
 
+class AggregateExec(PhysicalNode):
+    name = "Aggregate"
+
+    def __init__(self, group_columns: Sequence[str], aggregates,
+                 out_schema: Schema, child: PhysicalNode):
+        self.group_columns = list(group_columns)
+        self.aggregates = list(aggregates)
+        self.out_schema = out_schema
+        self.child = child
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def simple_string(self) -> str:
+        aggs = ", ".join(f"{a.func}({a.column})" for a in self.aggregates)
+        return f"Aggregate [{', '.join(self.group_columns)}] [{aggs}]"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        from hyperspace_tpu.ops.aggregate import group_aggregate
+        return group_aggregate(self.child.execute(bucket),
+                               self.group_columns, self.aggregates,
+                               self.out_schema)
+
+
+class LimitExec(PhysicalNode):
+    name = "Limit"
+
+    def __init__(self, n: int, child: PhysicalNode):
+        self.n = n
+        self.child = child
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def simple_string(self) -> str:
+        return f"Limit {self.n}"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        import jax.numpy as jnp
+        batch = self.child.execute(bucket)
+        if batch.num_rows <= self.n:
+            return batch
+        return batch.take(jnp.arange(self.n, dtype=jnp.int32))
+
+
 class UnionExec(PhysicalNode):
     name = "Union"
 
@@ -267,7 +315,7 @@ class SortMergeJoinExec(PhysicalNode):
     def __init__(self, left: PhysicalNode, right: PhysicalNode,
                  left_keys: Sequence[str], right_keys: Sequence[str],
                  bucketed: bool, num_buckets: int = 0,
-                 out_schema: Optional[Schema] = None):
+                 out_schema: Optional[Schema] = None, how: str = "inner"):
         self.left = left
         self.right = right
         self.left_keys = list(left_keys)
@@ -275,6 +323,7 @@ class SortMergeJoinExec(PhysicalNode):
         self.bucketed = bucketed
         self.num_buckets = num_buckets
         self.out_schema = out_schema
+        self.how = how
 
     @property
     def children(self):
@@ -283,7 +332,7 @@ class SortMergeJoinExec(PhysicalNode):
     def simple_string(self) -> str:
         keys = ", ".join(f"{l}={r}" for l, r in zip(self.left_keys, self.right_keys))
         mode = f"bucketed({self.num_buckets})" if self.bucketed else "global"
-        return f"SortMergeJoin [{keys}] {mode}"
+        return f"SortMergeJoin {self.how} [{keys}] {mode}"
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         from hyperspace_tpu.ops.join import sort_merge_join
@@ -297,12 +346,12 @@ class SortMergeJoinExec(PhysicalNode):
             rbatch, r_lengths = self.right.execute_bucketed(self.num_buckets)
             return bucketed_sort_merge_join(lbatch, rbatch, l_lengths,
                                             r_lengths, self.left_keys,
-                                            self.right_keys)
+                                            self.right_keys, how=self.how)
         lbatch = self.left.execute(bucket)
         rbatch = self.right.execute(bucket)
         # Children end in SortExec, so sides arrive key-sorted.
         return sort_merge_join(lbatch, rbatch, self.left_keys,
-                               self.right_keys, presorted=True)
+                               self.right_keys, presorted=True, how=self.how)
 
 
 # ---------------------------------------------------------------------------
@@ -376,6 +425,22 @@ def plan_physical(plan: LogicalPlan,
         resolved = [plan.child.schema.field(c).name for c in plan.columns]
         return ProjectExec(resolved, child)
 
+    if isinstance(plan, Aggregate):
+        child_required = (set(plan.group_columns)
+                          | {a.column for a in plan.aggregates
+                             if a.column != "*"})
+        return AggregateExec(plan.group_columns, plan.aggregates,
+                             plan.schema,
+                             plan_physical(plan.child, child_required))
+
+    if isinstance(plan, Sort):
+        child_required = set(required) | set(plan.columns)
+        return SortExec(plan.columns,
+                        plan_physical(plan.child, child_required))
+
+    if isinstance(plan, Limit):
+        return LimitExec(plan.n, plan_physical(plan.child, required))
+
     if isinstance(plan, Union):
         # Children may expose different column orders for the same names
         # (index schema vs source schema): normalize through a Project.
@@ -386,7 +451,7 @@ def plan_physical(plan: LogicalPlan,
             for c in plan.children])
 
     if isinstance(plan, Join):
-        if plan.join_type != "inner":
+        if plan.join_type not in ("inner", "left_outer", "right_outer"):
             raise HyperspaceException(
                 f"Join type {plan.join_type} not yet supported by the executor.")
         left_keys, right_keys = _join_keys(plan.condition, plan.left.schema,
@@ -411,7 +476,8 @@ def plan_physical(plan: LogicalPlan,
             # Shuffle-free, sort-free bucketed SMJ — the indexed fast path.
             return SortMergeJoinExec(left_phys, right_phys, left_keys,
                                      right_keys, bucketed=True,
-                                     num_buckets=lspec.num_buckets)
+                                     num_buckets=lspec.num_buckets,
+                                     how=plan.join_type)
         # General path: hash exchange + sort on each side.
         num_partitions = max(lspec.num_buckets if lspec else 0,
                              rspec.num_buckets if rspec else 0, 200)
@@ -422,6 +488,7 @@ def plan_physical(plan: LogicalPlan,
                                                          num_partitions,
                                                          right_phys))
         return SortMergeJoinExec(left_sorted, right_sorted, left_keys,
-                                 right_keys, bucketed=False)
+                                 right_keys, bucketed=False,
+                                 how=plan.join_type)
 
     raise HyperspaceException(f"Cannot plan node: {plan!r}")

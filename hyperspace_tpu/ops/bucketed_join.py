@@ -141,15 +141,19 @@ def _match_core(l_ids, r_ids, l_idx, l_valid, r_idx, r_valid):
     lo_c = jnp.minimum(lo, r_len[:, None])
     hi_c = jnp.minimum(hi, r_len[:, None])
     counts = jnp.maximum(hi_c - lo_c, 0)
+    real = (lid_s != _I32_MAX).reshape(-1)  # non-padding left slots
     counts = jnp.where(lid_s == _I32_MAX, 0, counts)  # padding left rows
     flat = counts.reshape(-1)
     starts = jnp.cumsum(flat) - flat
-    return flat, starts, lo_c, l_pos, r_pos
+    return flat, starts, lo_c, l_pos, r_pos, real
 
 
 @partial(__import__("jax").jit, static_argnames=("total", "Ll"))
-def _expand_core(starts, lo_c, l_pos, r_pos, l_idx, r_idx,
+def _expand_core(starts, counts, lo_c, l_pos, r_pos, l_idx, r_idx,
                  total: int, Ll: int):
+    """Expand (bucket,row,offset) -> original row index pairs. Rows with
+    effective count but zero matches (left-outer padding slots) yield
+    right index -1."""
     import jax.numpy as jnp
 
     slots = jnp.arange(total, dtype=starts.dtype)
@@ -158,21 +162,33 @@ def _expand_core(starts, lo_c, l_pos, r_pos, l_idx, r_idx,
     i = (row % Ll).astype(jnp.int32)
     offset = (slots - jnp.take(starts, row)).astype(jnp.int32)
     l_slot = l_pos[b, i]
-    r_slot = r_pos[b, lo_c[b, i] + offset]
-    return l_idx[b, l_slot], r_idx[b, r_slot]
+    matched = jnp.take(counts, row) > 0
+    Lr = r_pos.shape[1]
+    r_lookup = jnp.clip(lo_c[b, i] + offset, 0, Lr - 1)
+    r_slot = r_pos[b, r_lookup]
+    ri = jnp.where(matched, r_idx[b, r_slot], jnp.int32(-1))
+    return l_idx[b, l_slot], ri
 
 
 def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
                           l_lengths: np.ndarray, r_lengths: np.ndarray,
                           left_keys: Sequence[str],
-                          right_keys: Sequence[str]) -> Tuple:
+                          right_keys: Sequence[str],
+                          how: str = "inner") -> Tuple:
     """Join row-index pairs for two sides stored concat-in-bucket-order with
-    the given per-bucket lengths. One host sync total."""
+    the given per-bucket lengths. One host sync total. For how='left_outer'
+    unmatched left rows appear once with right index -1."""
     import jax.numpy as jnp
 
-    if left.num_rows == 0 or right.num_rows == 0:
-        empty = jnp.zeros(0, dtype=jnp.int32)
+    left_outer = how == "left_outer"
+    empty = jnp.zeros(0, dtype=jnp.int32)
+    if left.num_rows == 0:
         return empty, empty
+    if right.num_rows == 0 and not left_outer:
+        return empty, empty
+    if right.num_rows == 0:
+        li = jnp.arange(left.num_rows, dtype=jnp.int32)
+        return li, jnp.full(left.num_rows, -1, dtype=jnp.int32)
     l_ids, r_ids = encode_group_ids(left, right, left_keys, right_keys)
     Ll = next_pow2(max(1, int(l_lengths.max(initial=0))))
     Lr = next_pow2(max(1, int(r_lengths.max(initial=0))))
@@ -181,33 +197,68 @@ def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
     l_idx, l_valid = jnp.asarray(l_idx), jnp.asarray(l_valid)
     r_idx, r_valid = jnp.asarray(r_idx), jnp.asarray(r_valid)
 
-    counts, starts, lo_c, l_pos, r_pos = _match_core(
+    counts, starts, lo_c, l_pos, r_pos, real = _match_core(
         l_ids, r_ids, l_idx, l_valid, r_idx, r_valid)
+    if left_outer:
+        # One output row per unmatched REAL left row (incl. null keys).
+        counts = jnp.maximum(counts, real.astype(counts.dtype))
+        starts = jnp.cumsum(counts) - counts
     total = int(jnp.sum(counts))  # the one host sync
     if total == 0:
-        empty = jnp.zeros(0, dtype=jnp.int32)
         return empty, empty
-    return _expand_core(starts, lo_c, l_pos, r_pos, l_idx, r_idx,
+    return _expand_core(starts, counts, lo_c, l_pos, r_pos, l_idx, r_idx,
                         total, int(l_pos.shape[1]))
+
+
+def _gather_side(batch: ColumnBatch, idx):
+    """Gather rows by index; index -1 (unmatched outer row) yields null."""
+    import jax.numpy as jnp
+
+    unmatched = idx < 0
+    any_unmatched = bool(jnp.any(unmatched)) if idx.shape[0] else False
+    out = batch.take(jnp.clip(idx, 0, None) if any_unmatched else idx)
+    if not any_unmatched:
+        return out
+    columns = {}
+    for name, col in out.columns.items():
+        validity = (col.validity & ~unmatched
+                    if col.validity is not None else ~unmatched)
+        columns[name] = DeviceColumn(col.data, col.dtype, validity,
+                                     col.dictionary, col.dict_hashes)
+    return ColumnBatch(out.schema, columns)
+
+
+def assemble_join_output(left: ColumnBatch, right: ColumnBatch,
+                         li, ri) -> ColumnBatch:
+    """Gather both sides by index pairs into the joined batch; -1 on either
+    side (unmatched outer row) yields null columns for that side. Duplicate
+    output names get a `_r` suffix on the right."""
+    from hyperspace_tpu.plan.schema import Field, Schema
+
+    left_out = _gather_side(left, li)
+    right_out = _gather_side(right, ri)
+    fields = list(left_out.schema.fields)
+    columns = dict(left_out.columns)
+    left_names = {f.name.lower() for f in fields}
+    for f in right.schema.fields:
+        name = f.name if f.name.lower() not in left_names else f.name + "_r"
+        fields.append(Field(name, f.dtype, True))
+        columns[name] = right_out.columns[f.name]
+    return ColumnBatch(Schema(fields), columns)
 
 
 def bucketed_sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                              l_lengths: np.ndarray, r_lengths: np.ndarray,
                              left_keys: Sequence[str],
-                             right_keys: Sequence[str]) -> ColumnBatch:
-    """Full bucketed inner join over concat-in-bucket-order sides."""
-    from hyperspace_tpu.plan.schema import Field, Schema
-
-    li, ri = bucketed_join_indices(left, right, np.asarray(l_lengths),
-                                   np.asarray(r_lengths), left_keys,
-                                   right_keys)
-    left_out = left.take(li)
-    right_out = right.take(ri)
-    fields = list(left.schema.fields)
-    columns = dict(left_out.columns)
-    left_names = {f.name.lower() for f in fields}
-    for f in right.schema.fields:
-        name = f.name if f.name.lower() not in left_names else f.name + "_r"
-        fields.append(Field(name, f.dtype, f.nullable))
-        columns[name] = right_out.columns[f.name]
-    return ColumnBatch(Schema(fields), columns)
+                             right_keys: Sequence[str],
+                             how: str = "inner") -> ColumnBatch:
+    """Full bucketed join over concat-in-bucket-order sides."""
+    if how == "right_outer":
+        ri, li = bucketed_join_indices(right, left, np.asarray(r_lengths),
+                                       np.asarray(l_lengths), right_keys,
+                                       left_keys, how="left_outer")
+    else:
+        li, ri = bucketed_join_indices(left, right, np.asarray(l_lengths),
+                                       np.asarray(r_lengths), left_keys,
+                                       right_keys, how=how)
+    return assemble_join_output(left, right, li, ri)

@@ -84,17 +84,20 @@ def encode_join_keys(left: ColumnBatch, right: ColumnBatch,
     return l_ids, r_ids
 
 
-def merge_join_indices(left_ids, right_ids) -> Tuple:
-    """Inner-join row index pairs of two *sorted* id arrays.
+def merge_join_indices(left_ids, right_ids, how: str = "inner") -> Tuple:
+    """Join row index pairs of two *sorted* id arrays.
 
-    Returns (left_idx, right_idx) device arrays of equal length. One host
-    sync (the total match count) sizes the output.
+    Returns (left_idx, right_idx) device arrays of equal length; for
+    how='left_outer' every unmatched left row appears once with right index
+    -1. One host sync (the total count) sizes the output.
     """
     import jax.numpy as jnp
 
     lo = jnp.searchsorted(right_ids, left_ids, side="left")
     hi = jnp.searchsorted(right_ids, left_ids, side="right")
     counts = hi - lo
+    if how == "left_outer":
+        counts = jnp.maximum(counts, 1)
     starts = jnp.cumsum(counts) - counts  # exclusive cumsum
     total = int(jnp.sum(counts))  # host sync — sizes the result
     if total == 0:
@@ -102,25 +105,27 @@ def merge_join_indices(left_ids, right_ids) -> Tuple:
         return empty, empty
     slots = jnp.arange(total, dtype=counts.dtype)
     left_idx = jnp.searchsorted(starts, slots, side="right") - 1
+    matched = jnp.take(hi, left_idx) > jnp.take(lo, left_idx)
     right_idx = jnp.take(lo, left_idx) + (slots - jnp.take(starts, left_idx))
+    right_idx = jnp.where(matched, right_idx, -1)
     return left_idx.astype(jnp.int32), right_idx.astype(jnp.int32)
 
 
 def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                     left_keys: Sequence[str], right_keys: Sequence[str],
-                    presorted: bool = False):
-    """Inner join of two batches on equi-keys.
+                    presorted: bool = False, how: str = "inner"):
+    """Join of two batches on equi-keys (inner / left_outer / right_outer).
 
     If `presorted` is False, both sides are sorted by their group ids first
     (the plain path); bucketed index scans pass presorted=True and skip the
     sort — the observable saving the rewrite rules buy.
 
-    Returns (joined ColumnBatch, output column names are left's then
-    right's; duplicate names get a `_r` suffix on the right).
+    Output column names are left's then right's; duplicate names get a
+    `_r` suffix on the right.
     """
     import jax.numpy as jnp
 
-    from hyperspace_tpu.plan.schema import Field, Schema
+    from hyperspace_tpu.ops.bucketed_join import assemble_join_output
 
     l_ids, r_ids = encode_join_keys(left, right, left_keys, right_keys)
     if not presorted:
@@ -130,15 +135,8 @@ def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
         right = right.take(r_perm)
         l_ids = jnp.take(l_ids, l_perm)
         r_ids = jnp.take(r_ids, r_perm)
-    li, ri = merge_join_indices(l_ids, r_ids)
-    left_out = left.take(li)
-    right_out = right.take(ri)
-
-    fields = list(left.schema.fields)
-    columns = dict(left_out.columns)
-    left_names = {f.name.lower() for f in fields}
-    for f in right.schema.fields:
-        name = f.name if f.name.lower() not in left_names else f.name + "_r"
-        fields.append(Field(name, f.dtype, f.nullable))
-        columns[name] = right_out.columns[f.name]
-    return ColumnBatch(Schema(fields), columns)
+    if how == "right_outer":
+        ri, li = merge_join_indices(r_ids, l_ids, how="left_outer")
+    else:
+        li, ri = merge_join_indices(l_ids, r_ids, how=how)
+    return assemble_join_output(left, right, li, ri)

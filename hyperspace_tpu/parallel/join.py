@@ -63,13 +63,13 @@ def distributed_bucketed_join_indices(
     l_ids = put(l_ids, repl)
     r_ids = put(r_ids, repl)
 
-    counts, starts, lo_c, l_pos, r_pos = _match_core(
+    counts, starts, lo_c, l_pos, r_pos, _real = _match_core(
         l_ids, r_ids, l_idx, l_valid, r_idx, r_valid)
     total = int(jnp.sum(counts))
     if total == 0:
         empty = jnp.zeros(0, dtype=jnp.int32)
         return empty, empty
-    return _expand_core(starts, lo_c, l_pos, r_pos, l_idx, r_idx,
+    return _expand_core(starts, counts, lo_c, l_pos, r_pos, l_idx, r_idx,
                         total, Ll)
 
 

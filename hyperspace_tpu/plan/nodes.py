@@ -216,6 +216,133 @@ class Project(LogicalPlan):
         return f"Project [{', '.join(self.columns)}]"
 
 
+_AGG_FUNCS = ("sum", "count", "min", "max", "avg")
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One aggregation: func over column (column "*" for count(*))."""
+
+    func: str
+    column: str
+    alias: str
+
+    def __post_init__(self):
+        if self.func not in _AGG_FUNCS:
+            raise HyperspaceException(f"Unsupported aggregate: {self.func}")
+
+    def to_dict(self) -> dict:
+        return {"func": self.func, "column": self.column, "alias": self.alias}
+
+    @staticmethod
+    def from_dict(d: dict) -> "AggSpec":
+        return AggSpec(d["func"], d["column"], d["alias"])
+
+
+class Aggregate(LogicalPlan):
+    """Group-by aggregation (sum/count/min/max/avg). The reference delegates
+    aggregation to Spark SQL; this framework's engine executes it as
+    device segment reductions over sorted groups."""
+
+    def __init__(self, group_columns: Sequence[str],
+                 aggregates: Sequence[AggSpec], child: LogicalPlan):
+        self.group_columns = list(group_columns)
+        self.aggregates = list(aggregates)
+        if not self.aggregates:
+            raise HyperspaceException("Aggregate requires at least one "
+                                      "aggregation expression.")
+        self.child = child
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        from hyperspace_tpu.plan.schema import Field
+        fields = [self.child.schema.field(c) for c in self.group_columns]
+        for spec in self.aggregates:
+            if spec.func == "count":
+                dtype = "int64"
+            elif spec.func == "avg":
+                dtype = "float64"
+            elif spec.func == "sum":
+                src = self.child.schema.field(spec.column).dtype
+                dtype = ("float64" if src in ("float32", "float64")
+                         else "int64")
+            else:  # min/max keep the input type
+                dtype = self.child.schema.field(spec.column).dtype
+            fields.append(Field(spec.alias, dtype, True))
+        return Schema(fields)
+
+    def with_children(self, children):
+        (child,) = children
+        return Aggregate(self.group_columns, self.aggregates, child)
+
+    def to_dict(self) -> dict:
+        return {"node": "aggregate", "groupBy": list(self.group_columns),
+                "aggregates": [a.to_dict() for a in self.aggregates],
+                "child": self.child.to_dict()}
+
+    def simple_string(self) -> str:
+        aggs = ", ".join(f"{a.func}({a.column}) AS {a.alias}"
+                         for a in self.aggregates)
+        return f"Aggregate [{', '.join(self.group_columns)}] [{aggs}]"
+
+
+class Sort(LogicalPlan):
+    """ORDER BY (ascending, nulls first — the engine's sort order)."""
+
+    def __init__(self, columns: Sequence[str], child: LogicalPlan):
+        self.columns = list(columns)
+        self.child = child
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def with_children(self, children):
+        (child,) = children
+        return Sort(self.columns, child)
+
+    def to_dict(self) -> dict:
+        return {"node": "sort", "columns": list(self.columns),
+                "child": self.child.to_dict()}
+
+    def simple_string(self) -> str:
+        return f"Sort [{', '.join(self.columns)}]"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        if n < 0:
+            raise HyperspaceException("Limit must be non-negative.")
+        self.n = n
+        self.child = child
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+    def with_children(self, children):
+        (child,) = children
+        return Limit(self.n, child)
+
+    def to_dict(self) -> dict:
+        return {"node": "limit", "n": self.n, "child": self.child.to_dict()}
+
+    def simple_string(self) -> str:
+        return f"Limit {self.n}"
+
+
 class Union(LogicalPlan):
     """Row-wise union of same-schema children (column names must align).
     Exists for Hybrid Scan: index data UNION appended source files."""
@@ -265,7 +392,21 @@ class Join(LogicalPlan):
 
     @property
     def schema(self) -> Schema:
-        return Schema(list(self.left.schema.fields) + list(self.right.schema.fields))
+        """Left fields then right fields; duplicate names get a `_r` suffix
+        on the right (matching the executor's output); outer joins make the
+        nullable side's fields nullable."""
+        from hyperspace_tpu.plan.schema import Field as SchemaField
+        fields = list(self.left.schema.fields)
+        left_names = {f.name.lower() for f in fields}
+        if self.join_type in ("right_outer", "full_outer"):
+            fields = [SchemaField(f.name, f.dtype, True) for f in fields]
+        right_nullable = self.join_type in ("left_outer", "full_outer")
+        for f in self.right.schema.fields:
+            name = (f.name if f.name.lower() not in left_names
+                    else f.name + "_r")
+            fields.append(SchemaField(name, f.dtype,
+                                      f.nullable or right_nullable))
+        return Schema(fields)
 
     def with_children(self, children):
         left, right = children

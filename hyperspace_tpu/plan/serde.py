@@ -16,8 +16,9 @@ import json
 
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.plan.expr import Expression
-from hyperspace_tpu.plan.nodes import (BucketSpec, Filter, Join, LogicalPlan,
-                                       Project, Scan, Union)
+from hyperspace_tpu.plan.nodes import (Aggregate, AggSpec, BucketSpec, Filter,
+                                       Join, Limit, LogicalPlan, Project,
+                                       Scan, Sort, Union)
 from hyperspace_tpu.plan.schema import Field, Schema
 
 
@@ -43,6 +44,14 @@ def plan_from_dict(d: dict) -> LogicalPlan:
         return Project(d["columns"], plan_from_dict(d["child"]))
     if node == "union":
         return Union([plan_from_dict(c) for c in d["children"]])
+    if node == "aggregate":
+        return Aggregate(d["groupBy"],
+                         [AggSpec.from_dict(a) for a in d["aggregates"]],
+                         plan_from_dict(d["child"]))
+    if node == "sort":
+        return Sort(d["columns"], plan_from_dict(d["child"]))
+    if node == "limit":
+        return Limit(d["n"], plan_from_dict(d["child"]))
     if node == "join":
         return Join(plan_from_dict(d["left"]), plan_from_dict(d["right"]),
                     Expression.from_dict(d["condition"]),

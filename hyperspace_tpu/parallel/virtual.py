@@ -1,0 +1,43 @@
+"""Virtual multi-device bootstrap for tests and dry runs.
+
+Multi-chip behavior is validated the way the reference validates
+distribution — a real local multi-way runtime in one process (`local[4]`
+SparkSession, reference `SparkInvolvedSuite.scala:29-35`): here, an
+n-device virtual CPU mesh. Used by `tests/conftest.py` and the driver's
+`__graft_entry__.dryrun_multichip` gate.
+"""
+
+from __future__ import annotations
+
+
+def ensure_devices(n_devices: int) -> None:
+    """Make `jax.devices()` report at least ``n_devices`` devices.
+
+    Real hardware with enough chips is used as-is. Otherwise the live
+    backends are dropped and CPU is re-initialized with a forced device
+    count. ``clear_backends`` MUST precede the config updates — jax
+    refuses ``jax_num_cpu_devices`` changes while backends are live.
+
+    PROCESS-DESTRUCTIVE in the fallback path: it pins jax_platforms=cpu
+    for the rest of the process and invalidates every live jax array and
+    compiled computation. Call it before any device work (tests do it at
+    conftest import; the dryrun gate does it first thing). Subprocesses
+    are unaffected (nothing is written to ``os.environ``).
+    """
+    import jax
+
+    try:
+        if len(jax.devices()) >= n_devices:
+            return
+    except RuntimeError:
+        pass
+
+    import jax.extend.backend
+
+    jax.extend.backend.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)
+    if len(jax.devices()) < n_devices:
+        raise RuntimeError(
+            f"virtual mesh bootstrap failed: have {len(jax.devices())} "
+            f"devices, requested {n_devices}")

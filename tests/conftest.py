@@ -3,18 +3,14 @@
 Distribution is tested the way the reference tests it — a real local
 multi-way runtime in one process (`local[4]` SparkSession in
 `SparkInvolvedSuite.scala:29-35`): here, an 8-device virtual CPU mesh via
-XLA's host-platform device-count flag. Env vars must be set before jax is
-first imported.
+`parallel.virtual.ensure_devices` (jax_num_cpu_devices), forced before
+any test touches a device.
 """
 
 import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -23,6 +19,10 @@ import jax
 # The environment's site hook pins jax_platforms to the axon TPU plugin,
 # overriding JAX_PLATFORMS; force the virtual 8-device CPU mesh for tests.
 jax.config.update("jax_platforms", "cpu")
+
+from hyperspace_tpu.parallel.virtual import ensure_devices
+
+ensure_devices(8)
 
 import numpy as np
 import pytest

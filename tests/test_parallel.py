@@ -1,5 +1,5 @@
-"""Distribution tests on the virtual 8-device CPU mesh (conftest sets
-xla_force_host_platform_device_count=8) — the reference's `local[4]`
+"""Distribution tests on the virtual 8-device CPU mesh (conftest calls
+`parallel.virtual.ensure_devices(8)`) — the reference's `local[4]`
 equivalent (SURVEY §4 takeaway)."""
 
 import numpy as np

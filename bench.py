@@ -75,23 +75,27 @@ def cpu_baseline(table, out_dir):
 
 
 def device_build(table, out_dir_base):
-    from hyperspace_tpu.io import columnar
-    from hyperspace_tpu.io.builder import write_bucketed_batch
+    """The PRODUCT build path (`io/builder.write_bucketed_table` with no
+    pre-staged device state): per build, the key column is staged to the
+    device (narrow 32-bit lane transport when the range allows), the
+    device computes the bucket+sort permutation, and the host streams
+    bucket files while permutation chunks are still in flight. The
+    payload never crosses the link."""
+    from hyperspace_tpu.io.builder import write_bucketed_table
 
     import jax
     log(f"devices: {jax.devices()}")
-    batch = columnar.from_arrow(table)
-    # Warm-up: compile the fused build program for this shape.
+    # Warm-up: compile the fused permutation program for this shape.
     t0 = time.perf_counter()
-    write_bucketed_batch(batch, ["key"], NUM_BUCKETS, out_dir_base + "_warm")
+    write_bucketed_table(table, ["key"], NUM_BUCKETS, out_dir_base + "_warm")
     log(f"cold build (incl. compile): {time.perf_counter() - t0:.2f}s")
     shutil.rmtree(out_dir_base + "_warm", ignore_errors=True)
 
     best = float("inf")
-    for i in range(3):
+    for i in range(5):
         out = f"{out_dir_base}_{i}"
         t0 = time.perf_counter()
-        write_bucketed_batch(batch, ["key"], NUM_BUCKETS, out)
+        write_bucketed_table(table, ["key"], NUM_BUCKETS, out)
         elapsed = time.perf_counter() - t0
         log(f"warm build {i}: {elapsed:.3f}s ({N_ROWS/elapsed:,.0f} rows/s)")
         best = min(best, elapsed)
@@ -103,9 +107,10 @@ def main():
     work = tempfile.mkdtemp(prefix="hs_bench_")
     try:
         table = make_table()
-        cpu_s = cpu_baseline(table, os.path.join(work, "cpu"))
+        cpu_s = min(cpu_baseline(table, os.path.join(work, f"cpu{i}"))
+                    for i in range(2))
         cpu_rate = N_ROWS / cpu_s
-        log(f"cpu baseline: {cpu_s:.3f}s ({cpu_rate:,.0f} rows/s)")
+        log(f"cpu baseline (best of 2): {cpu_s:.3f}s ({cpu_rate:,.0f} rows/s)")
 
         tpu_s = device_build(table, os.path.join(work, "tpu"))
         tpu_rate = N_ROWS / tpu_s

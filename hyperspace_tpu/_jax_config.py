@@ -7,6 +7,22 @@ truncate them. The perf-critical kernels (hashing, sort keys) operate on
 not sacrificed.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: first-ever compile of the fused build/join
+# programs costs tens of seconds against the tunneled TPU; subsequent
+# processes reuse the on-disk executable. Opt out with
+# HYPERSPACE_JAX_CACHE=0 or redirect via JAX_COMPILATION_CACHE_DIR.
+if os.environ.get("HYPERSPACE_JAX_CACHE", "1") == "1":
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           os.path.expanduser("~/.cache/hyperspace_tpu_xla")))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the persistent cache: run without it

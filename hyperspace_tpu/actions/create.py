@@ -104,7 +104,7 @@ class CreateActionBase(Action):
         from hyperspace_tpu.io.builder import write_index
         write_index(df, list(index_config.indexed_columns),
                     list(index_config.included_columns),
-                    self.num_buckets(), path)
+                    self.num_buckets(), path, conf=self.conf)
 
 
 class CreateAction(CreateActionBase):

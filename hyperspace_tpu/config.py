@@ -72,6 +72,18 @@ class HyperspaceConf:
                             constants.INDEX_NUM_BUCKETS_DEFAULT)
 
     @property
+    def distribution(self) -> str:
+        """"auto" | "true" | "false" — see `parallel/context.py`."""
+        return (self.get(constants.DISTRIBUTION_ENABLED,
+                         constants.DISTRIBUTION_ENABLED_DEFAULT) or
+                "auto").lower()
+
+    @property
+    def distribution_min_rows(self) -> int:
+        return self.get_int(constants.DISTRIBUTION_MIN_ROWS,
+                            constants.DISTRIBUTION_MIN_ROWS_DEFAULT)
+
+    @property
     def cache_expiry_seconds(self) -> int:
         return self.get_int(
             constants.INDEX_CACHE_EXPIRY_DURATION_SECONDS,

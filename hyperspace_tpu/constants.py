@@ -24,6 +24,15 @@ INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
 
 HYBRID_SCAN_ENABLED = "spark.hyperspace.index.hybridscan.enabled"
 
+# Mesh distribution of the data plane (no reference analog — Spark owns the
+# cluster there; here the "cluster" is the jax device mesh). Values:
+# "auto" (default: distribute when >1 device is visible), "true", "false".
+DISTRIBUTION_ENABLED = "spark.hyperspace.distribution.enabled"
+DISTRIBUTION_ENABLED_DEFAULT = "auto"
+# Minimum row count before the sharded filter scan pays for itself.
+DISTRIBUTION_MIN_ROWS = "spark.hyperspace.distribution.min.rows"
+DISTRIBUTION_MIN_ROWS_DEFAULT = 4096
+
 WAREHOUSE_PATH = "spark.hyperspace.warehouse.dir"
 WAREHOUSE_PATH_DEFAULT = "warehouse"
 

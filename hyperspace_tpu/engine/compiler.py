@@ -98,47 +98,92 @@ class ExpressionCompiler:
         return None
 
     # -- predicates -------------------------------------------------------
+    #
+    # SQL three-valued (Kleene) logic: each predicate compiles to a pair
+    # (true_mask, known) where `true_mask` marks rows DEFINITELY true
+    # (so true_mask implies known; `known & ~true_mask` is definitely
+    # false; `~known` is NULL/unknown). `known is None` means all-known —
+    # the common null-free fast path stays two fused vector ops per node.
+    # NOT flips definite truth within the known rows, so NULL stays NULL
+    # and a filter never passes it (the reference inherits exactly this
+    # from Spark; previously `~mask` wrongly passed null rows).
 
     def predicate(self, e: E.Expression):
-        """Compile to a bool mask (True = row passes)."""
+        """Compile to a bool mask (True = row DEFINITELY passes; SQL's
+        not-true rows, including NULLs, are False)."""
+        mask, _known = self.predicate3(e)
+        return mask
+
+    def predicate3(self, e: E.Expression):
+        """Compile to (true_mask, known); known=None means all rows known."""
         import jax.numpy as jnp
 
         n = self.batch.num_rows
         if isinstance(e, E.And):
-            return self.predicate(e.left) & self.predicate(e.right)
+            lt, lk = self.predicate3(e.left)
+            rt, rk = self.predicate3(e.right)
+            mask = lt & rt
+            if lk is None and rk is None:
+                return mask, None
+            # Known iff both known, or either side is definitely false.
+            lk_ = jnp.ones(n, bool) if lk is None else lk
+            rk_ = jnp.ones(n, bool) if rk is None else rk
+            return mask, (lk_ & rk_) | (lk_ & ~lt) | (rk_ & ~rt)
         if isinstance(e, E.Or):
-            return self.predicate(e.left) | self.predicate(e.right)
+            return self._or3(self.predicate3(e.left),
+                             self.predicate3(e.right), n)
         if isinstance(e, E.Not):
-            return ~self.predicate(e.child)
+            t, k = self.predicate3(e.child)
+            if k is None:
+                return ~t, None
+            return k & ~t, k
         if isinstance(e, E.IsNull):
             col = self._column_of(e.child)
             if col is None:
                 raise HyperspaceException("IS NULL requires a column.")
             if col.validity is None:
-                return jnp.zeros(n, bool)
-            return ~col.validity
+                return jnp.zeros(n, bool), None
+            return ~col.validity, None
         if isinstance(e, E.IsNotNull):
             col = self._column_of(e.child)
             if col is None:
                 raise HyperspaceException("IS NOT NULL requires a column.")
             if col.validity is None:
-                return jnp.ones(n, bool)
-            return col.validity
+                return jnp.ones(n, bool), None
+            return col.validity, None
         if isinstance(e, E.In):
             folded = None
             for v in e.values:
-                term = self.predicate(E.EqualTo(e.child, v))
-                folded = term if folded is None else (folded | term)
-            return folded if folded is not None else jnp.zeros(n, bool)
+                term = self.predicate3(E.EqualTo(e.child, v))
+                folded = term if folded is None else (
+                    self._or3(folded, term, n))
+            if folded is None:
+                return jnp.zeros(n, bool), None
+            return folded
         if isinstance(e, (E.EqualTo, E.NotEqualTo, E.LessThan,
                           E.LessThanOrEqual, E.GreaterThan,
                           E.GreaterThanOrEqual)):
             return self._comparison(e)
         if isinstance(e, E.Literal):
             if isinstance(e.value, bool):
-                return jnp.full(n, e.value, dtype=bool)
+                return jnp.full(n, e.value, dtype=bool), None
             raise HyperspaceException(f"Non-boolean literal predicate: {e!r}")
         raise HyperspaceException(f"Unsupported predicate: {e!r}")
+
+    @staticmethod
+    def _or3(a, b, n):
+        """Kleene OR over (true_mask, known) pairs: known iff both known,
+        or either side is definitely true."""
+        import jax.numpy as jnp
+
+        at, ak = a
+        bt, bk = b
+        mask = at | bt
+        if ak is None and bk is None:
+            return mask, None
+        ak_ = jnp.ones(n, bool) if ak is None else ak
+        bk_ = jnp.ones(n, bool) if bk is None else bk
+        return mask, (ak_ & bk_) | mask
 
     def _comparison(self, e):
         import jax.numpy as jnp
@@ -149,12 +194,12 @@ class ExpressionCompiler:
         # string column vs string literal -> code-space range test
         if lcol is not None and lcol.is_string and isinstance(e.right, E.Literal):
             mask = _string_literal_compare(op, lcol, str(e.right.value))
-            return self._mask_nulls(mask, lcol.validity, None)
+            return self._with_validity(mask, lcol.validity, None)
         if rcol is not None and rcol.is_string and isinstance(e.left, E.Literal):
             flipped = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
                        "eq": "eq", "ne": "ne"}[op]
             mask = _string_literal_compare(flipped, rcol, str(e.left.value))
-            return self._mask_nulls(mask, rcol.validity, None)
+            return self._with_validity(mask, rcol.validity, None)
         if (lcol is not None and lcol.is_string) or (rcol is not None and rcol.is_string):
             raise HyperspaceException(
                 "String column-to-column comparison is not supported in "
@@ -162,14 +207,15 @@ class ExpressionCompiler:
         lv, lval = self.value(e.left)
         rv, rval = self.value(e.right)
         mask = getattr(jnp.asarray(lv), _CMP[op])(rv)
-        return self._mask_nulls(mask, lval, rval)
+        return self._with_validity(mask, lval, rval)
 
     @staticmethod
-    def _mask_nulls(mask, lval, rval):
+    def _with_validity(mask, lval, rval):
+        """(raw compare, operand validity) -> (true_mask, known)."""
         validity = ExpressionCompiler._merge_validity(lval, rval)
         if validity is None:
-            return mask
-        return mask & validity
+            return mask, None
+        return mask & validity, validity
 
 
 def compile_predicate(expression: E.Expression, batch: ColumnBatch):

@@ -82,11 +82,15 @@ class DataFrame:
             return self.session.optimize(self.plan)
         return self.plan
 
+    def _conf(self):
+        return self.session.conf if self.session is not None else None
+
     def collect(self):
         """Execute and return an Arrow table."""
         from hyperspace_tpu.engine.executor import execute_plan
         from hyperspace_tpu.io.columnar import to_arrow
-        return to_arrow(execute_plan(self._optimized_plan()))
+        return to_arrow(execute_plan(self._optimized_plan(),
+                                     conf=self._conf()))
 
     def to_pandas(self):
         return self.collect().to_pandas()
@@ -98,7 +102,8 @@ class DataFrame:
         """(logical, optimized, physical) — used by plananalysis."""
         from hyperspace_tpu.engine.executor import compile_plan
         optimized = self._optimized_plan()
-        return self.plan, optimized, compile_plan(optimized)
+        return self.plan, optimized, compile_plan(optimized,
+                                                  conf=self._conf())
 
     def __repr__(self):
         return f"DataFrame[{', '.join(self.schema.names)}]"

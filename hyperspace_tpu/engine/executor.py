@@ -11,9 +11,10 @@ from hyperspace_tpu.plan.nodes import LogicalPlan
 
 
 def compile_plan(plan: LogicalPlan,
-                 projection: Optional[Sequence[str]] = None) -> PhysicalNode:
+                 projection: Optional[Sequence[str]] = None,
+                 conf=None) -> PhysicalNode:
     required = set(projection) if projection is not None else None
-    physical = plan_physical(plan, required)
+    physical = plan_physical(plan, required, conf)
     if projection is not None:
         from hyperspace_tpu.engine.physical import ProjectExec
         physical = ProjectExec(list(projection), physical)
@@ -21,5 +22,6 @@ def compile_plan(plan: LogicalPlan,
 
 
 def execute_plan(plan: LogicalPlan,
-                 projection: Optional[Sequence[str]] = None) -> ColumnBatch:
-    return compile_plan(plan, projection).execute()
+                 projection: Optional[Sequence[str]] = None,
+                 conf=None) -> ColumnBatch:
+    return compile_plan(plan, projection, conf).execute()

@@ -130,9 +130,11 @@ class ScanExec(PhysicalNode):
 class FilterExec(PhysicalNode):
     name = "Filter"
 
-    def __init__(self, condition: E.Expression, child: PhysicalNode):
+    def __init__(self, condition: E.Expression, child: PhysicalNode,
+                 conf=None):
         self.condition = condition
         self.child = child
+        self.conf = conf
 
     @property
     def children(self):
@@ -143,9 +145,14 @@ class FilterExec(PhysicalNode):
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         from hyperspace_tpu.engine.compiler import apply_filter
+        from hyperspace_tpu.parallel.context import should_distribute
         batch = self.child.execute(bucket)
         if batch.num_rows == 0:
             return batch
+        mesh = should_distribute(self.conf, batch.num_rows)
+        if mesh is not None:
+            from hyperspace_tpu.parallel.scan import distributed_filter
+            return distributed_filter(batch, self.condition, mesh)
         return apply_filter(batch, self.condition)
 
     def execute_bucketed(self, num_buckets: int):
@@ -315,7 +322,8 @@ class SortMergeJoinExec(PhysicalNode):
     def __init__(self, left: PhysicalNode, right: PhysicalNode,
                  left_keys: Sequence[str], right_keys: Sequence[str],
                  bucketed: bool, num_buckets: int = 0,
-                 out_schema: Optional[Schema] = None, how: str = "inner"):
+                 out_schema: Optional[Schema] = None, how: str = "inner",
+                 conf=None):
         self.left = left
         self.right = right
         self.left_keys = list(left_keys)
@@ -324,6 +332,7 @@ class SortMergeJoinExec(PhysicalNode):
         self.num_buckets = num_buckets
         self.out_schema = out_schema
         self.how = how
+        self.conf = conf
 
     @property
     def children(self):
@@ -344,6 +353,16 @@ class SortMergeJoinExec(PhysicalNode):
             from hyperspace_tpu.ops.bucketed_join import bucketed_sort_merge_join
             lbatch, l_lengths = self.left.execute_bucketed(self.num_buckets)
             rbatch, r_lengths = self.right.execute_bucketed(self.num_buckets)
+            mesh = self._join_mesh(lbatch.num_rows + rbatch.num_rows)
+            if mesh is not None:
+                from hyperspace_tpu.ops.bucketed_join import (
+                    assemble_join_output)
+                from hyperspace_tpu.parallel.join import (
+                    distributed_bucketed_join_indices)
+                li, ri = distributed_bucketed_join_indices(
+                    lbatch, rbatch, l_lengths, r_lengths, self.left_keys,
+                    self.right_keys, mesh)
+                return assemble_join_output(lbatch, rbatch, li, ri)
             return bucketed_sort_merge_join(lbatch, rbatch, l_lengths,
                                             r_lengths, self.left_keys,
                                             self.right_keys, how=self.how)
@@ -352,6 +371,19 @@ class SortMergeJoinExec(PhysicalNode):
         # Children end in SortExec, so sides arrive key-sorted.
         return sort_merge_join(lbatch, rbatch, self.left_keys,
                                self.right_keys, presorted=True, how=self.how)
+
+    def _join_mesh(self, total_rows: int):
+        """Mesh for the distributed co-bucketed join, or None. Requires an
+        inner join (the distributed index path has no outer expansion) and
+        the bucket<->shard map (num_buckets divisible by mesh size)."""
+        from hyperspace_tpu.parallel.context import (mesh_size,
+                                                     should_distribute)
+        if self.how != "inner":
+            return None
+        mesh = should_distribute(self.conf, total_rows)
+        if mesh is None or self.num_buckets % mesh_size(mesh) != 0:
+            return None
+        return mesh
 
 
 # ---------------------------------------------------------------------------
@@ -406,8 +438,11 @@ def _required_for(plan: LogicalPlan, required: Set[str]) -> List[str]:
 
 
 def plan_physical(plan: LogicalPlan,
-                  required: Optional[Set[str]] = None) -> PhysicalNode:
-    """Logical -> physical with projection pushdown into scans."""
+                  required: Optional[Set[str]] = None,
+                  conf=None) -> PhysicalNode:
+    """Logical -> physical with projection pushdown into scans. `conf`
+    carries the session's distribution settings to the operators that can
+    execute on the mesh (Filter scans, bucketed SMJ)."""
     if required is None:
         required = set(plan.schema.names)
 
@@ -417,10 +452,11 @@ def plan_physical(plan: LogicalPlan,
     if isinstance(plan, Filter):
         child_required = set(required) | plan.condition.references()
         return FilterExec(plan.condition,
-                          plan_physical(plan.child, child_required))
+                          plan_physical(plan.child, child_required, conf),
+                          conf=conf)
 
     if isinstance(plan, Project):
-        child = plan_physical(plan.child, set(plan.columns))
+        child = plan_physical(plan.child, set(plan.columns), conf)
         # Resolve names against the child schema but KEEP the declared order.
         resolved = [plan.child.schema.field(c).name for c in plan.columns]
         return ProjectExec(resolved, child)
@@ -431,15 +467,15 @@ def plan_physical(plan: LogicalPlan,
                              if a.column != "*"})
         return AggregateExec(plan.group_columns, plan.aggregates,
                              plan.schema,
-                             plan_physical(plan.child, child_required))
+                             plan_physical(plan.child, child_required, conf))
 
     if isinstance(plan, Sort):
         child_required = set(required) | set(plan.columns)
         return SortExec(plan.columns,
-                        plan_physical(plan.child, child_required))
+                        plan_physical(plan.child, child_required, conf))
 
     if isinstance(plan, Limit):
-        return LimitExec(plan.n, plan_physical(plan.child, required))
+        return LimitExec(plan.n, plan_physical(plan.child, required, conf))
 
     if isinstance(plan, Union):
         # Children may expose different column orders for the same names
@@ -447,7 +483,7 @@ def plan_physical(plan: LogicalPlan,
         wanted = _required_for(plan, required)
         return UnionExec([
             ProjectExec([c.schema.field(n).name for n in wanted],
-                        plan_physical(c, set(wanted)))
+                        plan_physical(c, set(wanted), conf))
             for c in plan.children])
 
     if isinstance(plan, Join):
@@ -460,8 +496,8 @@ def plan_physical(plan: LogicalPlan,
                          | set(left_keys))
         right_required = ({n for n in required if plan.right.schema.contains(n)}
                           | set(right_keys))
-        left_phys = plan_physical(plan.left, left_required)
-        right_phys = plan_physical(plan.right, right_required)
+        left_phys = plan_physical(plan.left, left_required, conf)
+        right_phys = plan_physical(plan.right, right_required, conf)
 
         lspec = _underlying_bucket_spec(plan.left)
         rspec = _underlying_bucket_spec(plan.right)
@@ -477,7 +513,7 @@ def plan_physical(plan: LogicalPlan,
             return SortMergeJoinExec(left_phys, right_phys, left_keys,
                                      right_keys, bucketed=True,
                                      num_buckets=lspec.num_buckets,
-                                     how=plan.join_type)
+                                     how=plan.join_type, conf=conf)
         # General path: hash exchange + sort on each side.
         num_partitions = max(lspec.num_buckets if lspec else 0,
                              rspec.num_buckets if rspec else 0, 200)
@@ -489,6 +525,6 @@ def plan_physical(plan: LogicalPlan,
                                                          right_phys))
         return SortMergeJoinExec(left_sorted, right_sorted, left_keys,
                                  right_keys, bucketed=False,
-                                 how=plan.join_type)
+                                 how=plan.join_type, conf=conf)
 
     raise HyperspaceException(f"Cannot plan node: {plan!r}")

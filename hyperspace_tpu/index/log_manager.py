@@ -57,22 +57,28 @@ class IndexLogManagerImpl(IndexLogManager):
     def _path_for(self, log_id: int) -> str:
         return os.path.join(self.log_dir, str(log_id))
 
-    def get_log(self, log_id: int) -> Optional[LogEntry]:
-        path = self._path_for(log_id)
-        if not os.path.exists(path):
-            return None
-        # Retry briefly on a torn read: on no-hardlink filesystems the OCC
-        # fallback publishes the filename before its contents (see
-        # file_utils.atomic_write_if_absent).
+    def _read_entry(self, path: str) -> tuple[LogEntry, str]:
+        """Read + parse a log file, retrying briefly on a torn read: on
+        no-hardlink filesystems the OCC fallback publishes the filename
+        before its contents (see file_utils.atomic_write_if_absent). ALL
+        log-file reads must come through here, not just get_log."""
         last_error: Exception | None = None
         for _ in range(5):
             try:
-                return LogEntry.from_json(file_utils.read_contents(path))
+                contents = file_utils.read_contents(path)
+                return LogEntry.from_json(contents), contents
             except (json.JSONDecodeError, ValueError) as exc:
                 last_error = exc
                 time.sleep(0.02)
         raise HyperspaceException(
             f"Corrupt log entry at {path}: {last_error}")
+
+    def get_log(self, log_id: int) -> Optional[LogEntry]:
+        path = self._path_for(log_id)
+        if not os.path.exists(path):
+            return None
+        entry, _ = self._read_entry(path)
+        return entry
 
     def get_latest_id(self) -> Optional[int]:
         """Max numeric filename (reference `IndexLogManager.scala:80-89`)."""
@@ -90,7 +96,8 @@ class IndexLogManagerImpl(IndexLogManager):
         (reference `IndexLogManager.scala:91-110`)."""
         stable_path = os.path.join(self.log_dir, constants.LATEST_STABLE_LOG)
         if os.path.exists(stable_path):
-            return LogEntry.from_json(file_utils.read_contents(stable_path))
+            entry, _ = self._read_entry(stable_path)
+            return entry
         latest = self.get_latest_id()
         if latest is None:
             return None
@@ -105,11 +112,11 @@ class IndexLogManagerImpl(IndexLogManager):
         source = self._path_for(log_id)
         if not os.path.exists(source):
             return False
-        entry = LogEntry.from_json(file_utils.read_contents(source))
+        entry, contents = self._read_entry(source)
         if entry.state not in constants.STABLE_STATES:
             return False
         file_utils.create_file(os.path.join(self.log_dir, constants.LATEST_STABLE_LOG),
-                               file_utils.read_contents(source))
+                               contents)
         return True
 
     def delete_latest_stable_log(self) -> bool:

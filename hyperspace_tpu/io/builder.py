@@ -31,44 +31,228 @@ from hyperspace_tpu.io import columnar, parquet
 from hyperspace_tpu.plan.nodes import BucketSpec
 
 
+def _write_sorted_runs(table, perm_chunks, starts, ends, path: str,
+                       file_suffix: Optional[str]) -> List[str]:
+    """Apply the device-computed permutation chunk by chunk on the host and
+    stream bucket files out.
+
+    `perm_chunks` are contiguous slices of the global (bucket, *keys) sort
+    permutation, still device-resident: their D2H copies are issued
+    asynchronously up front, so chunk i+1 is in flight over the link while
+    chunk i is being gathered (Arrow `take`) and parquet-encoded. A bucket
+    whose rows span a chunk boundary is written as multiple run files
+    (`part-NNNNN-cKK.parquet`); runs are contiguous in sort order, so their
+    name-ordered concatenation stays fully sorted — the same multi-run
+    layout the incremental-refresh deltas already use.
+    """
+    import pyarrow as pa
+
+    # Order matters: issue every chunk's DMA before the first blocking
+    # np.asarray (starts/ends below) so the transfers run during the
+    # device-sort sync instead of after it.
+    for chunk in perm_chunks:
+        if hasattr(chunk, "copy_to_host_async"):
+            try:
+                chunk.copy_to_host_async()
+            except Exception:
+                pass  # best-effort prefetch only
+    starts, ends = np.asarray(starts), np.asarray(ends)
+    written: List[str] = []
+    os.makedirs(path, exist_ok=True)
+    multi = len(perm_chunks) > 1
+    offset = 0
+    for ci, chunk in enumerate(perm_chunks):
+        perm_np = np.asarray(chunk)
+        m = len(perm_np)
+        if m == 0:
+            continue
+        chunk_table = table.take(pa.array(perm_np))
+        # Buckets intersecting sorted-row range [offset, offset + m).
+        b_lo = int(np.searchsorted(ends, offset, side="right"))
+        b_hi = int(np.searchsorted(starts, offset + m, side="left"))
+        for b in range(b_lo, b_hi):
+            s = max(int(starts[b]), offset)
+            e = min(int(ends[b]), offset + m)
+            if e <= s:
+                continue  # empty bucket -> no file, like Spark bucketed output
+            suffix = file_suffix
+            if multi and (int(starts[b]) < offset or int(ends[b]) > offset + m):
+                # Partial run of a chunk-spanning bucket: unique, ordered name.
+                suffix = f"{file_suffix or ''}c{ci:02d}"
+            out = os.path.join(path, parquet.bucket_file_name(b, suffix))
+            parquet.write_table(chunk_table.slice(s - offset, e - s), out)
+            written.append(out)
+        offset += m
+    return written
+
+
+def _stage_key_tree(table, names: Sequence[str]):
+    """Stage the key columns of a host Arrow table as a device key tree
+    for `ops.build.permutation_from_tree`, with narrow transport: a
+    null-free int64 column whose values fit uint32 (host range check over
+    data already in cache) ships HALF the bytes as a single `lo32` lane —
+    hash identity and sort order are unchanged (`ops/build.py`)."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+
+    tree = {}
+    wide = []
+    for name in names:
+        arr = table.column(name)
+        chunk = (arr.combine_chunks() if hasattr(arr, "combine_chunks")
+                 else arr)
+        if pa.types.is_int64(chunk.type) and chunk.null_count == 0:
+            vals = chunk.to_numpy(zero_copy_only=False)
+            if len(vals) and vals.min() >= 0 and vals.max() < 1 << 32:
+                tree[name] = {"lo32": jnp.asarray(vals.astype(np.uint32))}
+                continue
+        wide.append(name)
+    if wide:
+        batch = columnar.from_arrow(table.select(wide))
+        staged, _aux = columnar.batch_to_tree(batch)
+        tree.update(staged)
+    return tree
+
+
+def write_bucketed_table(table, indexed_columns: Sequence[str],
+                         num_buckets: int, path: str,
+                         file_suffix: Optional[str] = None,
+                         key_batch: Optional[columnar.ColumnBatch] = None
+                         ) -> List[str]:
+    """Bucketed build from a HOST Arrow table: only the key columns touch
+    the device (hash + sort -> permutation); payload rows never cross the
+    link. `key_batch` may pass an already-staged device batch containing
+    the key columns (any extra columns are ignored)."""
+    from hyperspace_tpu.ops.build import (build_permutation,
+                                          permutation_from_tree)
+
+    if table.num_rows == 0:
+        os.makedirs(path, exist_ok=True)
+        return []
+    if key_batch is None:
+        by_lower = {n.lower(): n for n in table.column_names}
+        missing = [c for c in indexed_columns if c.lower() not in by_lower]
+        if missing:
+            raise HyperspaceException(
+                f"Column not found in table: {', '.join(missing)}")
+        names = [by_lower[c.lower()] for c in indexed_columns]
+        tree = _stage_key_tree(table, names)
+        chunks, starts, ends = permutation_from_tree(
+            tree, names, table.num_rows, num_buckets)
+    else:
+        if key_batch.num_rows != table.num_rows:
+            raise HyperspaceException(
+                f"key_batch rows ({key_batch.num_rows}) != table rows "
+                f"({table.num_rows}); the permutation would silently drop "
+                f"rows.")
+        chunks, starts, ends = build_permutation(key_batch, indexed_columns,
+                                                 num_buckets)
+    return _write_sorted_runs(table, chunks, starts, ends, path, file_suffix)
+
+
 def write_bucketed_batch(batch: columnar.ColumnBatch,
                          indexed_columns: Sequence[str],
                          num_buckets: int, path: str,
                          file_suffix: Optional[str] = None) -> List[str]:
-    """Steps 2-5: bucket + sort a device batch, write one file per bucket.
-    The hash/sort/gather pipeline runs as ONE jitted XLA program
-    (`ops/build.py`). Returns the written file paths."""
-    from hyperspace_tpu.ops.build import build_sorted
-    sorted_batch, starts, ends = build_sorted(batch, indexed_columns,
-                                              num_buckets)
-    starts = np.asarray(starts)
-    ends = np.asarray(ends)
+    """Bucketed build from a DEVICE-resident batch (post-filter/plan data).
 
-    table = columnar.to_arrow(sorted_batch)  # one device->host transfer
+    The permutation program and the unsorted payload's D2H copies are
+    dispatched together so the payload transfer overlaps the device sort;
+    the permutation is then applied host-side per chunk. This replaces the
+    old device payload gather + sorted-payload transfer, which serialized
+    the big D2H behind the sort."""
+    from hyperspace_tpu.ops.build import build_permutation
+
+    if batch.num_rows == 0:
+        os.makedirs(path, exist_ok=True)
+        return []
+    chunks, starts, ends = build_permutation(batch, indexed_columns,
+                                             num_buckets)
+    table = columnar.to_arrow(batch)  # async copies overlap the sort
+    return _write_sorted_runs(table, chunks, starts, ends, path, file_suffix)
+
+
+def _plain_scan_source(plan) -> Optional[tuple]:
+    """If the plan is just Project*(Scan) — the shape CreateAction.validate
+    admits (reference `CreateAction.scala:42-62`) — return (files, scan
+    schema); else None. Lets the build read payload straight from parquet
+    on the host instead of round-tripping every column through HBM."""
+    from hyperspace_tpu.plan.nodes import Project, Scan
+
+    node = plan
+    while isinstance(node, Project):
+        node = node.child
+    if isinstance(node, Scan) and node.bucket_spec is None:
+        files = node.files()
+        if files:
+            return files, node.schema
+    return None
+
+
+def write_bucket_ordered(batch: columnar.ColumnBatch, lengths,
+                         num_buckets: int, path: str,
+                         file_suffix: Optional[str] = None) -> List[str]:
+    """Write a batch already concatenated in bucket order (the distributed
+    build's output shape) as bucketed parquet files."""
+    table = columnar.to_arrow(batch)
     written: List[str] = []
     os.makedirs(path, exist_ok=True)
+    offset = 0
     for b in range(num_buckets):
-        if ends[b] <= starts[b]:
-            continue  # empty bucket -> no file, like Spark bucketed output
-        out = os.path.join(path, parquet.bucket_file_name(b, file_suffix))
-        parquet.write_table(table.slice(int(starts[b]),
-                                        int(ends[b] - starts[b])), out)
-        written.append(out)
+        count = int(lengths[b])
+        if count > 0:
+            out = os.path.join(path, parquet.bucket_file_name(b, file_suffix))
+            parquet.write_table(table.slice(offset, count), out)
+            written.append(out)
+        offset += count
     return written
 
 
 def write_index(df, indexed_columns: Sequence[str],
                 included_columns: Sequence[str], num_buckets: int,
-                path: str) -> List[str]:
-    """THE index build job (reference `CreateActionBase.scala:99-120`)."""
+                path: str, conf=None) -> List[str]:
+    """THE index build job (reference `CreateActionBase.scala:99-120`).
+
+    With a multi-device mesh active (`parallel/context.py`) the build runs
+    the mesh-sharded all_to_all pipeline — the reference's cluster-wide
+    `repartition(numBuckets, indexedCols)` shuffle
+    (`CreateActionBase.scala:110-111`) expressed as XLA collectives."""
     from hyperspace_tpu.engine.executor import execute_plan
+    from hyperspace_tpu.parallel.context import should_distribute
+
+    def build_distributed(mesh, batch):
+        from hyperspace_tpu.parallel.build import distributed_build
+
+        built, lengths = distributed_build(batch, indexed_columns,
+                                           num_buckets, mesh)
+        return write_bucket_ordered(built, lengths, num_buckets, path)
 
     columns = list(indexed_columns) + list(included_columns)
-    batch = execute_plan(df.plan, projection=columns)
-    written = write_bucketed_batch(batch, indexed_columns, num_buckets, path)
+    source = _plain_scan_source(df.plan)
+    if source is not None:
+        files, scan_schema = source
+        names = [scan_schema.field(c).name for c in columns]
+        table = parquet.read_table(files, columns=names)
+        schema = scan_schema.select(columns)
+        mesh = should_distribute(conf, table.num_rows)
+        if mesh is not None:
+            written = build_distributed(mesh, columnar.from_arrow(table,
+                                                                  schema))
+        else:
+            written = write_bucketed_table(table, indexed_columns,
+                                           num_buckets, path)
+    else:
+        batch = execute_plan(df.plan, projection=columns, conf=conf)
+        schema = batch.schema
+        mesh = should_distribute(conf, batch.num_rows)
+        if mesh is not None:
+            written = build_distributed(mesh, batch)
+        else:
+            written = write_bucketed_batch(batch, indexed_columns,
+                                           num_buckets, path)
     spec = BucketSpec(num_buckets, tuple(indexed_columns),
                       tuple(indexed_columns))
-    parquet.write_bucket_spec(path, spec, batch.schema)
+    parquet.write_bucket_spec(path, spec, schema)
     return written
 
 

@@ -149,11 +149,16 @@ def _match_core(l_ids, r_ids, l_idx, l_valid, r_idx, r_valid):
 
 
 @partial(__import__("jax").jit, static_argnames=("total", "Ll"))
-def _expand_core(starts, counts, lo_c, l_pos, r_pos, l_idx, r_idx,
+def _expand_core(starts, match_counts, lo_c, l_pos, r_pos, l_idx, r_idx,
                  total: int, Ll: int):
-    """Expand (bucket,row,offset) -> original row index pairs. Rows with
-    effective count but zero matches (left-outer padding slots) yield
-    right index -1."""
+    """Expand (bucket,row,offset) -> original row index pairs.
+
+    `starts` is the cumulative layout of EFFECTIVE counts (outer joins
+    reserve one output slot for unmatched real left rows); `match_counts`
+    is the TRUE per-slot match count from `_match_core`, pre-outer-fill —
+    a slot whose true count is zero emits right index -1. Deriving
+    `matched` from the effective counts would make every reserved outer
+    slot look matched and gather an arbitrary right row."""
     import jax.numpy as jnp
 
     slots = jnp.arange(total, dtype=starts.dtype)
@@ -162,7 +167,7 @@ def _expand_core(starts, counts, lo_c, l_pos, r_pos, l_idx, r_idx,
     i = (row % Ll).astype(jnp.int32)
     offset = (slots - jnp.take(starts, row)).astype(jnp.int32)
     l_slot = l_pos[b, i]
-    matched = jnp.take(counts, row) > 0
+    matched = jnp.take(match_counts, row) > 0
     Lr = r_pos.shape[1]
     r_lookup = jnp.clip(lo_c[b, i] + offset, 0, Lr - 1)
     r_slot = r_pos[b, r_lookup]
@@ -197,17 +202,18 @@ def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
     l_idx, l_valid = jnp.asarray(l_idx), jnp.asarray(l_valid)
     r_idx, r_valid = jnp.asarray(r_idx), jnp.asarray(r_valid)
 
-    counts, starts, lo_c, l_pos, r_pos, real = _match_core(
+    match_counts, starts, lo_c, l_pos, r_pos, real = _match_core(
         l_ids, r_ids, l_idx, l_valid, r_idx, r_valid)
+    counts = match_counts
     if left_outer:
         # One output row per unmatched REAL left row (incl. null keys).
-        counts = jnp.maximum(counts, real.astype(counts.dtype))
+        counts = jnp.maximum(match_counts, real.astype(match_counts.dtype))
         starts = jnp.cumsum(counts) - counts
     total = int(jnp.sum(counts))  # the one host sync
     if total == 0:
         return empty, empty
-    return _expand_core(starts, counts, lo_c, l_pos, r_pos, l_idx, r_idx,
-                        total, int(l_pos.shape[1]))
+    return _expand_core(starts, match_counts, lo_c, l_pos, r_pos, l_idx,
+                        r_idx, total, int(l_pos.shape[1]))
 
 
 def _gather_side(batch: ColumnBatch, idx):

@@ -25,9 +25,16 @@ def _tree_hash_lanes(entry):
     """Hash-input lanes of one column tree entry (mirrors
     `ops/hash_partition.column_hash_lanes` on raw arrays): strings gather
     their dictionary value hashes; numerics decompose into 32-bit key
-    lanes; null rows contribute all-zero lanes."""
+    lanes; null rows contribute all-zero lanes. A `lo32` entry is the
+    narrow transport of an int64 column whose hi lane is provably zero
+    (host-checked range): the hash still mixes the canonical [hi, lo]
+    lane chain — hi synthesized as zeros — so bucket ids are bit-identical
+    to the wide path."""
     import jax.numpy as jnp
 
+    if "lo32" in entry:
+        lo = entry["lo32"]
+        return [jnp.zeros_like(lo), lo]
     data = entry["data"]
     if "hash_hi" in entry:
         lanes = [jnp.take(entry["hash_hi"], data),
@@ -42,6 +49,9 @@ def _tree_hash_lanes(entry):
 
 
 def _entry_sort_lanes(entry):
+    if "lo32" in entry:
+        # hi lane is constant zero -> order is fully determined by lo.
+        return [entry["lo32"]]
     lanes = []
     if "validity" in entry:
         lanes.append(entry["validity"])
@@ -107,6 +117,69 @@ def _build_core(tree, key_names: Tuple[str, ...], num_buckets: int,
     starts = jnp.searchsorted(sorted_bucket, buckets, side="left")
     ends = jnp.searchsorted(sorted_bucket, buckets, side="right")
     return sorted_tree, sorted_bucket, starts, ends
+
+
+@partial(__import__("jax").jit,
+         static_argnames=("key_names", "num_buckets", "n_chunks",
+                          "use_pallas"))
+def _perm_core(key_tree, key_names: Tuple[str, ...], num_buckets: int,
+               n_chunks: int, use_pallas: bool = False):
+    """Permutation-only build core: hash + ONE stable (bucket, *keys) sort
+    over the KEY columns, returning the int32 row permutation (split into
+    n_chunks contiguous slices for overlapped D2H) + per-bucket ranges.
+
+    The payload never touches the device: profiling on the tunneled v5e
+    showed the D2H of gathered payload columns dominating the whole build
+    (~1.3s of a 2.2s/2M-row build), while the permutation is one int32
+    lane. The host applies the permutation with Arrow `take` (C++) and
+    streams bucket files while later chunks are still in flight.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    bucket = _tree_bucket_ids(key_tree, key_names, num_buckets, use_pallas)
+    n = bucket.shape[0]
+    operands = [bucket]
+    for name in key_names:
+        operands.extend(_entry_sort_lanes(key_tree[name]))
+    iota = jnp.arange(n, dtype=jnp.int32)
+    results = jax.lax.sort([*operands, iota], num_keys=len(operands),
+                           is_stable=True)
+    perm = results[-1]
+    sorted_bucket = results[0]
+    buckets = jnp.arange(num_buckets, dtype=jnp.int32)
+    starts = jnp.searchsorted(sorted_bucket, buckets, side="left")
+    ends = jnp.searchsorted(sorted_bucket, buckets, side="right")
+    base = n // n_chunks
+    chunks = tuple(
+        jax.lax.slice(perm, (i * base,),
+                      ((i + 1) * base if i < n_chunks - 1 else n,))
+        for i in range(n_chunks))
+    return chunks, starts, ends
+
+
+def permutation_from_tree(key_tree, key_names: Sequence[str], n: int,
+                          num_buckets: int, n_chunks: int = 0):
+    """As `build_permutation` over an already-staged device key tree."""
+    if n_chunks <= 0:
+        # Chunked D2H only pays off once the transfer dwarfs the ~0.1s
+        # per-sync latency of the tunneled device link.
+        n_chunks = 4 if n >= 1 << 19 else 1
+    n_chunks = max(1, min(n_chunks, n))
+    return _perm_core(key_tree, tuple(key_names), num_buckets, n_chunks,
+                      use_pallas=_pallas_enabled())
+
+
+def build_permutation(batch: ColumnBatch, key_columns: Sequence[str],
+                      num_buckets: int, n_chunks: int = 0):
+    """Device-computed sort permutation for a bucketed build. `batch` only
+    needs the key columns resident. Returns (perm chunk arrays, starts,
+    ends); concatenated chunks give the full row permutation in
+    (bucket, *keys) order."""
+    key_names = tuple(batch.schema.field(c).name for c in key_columns)
+    tree, _aux = batch_to_tree(batch.select(key_names))
+    return permutation_from_tree(tree, key_names, batch.num_rows,
+                                 num_buckets, n_chunks)
 
 
 def build_sorted(batch: ColumnBatch, key_columns: Sequence[str],

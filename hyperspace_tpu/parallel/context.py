@@ -1,0 +1,57 @@
+"""Distribution context: decides whether the data plane runs on a mesh.
+
+The reference delegates this decision to the Spark cluster it runs inside
+(every build/join IS distributed there, `CreateActionBase.scala:110-111`,
+`JoinIndexRule.scala:124-153`); here the "cluster" is the set of visible
+jax devices. `spark.hyperspace.distribution.enabled`:
+
+- "auto" (default): distribute when more than one device is visible;
+- "true": distribute (no-op on a single device — there is no mesh to use);
+- "false": always single-chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from hyperspace_tpu import constants
+
+
+def distribution_mesh(conf=None):
+    """The mesh to distribute over, or None for single-chip execution."""
+    mode = conf.distribution if conf is not None else "auto"
+    if mode == "false":
+        return None
+    import jax
+
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        return None
+    if len(devices) < 2:
+        return None
+    from hyperspace_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(len(devices))
+
+
+def mesh_size(mesh) -> int:
+    from hyperspace_tpu.parallel.mesh import SHARD_AXIS
+
+    return mesh.shape[SHARD_AXIS]
+
+
+def should_distribute(conf, num_rows: Optional[int] = None):
+    """Mesh to use for this operation, or None. In "auto" mode small
+    batches stay single-chip — per-shard padding plus collective latency
+    dwarfs the work below `distribution.min.rows`; "true" distributes
+    regardless of size (tests use this to exercise the mesh paths)."""
+    mesh = distribution_mesh(conf)
+    if mesh is None:
+        return None
+    mode = conf.distribution if conf is not None else "auto"
+    min_rows = (conf.distribution_min_rows if conf is not None
+                else constants.DISTRIBUTION_MIN_ROWS_DEFAULT)
+    if mode == "auto" and num_rows is not None and num_rows < min_rows:
+        return None
+    return mesh

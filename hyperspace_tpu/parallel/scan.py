@@ -1,0 +1,63 @@
+"""Mesh-sharded predicate scan.
+
+Reference rationale: `FilterIndexRule.scala:112-120` replaces the relation
+with NO BucketSpec precisely so the engine parallelizes the scan freely —
+the filter path's parallelism axis is rows, not buckets (SURVEY §2.12 row
+4). Here rows are sharded over the mesh and the compiled predicate runs
+SPMD: each chip evaluates the mask over its shard; only the compaction
+gather crosses chips.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from hyperspace_tpu.io.columnar import ColumnBatch, DeviceColumn
+from hyperspace_tpu.parallel.mesh import SHARD_AXIS, shard_rows
+
+
+def shard_batch(batch: ColumnBatch, mesh):
+    """Pad rows to a multiple of the mesh size and place every column
+    row-sharded. Returns (sharded batch, row_valid mask) — padding rows are
+    marked invalid and must be excluded by the caller."""
+    import jax
+    import jax.numpy as jnp
+
+    n = batch.num_rows
+    n_shards = mesh.shape[SHARD_AXIS]
+    padded = -(-n // n_shards) * n_shards
+    pad = padded - n
+    sharding = shard_rows(mesh)
+
+    def place(arr, fill):
+        if pad:
+            arr = jnp.concatenate(
+                [arr, jnp.full((pad,) + arr.shape[1:], fill, arr.dtype)])
+        return jax.device_put(arr, sharding)
+
+    columns: Dict[str, DeviceColumn] = {}
+    for name, col in batch.columns.items():
+        columns[name] = DeviceColumn(
+            data=place(col.data, 0),
+            dtype=col.dtype,
+            validity=(place(col.validity, False)
+                      if col.validity is not None else None),
+            dictionary=col.dictionary,
+            dict_hashes=col.dict_hashes)
+    row_valid = place(jnp.ones(n, dtype=bool), False)
+    return ColumnBatch(batch.schema, columns), row_valid
+
+
+def distributed_filter(batch: ColumnBatch, expression, mesh) -> ColumnBatch:
+    """Filter `batch` on the mesh; result equals the single-chip
+    `engine.compiler.apply_filter` bit for bit. The predicate (the FLOPs)
+    runs shard-locally; the compaction gather is the only cross-chip step."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.engine.compiler import compile_predicate
+
+    sharded, row_valid = shard_batch(batch, mesh)
+    mask = compile_predicate(expression, sharded) & row_valid
+    count = int(jnp.sum(mask))  # host sync — sizes the output
+    (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
+    return sharded.take(indices)

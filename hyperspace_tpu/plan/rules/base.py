@@ -42,12 +42,16 @@ class Rule:
         if cache_key not in self._sig_cache:
             try:
                 provider = SignatureProviderFactory.create(stored.provider)
-                self._sig_cache[cache_key] = provider.signature(plan)
+                sig = provider.signature(plan)
             except Exception as exc:  # provider failure -> no match, not a crash
                 logger.warning("Signature provider %s failed: %s",
                                stored.provider, exc)
-                self._sig_cache[cache_key] = None
-        current = self._sig_cache[cache_key]
+                sig = None
+            # Pin the plan object in the cache value: id() keys are only
+            # unique while the object is alive, and per-candidate plans
+            # built inside one apply() can be GC'd and their id reused.
+            self._sig_cache[cache_key] = (plan, sig)
+        current = self._sig_cache[cache_key][1]
         return current is not None and current == stored.value
 
     @staticmethod

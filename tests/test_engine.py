@@ -71,6 +71,50 @@ def test_filter_on_nullable_column(session, tmp_path):
     assert df.filter(col("x").is_not_null()).count() == 3
 
 
+def test_not_predicate_three_valued_null_semantics(session, tmp_path):
+    """Regression: NOT over a nullable comparison must keep NULL rows
+    filtered (SQL: NOT(NULL = 5) is NULL, which is not-true). The old
+    compiler folded NULL to False and ~mask let those rows through."""
+    table = pa.table({"x": pa.array([1, None, 5], type=pa.int64()),
+                      "s": pa.array(["a", None, "c"])})
+    d = tmp_path / "nulls3v"
+    d.mkdir()
+    pq.write_table(table, str(d / "part-0.parquet"))
+    df = session.read_parquet(str(d))
+
+    assert df.filter(~(col("x") == 5)).to_pandas()["x"].tolist() == [1]
+    assert df.filter(~(col("x") != 5)).to_pandas()["x"].tolist() == [5]
+    assert df.filter(~col("x").isin(1, 2)).to_pandas()["x"].tolist() == [5]
+    # Double negation keeps NULL out too.
+    assert df.filter(~~(col("x") == 5)).to_pandas()["x"].tolist() == [5]
+    # NOT over string comparisons rides the same validity.
+    assert df.filter(~(col("s") == "c")).to_pandas()["s"].tolist() == ["a"]
+    # IS NULL under NOT is always known.
+    assert df.filter(~col("x").is_null()).count() == 2
+
+
+def test_kleene_and_or_with_nulls(session, tmp_path):
+    """Kleene logic: FALSE AND NULL = FALSE (known), TRUE OR NULL = TRUE
+    (known) — so NOT over those combinations behaves like SQL/Spark."""
+    table = pa.table({"x": pa.array([1, None, 5], type=pa.int64()),
+                      "y": pa.array([7, 8, None], type=pa.int64())})
+    d = tmp_path / "kleene"
+    d.mkdir()
+    pq.write_table(table, str(d / "part-0.parquet"))
+    df = session.read_parquet(str(d))
+
+    # NOT(x=5 AND y=9): row x=1 -> NOT(F AND ?)=T; x=None -> NOT(NULL AND F)
+    # = NOT F = T (y=8 makes the AND definitely false); x=5,y=None ->
+    # NOT(T AND NULL) = NULL -> filtered.
+    out = df.filter(~((col("x") == 5) & (col("y") == 9)))
+    assert out.to_pandas()["y"].tolist() == [7, 8]
+    # NOT(x=1 OR y=8): x=1 -> NOT T = F; None,8 -> NOT(NULL OR T)=NOT T=F;
+    # 5,None -> NOT(F OR NULL) = NULL -> filtered. Nothing passes.
+    assert df.filter(~((col("x") == 1) | (col("y") == 8))).count() == 0
+    # And the positive forms still work.
+    assert df.filter((col("x") == 1) | (col("y") == 8)).count() == 2
+
+
 def test_select_and_projection_pushdown(df):
     q = df.filter(col("clicks") > 50).select("id", "score")
     _, _, physical = q.explain_plans()

@@ -166,6 +166,103 @@ def test_bucketed_join_empty_side():
     assert columnar.to_arrow(out).column_names == ["k", "x", "k_r", "y"]
 
 
+def test_bucketed_left_outer_unmatched_rows_get_null():
+    """Regression: unmatched left rows must emit right index -1, not an
+    arbitrary right row (the outer fill used to overwrite the true match
+    counts before _expand_core derived its matched mask)."""
+    from hyperspace_tpu.ops.bucketed_join import bucketed_join_indices
+    left = batch_of(k=np.array([1, 2, 3], np.int64),
+                    x=np.array([10, 20, 30], np.int64))
+    right = batch_of(k=np.array([1, 3], np.int64),
+                     y=np.array([100, 300], np.int64))
+    li, ri = bucketed_join_indices(left, right, np.array([3], np.int64),
+                                   np.array([2], np.int64), ["k"], ["k"],
+                                   how="left_outer")
+    pairs = sorted(zip(np.asarray(li).tolist(), np.asarray(ri).tolist()))
+    assert pairs == [(0, 0), (1, -1), (2, 1)]
+
+
+def test_bucketed_outer_join_null_payloads():
+    """Full outer-join assembly: unmatched rows carry nulls on the other
+    side, for both left_outer and right_outer, across buckets."""
+    from hyperspace_tpu.ops.bucketed_join import bucketed_sort_merge_join
+    left = batch_of(k=np.array([1, 2, 5, 6], np.int64),
+                    x=np.array([10, 20, 50, 60], np.int64))
+    right = batch_of(k=np.array([2, 5, 7], np.int64),
+                     y=np.array([200, 500, 700], np.int64))
+    # Two buckets: left has rows [1,2] then [5,6]; right [2] then [5,7].
+    out = columnar.to_arrow(bucketed_sort_merge_join(
+        left, right, np.array([2, 2], np.int64), np.array([1, 2], np.int64),
+        ["k"], ["k"], how="left_outer"))
+    rows = sorted(zip(out.column("x").to_pylist(), out.column("y").to_pylist()))
+    assert rows == [(10, None), (20, 200), (50, 500), (60, None)]
+
+    out = columnar.to_arrow(bucketed_sort_merge_join(
+        left, right, np.array([2, 2], np.int64), np.array([1, 2], np.int64),
+        ["k"], ["k"], how="right_outer"))
+    rows = sorted(zip(out.column("x").to_pylist(), out.column("y").to_pylist()),
+                  key=lambda t: (t[0] is None, t))
+    assert rows == [(20, 200), (50, 500), (None, 700)]
+
+
+def test_bucketed_left_outer_null_keys_unmatched():
+    """NULL join keys never match but still appear once in a left outer."""
+    from hyperspace_tpu.ops.bucketed_join import bucketed_sort_merge_join
+    left = columnar.from_arrow(pa.table({
+        "k": pa.array([1, None, 3], type=pa.int64()),
+        "x": pa.array([10, 20, 30], type=pa.int64())}))
+    right = batch_of(k=np.array([1, 3], np.int64),
+                     y=np.array([100, 300], np.int64))
+    out = columnar.to_arrow(bucketed_sort_merge_join(
+        left, right, np.array([3], np.int64), np.array([2], np.int64),
+        ["k"], ["k"], how="left_outer"))
+    rows = sorted(zip(out.column("x").to_pylist(), out.column("y").to_pylist()))
+    assert rows == [(10, 100), (20, None), (30, 300)]
+
+
+def test_narrow_key_transport_matches_wide_path(tmp_path):
+    """`_stage_key_tree`'s lo32 narrow transport must produce the exact
+    same bucket layout and row order as the wide int64 path — bucket ids
+    ride the same [hi=0, lo] hash lane chain."""
+    import os
+    import pyarrow.parquet as pq
+    from hyperspace_tpu.io.builder import write_bucketed_table
+
+    rng = np.random.default_rng(11)
+    n = 5000
+    table = pa.table({
+        "k": rng.integers(0, 1 << 31, n).astype(np.int64),  # fits uint32
+        "v": np.arange(n, dtype=np.int64),
+    })
+    narrow_dir = str(tmp_path / "narrow")
+    wide_dir = str(tmp_path / "wide")
+    write_bucketed_table(table, ["k"], 8, narrow_dir)  # narrow staging
+    write_bucketed_table(table, ["k"], 8, wide_dir,
+                         key_batch=columnar.from_arrow(table))  # wide lanes
+    narrow_files = sorted(os.listdir(narrow_dir))
+    assert narrow_files == sorted(os.listdir(wide_dir))
+    for f in narrow_files:
+        a = pq.read_table(os.path.join(narrow_dir, f))
+        b = pq.read_table(os.path.join(wide_dir, f))
+        assert a.equals(b), f
+
+    # Values outside uint32 range must take the wide path and still work.
+    big = pa.table({
+        "k": (rng.integers(0, 1 << 31, 1000).astype(np.int64)
+              - (1 << 30)) * 8,  # negatives + >2^32
+        "v": np.arange(1000, dtype=np.int64),
+    })
+    big_dir = str(tmp_path / "big")
+    write_bucketed_table(big, ["k"], 4, big_dir)
+    rows = sum(pq.read_table(os.path.join(big_dir, f)).num_rows
+               for f in os.listdir(big_dir) if f.endswith(".parquet"))
+    assert rows == 1000
+    for f in os.listdir(big_dir):
+        if f.endswith(".parquet"):
+            ks = pq.read_table(os.path.join(big_dir, f)).column("k").to_pylist()
+            assert ks == sorted(ks)
+
+
 def test_float_hash_identity_shared_between_paths():
     """Eager column_hash32 and the jitted build core must agree on float
     keys — on-disk bucket layout depends on one shared hash identity."""

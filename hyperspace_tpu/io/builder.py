@@ -104,7 +104,16 @@ def _stage_key_tree(table, names: Sequence[str]):
         if pa.types.is_int64(chunk.type) and chunk.null_count == 0:
             vals = chunk.to_numpy(zero_copy_only=False)
             if len(vals) and vals.min() >= 0 and vals.max() < 1 << 32:
-                tree[name] = {"lo32": jnp.asarray(vals.astype(np.uint32))}
+                lo = vals.astype(np.uint32)
+                if len(lo) >= 1 << 19:
+                    # Several concurrent H2D streams beat one big transfer
+                    # on the tunneled link; the program concatenates.
+                    import jax
+                    parts = np.array_split(lo, 4)
+                    tree[name] = {"lo32_chunks": tuple(
+                        jax.device_put(p) for p in parts)}
+                else:
+                    tree[name] = {"lo32": jnp.asarray(lo)}
                 continue
         wide.append(name)
     if wide:

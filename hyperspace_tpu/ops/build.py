@@ -32,6 +32,7 @@ def _tree_hash_lanes(entry):
     to the wide path."""
     import jax.numpy as jnp
 
+    entry = _entry_assemble(entry)
     if "lo32" in entry:
         lo = entry["lo32"]
         return [jnp.zeros_like(lo), lo]
@@ -49,6 +50,7 @@ def _tree_hash_lanes(entry):
 
 
 def _entry_sort_lanes(entry):
+    entry = _entry_assemble(entry)
     if "lo32" in entry:
         # hi lane is constant zero -> order is fully determined by lo.
         return [entry["lo32"]]
@@ -117,6 +119,27 @@ def _build_core(tree, key_names: Tuple[str, ...], num_buckets: int,
     starts = jnp.searchsorted(sorted_bucket, buckets, side="left")
     ends = jnp.searchsorted(sorted_bucket, buckets, side="right")
     return sorted_tree, sorted_bucket, starts, ends
+
+
+# Transfer policy for the tunneled host<->device link: split transfers of
+# >= LINK_CHUNK_ROWS rows into LINK_CHUNKS concurrent streams (measured
+# ~1.7x faster than one stream; below the threshold the ~0.1s per-sync
+# latency dominates). Shared by the H2D staging (`io/builder.py`) and the
+# D2H permutation fetch (`permutation_from_tree`).
+LINK_CHUNK_ROWS = 1 << 19
+LINK_CHUNKS = 4
+
+
+def _entry_assemble(entry):
+    """Reassemble a chunk-staged entry (lo32 shipped as LINK_CHUNKS
+    concurrent H2D streams) into its single-array form inside the compiled
+    program. Called by every entry reader so ALL consumers of a staged
+    tree handle the chunked form."""
+    import jax.numpy as jnp
+
+    if "lo32_chunks" in entry:
+        return {"lo32": jnp.concatenate(entry["lo32_chunks"])}
+    return entry
 
 
 @partial(__import__("jax").jit,

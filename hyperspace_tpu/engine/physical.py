@@ -167,13 +167,18 @@ class FilterExec(PhysicalNode):
         if batch.num_rows == 0:
             return batch, lengths
         mask = compile_predicate(self.condition, batch)
-        host_mask = np.asarray(mask)
-        count = int(host_mask.sum())
+        # Per-bucket survivor counts as ONE device segment-sum (row ->
+        # bucket via searchsorted over the running lengths), then a single
+        # [num_buckets] transfer sizes both the new lengths and the gather.
+        import jax
+        csum = jnp.cumsum(jnp.asarray(lengths, dtype=jnp.int64))
+        row_bucket = jnp.searchsorted(
+            csum, jnp.arange(batch.num_rows, dtype=jnp.int64), side="right")
+        new_lengths = np.asarray(jax.ops.segment_sum(
+            mask.astype(jnp.int32), row_bucket.astype(jnp.int32),
+            num_segments=num_buckets)).astype(np.int64)
+        count = int(new_lengths.sum())
         (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
-        boundaries = np.concatenate([[0], np.cumsum(lengths)]).astype(int)
-        new_lengths = np.asarray(
-            [host_mask[boundaries[b]:boundaries[b + 1]].sum()
-             for b in range(num_buckets)], dtype=np.int64)
         return batch.take(indices), new_lengths
 
 
@@ -350,10 +355,16 @@ class SortMergeJoinExec(PhysicalNode):
             # (`ops/bucketed_join.py`): zero shuffle, zero global sort, no
             # per-bucket compile explosion. Buckets are independent ->
             # mesh-parallel in `parallel/join.py`.
-            from hyperspace_tpu.ops.bucketed_join import bucketed_sort_merge_join
+            from hyperspace_tpu.ops.bucketed_join import (
+                bucketed_sort_merge_join, padded_skew)
             lbatch, l_lengths = self.left.execute_bucketed(self.num_buckets)
             rbatch, r_lengths = self.right.execute_bucketed(self.num_buckets)
-            mesh = self._join_mesh(lbatch.num_rows + rbatch.num_rows)
+            # The mesh path shares the padded [B, L] layout; under hot-key
+            # skew route single-chip so the global-join fallback applies.
+            skewed = padded_skew(l_lengths, r_lengths, lbatch.num_rows,
+                                 rbatch.num_rows)
+            mesh = (None if skewed
+                    else self._join_mesh(lbatch.num_rows + rbatch.num_rows))
             if mesh is not None:
                 from hyperspace_tpu.ops.bucketed_join import (
                     assemble_join_output)

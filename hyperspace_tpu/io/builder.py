@@ -105,11 +105,13 @@ def _stage_key_tree(table, names: Sequence[str]):
             vals = chunk.to_numpy(zero_copy_only=False)
             if len(vals) and vals.min() >= 0 and vals.max() < 1 << 32:
                 lo = vals.astype(np.uint32)
-                if len(lo) >= 1 << 19:
+                from hyperspace_tpu.ops.build import (LINK_CHUNK_ROWS,
+                                                      LINK_CHUNKS)
+                if len(lo) >= LINK_CHUNK_ROWS:
                     # Several concurrent H2D streams beat one big transfer
                     # on the tunneled link; the program concatenates.
                     import jax
-                    parts = np.array_split(lo, 4)
+                    parts = np.array_split(lo, LINK_CHUNKS)
                     tree[name] = {"lo32_chunks": tuple(
                         jax.device_put(p) for p in parts)}
                 else:

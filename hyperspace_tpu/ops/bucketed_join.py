@@ -35,9 +35,55 @@ from hyperspace_tpu.ops import keys as keymod
 
 _I32_MAX = np.int32(np.iinfo(np.int32).max)
 
+# Skew guard: the padded [B, L] layout costs B * next_pow2(max bucket len)
+# cells per side, so ONE hot key inflates every bucket's row to L and the
+# batched join degrades to O(B*L) memory/compute. Past this blowup the
+# layout loses to a global id-sort + merge join, whose cost is
+# O((n+m) log(n+m)) regardless of how keys distribute — the analog of
+# Spark's ragged partitions, where no bucket pays for a neighbour's skew.
+SKEW_BLOWUP_FACTOR = 8
+SKEW_MIN_CELLS = 1 << 22
+
 
 def next_pow2(n: int) -> int:
     return 1 << max(4, (int(n) - 1).bit_length())
+
+
+def padded_skew(l_lengths, r_lengths, n_rows: int, m_rows: int) -> bool:
+    """True when the padded bucket layout would materially out-size the
+    actual row count (hot-key skew) and the global join should be used."""
+    B = max(len(l_lengths), 1)
+    Ll = next_pow2(max(1, int(np.asarray(l_lengths).max(initial=0))))
+    Lr = next_pow2(max(1, int(np.asarray(r_lengths).max(initial=0))))
+    cells = B * (Ll + Lr)
+    return (cells > SKEW_MIN_CELLS
+            and cells > SKEW_BLOWUP_FACTOR * max(n_rows + m_rows, 1))
+
+
+def _global_join_indices(left: ColumnBatch, right: ColumnBatch,
+                         left_keys: Sequence[str],
+                         right_keys: Sequence[str], how: str) -> Tuple:
+    """Skew fallback. Both sides are bucketed by the same hash of the same
+    keys, so equal key tuples always share a bucket: a global id-sort +
+    merge join over all rows returns exactly the per-bucket match set
+    (row order differs; join output order is unspecified), with memory
+    bounded by the true row count."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.join import merge_join_indices
+
+    l_ids, r_ids = encode_group_ids(left, right, left_keys, right_keys)
+    l_perm = jnp.argsort(l_ids, stable=True)
+    r_perm = jnp.argsort(r_ids, stable=True)
+    li_s, ri_s = merge_join_indices(jnp.take(l_ids, l_perm),
+                                    jnp.take(r_ids, r_perm), how=how)
+    if li_s.shape[0] == 0:
+        return li_s, ri_s
+    li = jnp.take(l_perm, li_s).astype(jnp.int32)
+    ri = jnp.where(ri_s >= 0,
+                   jnp.take(r_perm, jnp.clip(ri_s, 0, None)),
+                   jnp.int32(-1)).astype(jnp.int32)
+    return li, ri
 
 
 def encode_group_ids(left: ColumnBatch, right: ColumnBatch,
@@ -194,6 +240,9 @@ def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
     if right.num_rows == 0:
         li = jnp.arange(left.num_rows, dtype=jnp.int32)
         return li, jnp.full(left.num_rows, -1, dtype=jnp.int32)
+    if padded_skew(l_lengths, r_lengths, left.num_rows, right.num_rows):
+        return _global_join_indices(left, right, left_keys, right_keys,
+                                    "left_outer" if left_outer else how)
     l_ids, r_ids = encode_group_ids(left, right, left_keys, right_keys)
     Ll = next_pow2(max(1, int(l_lengths.max(initial=0))))
     Lr = next_pow2(max(1, int(r_lengths.max(initial=0))))

@@ -187,7 +187,7 @@ def permutation_from_tree(key_tree, key_names: Sequence[str], n: int,
     if n_chunks <= 0:
         # Chunked D2H only pays off once the transfer dwarfs the ~0.1s
         # per-sync latency of the tunneled device link.
-        n_chunks = 4 if n >= 1 << 19 else 1
+        n_chunks = LINK_CHUNKS if n >= LINK_CHUNK_ROWS else 1
     n_chunks = max(1, min(n_chunks, n))
     return _perm_core(key_tree, tuple(key_names), num_buckets, n_chunks,
                       use_pallas=_pallas_enabled())

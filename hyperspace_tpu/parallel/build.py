@@ -190,8 +190,6 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
             break
         capacity *= 2  # exact recovery: nothing was lost, rerun wider
 
-    valid = np.asarray(out["__valid__"]["data"])
-    buckets = np.asarray(out["__bucket__"]["data"])
     result_tree = {}
     for name, entry in out.items():
         if name.startswith("__"):
@@ -204,12 +202,18 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
         result_tree[name] = cleaned
     full = tree_to_batch(result_tree, batch.schema, aux)
 
-    # Compact to valid rows on host indices (valid rows are contiguous per
-    # shard segment, ordered by bucket).
-    keep_idx = np.nonzero(valid)[0]
-    compacted = full.take(jnp.asarray(keep_idx))
-    kept_buckets = buckets[keep_idx]
-    lengths = np.bincount(kept_buckets, minlength=num_buckets).astype(np.int64)
-    order = np.argsort(kept_buckets, kind="stable")
-    final = compacted.take(jnp.asarray(order))
+    # Compact + globally order ON DEVICE: invalid rows carry bucket id
+    # num_buckets, and every bucket lives on exactly one shard
+    # (bucket % n_shards), so ONE stable argsort by bucket yields global
+    # (bucket, keys) order with invalid rows at the tail — the per-shard
+    # key order within each bucket is preserved. The only host traffic is
+    # the [num_buckets] length vector, which also sizes the final slice.
+    buckets_dev = out["__bucket__"]["data"]
+    valid_dev = out["__valid__"]["data"]
+    order = jnp.argsort(buckets_dev, stable=True)
+    lengths = np.asarray(jax.ops.segment_sum(
+        valid_dev.astype(jnp.int32), buckets_dev.astype(jnp.int32),
+        num_segments=num_buckets + 1))[:num_buckets].astype(np.int64)
+    total = int(lengths.sum())
+    final = full.take(order[:total])
     return final, lengths

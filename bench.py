@@ -1,15 +1,17 @@
-"""Benchmark: CoveringIndex build rows/sec/chip (BASELINE.md north star).
+"""Benchmark ladder (BASELINE.md configs 1-4).
 
-Measures the warm end-to-end index build — source batch on device ->
-hash-partition -> single bucket+key sort -> host transfer -> bucketed
-parquet write — and compares against an equivalent vectorized CPU pipeline
-(numpy hash + lexsort + pyarrow bucketed write), the fastest commodity
-single-node baseline available here (the reference publishes no numbers,
-BASELINE.md).
+Rungs measured warm, best-of-N, each against the fastest commodity
+single-node CPU comparator available here (vectorized numpy/pyarrow/pandas
+— the reference publishes no numbers, BASELINE.md):
 
-Prints exactly ONE JSON line on stdout:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-Diagnostics go to stderr.
+  1. covering-index build (hash-partition + bucket sort + bucketed parquet)
+  2. multi-column filter query served by FilterIndexRule (incl. included cols)
+  3. two-table equi-join served by JoinIndexRule's bucketed SMJ
+  4. hybrid scan: index + appended source files (no refresh)
+
+Prints exactly ONE JSON line on stdout — the north-star metric
+(covering_index_build_rows_per_sec_chip) with per-rung detail nested under
+"rungs". Diagnostics go to stderr.
 """
 
 import json
@@ -24,42 +26,71 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
+N_RIGHT = int(os.environ.get("BENCH_RIGHT_ROWS", max(N_ROWS // 10, 1)))
 NUM_BUCKETS = int(os.environ.get("BENCH_BUCKETS", 64))
+WARM_RUNS = int(os.environ.get("BENCH_WARM_RUNS", 5))
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def make_table():
-    import pyarrow as pa
-    rng = np.random.default_rng(42)
-    return pa.table({
-        "key": rng.integers(0, N_ROWS // 4, N_ROWS).astype(np.int64),
-        "id": np.arange(N_ROWS, dtype=np.int64),
-        "score": rng.random(N_ROWS).astype(np.float64),
-    })
+def best_of(fn, runs=WARM_RUNS, label=""):
+    best = float("inf")
+    for i in range(runs):
+        t0 = time.perf_counter()
+        out = fn()
+        elapsed = time.perf_counter() - t0
+        log(f"  {label} run {i}: {elapsed:.3f}s")
+        best = min(best, elapsed)
+        del out
+    return best
 
 
-def cpu_baseline(table, out_dir):
-    """Same pipeline, vectorized numpy + pyarrow on host."""
-    import pyarrow.parquet as pq
+def fmix32(h):
+    h = h ^ (h >> np.uint32(16))
+    h = (h * np.uint32(0x85EBCA6B))
+    h = h ^ (h >> np.uint32(13))
+    h = (h * np.uint32(0xC2B2AE35))
+    return h ^ (h >> np.uint32(16))
 
-    t0 = time.perf_counter()
-    key = table.column("key").to_numpy()
-    # murmur-style mix on 32-bit halves (same work as the device kernel)
-    def fmix32(h):
-        h = h ^ (h >> np.uint32(16))
-        h = (h * np.uint32(0x85EBCA6B))
-        h = h ^ (h >> np.uint32(13))
-        h = (h * np.uint32(0xC2B2AE35))
-        return h ^ (h >> np.uint32(16))
+
+def cpu_bucket_ids(key, num_buckets):
     hi = (key >> 32).astype(np.uint32)
     lo = (key & 0xFFFFFFFF).astype(np.uint32)
     h1, h2 = fmix32(hi), fmix32(lo)
     h = h1 ^ (h2 + np.uint32(0x9E3779B9) + (h1 << np.uint32(6))
               + (h1 >> np.uint32(2)))
-    bucket = (h % np.uint32(NUM_BUCKETS)).astype(np.int32)
+    return (h % np.uint32(num_buckets)).astype(np.int32)
+
+
+def make_tables():
+    import pyarrow as pa
+    rng = np.random.default_rng(42)
+    left = pa.table({
+        "key": rng.integers(0, N_ROWS // 4, N_ROWS).astype(np.int64),
+        "k2": rng.integers(0, 100, N_ROWS).astype(np.int64),
+        "id": np.arange(N_ROWS, dtype=np.int64),
+        "score": rng.random(N_ROWS).astype(np.float64),
+    })
+    right = pa.table({
+        "key": rng.integers(0, N_ROWS // 4, N_RIGHT).astype(np.int64),
+        "val": rng.random(N_RIGHT).astype(np.float64),
+    })
+    return left, right
+
+
+# ---------------------------------------------------------------------------
+# Rung 1 — covering-index build
+# ---------------------------------------------------------------------------
+
+
+def cpu_build(table, out_dir):
+    """Same pipeline, vectorized numpy + pyarrow on host."""
+    import pyarrow.parquet as pq
+
+    key = table.column("key").to_numpy()
+    bucket = cpu_bucket_ids(key, NUM_BUCKETS)
     order = np.lexsort((key, bucket))
     sorted_table = table.take(order)
     sorted_bucket = bucket[order]
@@ -71,56 +102,254 @@ def cpu_baseline(table, out_dir):
             pq.write_table(sorted_table.slice(int(starts[b]),
                                               int(ends[b] - starts[b])),
                            os.path.join(out_dir, f"part-{b:05d}.parquet"))
-    return time.perf_counter() - t0
 
 
-def device_build(table, out_dir_base):
-    """The PRODUCT build path (`io/builder.write_bucketed_table` with no
-    pre-staged device state): per build, the key column is staged to the
-    device (narrow 32-bit lane transport when the range allows), the
-    device computes the bucket+sort permutation, and the host streams
-    bucket files while permutation chunks are still in flight. The
-    payload never crosses the link."""
+def rung1_build(table, work):
+    """PRODUCT build path: per build, keys staged to device (narrow 32-bit
+    lanes when the range allows), device computes the bucket+sort
+    permutation, host streams bucket files while permutation chunks are in
+    flight; the payload never crosses the link."""
     from hyperspace_tpu.io.builder import write_bucketed_table
 
-    import jax
-    log(f"devices: {jax.devices()}")
-    # Warm-up: compile the fused permutation program for this shape.
-    t0 = time.perf_counter()
-    write_bucketed_table(table, ["key"], NUM_BUCKETS, out_dir_base + "_warm")
-    log(f"cold build (incl. compile): {time.perf_counter() - t0:.2f}s")
-    shutil.rmtree(out_dir_base + "_warm", ignore_errors=True)
+    counter = [0]
 
-    best = float("inf")
-    for i in range(5):
-        out = f"{out_dir_base}_{i}"
-        t0 = time.perf_counter()
+    def dev():
+        out = os.path.join(work, f"tpu{counter[0]}")
+        counter[0] += 1
         write_bucketed_table(table, ["key"], NUM_BUCKETS, out)
-        elapsed = time.perf_counter() - t0
-        log(f"warm build {i}: {elapsed:.3f}s ({N_ROWS/elapsed:,.0f} rows/s)")
-        best = min(best, elapsed)
         shutil.rmtree(out, ignore_errors=True)
-    return best
+
+    def cpu():
+        out = os.path.join(work, f"cpu{counter[0]}")
+        counter[0] += 1
+        cpu_build(table, out)
+        shutil.rmtree(out, ignore_errors=True)
+
+    t0 = time.perf_counter()
+    dev()
+    log(f"rung1 cold build (incl. compile): {time.perf_counter() - t0:.2f}s")
+    dev_s = best_of(dev, label="rung1 device")
+    cpu_s = best_of(cpu, runs=2, label="rung1 cpu")
+    return dev_s, cpu_s
+
+
+# ---------------------------------------------------------------------------
+# Session fixture for the query rungs
+# ---------------------------------------------------------------------------
+
+
+def make_session(work):
+    from hyperspace_tpu import HyperspaceConf, HyperspaceSession
+    conf = HyperspaceConf({
+        "hyperspace.warehouse.dir": os.path.join(work, "wh"),
+        "spark.hyperspace.index.num.buckets": str(NUM_BUCKETS),
+    })
+    return HyperspaceSession(conf)
+
+
+# ---------------------------------------------------------------------------
+# Rung 2 — multi-column filter query via FilterIndexRule
+# ---------------------------------------------------------------------------
+
+
+def rung2_filter(sess, hs, ldf, left, work):
+    from hyperspace_tpu import IndexConfig
+    from hyperspace_tpu.plan.expr import col, lit
+    import pyarrow.parquet as pq
+
+    # Bucket by `key` only: the k2 range term can then still be served
+    # (included column) while the key equality prunes the read to one
+    # bucket. Bucketing by both would defeat pruning for range predicates.
+    hs.create_index(ldf, IndexConfig("bench_filter_idx", ["key"],
+                                     ["k2", "id", "score"]))
+    key_hit = int(left.column("key")[0].as_py())
+
+    def q():
+        return (ldf.filter((col("key") == lit(key_hit)) & (col("k2") < lit(50)))
+                .select("id", "score").collect())
+
+    sess.enable_hyperspace()
+    plan = (ldf.filter((col("key") == lit(key_hit)) & (col("k2") < lit(50)))
+            .select("id", "score"))._optimized_plan()
+    roots = [p for s in plan.collect_leaves() for p in s.root_paths]
+    assert any("v__=" in p for p in roots), f"rung2 not index-served: {roots}"
+    q()  # warm compile
+    dev_s = best_of(q, label="rung2 device")
+    sess.disable_hyperspace()
+
+    src_files = sorted(
+        os.path.join(work, "left", f) for f in os.listdir(
+            os.path.join(work, "left")))
+
+    def cpu():
+        t = pq.read_table(src_files, columns=["key", "k2", "id", "score"])
+        key = t.column("key").to_numpy()
+        k2 = t.column("k2").to_numpy()
+        mask = (key == key_hit) & (k2 < 50)
+        return t.select(["id", "score"]).take(np.nonzero(mask)[0])
+
+    cpu_s = best_of(cpu, runs=3, label="rung2 cpu")
+    return dev_s, cpu_s
+
+
+# ---------------------------------------------------------------------------
+# Rung 3 — two-table bucketed SMJ via JoinIndexRule
+# ---------------------------------------------------------------------------
+
+
+def rung3_join(sess, hs, ldf, rdf, work):
+    from hyperspace_tpu import IndexConfig
+    import pyarrow.parquet as pq
+
+    hs.create_index(ldf, IndexConfig("bench_join_l", ["key"], ["id"]))
+    hs.create_index(rdf, IndexConfig("bench_join_r", ["key"], ["val"]))
+
+    def q():
+        return (ldf.select("key", "id").join(rdf.select("key", "val"),
+                                             on="key")
+                .select("id", "val").collect())
+
+    sess.enable_hyperspace()
+    plan = (ldf.select("key", "id").join(rdf.select("key", "val"), on="key")
+            .select("id", "val"))._optimized_plan()
+    scans = plan.collect_leaves()
+    assert all(s.bucket_spec is not None for s in scans), "rung3 not bucketed"
+    q()
+    dev_s = best_of(q, label="rung3 device")
+    sess.disable_hyperspace()
+
+    lfiles = [os.path.join(work, "left", f)
+              for f in os.listdir(os.path.join(work, "left"))]
+    rfiles = [os.path.join(work, "right", f)
+              for f in os.listdir(os.path.join(work, "right"))]
+
+    def cpu():
+        import pandas as pd
+        lt = pq.read_table(lfiles, columns=["key", "id"]).to_pandas()
+        rt = pq.read_table(rfiles, columns=["key", "val"]).to_pandas()
+        return lt.merge(rt, on="key")[["id", "val"]]
+
+    cpu_s = best_of(cpu, runs=3, label="rung3 cpu")
+    return dev_s, cpu_s
+
+
+# ---------------------------------------------------------------------------
+# Rung 4 — hybrid scan (index + appended files)
+# ---------------------------------------------------------------------------
+
+
+def rung4_hybrid(sess, hs, left, work):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from hyperspace_tpu import IndexConfig
+    from hyperspace_tpu.plan.expr import col, lit
+
+    # Fresh source dir so the appended files don't disturb rungs 2/3.
+    hdir = os.path.join(work, "hybrid")
+    os.makedirs(hdir)
+    pq.write_table(left, os.path.join(hdir, "part-0.parquet"))
+    hdf = sess.read_parquet(hdir)
+    hs.create_index(hdf, IndexConfig("bench_hybrid_idx", ["key"],
+                                     ["id", "score"]))
+    # Append ~5% new rows AFTER the index build.
+    rng = np.random.default_rng(7)
+    n_app = max(N_ROWS // 20, 1)
+    appended = pa.table({
+        "key": rng.integers(0, N_ROWS // 4, n_app).astype(np.int64),
+        "k2": rng.integers(0, 100, n_app).astype(np.int64),
+        "id": np.arange(N_ROWS, N_ROWS + n_app, dtype=np.int64),
+        "score": rng.random(n_app).astype(np.float64),
+    })
+    pq.write_table(appended, os.path.join(hdir, "part-1.parquet"))
+    sess.conf.set("hyperspace.index.hybridscan.enabled", "true")
+
+    key_hit = int(left.column("key")[0].as_py())
+    hdf = sess.read_parquet(hdir)  # re-list: new files
+
+    def q():
+        return (hdf.filter(col("key") == lit(key_hit))
+                .select("id", "score").collect())
+
+    sess.enable_hyperspace()
+    plan = (hdf.filter(col("key") == lit(key_hit))
+            .select("id", "score"))._optimized_plan()
+    from hyperspace_tpu.plan.nodes import Union as UnionNode
+    found_union = [False]
+
+    def _see(node):
+        if isinstance(node, UnionNode):
+            found_union[0] = True
+        return node
+
+    plan.transform_up(_see)
+    assert found_union[0], "rung4 not hybrid-served (no Union in plan)"
+    q()
+    dev_s = best_of(q, label="rung4 device")
+    sess.disable_hyperspace()
+
+    files = sorted(os.path.join(hdir, f) for f in os.listdir(hdir))
+
+    def cpu():
+        t = pq.read_table(files, columns=["key", "id", "score"])
+        key = t.column("key").to_numpy()
+        mask = key == key_hit
+        return t.select(["id", "score"]).take(np.nonzero(mask)[0])
+
+    cpu_s = best_of(cpu, runs=3, label="rung4 cpu")
+    return dev_s, cpu_s
 
 
 def main():
     work = tempfile.mkdtemp(prefix="hs_bench_")
     try:
-        table = make_table()
-        cpu_s = min(cpu_baseline(table, os.path.join(work, f"cpu{i}"))
-                    for i in range(2))
-        cpu_rate = N_ROWS / cpu_s
-        log(f"cpu baseline (best of 2): {cpu_s:.3f}s ({cpu_rate:,.0f} rows/s)")
+        import jax
+        log(f"devices: {jax.devices()}")
+        import pyarrow.parquet as pq
+        left, right = make_tables()
+        os.makedirs(os.path.join(work, "left"))
+        os.makedirs(os.path.join(work, "right"))
+        pq.write_table(left, os.path.join(work, "left", "part-0.parquet"))
+        pq.write_table(right, os.path.join(work, "right", "part-0.parquet"))
 
-        tpu_s = device_build(table, os.path.join(work, "tpu"))
-        tpu_rate = N_ROWS / tpu_s
+        dev1, cpu1 = rung1_build(left, work)
+        rate1 = N_ROWS / dev1
+        log(f"rung1: device {dev1:.3f}s vs cpu {cpu1:.3f}s "
+            f"({rate1:,.0f} rows/s, x{cpu1 / dev1:.2f})")
 
-        print(json.dumps({
+        sess = make_session(work)
+        from hyperspace_tpu import Hyperspace
+        hs = Hyperspace(sess)
+        ldf = sess.read_parquet(os.path.join(work, "left"))
+        rdf = sess.read_parquet(os.path.join(work, "right"))
+
+        dev2, cpu2 = rung2_filter(sess, hs, ldf, left, work)
+        log(f"rung2: device {dev2:.3f}s vs cpu {cpu2:.3f}s (x{cpu2 / dev2:.2f})")
+        dev3, cpu3 = rung3_join(sess, hs, ldf, rdf, work)
+        log(f"rung3: device {dev3:.3f}s vs cpu {cpu3:.3f}s (x{cpu3 / dev3:.2f})")
+        dev4, cpu4 = rung4_hybrid(sess, hs, left, work)
+        log(f"rung4: device {dev4:.3f}s vs cpu {cpu4:.3f}s (x{cpu4 / dev4:.2f})")
+
+        result = {
             "metric": "covering_index_build_rows_per_sec_chip",
-            "value": round(tpu_rate, 1),
+            "value": round(rate1, 1),
             "unit": "rows/s",
-            "vs_baseline": round(tpu_rate / cpu_rate, 3),
-        }))
+            "vs_baseline": round(cpu1 / dev1, 3),
+            "rungs": {
+                "1_build": {"device_s": round(dev1, 3),
+                            "cpu_s": round(cpu1, 3),
+                            "vs_baseline": round(cpu1 / dev1, 3)},
+                "2_filter_query": {"device_s": round(dev2, 3),
+                                   "cpu_s": round(cpu2, 3),
+                                   "vs_baseline": round(cpu2 / dev2, 3)},
+                "3_bucketed_smj": {"device_s": round(dev3, 3),
+                                   "cpu_s": round(cpu3, 3),
+                                   "vs_baseline": round(cpu3 / dev3, 3)},
+                "4_hybrid_scan": {"device_s": round(dev4, 3),
+                                  "cpu_s": round(cpu4, 3),
+                                  "vs_baseline": round(cpu4 / dev4, 3)},
+            },
+        }
+        print(json.dumps(result))
     finally:
         shutil.rmtree(work, ignore_errors=True)
 

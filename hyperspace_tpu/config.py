@@ -79,6 +79,12 @@ class HyperspaceConf:
                 "auto").lower()
 
     @property
+    def min_device_rows(self) -> int:
+        """Batches below this row count run on the host lane."""
+        return self.get_int(constants.MIN_DEVICE_ROWS,
+                            constants.MIN_DEVICE_ROWS_DEFAULT)
+
+    @property
     def distribution_min_rows(self) -> int:
         return self.get_int(constants.DISTRIBUTION_MIN_ROWS,
                             constants.DISTRIBUTION_MIN_ROWS_DEFAULT)

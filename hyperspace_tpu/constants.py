@@ -33,6 +33,16 @@ DISTRIBUTION_ENABLED_DEFAULT = "auto"
 DISTRIBUTION_MIN_ROWS = "spark.hyperspace.distribution.min.rows"
 DISTRIBUTION_MIN_ROWS_DEFAULT = 4096
 
+# Adaptive host/device execution lane: batches below this row count are
+# evaluated with host numpy, larger batches run on the accelerator. The
+# default is tuned for a high-latency (tunneled) device link where each
+# blocking sync costs ~100 ms — there the crossover for query operators
+# sits in the millions of rows (index reads are pruned/pre-sorted, so the
+# host work per row is tiny). On a directly-attached TPU set this lower,
+# or 0 to force everything onto the device.
+MIN_DEVICE_ROWS = "spark.hyperspace.execution.min.device.rows"
+MIN_DEVICE_ROWS_DEFAULT = 4_194_304
+
 WAREHOUSE_PATH = "spark.hyperspace.warehouse.dir"
 WAREHOUSE_PATH_DEFAULT = "warehouse"
 

@@ -32,18 +32,16 @@ def _col_and_validity(batch: ColumnBatch, name: str):
     return col, col.validity
 
 
-def _string_literal_compare(op: str, col: DeviceColumn, value: str):
-    import jax.numpy as jnp
-
+def _string_literal_compare(op: str, col: DeviceColumn, value: str, xp):
     d = col.dictionary
     left = int(np.searchsorted(d, value, side="left"))
     right = int(np.searchsorted(d, value, side="right"))
     present = left < right
     code = col.data
     if op == "eq":
-        return (code == left) if present else jnp.zeros(code.shape, bool)
+        return (code == left) if present else xp.zeros(code.shape, bool)
     if op == "ne":
-        return (code != left) if present else jnp.ones(code.shape, bool)
+        return (code != left) if present else xp.ones(code.shape, bool)
     if op == "lt":
         return code < left
     if op == "le":
@@ -60,16 +58,24 @@ _CMP = {"eq": "__eq__", "ne": "__ne__", "lt": "__lt__", "le": "__le__",
 
 
 class ExpressionCompiler:
+    """Compiles expressions over a batch. The array module (`xp`) follows
+    the batch's residence: host batches evaluate with numpy (zero device
+    round-trips — the adaptive host lane for small reads), device batches
+    with jax.numpy (XLA-fused)."""
+
     def __init__(self, batch: ColumnBatch):
         self.batch = batch
+        if batch.is_host:
+            self.xp = np
+        else:
+            import jax.numpy as jnp
+            self.xp = jnp
 
     # -- value expressions ------------------------------------------------
 
     def value(self, e: E.Expression) -> Tuple[object, Optional[object]]:
         """Compile to (array, validity|None). Strings yield their codes and
         may only feed comparisons handled in `predicate`."""
-        import jax.numpy as jnp
-
         if isinstance(e, E.Column):
             col, validity = _col_and_validity(self.batch, e.name)
             return col.data, validity
@@ -78,8 +84,8 @@ class ExpressionCompiler:
         if isinstance(e, (E.Add, E.Sub, E.Mul, E.Div)):
             lv, lval = self.value(e.left)
             rv, rval = self.value(e.right)
-            ops = {"add": jnp.add, "sub": jnp.subtract,
-                   "mul": jnp.multiply, "div": jnp.divide}
+            ops = {"add": self.xp.add, "sub": self.xp.subtract,
+                   "mul": self.xp.multiply, "div": self.xp.divide}
             out = ops[type(e).op](lv, rv)
             return out, self._merge_validity(lval, rval)
         raise HyperspaceException(f"Unsupported value expression: {e!r}")
@@ -116,8 +122,7 @@ class ExpressionCompiler:
 
     def predicate3(self, e: E.Expression):
         """Compile to (true_mask, known); known=None means all rows known."""
-        import jax.numpy as jnp
-
+        xp = self.xp
         n = self.batch.num_rows
         if isinstance(e, E.And):
             lt, lk = self.predicate3(e.left)
@@ -126,12 +131,12 @@ class ExpressionCompiler:
             if lk is None and rk is None:
                 return mask, None
             # Known iff both known, or either side is definitely false.
-            lk_ = jnp.ones(n, bool) if lk is None else lk
-            rk_ = jnp.ones(n, bool) if rk is None else rk
+            lk_ = xp.ones(n, bool) if lk is None else lk
+            rk_ = xp.ones(n, bool) if rk is None else rk
             return mask, (lk_ & rk_) | (lk_ & ~lt) | (rk_ & ~rt)
         if isinstance(e, E.Or):
             return self._or3(self.predicate3(e.left),
-                             self.predicate3(e.right), n)
+                             self.predicate3(e.right), n, xp)
         if isinstance(e, E.Not):
             t, k = self.predicate3(e.child)
             if k is None:
@@ -142,23 +147,23 @@ class ExpressionCompiler:
             if col is None:
                 raise HyperspaceException("IS NULL requires a column.")
             if col.validity is None:
-                return jnp.zeros(n, bool), None
+                return xp.zeros(n, bool), None
             return ~col.validity, None
         if isinstance(e, E.IsNotNull):
             col = self._column_of(e.child)
             if col is None:
                 raise HyperspaceException("IS NOT NULL requires a column.")
             if col.validity is None:
-                return jnp.ones(n, bool), None
+                return xp.ones(n, bool), None
             return col.validity, None
         if isinstance(e, E.In):
             folded = None
             for v in e.values:
                 term = self.predicate3(E.EqualTo(e.child, v))
                 folded = term if folded is None else (
-                    self._or3(folded, term, n))
+                    self._or3(folded, term, n, xp))
             if folded is None:
-                return jnp.zeros(n, bool), None
+                return xp.zeros(n, bool), None
             return folded
         if isinstance(e, (E.EqualTo, E.NotEqualTo, E.LessThan,
                           E.LessThanOrEqual, E.GreaterThan,
@@ -166,39 +171,37 @@ class ExpressionCompiler:
             return self._comparison(e)
         if isinstance(e, E.Literal):
             if isinstance(e.value, bool):
-                return jnp.full(n, e.value, dtype=bool), None
+                return xp.full(n, e.value, dtype=bool), None
             raise HyperspaceException(f"Non-boolean literal predicate: {e!r}")
         raise HyperspaceException(f"Unsupported predicate: {e!r}")
 
     @staticmethod
-    def _or3(a, b, n):
+    def _or3(a, b, n, xp):
         """Kleene OR over (true_mask, known) pairs: known iff both known,
         or either side is definitely true."""
-        import jax.numpy as jnp
-
         at, ak = a
         bt, bk = b
         mask = at | bt
         if ak is None and bk is None:
             return mask, None
-        ak_ = jnp.ones(n, bool) if ak is None else ak
-        bk_ = jnp.ones(n, bool) if bk is None else bk
+        ak_ = xp.ones(n, bool) if ak is None else ak
+        bk_ = xp.ones(n, bool) if bk is None else bk
         return mask, (ak_ & bk_) | mask
 
     def _comparison(self, e):
-        import jax.numpy as jnp
-
         op = type(e).op
         lcol = self._column_of(e.left)
         rcol = self._column_of(e.right)
         # string column vs string literal -> code-space range test
         if lcol is not None and lcol.is_string and isinstance(e.right, E.Literal):
-            mask = _string_literal_compare(op, lcol, str(e.right.value))
+            mask = _string_literal_compare(op, lcol, str(e.right.value),
+                                           self.xp)
             return self._with_validity(mask, lcol.validity, None)
         if rcol is not None and rcol.is_string and isinstance(e.left, E.Literal):
             flipped = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
                        "eq": "eq", "ne": "ne"}[op]
-            mask = _string_literal_compare(flipped, rcol, str(e.left.value))
+            mask = _string_literal_compare(flipped, rcol,
+                                           str(e.left.value), self.xp)
             return self._with_validity(mask, rcol.validity, None)
         if (lcol is not None and lcol.is_string) or (rcol is not None and rcol.is_string):
             raise HyperspaceException(
@@ -206,7 +209,7 @@ class ExpressionCompiler:
                 "filters; use a join.")
         lv, lval = self.value(e.left)
         rv, rval = self.value(e.right)
-        mask = getattr(jnp.asarray(lv), _CMP[op])(rv)
+        mask = getattr(self.xp.asarray(lv), _CMP[op])(rv)
         return self._with_validity(mask, lval, rval)
 
     @staticmethod
@@ -223,11 +226,14 @@ def compile_predicate(expression: E.Expression, batch: ColumnBatch):
 
 
 def apply_filter(batch: ColumnBatch, expression: E.Expression) -> ColumnBatch:
-    """Filter a batch: fused mask eval + one compaction gather. The row
-    count is the single host sync (it sizes the result)."""
+    """Filter a batch: fused mask eval + one compaction gather. On the
+    device lane the row count is the single host sync (it sizes the
+    result); on the host lane everything is numpy — no device traffic."""
+    mask = compile_predicate(expression, batch)
+    if isinstance(mask, np.ndarray):
+        return batch.take(np.nonzero(mask)[0].astype(np.int32))
     import jax.numpy as jnp
 
-    mask = compile_predicate(expression, batch)
     count = int(jnp.sum(mask))  # host sync — sizes the output
     (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
     return batch.take(indices)

@@ -66,16 +66,31 @@ def _empty_batch(schema: Schema) -> columnar.ColumnBatch:
 class ScanExec(PhysicalNode):
     name = "Scan"
 
-    def __init__(self, scan: Scan, columns: Sequence[str]):
+    def __init__(self, scan: Scan, columns: Sequence[str],
+                 allowed_buckets: Optional[Set[int]] = None, conf=None):
         self.scan = scan
         self.columns = list(columns)
         self.out_schema = scan.schema.select(columns)
+        self.conf = conf
+        # Bucket pruning: when a filter above constrains every bucket
+        # column to literal values, only these buckets can contain matches
+        # (set by the planner, `_prune_buckets`). The index read then
+        # touches 1/num_buckets of the files per point value — the engine
+        # analog of partition pruning, and the device-path win the bucketed
+        # layout buys beyond the reference (whose filter swap stays
+        # unbucketed purely for Spark scan parallelism,
+        # `index/rules/FilterIndexRule.scala:112-120`).
+        self.allowed_buckets = allowed_buckets
 
     def simple_string(self) -> str:
         bucket = (f", buckets={self.scan.bucket_spec.num_buckets}"
                   if self.scan.bucket_spec else "")
+        pruned = ""
+        if self.allowed_buckets is not None and self.scan.bucket_spec:
+            pruned = (f", prunedBuckets={len(self.allowed_buckets)}"
+                      f"/{self.scan.bucket_spec.num_buckets}")
         return (f"Scan parquet [{', '.join(self.columns)}] "
-                f"{self.scan.root_paths}{bucket}")
+                f"{self.scan.root_paths}{bucket}{pruned}")
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         if bucket is not None:
@@ -84,12 +99,26 @@ class ScanExec(PhysicalNode):
             files: List[str] = []
             for root in self.scan.root_paths:
                 files.extend(parquet.bucket_files(root).get(bucket, []))
+        elif self.allowed_buckets is not None and self.scan.bucket_spec:
+            files = []
+            for root in self.scan.root_paths:
+                per_bucket = parquet.bucket_files(root)
+                for b in sorted(self.allowed_buckets):
+                    files.extend(per_bucket.get(b, []))
         else:
             files = self.scan.files()
         if not files:
             return _empty_batch(self.out_schema)
         table = parquet.read_table(files, columns=self.columns)
-        batch = columnar.from_arrow(table, self.out_schema)
+        # Adaptive lane: small reads (e.g. a pruned point-filter bucket)
+        # stay in host memory — a device round-trip (~100 ms tunneled)
+        # would dwarf the work. Downstream jnp operators promote host
+        # batches to the device transparently when they need it.
+        from hyperspace_tpu.constants import MIN_DEVICE_ROWS_DEFAULT
+        min_dev = (self.conf.min_device_rows if self.conf is not None
+                   else MIN_DEVICE_ROWS_DEFAULT)
+        host = bucket is None and table.num_rows < min_dev
+        batch = columnar.from_arrow(table, self.out_schema, device=not host)
         if bucket is not None and len(files) > 1:
             # Multiple sorted runs in one bucket (incremental deltas): the
             # concat is not globally sorted — restore order on device.
@@ -105,26 +134,36 @@ class ScanExec(PhysicalNode):
         metadata — no device work. (The batched join sorts per-bucket ids
         itself, so multi-run buckets need no pre-sort here.)"""
         import numpy as np
-        import pyarrow as pa
-        import pyarrow.parquet as pq
 
         if self.scan.bucket_spec is None:
             raise HyperspaceException("Bucketed read on unbucketed scan.")
         per_bucket = {}
         for root in self.scan.root_paths:
             for b, files in parquet.bucket_files(root).items():
+                if (self.allowed_buckets is not None
+                        and b not in self.allowed_buckets):
+                    # Pruned by the filter above: no row in this bucket can
+                    # survive it, so an empty bucket is equivalent.
+                    continue
                 per_bucket.setdefault(b, []).extend(files)
-        tables = []
+        # ONE ordered concurrent read of all bucket files; per-bucket
+        # lengths come from parquet footers (no data read).
+        ordered = [(b, f) for b in range(num_buckets)
+                   for f in per_bucket.get(b, [])]
         lengths = np.zeros(num_buckets, dtype=np.int64)
-        for b in range(num_buckets):
-            for f in per_bucket.get(b, []):
-                t = pq.read_table(f, columns=self.columns)
-                lengths[b] += t.num_rows
-                tables.append(t)
-        if not tables:
+        if not ordered:
             return _empty_batch(self.out_schema), lengths
-        table = pa.concat_tables(tables, promote_options="default")
-        return columnar.from_arrow(table, self.out_schema), lengths
+        counts = parquet.file_row_counts([f for _, f in ordered])
+        for (b, _), c in zip(ordered, counts):
+            lengths[b] += c
+        table = parquet.read_table([f for _, f in ordered],
+                                   columns=self.columns)
+        from hyperspace_tpu.constants import MIN_DEVICE_ROWS_DEFAULT
+        min_dev = (self.conf.min_device_rows if self.conf is not None
+                   else MIN_DEVICE_ROWS_DEFAULT)
+        host = table.num_rows < min_dev
+        return columnar.from_arrow(table, self.out_schema,
+                                   device=not host), lengths
 
 
 class FilterExec(PhysicalNode):
@@ -149,7 +188,15 @@ class FilterExec(PhysicalNode):
         batch = self.child.execute(bucket)
         if batch.num_rows == 0:
             return batch
-        mesh = should_distribute(self.conf, batch.num_rows)
+        # A host-lane batch stayed below min_device_rows precisely to skip
+        # device transfers — shipping it to the mesh in "auto" mode would
+        # pay them anyway. Explicit distribution.enabled=true still
+        # distributes (tests exercise the mesh path with tiny batches).
+        if batch.is_host and (self.conf is None
+                              or self.conf.distribution == "auto"):
+            mesh = None
+        else:
+            mesh = should_distribute(self.conf, batch.num_rows)
         if mesh is not None:
             from hyperspace_tpu.parallel.scan import distributed_filter
             return distributed_filter(batch, self.condition, mesh)
@@ -167,6 +214,14 @@ class FilterExec(PhysicalNode):
         if batch.num_rows == 0:
             return batch, lengths
         mask = compile_predicate(self.condition, batch)
+        if isinstance(mask, np.ndarray):  # host lane
+            row_bucket = np.searchsorted(np.cumsum(lengths),
+                                         np.arange(batch.num_rows),
+                                         side="right")
+            new_lengths = np.bincount(row_bucket[mask],
+                                      minlength=num_buckets).astype(np.int64)
+            indices = np.nonzero(mask)[0].astype(np.int32)
+            return batch.take(indices), new_lengths
         # Per-bucket survivor counts as ONE device segment-sum (row ->
         # bucket via searchsorted over the running lengths), then a single
         # [num_buckets] transfer sizes both the new lengths and the gather.
@@ -291,10 +346,13 @@ class LimitExec(PhysicalNode):
         return f"Limit {self.n}"
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
-        import jax.numpy as jnp
+        import numpy as np
         batch = self.child.execute(bucket)
         if batch.num_rows <= self.n:
             return batch
+        if batch.is_host:
+            return batch.take(np.arange(self.n, dtype=np.int32))
+        import jax.numpy as jnp
         return batch.take(jnp.arange(self.n, dtype=jnp.int32))
 
 
@@ -357,13 +415,25 @@ class SortMergeJoinExec(PhysicalNode):
             # mesh-parallel in `parallel/join.py`.
             from hyperspace_tpu.ops.bucketed_join import (
                 bucketed_sort_merge_join, padded_skew)
-            lbatch, l_lengths = self.left.execute_bucketed(self.num_buckets)
-            rbatch, r_lengths = self.right.execute_bucketed(self.num_buckets)
+            # The two sides' reads are independent IO — overlap them.
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                lf = pool.submit(self.left.execute_bucketed, self.num_buckets)
+                rf = pool.submit(self.right.execute_bucketed,
+                                 self.num_buckets)
+                lbatch, l_lengths = lf.result()
+                rbatch, r_lengths = rf.result()
             # The mesh path shares the padded [B, L] layout; under hot-key
             # skew route single-chip so the global-join fallback applies.
+            # Host-lane sides skip the mesh in "auto" mode for the same
+            # reason FilterExec does: distribution would pay the device
+            # transfers the lane exists to avoid.
             skewed = padded_skew(l_lengths, r_lengths, lbatch.num_rows,
                                  rbatch.num_rows)
-            mesh = (None if skewed
+            host_sides = (lbatch.is_host and rbatch.is_host
+                          and (self.conf is None
+                               or self.conf.distribution == "auto"))
+            mesh = (None if skewed or host_sides
                     else self._join_mesh(lbatch.num_rows + rbatch.num_rows))
             if mesh is not None:
                 from hyperspace_tpu.ops.bucketed_join import (
@@ -373,7 +443,8 @@ class SortMergeJoinExec(PhysicalNode):
                 li, ri = distributed_bucketed_join_indices(
                     lbatch, rbatch, l_lengths, r_lengths, self.left_keys,
                     self.right_keys, mesh)
-                return assemble_join_output(lbatch, rbatch, li, ri)
+                return assemble_join_output(lbatch, rbatch, li, ri,
+                                            how=self.how)
             return bucketed_sort_merge_join(lbatch, rbatch, l_lengths,
                                             r_lengths, self.left_keys,
                                             self.right_keys, how=self.how)
@@ -400,6 +471,97 @@ class SortMergeJoinExec(PhysicalNode):
 # ---------------------------------------------------------------------------
 # Planner
 # ---------------------------------------------------------------------------
+
+
+_PRUNE_MAX_COMBOS = 64
+
+
+def _literal_values_for(column: str, conjuncts) -> Optional[List]:
+    """Literal values `column` may take under the conjunction, from the
+    narrowest `col = lit` / `col IN (lits)` constraint; None if
+    unconstrained (or only constrained through nulls, where pruning is
+    skipped — `x = NULL` is never true, so correctness never depends on
+    pruning)."""
+    best: Optional[List] = None
+    for c in conjuncts:
+        values = None
+        if isinstance(c, E.EqualTo):
+            a, b = c.left, c.right
+            if isinstance(a, E.Column) and isinstance(b, E.Literal):
+                values = [b.value] if a.name.lower() == column else None
+            elif isinstance(b, E.Column) and isinstance(a, E.Literal):
+                values = [a.value] if b.name.lower() == column else None
+        elif (isinstance(c, E.In) and isinstance(c.child, E.Column)
+              and c.child.name.lower() == column):
+            values = [v.value for v in c.values]
+        if values is None or any(v is None for v in values):
+            continue
+        if best is None or len(values) < len(best):
+            best = values
+    return best
+
+
+def _prune_buckets(condition: E.Expression,
+                   scan: Scan) -> Optional[Set[int]]:
+    """Bucket ids that can contain rows satisfying `condition`, or None
+    when pruning does not apply. Sound because every bucket column must be
+    pinned to literals by top-level conjuncts: any matching row hashes to
+    one of the returned buckets. The literal tuples are hashed with THE
+    build hash kernel (`ops/hash_partition.bucket_ids`) so the computed
+    ids match the on-disk layout exactly."""
+    import itertools
+
+    spec = scan.bucket_spec
+    if spec is None:
+        return None
+    conjuncts = E.split_conjunctive(condition)
+    per_column: List[List] = []
+    for c in spec.bucket_columns:
+        values = _literal_values_for(c.lower(), conjuncts)
+        if values is None:
+            return None
+        per_column.append(values)
+    combos = list(itertools.product(*per_column))
+    if not combos or len(combos) > _PRUNE_MAX_COMBOS:
+        return None
+    import numpy as np_
+
+    from hyperspace_tpu.ops.host_hash import host_bucket_ids
+
+    key_schema = scan.schema.select(list(spec.bucket_columns))
+    np_of = {"int64": np_.int64, "int32": np_.int32, "int16": np_.int16,
+             "int8": np_.int8, "bool": np_.bool_, "float64": np_.float64,
+             "float32": np_.float32, "date32": np_.int32,
+             "timestamp": np_.int64, "string": None}
+    try:
+        columns = []
+        for i, f in enumerate(key_schema.fields):
+            vals = [combo[i] for combo in combos]
+            dt = np_of[f.dtype]
+            columns.append(np_.asarray(vals, dtype=str) if dt is None
+                           else np_.asarray(vals).astype(dt))
+        # Host mirror of the build hash — no device round-trip; identity
+        # pinned against `ops/hash_partition.bucket_ids` by test.
+        ids = host_bucket_ids(columns, [f.dtype for f in key_schema.fields],
+                              spec.num_buckets)
+    except (ValueError, TypeError, OverflowError, HyperspaceException):
+        return None  # literal not representable in the key type -> no prune
+    return set(int(b) for b in ids)
+
+
+def _apply_bucket_pruning(condition: E.Expression, child: PhysicalNode):
+    """Descend Project chains — and Union fan-outs (hybrid scan: index
+    UNION appended files) — to each ScanExec and attach the allowed bucket
+    set derived from the filter condition (no-op on unbucketed scans)."""
+    node = child
+    while isinstance(node, ProjectExec):
+        node = node.child
+    if isinstance(node, UnionExec):
+        for c in node.children:
+            _apply_bucket_pruning(condition, c)
+    elif isinstance(node, ScanExec) and node.allowed_buckets is None:
+        node.allowed_buckets = _prune_buckets(condition, node.scan)
+    return child
 
 
 def _join_keys(condition: E.Expression, left_schema: Schema,
@@ -458,13 +620,13 @@ def plan_physical(plan: LogicalPlan,
         required = set(plan.schema.names)
 
     if isinstance(plan, Scan):
-        return ScanExec(plan, _required_for(plan, required))
+        return ScanExec(plan, _required_for(plan, required), conf=conf)
 
     if isinstance(plan, Filter):
         child_required = set(required) | plan.condition.references()
-        return FilterExec(plan.condition,
-                          plan_physical(plan.child, child_required, conf),
-                          conf=conf)
+        child = _apply_bucket_pruning(
+            plan.condition, plan_physical(plan.child, child_required, conf))
+        return FilterExec(plan.condition, child, conf=conf)
 
     if isinstance(plan, Project):
         child = plan_physical(plan.child, set(plan.columns), conf)

@@ -57,22 +57,27 @@ def _string_hash64(values: np.ndarray) -> np.ndarray:
     return out
 
 
-def _split_hashes(hashes: np.ndarray):
-    """uint64 value hashes -> device (hi, lo) uint32 pair."""
+def _split_hashes(hashes: np.ndarray, device: bool = True):
+    """uint64 value hashes -> (hi, lo) uint32 pair (device or host)."""
+    hi = (hashes >> np.uint64(32)).astype(np.uint32)
+    lo = (hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    if not device:
+        return hi, lo
     import jax.numpy as jnp
-    hi = jnp.asarray((hashes >> np.uint64(32)).astype(np.uint32))
-    lo = jnp.asarray((hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32))
-    return hi, lo
+    return jnp.asarray(hi), jnp.asarray(lo)
 
 
-def _merged_dictionary(dictionaries):
+def _merged_dictionary(dictionaries, device: bool = True):
     """Merge sorted dictionaries and build remap tables + value hashes.
-    Returns (merged, [device remap array per input], (hi, lo))."""
-    import jax.numpy as jnp
+    Returns (merged, [remap array per input], (hi, lo))."""
     merged = np.unique(np.concatenate(list(dictionaries)))
-    remaps = [jnp.asarray(np.searchsorted(merged, d).astype(np.int32))
+    remaps = [np.searchsorted(merged, d).astype(np.int32)
               for d in dictionaries]
-    return merged, remaps, _split_hashes(_string_hash64(merged))
+    if device:
+        import jax.numpy as jnp
+        remaps = [jnp.asarray(r) for r in remaps]
+    return merged, remaps, _split_hashes(_string_hash64(merged),
+                                         device=device)
 
 
 @dataclass
@@ -95,6 +100,13 @@ class DeviceColumn:
     @property
     def is_string(self) -> bool:
         return self.dictionary is not None
+
+    @property
+    def is_host(self) -> bool:
+        """True when the payload lives in host memory (numpy). Host-lane
+        columns flow through the same operators; numpy-aware ops stay on
+        host, jnp ops transparently promote to the device."""
+        return isinstance(self.data, np.ndarray)
 
     def __len__(self) -> int:
         return int(self.data.shape[0])
@@ -122,15 +134,22 @@ class ColumnBatch:
         return ColumnBatch(schema, {f.name: self.columns[f.name]
                                     for f in schema.fields})
 
+    @property
+    def is_host(self) -> bool:
+        return all(c.is_host for c in self.columns.values())
+
     def take(self, indices) -> "ColumnBatch":
-        """Row gather by device index array."""
-        jnp = _jnp()
+        """Row gather by index array. Host-lane batches gather with numpy
+        (no device round-trip) when the indices are host-side too."""
+        host = (isinstance(indices, np.ndarray)
+                and all(c.is_host for c in self.columns.values()))
+        xp = np if host else _jnp()
         out = {}
         for name, col in self.columns.items():
             out[name] = DeviceColumn(
-                data=jnp.take(col.data, indices, axis=0),
+                data=xp.take(col.data, indices, axis=0),
                 dtype=col.dtype,
-                validity=(jnp.take(col.validity, indices, axis=0)
+                validity=(xp.take(col.validity, indices, axis=0)
                           if col.validity is not None else None),
                 dictionary=col.dictionary,
                 dict_hashes=col.dict_hashes)
@@ -187,10 +206,17 @@ def _encode_strings_arrow(arr):
     return codes, dictionary, hashes, validity
 
 
-def from_arrow(table, schema: Optional[Schema] = None) -> ColumnBatch:
-    """Arrow table -> device ColumnBatch. Nulls become validity masks with
-    sentinel-filled payloads (0 / empty string)."""
-    import jax.numpy as jnp
+def from_arrow(table, schema: Optional[Schema] = None,
+               device: bool = True) -> ColumnBatch:
+    """Arrow table -> ColumnBatch. Nulls become validity masks with
+    sentinel-filled payloads (0 / empty string). `device=False` keeps the
+    columns in host memory (numpy) for the adaptive host lane — small
+    batches where a device round-trip would dominate the work."""
+    if device:
+        import jax.numpy as jnp
+        _asarray = jnp.asarray
+    else:
+        _asarray = np.asarray
 
     if schema is None:
         schema = Schema.from_arrow(table.schema)
@@ -200,10 +226,10 @@ def from_arrow(table, schema: Optional[Schema] = None) -> ColumnBatch:
         if f.dtype == "string":
             codes, dictionary, hashes, validity = _encode_strings_arrow(arr)
             columns[f.name] = DeviceColumn(
-                data=jnp.asarray(codes), dtype="string",
-                validity=(jnp.asarray(validity) if validity is not None else None),
+                data=_asarray(codes), dtype="string",
+                validity=(_asarray(validity) if validity is not None else None),
                 dictionary=dictionary,
-                dict_hashes=_split_hashes(hashes))
+                dict_hashes=_split_hashes(hashes, device=device))
         else:
             np_dtype = _NUMERIC_NP.get(f.dtype)
             if np_dtype is None:
@@ -221,8 +247,8 @@ def from_arrow(table, schema: Optional[Schema] = None) -> ColumnBatch:
                 np_vals = np.where(mask, np.nan_to_num(np_vals), 0)
             np_vals = np.asarray(np_vals).astype(np_dtype)
             columns[f.name] = DeviceColumn(
-                data=jnp.asarray(np_vals), dtype=f.dtype,
-                validity=(jnp.asarray(mask) if has_nulls else None))
+                data=_asarray(np_vals), dtype=f.dtype,
+                validity=(_asarray(mask) if has_nulls else None))
     return ColumnBatch(schema, columns)
 
 
@@ -273,13 +299,15 @@ def to_arrow(batch: ColumnBatch):
 
 def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
     """Concatenate batches row-wise. String columns are re-unified through a
-    merged sorted dictionary so codes stay order-preserving and comparable."""
-    import jax.numpy as jnp
-
+    merged sorted dictionary so codes stay order-preserving and comparable.
+    All-host inputs concatenate on the host lane; any device input promotes
+    the result to the device."""
     if not batches:
         raise HyperspaceException("Cannot concat zero batches.")
     if len(batches) == 1:
         return batches[0]
+    host = all(b.is_host for b in batches)
+    xp = np if host else _jnp()
     schema = batches[0].schema
     out: Dict[str, DeviceColumn] = {}
     for f in schema.fields:
@@ -287,19 +315,19 @@ def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
         any_validity = any(c.validity is not None for c in cols)
         validity = None
         if any_validity:
-            validity = jnp.concatenate([
+            validity = xp.concatenate([
                 c.validity if c.validity is not None
-                else jnp.ones(len(c), dtype=bool) for c in cols])
+                else xp.ones(len(c), dtype=bool) for c in cols])
         if f.dtype == "string":
             merged, remaps, hashes = _merged_dictionary(
-                [c.dictionary for c in cols])
-            remapped = [jnp.take(remap, c.data)
+                [c.dictionary for c in cols], device=not host)
+            remapped = [xp.take(remap, c.data)
                         for remap, c in zip(remaps, cols)]
-            out[f.name] = DeviceColumn(jnp.concatenate(remapped), "string",
+            out[f.name] = DeviceColumn(xp.concatenate(remapped), "string",
                                        validity, merged, hashes)
         else:
             out[f.name] = DeviceColumn(
-                jnp.concatenate([c.data for c in cols]), f.dtype, validity)
+                xp.concatenate([c.data for c in cols]), f.dtype, validity)
     return ColumnBatch(schema, out)
 
 

@@ -35,16 +35,33 @@ def bucket_of_file(path: str) -> Optional[int]:
 
 
 def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
-    """Read one or more parquet files/dirs into a single Arrow table."""
+    """Read one or more parquet files/dirs into a single Arrow table, in
+    path order. Files are read concurrently (pyarrow releases the GIL);
+    order is preserved by the map."""
     import pyarrow.parquet as pq
     import pyarrow as pa
 
-    tables = []
-    for path in paths:
-        tables.append(pq.read_table(path, columns=list(columns) if columns else None))
-    if not tables:
+    if not paths:
         raise HyperspaceException("No parquet inputs to read.")
+    cols = list(columns) if columns else None
+    if len(paths) == 1:
+        return pq.read_table(paths[0], columns=cols)
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        tables = list(pool.map(lambda p: pq.read_table(p, columns=cols),
+                               paths))
     return pa.concat_tables(tables, promote_options="default")
+
+
+def file_row_counts(paths: Sequence[str]) -> List[int]:
+    """Per-file row counts from parquet footers (no data read)."""
+    import pyarrow.parquet as pq
+
+    if len(paths) <= 1:
+        return [pq.read_metadata(p).num_rows for p in paths]
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        return list(pool.map(lambda p: pq.read_metadata(p).num_rows, paths))
 
 
 def write_table(table, path: str) -> None:

@@ -26,6 +26,9 @@ from hyperspace_tpu.plan.schema import Schema
 def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
                     aggregates: Sequence[AggSpec],
                     out_schema: Schema) -> ColumnBatch:
+    if batch.is_host and batch.num_rows > 0:
+        return _host_group_aggregate(batch, group_columns, aggregates,
+                                     out_schema)
     import jax
     import jax.numpy as jnp
 
@@ -118,6 +121,22 @@ def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
                 data = total
             else:
                 data = total.astype(jnp.float64) / jnp.maximum(counts, 1)
+        elif spec.func == "stddev":
+            # Sample stddev (SQL stddev_samp) via TWO passes: per-group
+            # mean, then squared deviations — the one-pass sum-of-squares
+            # identity catastrophically cancels in float64 when
+            # mean^2 >> variance (ids, timestamps). Null when fewer than
+            # 2 non-null inputs.
+            x = jnp.where(valid, values, 0).astype(jnp.float64)
+            cnt = counts.astype(jnp.float64)
+            mu = jax.ops.segment_sum(
+                x, segment_ids, num_segments=num_groups) / jnp.maximum(cnt, 1)
+            dev = jnp.where(valid, x - jnp.take(mu, segment_ids), 0.0)
+            var = jax.ops.segment_sum(
+                dev * dev, segment_ids,
+                num_segments=num_groups) / jnp.maximum(cnt - 1, 1)
+            data = jnp.sqrt(jnp.maximum(var, 0.0))
+            validity_out = counts > 1
         elif spec.func == "min":
             big = _dtype_max(values.dtype)
             data = jax.ops.segment_min(jnp.where(valid, values, big),
@@ -126,9 +145,12 @@ def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
             small = _dtype_min(values.dtype)
             data = jax.ops.segment_max(jnp.where(valid, values, small),
                                        segment_ids, num_segments=num_groups)
+        # Validity is attached unconditionally: deciding with
+        # `bool(any(~validity_out))` would cost one blocking device sync
+        # per aggregate; an all-True mask is semantically identical.
         columns[out_field.name] = DeviceColumn(
             data.astype(_NP_OF[out_field.dtype]), out_field.dtype,
-            validity=(validity_out if bool(jnp.any(~validity_out)) else None))
+            validity=validity_out)
     return ColumnBatch(out_schema, columns)
 
 
@@ -144,3 +166,97 @@ def _dtype_min(dtype):
     if jnp.issubdtype(dtype, jnp.floating):
         return -jnp.inf
     return jnp.iinfo(dtype).min
+
+
+def _host_group_aggregate(batch: ColumnBatch,
+                          group_columns: Sequence[str],
+                          aggregates: Sequence[AggSpec],
+                          out_schema: Schema) -> ColumnBatch:
+    """Host-lane (numpy) mirror of the device aggregation: same grouping
+    (stable lexicographic sort, nulls first) and the same SQL null
+    semantics, with contiguous-segment `ufunc.reduceat` reductions."""
+    from hyperspace_tpu.ops.keys import host_column_sort_lanes
+
+    _HOST_NP = {"int64": np.int64, "float64": np.float64, "int32": np.int32,
+                "float32": np.float32, "int8": np.int8, "int16": np.int16,
+                "bool": np.bool_, "date32": np.int32, "timestamp": np.int64,
+                "string": np.int32}
+    from hyperspace_tpu.ops.keys import host_dense_group_ids
+
+    n = batch.num_rows
+    if group_columns:
+        operands = []
+        for name in group_columns:
+            operands.extend(host_column_sort_lanes(batch.column(name)))
+        perm, segment_ids = host_dense_group_ids(operands)
+        perm = perm.astype(np.int32)
+        num_groups = int(segment_ids[-1]) + 1
+        sorted_batch = batch.take(perm)
+        starts = np.searchsorted(segment_ids, np.arange(num_groups),
+                                 side="left")
+    else:
+        segment_ids = np.zeros(n, dtype=np.int32)
+        num_groups = 1
+        sorted_batch = batch
+        starts = np.zeros(1, dtype=np.int64)
+
+    columns = {}
+    for name in group_columns:
+        src = sorted_batch.column(name)
+        f = batch.schema.field(name)
+        columns[f.name] = DeviceColumn(
+            data=np.asarray(src.data)[starts], dtype=src.dtype,
+            validity=(np.asarray(src.validity)[starts]
+                      if src.validity is not None else None),
+            dictionary=src.dictionary, dict_hashes=src.dict_hashes)
+
+    for spec in aggregates:
+        out_field = out_schema.field(spec.alias)
+        if spec.func == "count" and spec.column == "*":
+            data = np.bincount(segment_ids,
+                               minlength=num_groups).astype(np.int64)
+            columns[out_field.name] = DeviceColumn(data, "int64")
+            continue
+        src = sorted_batch.column(spec.column)
+        if src.is_string and spec.func != "count":
+            raise HyperspaceException(
+                f"Aggregate {spec.func} over string column {spec.column} "
+                "is not supported.")
+        valid = (np.asarray(src.validity) if src.validity is not None
+                 else np.ones(n, dtype=bool))
+        counts = np.bincount(segment_ids, weights=valid,
+                             minlength=num_groups).astype(np.int64)
+        if spec.func == "count":
+            columns[out_field.name] = DeviceColumn(counts, "int64")
+            continue
+        values = np.asarray(src.data)
+        validity_out = counts > 0
+        if spec.func in ("sum", "avg"):
+            acc = (np.float64 if out_field.dtype == "float64" else np.int64)
+            total = np.add.reduceat(
+                np.where(valid, values, 0).astype(acc), starts)
+            data = (total if spec.func == "sum"
+                    else total.astype(np.float64) / np.maximum(counts, 1))
+        elif spec.func == "stddev":
+            # Two-pass shifted variance; see the device lane for why the
+            # one-pass identity is numerically unsafe.
+            x = np.where(valid, values, 0).astype(np.float64)
+            cnt = counts.astype(np.float64)
+            mu = np.add.reduceat(x, starts) / np.maximum(cnt, 1)
+            dev = np.where(valid, x - mu[segment_ids], 0.0)
+            var = np.add.reduceat(dev * dev, starts) / np.maximum(
+                cnt - 1, 1)
+            data = np.sqrt(np.maximum(var, 0.0))
+            validity_out = counts > 1
+        elif spec.func == "min":
+            big = (np.inf if np.issubdtype(values.dtype, np.floating)
+                   else np.iinfo(values.dtype).max)
+            data = np.minimum.reduceat(np.where(valid, values, big), starts)
+        else:  # max
+            small = (-np.inf if np.issubdtype(values.dtype, np.floating)
+                     else np.iinfo(values.dtype).min)
+            data = np.maximum.reduceat(np.where(valid, values, small), starts)
+        columns[out_field.name] = DeviceColumn(
+            data.astype(_HOST_NP[out_field.dtype]), out_field.dtype,
+            validity=validity_out)
+    return ColumnBatch(out_schema, columns)

@@ -240,6 +240,14 @@ def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
     if right.num_rows == 0:
         li = jnp.arange(left.num_rows, dtype=jnp.int32)
         return li, jnp.full(left.num_rows, -1, dtype=jnp.int32)
+    if left.is_host and right.is_host:
+        # Host lane: per-bucket searchsorted over the ALREADY-SORTED index
+        # layout (no sort, no hash — the bucketed-SMJ structural win); the
+        # general host sort join covers multi-key/string/nullable keys.
+        from hyperspace_tpu.ops.join import host_bucketed_join_indices
+        return host_bucketed_join_indices(
+            left, right, np.asarray(l_lengths), np.asarray(r_lengths),
+            left_keys, right_keys, how="left_outer" if left_outer else how)
     if padded_skew(l_lengths, r_lengths, left.num_rows, right.num_rows):
         return _global_join_indices(left, right, left_keys, right_keys,
                                     "left_outer" if left_outer else how)
@@ -265,15 +273,22 @@ def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
                         r_idx, total, int(l_pos.shape[1]))
 
 
-def _gather_side(batch: ColumnBatch, idx):
-    """Gather rows by index; index -1 (unmatched outer row) yields null."""
-    import jax.numpy as jnp
+def _gather_side(batch: ColumnBatch, idx, may_unmatch: bool = True):
+    """Gather rows by index; index -1 (unmatched outer row) yields null.
+    Host-lane batches with host indices gather in numpy.
 
+    `may_unmatch=False` (inner-join sides) skips the unmatched handling —
+    on device arrays a data-dependent `any()` would cost a blocking
+    host sync (~100 ms tunneled), so the decision must be static."""
+    if isinstance(idx, np.ndarray) and batch.is_host:
+        xp = np
+    else:
+        import jax.numpy as xp
+
+    if not may_unmatch or idx.shape[0] == 0:
+        return batch.take(idx)
     unmatched = idx < 0
-    any_unmatched = bool(jnp.any(unmatched)) if idx.shape[0] else False
-    out = batch.take(jnp.clip(idx, 0, None) if any_unmatched else idx)
-    if not any_unmatched:
-        return out
+    out = batch.take(xp.clip(idx, 0, None))
     columns = {}
     for name, col in out.columns.items():
         validity = (col.validity & ~unmatched
@@ -284,14 +299,18 @@ def _gather_side(batch: ColumnBatch, idx):
 
 
 def assemble_join_output(left: ColumnBatch, right: ColumnBatch,
-                         li, ri) -> ColumnBatch:
+                         li, ri, how: str = "left_outer") -> ColumnBatch:
     """Gather both sides by index pairs into the joined batch; -1 on either
     side (unmatched outer row) yields null columns for that side. Duplicate
-    output names get a `_r` suffix on the right."""
+    output names get a `_r` suffix on the right. `how` statically bounds
+    which sides can hold -1 (inner: neither; left_outer: right only;
+    right_outer: left only) so no data-dependent device sync is needed."""
     from hyperspace_tpu.plan.schema import Field, Schema
 
-    left_out = _gather_side(left, li)
-    right_out = _gather_side(right, ri)
+    left_out = _gather_side(left, li,
+                            may_unmatch=how in ("right_outer", "full_outer"))
+    right_out = _gather_side(right, ri,
+                             may_unmatch=how in ("left_outer", "full_outer"))
     fields = list(left_out.schema.fields)
     columns = dict(left_out.columns)
     left_names = {f.name.lower() for f in fields}
@@ -316,4 +335,4 @@ def bucketed_sort_merge_join(left: ColumnBatch, right: ColumnBatch,
         li, ri = bucketed_join_indices(left, right, np.asarray(l_lengths),
                                        np.asarray(r_lengths), left_keys,
                                        right_keys, how=how)
-    return assemble_join_output(left, right, li, ri)
+    return assemble_join_output(left, right, li, ri, how=how)

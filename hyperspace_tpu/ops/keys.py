@@ -67,3 +67,53 @@ def column_sort_lanes(col: DeviceColumn) -> List:
         lanes.append(col.validity)
     lanes.extend(key_lanes(col.data))
     return lanes
+
+
+def host_key_lanes(data) -> List:
+    """Host (numpy) mirror of `key_lanes`: same order-preserving
+    decomposition with zero device traffic, for the adaptive host lane."""
+    import numpy as np
+
+    dtype = data.dtype
+    if dtype == np.int64:
+        return [(data >> 32).astype(np.int32),
+                (data & 0xFFFFFFFF).astype(np.uint32)]
+    if dtype == np.float64:
+        from hyperspace_tpu.ops.host_hash import _float_order_bits
+        bits = _float_order_bits(data, np.uint64, 64)
+        return [(bits >> np.uint64(32)).astype(np.uint32),
+                (bits & np.uint64(0xFFFFFFFF)).astype(np.uint32)]
+    if dtype == np.float32:
+        from hyperspace_tpu.ops.host_hash import _float_order_bits
+        return [_float_order_bits(data, np.uint32, 32)]
+    if dtype == np.bool_:
+        return [data.astype(np.int32)]
+    if dtype in (np.int8, np.int16, np.int32):
+        return [data.astype(np.int32)]
+    return [data]
+
+
+def host_column_sort_lanes(col: DeviceColumn) -> List:
+    lanes: List = []
+    if col.validity is not None:
+        lanes.append(col.validity)
+    lanes.extend(host_key_lanes(col.data))
+    return lanes
+
+
+def host_dense_group_ids(keys):
+    """Stable dense group encoding on the host: np.lexsort over the key
+    arrays (primary key first), then adjacent-difference ids in sorted
+    order. Returns (perm, sorted_group_ids); original-order ids are
+    `out[perm] = sorted_group_ids`. Shared by the host join encode and the
+    host aggregation so the grouping invariants live in one place."""
+    import numpy as np
+
+    keys = [np.asarray(k) for k in keys]
+    perm = np.lexsort(tuple(reversed(keys)))
+    n = len(perm)
+    differs = np.zeros(n, dtype=np.int32)
+    for k in keys:
+        ks = k[perm]
+        differs[1:] |= (ks[1:] != ks[:-1]).astype(np.int32)
+    return perm, np.cumsum(differs, dtype=np.int32)

@@ -32,7 +32,17 @@ def _key_operands(batch: ColumnBatch, by: Sequence[str]) -> List:
 def sort_permutation(batch: ColumnBatch, by: Sequence[str],
                      leading_keys: Optional[Sequence] = None):
     """Stable lexicographic sort permutation by `by` columns; optional
-    `leading_keys` (e.g. bucket ids) sort before them."""
+    `leading_keys` (e.g. bucket ids) sort before them. Host-lane batches
+    sort with np.lexsort (stable) — no device round-trip."""
+    if batch.is_host and not leading_keys:
+        import numpy as np
+
+        from hyperspace_tpu.ops.keys import host_column_sort_lanes
+        operands = []
+        for name in by:
+            operands.extend(host_column_sort_lanes(batch.column(name)))
+        # np.lexsort's primary key is the LAST operand.
+        return np.lexsort(tuple(reversed(operands))).astype(np.int32)
     import jax
     import jax.numpy as jnp
 

@@ -216,7 +216,7 @@ class Project(LogicalPlan):
         return f"Project [{', '.join(self.columns)}]"
 
 
-_AGG_FUNCS = ("sum", "count", "min", "max", "avg")
+_AGG_FUNCS = ("sum", "count", "min", "max", "avg", "stddev")
 
 
 @dataclass(frozen=True)
@@ -264,7 +264,7 @@ class Aggregate(LogicalPlan):
         for spec in self.aggregates:
             if spec.func == "count":
                 dtype = "int64"
-            elif spec.func == "avg":
+            elif spec.func in ("avg", "stddev"):
                 dtype = "float64"
             elif spec.func == "sum":
                 src = self.child.schema.field(spec.column).dtype

@@ -56,11 +56,14 @@ class Rule:
 
     @staticmethod
     def index_scan(entry: IndexLogEntry, bucketed: bool) -> Scan:
-        """Build the replacement relation over the index data. Filter
-        rewrites pass bucketed=False — a plain scan keeps full read
-        parallelism (reference `FilterIndexRule.scala:112-120`); join
-        rewrites pass bucketed=True so the planner can elide Exchange+Sort
-        (reference `JoinIndexRule.scala:124-153`)."""
+        """Build the replacement relation over the index data. The
+        reference's filter rewrite drops the BucketSpec to keep Spark's
+        scan parallelism (`FilterIndexRule.scala:112-120`); this engine's
+        scan parallelism is unaffected by the spec, so filter rewrites
+        KEEP it (bucketed=True) — it is what lets the planner prune the
+        read to the literal's hash bucket(s). Join rewrites likewise pass
+        bucketed=True so Exchange+Sort are elided (reference
+        `JoinIndexRule.scala:124-153`)."""
         from hyperspace_tpu.plan.nodes import BucketSpec
 
         schema = Schema.from_json(entry.schema_json)

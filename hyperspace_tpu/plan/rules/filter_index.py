@@ -53,7 +53,7 @@ class FilterIndexRule(Rule):
         index = self._find_covering_index(filt, scan, project_columns,
                                           filter_columns)
         if index is not None:
-            source: LogicalPlan = self.index_scan(index, bucketed=False)
+            source: LogicalPlan = self.index_scan(index, bucketed=True)
             logger.info("FilterIndexRule: applying index %s", index.name)
         else:
             source = self._hybrid_scan_source(filt, scan, project_columns,
@@ -102,7 +102,7 @@ class FilterIndexRule(Rule):
             if not self.signature_matches(entry, restricted):
                 continue
             appended = sorted(current - stored)
-            index_scan = self.index_scan(entry, bucketed=False)
+            index_scan = self.index_scan(entry, bucketed=True)
             appended_scan = Scan(scan.root_paths, scan.schema,
                                  files=appended)
             needed_cols = [f.name for f in index_scan.schema.fields

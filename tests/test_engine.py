@@ -153,3 +153,111 @@ def test_count_and_collect(df, pdf):
 def test_empty_filter_result(df):
     out = df.filter(col("clicks") > 1000).to_pandas()
     assert len(out) == 0
+
+
+# ---------------------------------------------------------------------------
+# Bucket pruning (point filters over bucketed index layouts)
+# ---------------------------------------------------------------------------
+
+
+def _bucketed_source(tmp_path, n=5000, num_buckets=8, with_strings=False):
+    """Write a bucketed layout via the product build and return a Scan."""
+    from hyperspace_tpu.io.builder import write_bucketed_table
+    from hyperspace_tpu.plan.nodes import BucketSpec, Scan
+    from hyperspace_tpu.plan.schema import Schema
+
+    rng = np.random.default_rng(5)
+    cols = {"k": rng.integers(0, 500, n).astype(np.int64),
+            "v": np.arange(n, dtype=np.int64)}
+    if with_strings:
+        cols["s"] = np.array(["name_%d" % (i % 97) for i in range(n)])
+    table = pa.table(cols)
+    out = str(tmp_path / "bucketed")
+    keys = ["k"] if not with_strings else ["s"]
+    write_bucketed_table(table, keys, num_buckets, out)
+    schema = Schema.from_arrow(table.schema)
+    spec = BucketSpec(num_buckets, tuple(keys), tuple(keys))
+    return Scan([out], schema, bucket_spec=spec), table
+
+
+def test_bucket_pruning_point_filter_correct_and_pruned(session, tmp_path):
+    from hyperspace_tpu.engine.physical import plan_physical
+    from hyperspace_tpu.plan.nodes import Filter, Project
+
+    scan, table = _bucketed_source(tmp_path)
+    plan = Project(["v"], Filter(col("k") == lit(123), scan))
+    phys = plan_physical(plan)
+    scans = [n for n in phys.collect() if isinstance(n, ScanExec)]
+    assert scans and scans[0].allowed_buckets is not None
+    assert len(scans[0].allowed_buckets) == 1
+    assert "prunedBuckets=1/8" in scans[0].simple_string()
+
+    got = np.sort(np.asarray(phys.execute().column("v").data))
+    k = table.column("k").to_numpy()
+    expected = np.sort(table.column("v").to_numpy()[k == 123])
+    assert (got == expected).all()
+
+
+def test_bucket_pruning_in_list_and_unprunable_predicates(session, tmp_path):
+    from hyperspace_tpu.engine.physical import plan_physical, _prune_buckets
+    from hyperspace_tpu.plan.nodes import Filter
+
+    scan, table = _bucketed_source(tmp_path)
+    # IN list prunes to <= 3 buckets.
+    allowed = _prune_buckets(col("k").isin(7, 8, 9), scan)
+    assert allowed is not None and 1 <= len(allowed) <= 3
+    k = table.column("k").to_numpy()
+    phys = plan_physical(Filter(col("k").isin(7, 8, 9), scan))
+    got = np.sort(np.asarray(phys.execute().column("v").data))
+    expected = np.sort(table.column("v").to_numpy()[np.isin(k, [7, 8, 9])])
+    assert (got == expected).all()
+
+    # Range predicates and disjunctions must NOT prune.
+    assert _prune_buckets(col("k") > lit(5), scan) is None
+    assert _prune_buckets((col("k") == lit(1)) | (col("k") == lit(2)),
+                          scan) is None
+    # Conjunct with extra terms still prunes on the key equality.
+    assert _prune_buckets((col("k") == lit(1)) & (col("v") > lit(10)),
+                          scan) is not None
+
+
+def test_bucket_pruning_string_key(session, tmp_path):
+    from hyperspace_tpu.engine.physical import plan_physical
+    from hyperspace_tpu.plan.nodes import Filter
+
+    scan, table = _bucketed_source(tmp_path, with_strings=True)
+    phys = plan_physical(Filter(col("s") == lit("name_13"), scan))
+    scans = [n for n in phys.collect() if isinstance(n, ScanExec)]
+    assert scans[0].allowed_buckets is not None
+    got = np.sort(np.asarray(phys.execute().column("v").data))
+    s = np.array(table.column("s").to_pylist())
+    expected = np.sort(table.column("v").to_numpy()[s == "name_13"])
+    assert (got == expected).all()
+
+
+def test_bucket_pruning_e2e_filter_rule(tmp_path):
+    """FilterIndexRule swap + pruning end to end: results equal rules-off."""
+    from hyperspace_tpu import Hyperspace, IndexConfig
+    from hyperspace_tpu.engine.physical import ScanExec as SE
+
+    conf = HyperspaceConf({"hyperspace.warehouse.dir": str(tmp_path / "wh")})
+    sess = HyperspaceSession(conf)
+    hs = Hyperspace(sess)
+    rng = np.random.default_rng(11)
+    src = tmp_path / "src"
+    src.mkdir()
+    table = pa.table({"k": rng.integers(0, 100, 3000).astype(np.int64),
+                      "x": np.arange(3000, dtype=np.int64)})
+    pq.write_table(table, str(src / "part-0.parquet"))
+    df = sess.read_parquet(str(src))
+    hs.create_index(df, IndexConfig("pidx", ["k"], ["x"]))
+
+    q = lambda: df.filter(col("k") == lit(17)).select("x")
+    sess.enable_hyperspace()
+    phys = q().explain_plans()[2]
+    scans = [n for n in phys.collect() if isinstance(n, SE)]
+    assert any(s.allowed_buckets is not None for s in scans)
+    with_idx = q().collect().to_pandas().sort_values("x").reset_index(drop=True)
+    sess.disable_hyperspace()
+    without = q().collect().to_pandas().sort_values("x").reset_index(drop=True)
+    assert with_idx.equals(without)

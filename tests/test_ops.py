@@ -347,3 +347,115 @@ def test_bucketed_join_skew_left_outer_matches_global():
     lk = np.asarray(lb.column("k").data)
     matched = np.isin(lk[li], [7, 10_000, 10_001])
     assert ((ri >= 0) == matched).all()
+
+
+def test_host_bucket_ids_match_device():
+    """The host (numpy) hash mirror must agree with THE device hash
+    identity for every key dtype — bucket pruning and the on-disk layout
+    depend on it."""
+    from hyperspace_tpu.ops.host_hash import host_bucket_ids
+
+    rng = np.random.default_rng(13)
+    n, B = 257, 32
+    cases = {
+        "int64": rng.integers(-2**62, 2**62, n).astype(np.int64),
+        "int32": rng.integers(-2**31, 2**31 - 1, n).astype(np.int32),
+        "int16": rng.integers(-2**15, 2**15 - 1, n).astype(np.int16),
+        "bool": rng.integers(0, 2, n).astype(bool),
+        "float64": rng.standard_normal(n) * 1e6,
+        "float32": (rng.standard_normal(n) * 1e3).astype(np.float32),
+        "string": np.array(["v_%d" % v for v in rng.integers(0, 50, n)]),
+    }
+    for dtype, vals in cases.items():
+        table = pa.table({"k": pa.array(vals)})
+        batch = columnar.from_arrow(table)
+        dev = np.asarray(hash_partition.bucket_ids(batch, ["k"], B))
+        host = host_bucket_ids([vals], [dtype], B)
+        assert (dev == host).all(), f"identity mismatch for {dtype}"
+    # Multi-column combine order matters: (int64, string) pair.
+    table = pa.table({"a": pa.array(cases["int64"]),
+                      "s": pa.array(cases["string"])})
+    batch = columnar.from_arrow(table)
+    dev = np.asarray(hash_partition.bucket_ids(batch, ["a", "s"], B))
+    host = host_bucket_ids([cases["int64"], cases["string"]],
+                           ["int64", "string"], B)
+    assert (dev == host).all()
+
+
+def test_stddev_aggregate_and_host_device_parity():
+    """stddev (sample) on both lanes; host-lane aggregation must agree
+    with the device lane bit-for-bit on grouping and SQL null semantics."""
+    from hyperspace_tpu.io.columnar import from_arrow
+    from hyperspace_tpu.ops.aggregate import group_aggregate
+    from hyperspace_tpu.plan.nodes import Aggregate, AggSpec
+    from hyperspace_tpu.plan.schema import Schema
+
+    rng = np.random.default_rng(3)
+    n = 4000
+    table = pa.table({
+        "g": rng.integers(0, 37, n).astype(np.int64),
+        "x": pa.array([None if i % 11 == 0 else float(v) for i, v in
+                       enumerate(rng.standard_normal(n))], type=pa.float64()),
+        "y": rng.integers(-100, 100, n).astype(np.int64),
+    })
+    schema = Schema.from_arrow(table.schema)
+    specs = [AggSpec("count", "*", "cnt"), AggSpec("count", "x", "cx"),
+             AggSpec("sum", "y", "sy"), AggSpec("avg", "x", "ax"),
+             AggSpec("min", "y", "mny"), AggSpec("max", "y", "mxy"),
+             AggSpec("stddev", "x", "sx")]
+    from hyperspace_tpu.plan.nodes import Scan
+    out_schema = Aggregate(["g"], specs,
+                           Scan(["/nonexistent"], schema)).schema
+
+    host = group_aggregate(from_arrow(table, device=False), ["g"], specs,
+                           out_schema)
+    dev = group_aggregate(from_arrow(table, device=True), ["g"], specs,
+                          out_schema)
+    import pandas as pd
+    from hyperspace_tpu.io.columnar import to_arrow
+    h = to_arrow(host).to_pandas().sort_values("g").reset_index(drop=True)
+    d = to_arrow(dev).to_pandas().sort_values("g").reset_index(drop=True)
+    pd.testing.assert_frame_equal(h, d, check_exact=False, rtol=1e-9)
+    # Cross-check stddev against pandas (sample stddev).
+    ref = (table.to_pandas().groupby("g")["x"].std()
+           .reset_index(drop=True))
+    assert np.allclose(h["sx"].to_numpy(), ref.to_numpy(),
+                       rtol=1e-9, equal_nan=True)
+
+
+def test_stddev_no_catastrophic_cancellation():
+    """stddev over large-offset values (timestamp magnitude) must not
+    cancel: two-pass shifted variance on both lanes."""
+    from hyperspace_tpu.io.columnar import from_arrow
+    from hyperspace_tpu.ops.aggregate import group_aggregate
+    from hyperspace_tpu.plan.nodes import Aggregate, AggSpec, Scan
+    from hyperspace_tpu.plan.schema import Schema
+
+    rng = np.random.default_rng(1)
+    x = 1.7e15 + rng.standard_normal(1000)
+    table = pa.table({"g": np.zeros(1000, np.int64), "x": x})
+    schema = Schema.from_arrow(table.schema)
+    specs = [AggSpec("stddev", "x", "sx")]
+    out_schema = Aggregate(["g"], specs, Scan(["/nx"], schema)).schema
+    expected = np.std(x, ddof=1)
+    for device in (False, True):
+        out = group_aggregate(from_arrow(table, device=device), ["g"],
+                              specs, out_schema)
+        got = float(np.asarray(out.column("sx").data)[0])
+        assert abs(got - expected) < 1e-3, f"device={device}: {got}"
+
+
+def test_host_join_rejects_mismatched_key_lists():
+    """The host lane must enforce the same key-list validation as the
+    device path instead of silently truncating via zip."""
+    from hyperspace_tpu.io.columnar import from_arrow
+    from hyperspace_tpu.ops.join import sort_merge_join
+    from hyperspace_tpu.exceptions import HyperspaceException
+
+    left = from_arrow(pa.table({"a": np.arange(3, dtype=np.int64),
+                                "b": np.arange(3, dtype=np.int64)}),
+                      device=False)
+    right = from_arrow(pa.table({"a": np.arange(3, dtype=np.int64)}),
+                       device=False)
+    with pytest.raises(HyperspaceException):
+        sort_merge_join(left, right, ["a", "b"], ["a"])

@@ -80,7 +80,11 @@ def test_filter_rule_rewrites_covered_query(session, tmp_path):
     out = FilterIndexRule(session).apply(plan)
     leaf = out.collect_leaves()[0]
     assert "fidx" in leaf.root_paths[0]
-    assert leaf.bucket_spec is None  # filter rewrite keeps plain scan
+    # Filter rewrite keeps the bucket spec: unlike the reference (where a
+    # spec would throttle Spark's scan parallelism), carrying it lets the
+    # physical planner prune the read to the literal's hash bucket.
+    assert leaf.bucket_spec is not None
+    assert leaf.bucket_spec.bucket_columns == ("c1",)
     assert isinstance(out, Project) and out.columns == ["c2"]
 
 

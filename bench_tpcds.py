@@ -1,0 +1,127 @@
+"""TPC-DS benchmark — BASELINE.md ladder rung 5 (q17 / q25 / q64).
+
+Generates the deterministic table subset (hyperspace_tpu/tpcds), creates
+the covering indexes, and times each query three ways, warm best-of-N:
+  - rules ON   (index-accelerated framework execution)
+  - rules OFF  (framework execution without indexes)
+  - pandas     (vectorized CPU oracle — the commodity baseline)
+Result equality across all three is asserted before timing is reported
+(the reference's E2E guarantee, `E2EHyperspaceRulesTests.scala:330-346`).
+
+Prints exactly ONE JSON line:
+  {"metric": "tpcds_q17_q25_q64_wall_s", "value": <rules-on total>,
+   "vs_baseline": <pandas total / rules-on total>, "queries": {...}}
+
+BENCH_TPCDS_SCALE scales the fact tables (1.0 ~ 300k store_sales rows).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+SCALE = float(os.environ.get("BENCH_TPCDS_SCALE", 1.0))
+WARM_RUNS = int(os.environ.get("BENCH_WARM_RUNS", 3))
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def best_of(fn, runs=WARM_RUNS, label=""):
+    best, out = float("inf"), None
+    for i in range(runs):
+        t0 = time.perf_counter()
+        out = fn()
+        elapsed = time.perf_counter() - t0
+        log(f"  {label} run {i}: {elapsed:.3f}s")
+        best = min(best, elapsed)
+    return best, out
+
+
+def norm(df):
+    out = df.sort_values(list(df.columns)).reset_index(drop=True)
+    return out.astype({c: "float64" for c in out.columns
+                       if out[c].dtype.kind in "fi"})
+
+
+def main():
+    import pandas as pd
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceConf, HyperspaceSession
+    from hyperspace_tpu.tpcds import QUERIES, generate
+    from hyperspace_tpu.tpcds.queries import create_indexes
+
+    work = tempfile.mkdtemp(prefix="hs_tpcds_")
+    try:
+        t0 = time.perf_counter()
+        paths = generate(os.path.join(work, "data"), scale=SCALE)
+        log(f"generate (scale={SCALE}): {time.perf_counter() - t0:.1f}s")
+
+        sess = HyperspaceSession(HyperspaceConf({
+            "hyperspace.warehouse.dir": os.path.join(work, "wh"),
+            "spark.hyperspace.index.num.buckets": "32"}))
+        hs = Hyperspace(sess)
+        dfs = {n: sess.read_parquet(p) for n, p in paths.items()}
+        t0 = time.perf_counter()
+        create_indexes(hs, dfs)
+        index_build_s = time.perf_counter() - t0
+        log(f"index build (7 indexes): {index_build_s:.1f}s")
+
+        def read_pdfs():
+            # The oracle pays its parquet reads inside the timer, exactly
+            # like the framework re-reads per query (and like bench.py's
+            # rung 2-4 CPU comparators).
+            return {n: pq.read_table(os.path.join(p, "part-0.parquet"))
+                    .to_pandas() for n, p in paths.items()}
+
+        queries = {}
+        tot_on = tot_off = tot_cpu = 0.0
+        for name, (build, oracle) in QUERIES.items():
+            cpu_s, expected = best_of(lambda: oracle(read_pdfs()),
+                                      label=f"{name} pandas")
+            sess.enable_hyperspace()
+            build(dfs).collect()  # warm (compiles, file listings)
+            on_s, got_on = best_of(lambda: build(dfs).collect().to_pandas(),
+                                   label=f"{name} rules-on")
+            sess.disable_hyperspace()
+            off_s, got_off = best_of(lambda: build(dfs).collect().to_pandas(),
+                                     label=f"{name} rules-off")
+            for got, tag in ((got_on, "rules-on"), (got_off, "rules-off")):
+                pd.testing.assert_frame_equal(
+                    norm(got), norm(expected), check_dtype=False,
+                    check_exact=False, rtol=1e-6)
+            log(f"{name}: on {on_s:.3f}s off {off_s:.3f}s cpu {cpu_s:.3f}s "
+                f"(vs cpu x{cpu_s / on_s:.2f}, vs no-index x{off_s / on_s:.2f})")
+            queries[name] = {"rules_on_s": round(on_s, 4),
+                             "rules_off_s": round(off_s, 4),
+                             "pandas_s": round(cpu_s, 4),
+                             "vs_baseline": round(cpu_s / on_s, 3),
+                             "vs_no_index": round(off_s / on_s, 3),
+                             "rows": int(len(expected))}
+            tot_on += on_s
+            tot_off += off_s
+            tot_cpu += cpu_s
+
+        print(json.dumps({
+            "metric": "tpcds_q17_q25_q64_wall_s",
+            "value": round(tot_on, 3),
+            "unit": "s",
+            "vs_baseline": round(tot_cpu / tot_on, 3),
+            "scale": SCALE,
+            "index_build_s": round(index_build_s, 2),
+            "queries": queries,
+        }))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

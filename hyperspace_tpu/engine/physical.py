@@ -448,11 +448,31 @@ class SortMergeJoinExec(PhysicalNode):
             return bucketed_sort_merge_join(lbatch, rbatch, l_lengths,
                                             r_lengths, self.left_keys,
                                             self.right_keys, how=self.how)
-        lbatch = self.left.execute(bucket)
-        rbatch = self.right.execute(bucket)
-        # Children end in SortExec, so sides arrive key-sorted.
+        # General path: the planner wrapped each side in SortExec so the
+        # device merge join gets key-sorted input. Host-lane joins fold
+        # sorting into the join itself (the probe path sorts only the
+        # build side), so the planner sort is pure overhead for them:
+        # execute the Sort's CHILD raw, then pre-sort only when BOTH
+        # sides stay on the device (sorting just one would be wasted —
+        # presorted is all-or-nothing downstream).
+        def raw_side(node):
+            if isinstance(node, SortExec):
+                return node.child.execute(bucket), node.keys
+            return node.execute(bucket), None
+
+        lbatch, lkeys = raw_side(self.left)
+        rbatch, rkeys = raw_side(self.right)
+        presort = (lkeys is not None and rkeys is not None
+                   and not lbatch.is_host and not rbatch.is_host)
+        if presort:
+            from hyperspace_tpu.ops.sort import sort_batch
+            if lbatch.num_rows:
+                lbatch = sort_batch(lbatch, lkeys)
+            if rbatch.num_rows:
+                rbatch = sort_batch(rbatch, rkeys)
         return sort_merge_join(lbatch, rbatch, self.left_keys,
-                               self.right_keys, presorted=True, how=self.how)
+                               self.right_keys, presorted=presort,
+                               how=self.how)
 
     def _join_mesh(self, total_rows: int):
         """Mesh for the distributed co-bucketed join, or None. Requires an

@@ -76,3 +76,20 @@ def host_bucket_ids(columns: Sequence[np.ndarray], dtypes: Sequence[str],
     for values, dtype in zip(columns, dtypes):
         lanes.extend(_hash_lanes(values, dtype))
     return (host_flat_hash32(lanes) % np.uint32(num_buckets)).astype(np.int32)
+
+
+def host_column_hash_lanes(col) -> List[np.ndarray]:
+    """Hash-input lanes for a host-lane DeviceColumn, mirroring the device
+    `column_hash_lanes`: strings contribute gathered per-dictionary value
+    hashes, numerics their 32-bit key lanes; null rows contribute all-zero
+    lanes."""
+    if col.is_string:
+        hi, lo = col.dict_hashes
+        lanes = [np.asarray(hi)[col.data], np.asarray(lo)[col.data]]
+    else:
+        from hyperspace_tpu.ops.keys import host_key_lanes
+        lanes = [lane.astype(np.uint32) for lane in host_key_lanes(col.data)]
+    if col.validity is not None:
+        lanes = [np.where(col.validity, lane, np.uint32(0))
+                 for lane in lanes]
+    return lanes

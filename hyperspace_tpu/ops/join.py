@@ -227,13 +227,111 @@ def _host_merge_join_indices(left_ids, right_ids, how: str = "inner"):
     return left_idx.astype(np.int32), right_idx.astype(np.int32)
 
 
+def _packed_keys(left: ColumnBatch, right: ColumnBatch,
+                 left_keys: Sequence[str], right_keys: Sequence[str]):
+    """(left_vals, right_vals) int64/float arrays whose scalar order equals
+    the key-tuple lexicographic order, or None when the keys are not
+    packable (strings, nulls, ranges too wide). Single numeric key returns
+    the values as-is; multi-key packs integer tuples into one int64 via
+    per-column offsets and range products (order-preserving because every
+    column contributes a non-negative bounded digit)."""
+    import numpy as np
+
+    if len(left_keys) != len(right_keys) or not left_keys:
+        raise HyperspaceException("Join requires matching key column lists.")
+    lvals, rvals = [], []
+    for lk, rk in zip(left_keys, right_keys):
+        lcol, rcol = left.column(lk), right.column(rk)
+        if (lcol.is_string or rcol.is_string or lcol.validity is not None
+                or rcol.validity is not None):
+            return None
+        ld, rd = np.asarray(lcol.data), np.asarray(rcol.data)
+        if ld.dtype != rd.dtype:
+            common = np.promote_types(ld.dtype, rd.dtype)
+            ld, rd = ld.astype(common), rd.astype(common)
+        lvals.append(ld)
+        rvals.append(rd)
+    if len(lvals) == 1:
+        return lvals[0], rvals[0]
+    if any(v.dtype.kind == "f" for v in lvals):
+        return None  # float digits don't pack
+    mins, ranges = [], []
+    for ld, rd in zip(lvals, rvals):
+        if len(ld) == 0 and len(rd) == 0:
+            mins.append(0)
+            ranges.append(1)
+            continue
+        mn = min(int(ld.min()) if len(ld) else int(rd.min()),
+                 int(rd.min()) if len(rd) else int(ld.min()))
+        mx = max(int(ld.max()) if len(ld) else int(rd.max()),
+                 int(rd.max()) if len(rd) else int(ld.max()))
+        mins.append(mn)
+        ranges.append(mx - mn + 1)
+    capacity = 1
+    for r in ranges:
+        capacity *= r
+        if capacity > 1 << 62:
+            return None
+    lp = np.zeros(len(lvals[0]), dtype=np.int64)
+    rp = np.zeros(len(rvals[0]), dtype=np.int64)
+    for ld, rd, mn, r in zip(lvals, rvals, mins, ranges):
+        lp = lp * r + (ld.astype(np.int64) - mn)
+        rp = rp * r + (rd.astype(np.int64) - mn)
+    return lp, rp
+
+
+def _host_probe_join_indices(lv, rv, how: str) -> Tuple:
+    """Probe join over packed scalar keys: sort ONLY the right side, then
+    per-left-row match ranges via searchsorted — no sort of the (usually
+    much larger) probe side."""
+    import numpy as np
+
+    r_order = np.argsort(rv, kind="stable")
+    rs = rv[r_order]
+    lo = np.searchsorted(rs, lv, side="left")
+    hi = np.searchsorted(rs, lv, side="right")
+    counts = hi - lo
+    if how == "left_outer":
+        counts = np.maximum(counts, 1)
+    total = int(counts.sum())
+    if total == 0:
+        empty = np.zeros(0, dtype=np.int32)
+        return empty, empty
+    left_idx = np.repeat(np.arange(len(lv)), counts)
+    starts = np.cumsum(counts) - counts
+    offsets = np.arange(total) - starts[left_idx]
+    if how == "inner":
+        right_idx = r_order[lo[left_idx] + offsets]
+    else:
+        matched = hi[left_idx] > lo[left_idx]
+        right_idx = np.where(
+            matched, r_order[np.clip(lo[left_idx] + offsets, 0,
+                                     max(len(rv) - 1, 0))], -1)
+    return left_idx.astype(np.int32), right_idx.astype(np.int32)
+
+
 def host_join_indices(left: ColumnBatch, right: ColumnBatch,
                       left_keys: Sequence[str], right_keys: Sequence[str],
                       how: str = "inner") -> Tuple:
     """Join row-index pairs computed entirely on the host (numpy) for
     host-lane batches. `how` is inner or left_outer (callers swap sides
-    for right_outer)."""
+    for right_outer). Null-free numeric keys take the probe path (only
+    the build side is sorted); everything else goes through the general
+    dense-group-id encode."""
     import numpy as np
+
+    empty = np.zeros(0, dtype=np.int32)
+    if left.num_rows == 0:
+        return empty, empty
+    if right.num_rows == 0:
+        if how == "left_outer":
+            return (np.arange(left.num_rows, dtype=np.int32),
+                    np.full(left.num_rows, -1, dtype=np.int32))
+        return empty, empty
+
+    packed = _packed_keys(left, right, left_keys, right_keys)
+    if packed is not None:
+        return _host_probe_join_indices(packed[0], packed[1], how)
 
     l_ids, r_ids = _host_encode_join_keys(left, right, left_keys, right_keys)
     l_perm = np.argsort(l_ids, kind="stable")
@@ -261,19 +359,15 @@ def host_bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
     key; anything else falls back to the general host sort join."""
     import numpy as np
 
-    lcol = left.column(left_keys[0])
-    rcol = right.column(right_keys[0])
-    if (len(left_keys) != 1 or lcol.is_string or rcol.is_string
-            or lcol.validity is not None or rcol.validity is not None
-            or how not in ("inner", "left_outer")):
+    packed = (None if how not in ("inner", "left_outer")
+              else _packed_keys(left, right, left_keys, right_keys))
+    if packed is None:
         return host_join_indices(left, right, left_keys, right_keys,
                                  how="left_outer" if how == "left_outer"
                                  else "inner")
-    lkey = np.asarray(lcol.data)
-    rkey = np.asarray(rcol.data)
-    if lkey.dtype != rkey.dtype:
-        common = np.promote_types(lkey.dtype, rkey.dtype)
-        lkey, rkey = lkey.astype(common), rkey.astype(common)
+    # Packing is monotone in key-tuple order, so within-bucket sortedness
+    # of the key tuples carries over to the packed scalars.
+    lkey, rkey = packed
     B = len(l_lengths)
     lb = np.concatenate([[0], np.cumsum(l_lengths)]).astype(np.int64)
     rb = np.concatenate([[0], np.cumsum(r_lengths)]).astype(np.int64)

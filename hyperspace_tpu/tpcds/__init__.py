@@ -1,0 +1,12 @@
+"""TPC-DS benchmark subset (BASELINE.md rung 5).
+
+A deterministic generator for the table subset q17/q25/q64 touch, the three
+queries expressed on the framework's DataFrame API, and pandas oracle
+implementations used both as correctness checks and as the CPU baseline
+(the reference claims serde coverage of all TPC-DS queries,
+`index/serde/package.scala:46-49`; the analog here is the IR/engine
+executing these shapes end to end).
+"""
+
+from hyperspace_tpu.tpcds.generator import generate, TABLES  # noqa: F401
+from hyperspace_tpu.tpcds.queries import QUERIES  # noqa: F401

@@ -1,0 +1,198 @@
+"""Deterministic TPC-DS subset generator.
+
+Generates the tables q17 / q25 / q64 need, at a row scale controlled by
+`scale` (scale=1.0 approximates SF0.1 row counts for the fact tables).
+Schemas follow the TPC-DS column names/types the queries reference; value
+distributions are synthetic but respect the join topology: every foreign
+key is drawn from the referenced table's key domain, and store_returns /
+catalog_sales rows are derived from actual store_sales rows so the
+ss JOIN sr JOIN cs chains produce realistic match rates.
+
+Everything is seeded — same scale, same bytes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+# Rows at scale=1.0 (fact tables ~ SF0.1 / 30; dimensions fixed).
+_BASE = {
+    "store_sales": 300_000,
+    "date_dim": 73_049,     # 1998-01-01 .. 2197-12-31 in real TPC-DS
+    "store": 12,
+    "item": 2_000,
+    "customer": 10_000,
+    "promotion": 30,
+}
+
+TABLES = ("store_sales", "store_returns", "catalog_sales",
+          "catalog_returns", "date_dim", "store", "item", "customer",
+          "promotion")
+
+_QUARTERS = ["%dQ%d" % (y, q) for y in range(1998, 2004)
+             for q in range(1, 5)]
+
+
+def _date_dim(n_dates: int):
+    sk = np.arange(1, n_dates + 1, dtype=np.int64)
+    # ~91-day quarters cycling through _QUARTERS; years 1998..2003.
+    day = sk - 1
+    year = 1998 + (day // 365)
+    moy = 1 + (day % 365) // 31
+    qoy = 1 + (moy - 1) // 3
+    quarter_name = np.array(["%dQ%d" % (y, q) for y, q in
+                             zip(year, np.minimum(qoy, 4))])
+    return {
+        "d_date_sk": sk,
+        "d_year": year.astype(np.int64),
+        "d_moy": np.minimum(moy, 12).astype(np.int64),
+        "d_qoy": np.minimum(qoy, 4).astype(np.int64),
+        "d_quarter_name": quarter_name,
+    }
+
+
+def generate(out_dir: str, scale: float = 1.0,
+             seed: int = 20260730) -> Dict[str, str]:
+    """Write the table subset as parquet dirs under `out_dir`; returns
+    {table: path}. Idempotent for a given (out_dir, scale, seed): existing
+    table dirs are reused."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(seed)
+    n_ss = max(int(_BASE["store_sales"] * scale), 1000)
+    n_dates = _BASE["date_dim"] // 20  # ~6 years of days
+    n_item = max(int(_BASE["item"] * min(scale, 4)), 200)
+    n_cust = max(int(_BASE["customer"] * min(scale, 4)), 500)
+    n_store = _BASE["store"]
+    n_promo = _BASE["promotion"]
+
+    tables: Dict[str, dict] = {}
+    tables["date_dim"] = _date_dim(n_dates)
+
+    tables["store"] = {
+        "s_store_sk": np.arange(1, n_store + 1, dtype=np.int64),
+        "s_store_id": np.array(["S%04d" % i for i in range(n_store)]),
+        "s_store_name": np.array(["store_%d" % (i % 7) for i in range(n_store)]),
+        "s_state": np.array([["TN", "CA", "WA", "NY", "TX"][i % 5]
+                             for i in range(n_store)]),
+        "s_zip": np.array(["%05d" % (35000 + 13 * i) for i in range(n_store)]),
+    }
+
+    tables["item"] = {
+        "i_item_sk": np.arange(1, n_item + 1, dtype=np.int64),
+        "i_item_id": np.array(["I%08d" % (i % (n_item // 2 + 1))
+                               for i in range(n_item)]),
+        "i_item_desc": np.array(["desc_%d" % (i % 997) for i in range(n_item)]),
+        "i_product_name": np.array(["prod_%d" % i for i in range(n_item)]),
+        "i_current_price": np.round(rng.uniform(0.5, 100.0, n_item), 2),
+        "i_color": np.array([["red", "blue", "green", "plum", "puff",
+                              "misty", "navy", "orange"][i % 8]
+                             for i in range(n_item)]),
+    }
+
+    tables["customer"] = {
+        "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_customer_id": np.array(["C%010d" % i for i in range(n_cust)]),
+        "c_first_name": np.array(["fn_%d" % (i % 400) for i in range(n_cust)]),
+        "c_last_name": np.array(["ln_%d" % (i % 700) for i in range(n_cust)]),
+    }
+
+    tables["promotion"] = {
+        "p_promo_sk": np.arange(1, n_promo + 1, dtype=np.int64),
+        "p_promo_id": np.array(["P%06d" % i for i in range(n_promo)]),
+    }
+
+    # -- store_sales ------------------------------------------------------
+    # Sales concentrate in 1999-2001 (day 366..1460) so the year-filtered
+    # queries (q17 2000Q1, q25 Apr-Oct 2000, q64 2000 vs 2001) see dense
+    # data at every scale; date_dim itself still spans the full range.
+    lo_day, hi_day = 366, min(1460, n_dates)
+    ss_sold_date = rng.integers(lo_day, hi_day + 1, n_ss).astype(np.int64)
+    ss_item = rng.integers(1, n_item + 1, n_ss).astype(np.int64)
+    ss_cust = rng.integers(1, n_cust + 1, n_ss).astype(np.int64)
+    ss_store = rng.integers(1, n_store + 1, n_ss).astype(np.int64)
+    ss_ticket = np.arange(1, n_ss + 1, dtype=np.int64)
+    ss_qty = rng.integers(1, 100, n_ss).astype(np.int64)
+    ss_price = np.round(rng.uniform(1.0, 300.0, n_ss), 2)
+    tables["store_sales"] = {
+        "ss_sold_date_sk": ss_sold_date,
+        "ss_item_sk": ss_item,
+        "ss_customer_sk": ss_cust,
+        "ss_cdemo_sk": rng.integers(1, 1000, n_ss).astype(np.int64),
+        "ss_hdemo_sk": rng.integers(1, 1000, n_ss).astype(np.int64),
+        "ss_addr_sk": rng.integers(1, 1000, n_ss).astype(np.int64),
+        "ss_store_sk": ss_store,
+        "ss_promo_sk": rng.integers(1, n_promo + 1, n_ss).astype(np.int64),
+        "ss_ticket_number": ss_ticket,
+        "ss_quantity": ss_qty,
+        "ss_wholesale_cost": np.round(ss_price * 0.6, 2),
+        "ss_list_price": np.round(ss_price * 1.2, 2),
+        "ss_sales_price": ss_price,
+        "ss_net_profit": np.round(ss_price * ss_qty * 0.1
+                                  - rng.uniform(0, 50, n_ss), 2),
+    }
+
+    # -- store_returns: ~30% of sales return, tied to a real sale --------
+    n_sr = n_ss * 3 // 10
+    ret_pick = rng.choice(n_ss, n_sr, replace=False)
+    ret_lag = rng.integers(1, 90, n_sr)
+    tables["store_returns"] = {
+        "sr_returned_date_sk": np.minimum(ss_sold_date[ret_pick] + ret_lag,
+                                          n_dates).astype(np.int64),
+        "sr_item_sk": ss_item[ret_pick],
+        "sr_customer_sk": ss_cust[ret_pick],
+        "sr_ticket_number": ss_ticket[ret_pick],
+        "sr_return_quantity": np.maximum(
+            ss_qty[ret_pick] - rng.integers(0, 50, n_sr), 1).astype(np.int64),
+        "sr_net_loss": np.round(rng.uniform(1.0, 200.0, n_sr), 2),
+    }
+
+    # -- catalog_sales: some to the same (customer, item) pairs ----------
+    n_cs = n_ss * 6 // 10
+    cs_follow = rng.random(n_cs) < 0.5  # half follow a store sale
+    follow_pick = rng.choice(n_ss, n_cs, replace=True)
+    cs_item = np.where(cs_follow, ss_item[follow_pick],
+                       rng.integers(1, n_item + 1, n_cs)).astype(np.int64)
+    cs_cust = np.where(cs_follow, ss_cust[follow_pick],
+                       rng.integers(1, n_cust + 1, n_cs)).astype(np.int64)
+    cs_date = np.minimum(
+        np.where(cs_follow, ss_sold_date[follow_pick]
+                 + rng.integers(1, 120, n_cs),
+                 rng.integers(lo_day, hi_day + 1, n_cs)),
+        n_dates).astype(np.int64)
+    cs_qty = rng.integers(1, 100, n_cs).astype(np.int64)
+    cs_order = np.arange(1, n_cs + 1, dtype=np.int64)
+    tables["catalog_sales"] = {
+        "cs_sold_date_sk": cs_date,
+        "cs_bill_customer_sk": cs_cust,
+        "cs_item_sk": cs_item,
+        "cs_order_number": cs_order,
+        "cs_quantity": cs_qty,
+        "cs_ext_list_price": np.round(rng.uniform(5.0, 500.0, n_cs), 2),
+        "cs_net_profit": np.round(rng.uniform(-50.0, 300.0, n_cs), 2),
+    }
+
+    # -- catalog_returns: ~20% of catalog sales --------------------------
+    n_cr = n_cs * 2 // 10
+    cr_pick = rng.choice(n_cs, n_cr, replace=False)
+    tables["catalog_returns"] = {
+        "cr_item_sk": cs_item[cr_pick],
+        "cr_order_number": cs_order[cr_pick],
+        "cr_refunded_cash": np.round(rng.uniform(1.0, 150.0, n_cr), 2),
+        "cr_reversed_charge": np.round(rng.uniform(0.0, 40.0, n_cr), 2),
+        "cr_store_credit": np.round(rng.uniform(0.0, 40.0, n_cr), 2),
+    }
+
+    paths: Dict[str, str] = {}
+    for name, cols in tables.items():
+        path = os.path.join(out_dir, name)
+        paths[name] = path
+        if os.path.isdir(path) and os.listdir(path):
+            continue  # already generated (deterministic)
+        os.makedirs(path, exist_ok=True)
+        pq.write_table(pa.table(cols), os.path.join(path, "part-0.parquet"))
+    return paths

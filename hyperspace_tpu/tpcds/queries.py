@@ -1,0 +1,353 @@
+"""TPC-DS q17 / q25 / q64 on the framework DataFrame API, with pandas
+oracles.
+
+Each query is expressed as a join tree the rewrite rules can accelerate:
+the innermost join is a linear scan pair (JoinIndexRule's applicability,
+reference `JoinIndexRule.scala:210-211`), dimension filters run before
+their joins (FilterIndexRule + bucket pruning serve them), and dimension
+key columns are projected away immediately after each join so the thrice-
+joined date_dim never collides on output names.
+
+The pandas oracle for each query doubles as the CPU baseline and the
+correctness check: `bench_tpcds.py` and `tests/test_tpcds.py` assert
+sorted-result equality between rules-on, rules-off, and the oracle —
+the reference's own E2E guarantee
+(`E2EHyperspaceRulesTests.scala:330-346`).
+
+q64 is structurally faithful at reduced width: the cs_ui HAVING subquery,
+the cross_sales aggregation, and the year-over-year self-join of the
+aggregate are all present; low-cardinality demographic dimensions the
+subset generator does not model are omitted.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+from hyperspace_tpu.plan.expr import col, lit
+
+
+# ---------------------------------------------------------------------------
+# q17 — quarterly store/catalog behaviour of returned items
+# ---------------------------------------------------------------------------
+
+
+def q17(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk", "ss_store_sk",
+        "ss_ticket_number", "ss_quantity")
+    sr = dfs["store_returns"].select(
+        "sr_returned_date_sk", "sr_item_sk", "sr_customer_sk",
+        "sr_ticket_number", "sr_return_quantity")
+    cs = dfs["catalog_sales"].select(
+        "cs_sold_date_sk", "cs_bill_customer_sk", "cs_item_sk",
+        "cs_quantity")
+    d1 = (dfs["date_dim"].filter(col("d_quarter_name") == lit("2000Q1"))
+          .select("d_date_sk"))
+    d23q = col("d_quarter_name").isin("2000Q1", "2000Q2", "2000Q3")
+    d2 = dfs["date_dim"].filter(d23q).select("d_date_sk")
+    d3 = dfs["date_dim"].filter(d23q).select("d_date_sk")
+    store = dfs["store"].select("s_store_sk", "s_state")
+    item = dfs["item"].select("i_item_sk", "i_item_id", "i_item_desc")
+
+    j = ss.join(sr, on=(col("ss_customer_sk") == col("sr_customer_sk"))
+                & (col("ss_item_sk") == col("sr_item_sk"))
+                & (col("ss_ticket_number") == col("sr_ticket_number")))
+    j = j.join(cs, on=(col("sr_customer_sk") == col("cs_bill_customer_sk"))
+               & (col("sr_item_sk") == col("cs_item_sk")))
+    j = j.join(d1, on=col("ss_sold_date_sk") == col("d_date_sk")).select(
+        "ss_item_sk", "ss_store_sk", "ss_quantity", "sr_returned_date_sk",
+        "sr_return_quantity", "cs_sold_date_sk", "cs_quantity")
+    j = j.join(d2, on=col("sr_returned_date_sk") == col("d_date_sk")).select(
+        "ss_item_sk", "ss_store_sk", "ss_quantity", "sr_return_quantity",
+        "cs_sold_date_sk", "cs_quantity")
+    j = j.join(d3, on=col("cs_sold_date_sk") == col("d_date_sk")).select(
+        "ss_item_sk", "ss_store_sk", "ss_quantity", "sr_return_quantity",
+        "cs_quantity")
+    j = j.join(store, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.join(item, on=col("ss_item_sk") == col("i_item_sk"))
+    out = (j.group_by("i_item_id", "i_item_desc", "s_state").agg(
+        ("count", "ss_quantity", "store_sales_quantitycount"),
+        ("avg", "ss_quantity", "store_sales_quantityave"),
+        ("stddev", "ss_quantity", "store_sales_quantitystdev"),
+        ("count", "sr_return_quantity", "store_returns_quantitycount"),
+        ("avg", "sr_return_quantity", "store_returns_quantityave"),
+        ("stddev", "sr_return_quantity", "store_returns_quantitystdev"),
+        ("count", "cs_quantity", "catalog_sales_quantitycount"),
+        ("avg", "cs_quantity", "catalog_sales_quantityave"),
+        ("stddev", "cs_quantity", "catalog_sales_quantitystdev"))
+        .sort("i_item_id", "i_item_desc", "s_state").limit(100))
+    return out
+
+
+def q17_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    d1 = d[d.d_quarter_name == "2000Q1"][["d_date_sk"]]
+    d23 = d[d.d_quarter_name.isin(["2000Q1", "2000Q2", "2000Q3"])][["d_date_sk"]]
+    j = t["store_sales"].merge(
+        t["store_returns"],
+        left_on=["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
+        right_on=["sr_customer_sk", "sr_item_sk", "sr_ticket_number"])
+    j = j.merge(t["catalog_sales"],
+                left_on=["sr_customer_sk", "sr_item_sk"],
+                right_on=["cs_bill_customer_sk", "cs_item_sk"])
+    j = j.merge(d1, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(d23, left_on="sr_returned_date_sk", right_on="d_date_sk")
+    j = j.merge(d23, left_on="cs_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_state"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_id", "i_item_desc"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["i_item_id", "i_item_desc", "s_state"]).agg(
+        store_sales_quantitycount=("ss_quantity", "count"),
+        store_sales_quantityave=("ss_quantity", "mean"),
+        store_sales_quantitystdev=("ss_quantity", "std"),
+        store_returns_quantitycount=("sr_return_quantity", "count"),
+        store_returns_quantityave=("sr_return_quantity", "mean"),
+        store_returns_quantitystdev=("sr_return_quantity", "std"),
+        catalog_sales_quantitycount=("cs_quantity", "count"),
+        catalog_sales_quantityave=("cs_quantity", "mean"),
+        catalog_sales_quantitystdev=("cs_quantity", "std"),
+    ).reset_index()
+    return (g.sort_values(["i_item_id", "i_item_desc", "s_state"])
+            .head(100).reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# q25 — net profit flow of returned items, April..October
+# ---------------------------------------------------------------------------
+
+
+def q25(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk", "ss_store_sk",
+        "ss_ticket_number", "ss_net_profit")
+    sr = dfs["store_returns"].select(
+        "sr_returned_date_sk", "sr_item_sk", "sr_customer_sk",
+        "sr_ticket_number", "sr_net_loss")
+    cs = dfs["catalog_sales"].select(
+        "cs_sold_date_sk", "cs_bill_customer_sk", "cs_item_sk",
+        "cs_net_profit")
+    d1 = (dfs["date_dim"]
+          .filter((col("d_moy") == lit(4)) & (col("d_year") == lit(2000)))
+          .select("d_date_sk"))
+    d23f = ((col("d_moy") >= lit(4)) & (col("d_moy") <= lit(10))
+            & (col("d_year") == lit(2000)))
+    d2 = dfs["date_dim"].filter(d23f).select("d_date_sk")
+    d3 = dfs["date_dim"].filter(d23f).select("d_date_sk")
+    store = dfs["store"].select("s_store_sk", "s_store_id", "s_store_name")
+    item = dfs["item"].select("i_item_sk", "i_item_id", "i_item_desc")
+
+    j = ss.join(sr, on=(col("ss_customer_sk") == col("sr_customer_sk"))
+                & (col("ss_item_sk") == col("sr_item_sk"))
+                & (col("ss_ticket_number") == col("sr_ticket_number")))
+    j = j.join(cs, on=(col("sr_customer_sk") == col("cs_bill_customer_sk"))
+               & (col("sr_item_sk") == col("cs_item_sk")))
+    j = j.join(d1, on=col("ss_sold_date_sk") == col("d_date_sk")).select(
+        "ss_item_sk", "ss_store_sk", "ss_net_profit", "sr_returned_date_sk",
+        "sr_net_loss", "cs_sold_date_sk", "cs_net_profit")
+    j = j.join(d2, on=col("sr_returned_date_sk") == col("d_date_sk")).select(
+        "ss_item_sk", "ss_store_sk", "ss_net_profit", "sr_net_loss",
+        "cs_sold_date_sk", "cs_net_profit")
+    j = j.join(d3, on=col("cs_sold_date_sk") == col("d_date_sk")).select(
+        "ss_item_sk", "ss_store_sk", "ss_net_profit", "sr_net_loss",
+        "cs_net_profit")
+    j = j.join(store, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.join(item, on=col("ss_item_sk") == col("i_item_sk"))
+    out = (j.group_by("i_item_id", "i_item_desc", "s_store_id",
+                      "s_store_name").agg(
+        ("sum", "ss_net_profit", "store_sales_profit"),
+        ("sum", "sr_net_loss", "store_returns_loss"),
+        ("sum", "cs_net_profit", "catalog_sales_profit"))
+        .sort("i_item_id", "i_item_desc", "s_store_id", "s_store_name")
+        .limit(100))
+    return out
+
+
+def q25_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    d1 = d[(d.d_moy == 4) & (d.d_year == 2000)][["d_date_sk"]]
+    d23 = d[(d.d_moy >= 4) & (d.d_moy <= 10) & (d.d_year == 2000)][["d_date_sk"]]
+    j = t["store_sales"].merge(
+        t["store_returns"],
+        left_on=["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
+        right_on=["sr_customer_sk", "sr_item_sk", "sr_ticket_number"])
+    j = j.merge(t["catalog_sales"],
+                left_on=["sr_customer_sk", "sr_item_sk"],
+                right_on=["cs_bill_customer_sk", "cs_item_sk"])
+    j = j.merge(d1, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(d23, left_on="sr_returned_date_sk", right_on="d_date_sk")
+    j = j.merge(d23, left_on="cs_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_store_id", "s_store_name"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_id", "i_item_desc"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["i_item_id", "i_item_desc", "s_store_id",
+                   "s_store_name"]).agg(
+        store_sales_profit=("ss_net_profit", "sum"),
+        store_returns_loss=("sr_net_loss", "sum"),
+        catalog_sales_profit=("cs_net_profit", "sum")).reset_index()
+    return (g.sort_values(["i_item_id", "i_item_desc", "s_store_id",
+                           "s_store_name"]).head(100).reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# q64 — year-over-year cross-channel sales of returned items (reduced width)
+# ---------------------------------------------------------------------------
+
+_Q64_COLORS = ("plum", "puff", "misty")
+
+
+def _q64_cs_ui(dfs):
+    """Catalog sales whose list-price total exceeds 2x the refund total —
+    the HAVING subquery of q64 (filter over an aggregate)."""
+    cs = dfs["catalog_sales"].select("cs_item_sk", "cs_order_number",
+                                     "cs_ext_list_price")
+    cr = dfs["catalog_returns"].select(
+        "cr_item_sk", "cr_order_number", "cr_refunded_cash",
+        "cr_reversed_charge", "cr_store_credit")
+    j = cs.join(cr, on=(col("cs_item_sk") == col("cr_item_sk"))
+                & (col("cs_order_number") == col("cr_order_number")))
+    agg = j.group_by("cs_item_sk").agg(
+        ("sum", "cs_ext_list_price", "sale"),
+        ("sum", "cr_refunded_cash", "refund_cash"),
+        ("sum", "cr_reversed_charge", "refund_charge"),
+        ("sum", "cr_store_credit", "refund_credit"))
+    having = (col("sale") > ((col("refund_cash") + col("refund_charge")
+                              + col("refund_credit")) * lit(2.0)))
+    return agg.filter(having).select("cs_item_sk")
+
+
+def _q64_cross_sales(dfs, year: int):
+    ss = dfs["store_sales"].select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk", "ss_store_sk",
+        "ss_ticket_number", "ss_wholesale_cost", "ss_list_price")
+    sr = dfs["store_returns"].select("sr_item_sk", "sr_ticket_number")
+    dy = (dfs["date_dim"].filter(col("d_year") == lit(year))
+          .select("d_date_sk"))
+    store = dfs["store"].select("s_store_sk", "s_store_name", "s_zip")
+    item = (dfs["item"]
+            .filter(col("i_color").isin(*_Q64_COLORS)
+                    & (col("i_current_price") >= lit(20.0))
+                    & (col("i_current_price") <= lit(85.0)))
+            .select("i_item_sk", "i_product_name"))
+    customer = dfs["customer"].select("c_customer_sk")
+
+    j = ss.join(sr, on=(col("ss_item_sk") == col("sr_item_sk"))
+                & (col("ss_ticket_number") == col("sr_ticket_number")))
+    j = j.join(_q64_cs_ui(dfs), on=col("ss_item_sk") == col("cs_item_sk"))
+    j = j.join(dy, on=col("ss_sold_date_sk") == col("d_date_sk")).select(
+        "ss_item_sk", "ss_customer_sk", "ss_store_sk", "ss_wholesale_cost",
+        "ss_list_price")
+    j = j.join(store, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.join(item, on=col("ss_item_sk") == col("i_item_sk"))
+    j = j.join(customer, on=col("ss_customer_sk") == col("c_customer_sk"))
+    return j.group_by("i_product_name", "s_store_name", "s_zip").agg(
+        ("count", "*", "cnt"),
+        ("sum", "ss_wholesale_cost", "s1"),
+        ("sum", "ss_list_price", "s2"))
+
+
+def q64(dfs: Dict[str, "object"]):
+    cs1 = _q64_cross_sales(dfs, 2000)
+    cs2 = _q64_cross_sales(dfs, 2001)
+    j = cs1.join(cs2, on=(col("i_product_name") == col("i_product_name"))
+                 & (col("s_store_name") == col("s_store_name"))
+                 & (col("s_zip") == col("s_zip")))
+    # Self-join duplicates take the _r suffix on the cs2 side.
+    j = j.filter(col("cnt_r") <= col("cnt"))
+    return (j.select("i_product_name", "s_store_name", "s_zip",
+                     "cnt", "s1", "s2", "cnt_r", "s1_r", "s2_r")
+            .sort("i_product_name", "s_store_name", "s_zip").limit(100))
+
+
+def _q64_cs_ui_pandas(t):
+    j = t["catalog_sales"].merge(
+        t["catalog_returns"], left_on=["cs_item_sk", "cs_order_number"],
+        right_on=["cr_item_sk", "cr_order_number"])
+    g = j.groupby("cs_item_sk").agg(
+        sale=("cs_ext_list_price", "sum"),
+        refund_cash=("cr_refunded_cash", "sum"),
+        refund_charge=("cr_reversed_charge", "sum"),
+        refund_credit=("cr_store_credit", "sum")).reset_index()
+    keep = g[g.sale > 2.0 * (g.refund_cash + g.refund_charge
+                             + g.refund_credit)]
+    return keep[["cs_item_sk"]]
+
+
+def _q64_cross_sales_pandas(t, year: int):
+    d = t["date_dim"]
+    dy = d[d.d_year == year][["d_date_sk"]]
+    it = t["item"]
+    it = it[it.i_color.isin(list(_Q64_COLORS))
+            & (it.i_current_price >= 20.0) & (it.i_current_price <= 85.0)]
+    j = t["store_sales"].merge(
+        t["store_returns"][["sr_item_sk", "sr_ticket_number"]],
+        left_on=["ss_item_sk", "ss_ticket_number"],
+        right_on=["sr_item_sk", "sr_ticket_number"])
+    j = j.merge(_q64_cs_ui_pandas(t), left_on="ss_item_sk",
+                right_on="cs_item_sk")
+    j = j.merge(dy, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_store_name", "s_zip"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(it[["i_item_sk", "i_product_name"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(t["customer"][["c_customer_sk"]],
+                left_on="ss_customer_sk", right_on="c_customer_sk")
+    return j.groupby(["i_product_name", "s_store_name", "s_zip"]).agg(
+        cnt=("ss_item_sk", "size"),
+        s1=("ss_wholesale_cost", "sum"),
+        s2=("ss_list_price", "sum")).reset_index()
+
+
+def q64_pandas(t: Dict[str, "object"]):
+    cs1 = _q64_cross_sales_pandas(t, 2000)
+    cs2 = _q64_cross_sales_pandas(t, 2001)
+    j = cs1.merge(cs2, on=["i_product_name", "s_store_name", "s_zip"],
+                  suffixes=("", "_r"))
+    j = j[j.cnt_r <= j.cnt]
+    out = j[["i_product_name", "s_store_name", "s_zip",
+             "cnt", "s1", "s2", "cnt_r", "s1_r", "s2_r"]]
+    return (out.sort_values(["i_product_name", "s_store_name", "s_zip"])
+            .head(100).reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# Index set + registry
+# ---------------------------------------------------------------------------
+
+
+def create_indexes(hs, dfs) -> None:
+    """The covering indexes the three queries can use: the ss JOIN sr
+    pairs for JoinIndexRule (both key orders used by q17/q25 vs q64), the
+    cs_ui pair for q64, and the date_dim quarter filter for
+    FilterIndexRule + bucket pruning."""
+    from hyperspace_tpu import IndexConfig
+
+    hs.create_index(dfs["store_sales"], IndexConfig(
+        "idx_ss_ret", ["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
+        ["ss_sold_date_sk", "ss_store_sk", "ss_quantity", "ss_net_profit"]))
+    hs.create_index(dfs["store_returns"], IndexConfig(
+        "idx_sr_ret", ["sr_customer_sk", "sr_item_sk", "sr_ticket_number"],
+        ["sr_returned_date_sk", "sr_return_quantity", "sr_net_loss"]))
+    hs.create_index(dfs["store_sales"], IndexConfig(
+        "idx_ss_ticket", ["ss_item_sk", "ss_ticket_number"],
+        ["ss_sold_date_sk", "ss_customer_sk", "ss_store_sk",
+         "ss_wholesale_cost", "ss_list_price"]))
+    hs.create_index(dfs["store_returns"], IndexConfig(
+        "idx_sr_ticket", ["sr_item_sk", "sr_ticket_number"], []))
+    hs.create_index(dfs["catalog_sales"], IndexConfig(
+        "idx_cs_order", ["cs_item_sk", "cs_order_number"],
+        ["cs_ext_list_price"]))
+    hs.create_index(dfs["catalog_returns"], IndexConfig(
+        "idx_cr_order", ["cr_item_sk", "cr_order_number"],
+        ["cr_refunded_cash", "cr_reversed_charge", "cr_store_credit"]))
+    hs.create_index(dfs["date_dim"], IndexConfig(
+        "idx_dd_quarter", ["d_quarter_name"], ["d_date_sk"]))
+
+
+QUERIES: Dict[str, Tuple[Callable, Callable]] = {
+    "q17": (q17, q17_pandas),
+    "q25": (q25, q25_pandas),
+    "q64": (q64, q64_pandas),
+}

@@ -152,3 +152,43 @@ def test_multicolumn_two_lane_hash_consistency():
     pallas_path = np.asarray(hash_lanes_to_buckets(lanes, 16, interpret=True))
     assert (eager == jnp_path).all()
     assert (eager == pallas_path).all()
+
+
+def test_host_and_device_builds_produce_identical_layout(tmp_path):
+    """The host-lane build must write the SAME bucket layout (same rows in
+    the same buckets, sorted the same) as the device program — bucket
+    pruning and co-bucketed joins depend on the shared hash identity."""
+    import os
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from hyperspace_tpu.io import builder
+
+    rng = np.random.default_rng(17)
+    n = 3000
+    table = pa.table({
+        "k": rng.integers(0, 700, n).astype(np.int64),
+        "s": pa.array([None if i % 31 == 0 else "v%d" % (i % 53)
+                       for i in range(n)]),
+        "x": rng.standard_normal(n),
+    })
+    host_dir, dev_dir = str(tmp_path / "host"), str(tmp_path / "dev")
+    assert n < builder.BUILD_MIN_DEVICE_ROWS
+    builder.write_bucketed_table(table, ["k", "s"], 16, host_dir)
+    orig = builder.BUILD_MIN_DEVICE_ROWS
+    builder.BUILD_MIN_DEVICE_ROWS = 0
+    try:
+        builder.write_bucketed_table(table, ["k", "s"], 16, dev_dir)
+    finally:
+        builder.BUILD_MIN_DEVICE_ROWS = orig
+    host_files = sorted(os.listdir(host_dir))
+    dev_files = sorted(os.listdir(dev_dir))
+    assert host_files == dev_files
+    for f in host_files:
+        h = pq.read_table(os.path.join(host_dir, f))
+        d = pq.read_table(os.path.join(dev_dir, f))
+        hk = h.column("k").to_numpy()
+        dk = d.column("k").to_numpy()
+        assert (hk == dk).all(), f"bucket {f}: key order differs"
+        assert sorted(h.column("x").to_pylist()) == \
+            sorted(d.column("x").to_pylist())

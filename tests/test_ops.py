@@ -459,3 +459,21 @@ def test_host_join_rejects_mismatched_key_lists():
                        device=False)
     with pytest.raises(HyperspaceException):
         sort_merge_join(left, right, ["a", "b"], ["a"])
+
+
+def test_host_join_empty_sides():
+    """Empty build side on the host lane: outer joins emit -1, inner joins
+    emit nothing — no IndexError from indexing an empty order array."""
+    from hyperspace_tpu.io.columnar import from_arrow
+    from hyperspace_tpu.ops.join import host_join_indices
+
+    left = from_arrow(pa.table({"k": np.arange(3, dtype=np.int64)}),
+                      device=False)
+    right = from_arrow(pa.table({"k": pa.array([], type=pa.int64())}),
+                       device=False)
+    li, ri = host_join_indices(left, right, ["k"], ["k"], how="left_outer")
+    assert li.tolist() == [0, 1, 2] and ri.tolist() == [-1, -1, -1]
+    li, ri = host_join_indices(left, right, ["k"], ["k"], how="inner")
+    assert len(li) == 0 and len(ri) == 0
+    li, ri = host_join_indices(right, left, ["k"], ["k"], how="inner")
+    assert len(li) == 0

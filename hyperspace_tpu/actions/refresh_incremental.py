@@ -33,6 +33,10 @@ from hyperspace_tpu.actions.refresh import RefreshAction
 
 
 def _link_or_copy(src: str, dst: str) -> None:
+    from hyperspace_tpu.utils import file_utils, storage
+    if storage.is_url(src) or storage.is_url(dst):
+        file_utils.save_byte_array(dst, file_utils.load_byte_array(src))
+        return
     try:
         os.link(src, dst)
     except OSError:
@@ -87,15 +91,16 @@ class RefreshIncrementalAction(RefreshAction):
         from hyperspace_tpu.engine.executor import execute_plan
         from hyperspace_tpu.plan.nodes import Scan
 
+        from hyperspace_tpu.utils import file_utils
         out_dir = self.index_data_path
         prev_root = self.previous_entry.content.root
-        os.makedirs(out_dir, exist_ok=True)
+        file_utils.create_directory(out_dir)
         # Carry the previous version's runs forward (zero-copy links).
         for _bucket, files in sorted(parquet.bucket_files(prev_root).items()):
             for f in files:
                 _link_or_copy(f, os.path.join(out_dir, os.path.basename(f)))
         spec_path = os.path.join(prev_root, parquet.BUCKET_SPEC_FILE)
-        if os.path.exists(spec_path):
+        if file_utils.exists(spec_path):
             _link_or_copy(spec_path,
                           os.path.join(out_dir, parquet.BUCKET_SPEC_FILE))
 

@@ -260,18 +260,23 @@ class ProjectExec(PhysicalNode):
 
 
 class ExchangeExec(PhysicalNode):
-    """Hash-repartition marker. On one chip it is a pass-through; on a mesh
-    it lowers to the all-to-all in `parallel/build.py`. Its presence/absence
-    in the plan is the explain() observable, exactly like ShuffleExchange in
-    the reference's plan diffs."""
+    """Hash repartition — a REAL operator, not a marker. `execute` returns
+    rows grouped by hash partition of the keys (the single-chip meaning of
+    Spark's ShuffleExchange: same hash identity as the index build, so the
+    output layout matches what a bucketed index read produces); with a
+    mesh active it lowers to the all_to_all shuffle in `parallel/build.py`
+    over ICI. Its presence/absence in the plan is the explain() observable
+    — and the work it represents is actually performed or actually elided.
+    """
 
     name = "Exchange"
 
     def __init__(self, keys: Sequence[str], num_partitions: int,
-                 child: PhysicalNode):
+                 child: PhysicalNode, conf=None):
         self.keys = list(keys)
         self.num_partitions = num_partitions
         self.child = child
+        self.conf = conf
 
     @property
     def children(self):
@@ -280,8 +285,50 @@ class ExchangeExec(PhysicalNode):
     def simple_string(self) -> str:
         return f"Exchange hashpartitioning({', '.join(self.keys)}, {self.num_partitions})"
 
+    def execute_partitioned(self, bucket: Optional[int] = None):
+        """(batch grouped by partition id, per-partition lengths)."""
+        return self.partition(self.child.execute(bucket))
+
+    def partition(self, batch: columnar.ColumnBatch):
+        """Partition an already-executed batch (the join path unwraps the
+        Exchange and feeds the child batch back in)."""
+        import numpy as np
+
+        if batch.num_rows == 0:
+            return batch, np.zeros(self.num_partitions, dtype=np.int64)
+        if batch.is_host:
+            from hyperspace_tpu.ops.host_hash import (host_column_hash_lanes,
+                                                      host_flat_hash32)
+            lanes = []
+            for k in self.keys:
+                lanes.extend(host_column_hash_lanes(batch.column(k)))
+            ids = (host_flat_hash32(lanes)
+                   % np.uint32(self.num_partitions)).astype(np.int32)
+            perm = np.argsort(ids, kind="stable").astype(np.int32)
+            lengths = np.bincount(ids, minlength=self.num_partitions
+                                  ).astype(np.int64)
+            return batch.take(perm), lengths
+        from hyperspace_tpu.parallel.context import should_distribute
+        mesh = should_distribute(self.conf, batch.num_rows)
+        if mesh is not None:
+            # The reference's cluster shuffle: one lax.all_to_all over ICI.
+            from hyperspace_tpu.parallel.build import distributed_build
+            return distributed_build(batch, self.keys, self.num_partitions,
+                                     mesh)
+        import jax
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.ops.hash_partition import bucket_ids
+        ids = bucket_ids(batch, self.keys, self.num_partitions)
+        iota = jnp.arange(batch.num_rows, dtype=jnp.int32)
+        _, perm = jax.lax.sort([ids, iota], num_keys=1, is_stable=True)
+        lengths = np.asarray(jax.ops.segment_sum(
+            jnp.ones(batch.num_rows, dtype=jnp.int32), ids,
+            num_segments=self.num_partitions)).astype(np.int64)
+        return batch.take(perm), lengths
+
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
-        return self.child.execute(bucket)
+        return self.execute_partitioned(bucket)[0]
 
 
 class SortExec(PhysicalNode):
@@ -448,20 +495,53 @@ class SortMergeJoinExec(PhysicalNode):
             return bucketed_sort_merge_join(lbatch, rbatch, l_lengths,
                                             r_lengths, self.left_keys,
                                             self.right_keys, how=self.how)
-        # General path: the planner wrapped each side in SortExec so the
-        # device merge join gets key-sorted input. Host-lane joins fold
-        # sorting into the join itself (the probe path sorts only the
-        # build side), so the planner sort is pure overhead for them:
-        # execute the Sort's CHILD raw, then pre-sort only when BOTH
-        # sides stay on the device (sorting just one would be wasted —
-        # presorted is all-or-nothing downstream).
-        def raw_side(node):
+        # General path: the planner wrapped each side in
+        # Sort(Exchange(...)). Both are unwrapped here and the join picks
+        # the physical strategy:
+        # - host-lane sides: probe join (sorts only the build side) — the
+        #   planner's Exchange+Sort would be pure overhead;
+        # - device sides with co-partitionable Exchanges: REAL hash
+        #   repartition (mesh all_to_all when active), then the
+        #   co-partitioned bucketed merge join — the same machinery the
+        #   indexed path uses, minus the on-disk layout;
+        # - anything else: per-side device sort + merge join.
+        def unwrap(node):
+            sort_keys, exchange = None, None
             if isinstance(node, SortExec):
-                return node.child.execute(bucket), node.keys
-            return node.execute(bucket), None
+                sort_keys = node.keys
+                node = node.child
+            if isinstance(node, ExchangeExec):
+                exchange = node
+                node = node.child
+            return node, sort_keys, exchange
 
-        lbatch, lkeys = raw_side(self.left)
-        rbatch, rkeys = raw_side(self.right)
+        lnode, lkeys, lex = unwrap(self.left)
+        rnode, rkeys, rex = unwrap(self.right)
+        lbatch = lnode.execute(bucket)
+        rbatch = rnode.execute(bucket)
+        host = lbatch.is_host and rbatch.is_host
+
+        def same_key_dtypes() -> bool:
+            # Each side hashes with its OWN column's lane decomposition;
+            # co-partitioning is only sound when the decompositions agree
+            # (int32 vs int64 would bucket equal values differently —
+            # the general path promotes dtypes instead).
+            for lk, rk in zip(self.left_keys, self.right_keys):
+                if lbatch.column(lk).dtype != rbatch.column(rk).dtype:
+                    return False
+            return True
+
+        if (not host and lex is not None and rex is not None
+                and lex.num_partitions == rex.num_partitions
+                and self.how in ("inner", "left_outer", "right_outer")
+                and same_key_dtypes()):
+            from hyperspace_tpu.ops.bucketed_join import (
+                bucketed_sort_merge_join)
+            lpart, llen = lex.partition(lbatch)
+            rpart, rlen = rex.partition(rbatch)
+            return bucketed_sort_merge_join(lpart, rpart, llen, rlen,
+                                            self.left_keys, self.right_keys,
+                                            how=self.how)
         presort = (lkeys is not None and rkeys is not None
                    and not lbatch.is_host and not rbatch.is_host)
         if presort:
@@ -712,10 +792,11 @@ def plan_physical(plan: LogicalPlan,
                              rspec.num_buckets if rspec else 0, 200)
         left_sorted = SortExec(left_keys, ExchangeExec(left_keys,
                                                        num_partitions,
-                                                       left_phys))
+                                                       left_phys, conf=conf))
         right_sorted = SortExec(right_keys, ExchangeExec(right_keys,
                                                          num_partitions,
-                                                         right_phys))
+                                                         right_phys,
+                                                         conf=conf))
         return SortMergeJoinExec(left_sorted, right_sorted, left_keys,
                                  right_keys, bucketed=False,
                                  how=plan.join_type, conf=conf)

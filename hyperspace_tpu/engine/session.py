@@ -35,7 +35,20 @@ class HyperspaceSession:
         if schema is None:
             import pyarrow.parquet as pq
             import glob as _glob
+            from hyperspace_tpu.utils import storage
             probe = paths[0]
+            if storage.is_url(probe):
+                fs, real = storage.get_fs(probe)
+                if fs.isdir(real):
+                    candidates = sorted(
+                        f for f in fs.find(real) if f.endswith(".parquet"))
+                    if not candidates:
+                        raise HyperspaceException(
+                            f"No parquet files under {probe}")
+                    real = candidates[0]
+                with fs.open(real, "rb") as f:
+                    schema = Schema.from_arrow(pq.read_schema(f))
+                return DataFrame(Scan(list(paths), schema), self)
             if os.path.isdir(probe):
                 candidates = sorted(
                     _glob.glob(os.path.join(probe, "**", "*.parquet"),

@@ -13,7 +13,7 @@ from abc import ABC, abstractmethod
 from typing import List, Optional
 
 from hyperspace_tpu import constants
-from hyperspace_tpu.utils import file_utils
+from hyperspace_tpu.utils import file_utils, storage
 
 
 class IndexDataManager(ABC):
@@ -34,11 +34,11 @@ class IndexDataManagerImpl(IndexDataManager):
         self.index_path = index_path
 
     def _version_dirs(self) -> List[int]:
-        if not os.path.isdir(self.index_path):
+        if not file_utils.is_dir(self.index_path):
             return []
         prefix = constants.INDEX_VERSION_DIRECTORY_PREFIX + "="
         out = []
-        for name in os.listdir(self.index_path):
+        for name in storage.listdir_names(self.index_path):
             if name.startswith(prefix) and name[len(prefix):].isdigit():
                 out.append(int(name[len(prefix):]))
         return sorted(out)

@@ -19,6 +19,7 @@ from typing import Optional
 from hyperspace_tpu import constants
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.index.log_entry import LogEntry
+from hyperspace_tpu.utils import storage
 from hyperspace_tpu.utils import file_utils
 
 
@@ -75,16 +76,17 @@ class IndexLogManagerImpl(IndexLogManager):
 
     def get_log(self, log_id: int) -> Optional[LogEntry]:
         path = self._path_for(log_id)
-        if not os.path.exists(path):
+        if not file_utils.exists(path):
             return None
         entry, _ = self._read_entry(path)
         return entry
 
     def get_latest_id(self) -> Optional[int]:
         """Max numeric filename (reference `IndexLogManager.scala:80-89`)."""
-        if not os.path.isdir(self.log_dir):
+        if not file_utils.is_dir(self.log_dir):
             return None
-        ids = [int(name) for name in os.listdir(self.log_dir) if name.isdigit()]
+        ids = [int(name) for name in storage.listdir_names(self.log_dir)
+               if name.isdigit()]
         return max(ids) if ids else None
 
     def get_latest_log(self) -> Optional[LogEntry]:
@@ -95,7 +97,7 @@ class IndexLogManagerImpl(IndexLogManager):
         """Read `latestStable`, else scan ids downward for a stable state
         (reference `IndexLogManager.scala:91-110`)."""
         stable_path = os.path.join(self.log_dir, constants.LATEST_STABLE_LOG)
-        if os.path.exists(stable_path):
+        if file_utils.exists(stable_path):
             entry, _ = self._read_entry(stable_path)
             return entry
         latest = self.get_latest_id()
@@ -110,7 +112,7 @@ class IndexLogManagerImpl(IndexLogManager):
     def create_latest_stable_log(self, log_id: int) -> bool:
         """Copy `<id>` -> `latestStable` (reference `IndexLogManager.scala:112-122`)."""
         source = self._path_for(log_id)
-        if not os.path.exists(source):
+        if not file_utils.exists(source):
             return False
         entry, contents = self._read_entry(source)
         if entry.state not in constants.STABLE_STATES:
@@ -122,7 +124,7 @@ class IndexLogManagerImpl(IndexLogManager):
     def delete_latest_stable_log(self) -> bool:
         """Reference `IndexLogManager.scala:124-137`."""
         path = os.path.join(self.log_dir, constants.LATEST_STABLE_LOG)
-        if not os.path.exists(path):
+        if not file_utils.exists(path):
             return True
         try:
             os.remove(path)
@@ -131,7 +133,7 @@ class IndexLogManagerImpl(IndexLogManager):
             return False
 
     def write_log(self, log_id: int, entry: LogEntry) -> bool:
-        if os.path.exists(self._path_for(log_id)):
+        if file_utils.exists(self._path_for(log_id)):
             return False
         entry.id = log_id
         return file_utils.atomic_write_if_absent(self._path_for(log_id),

@@ -19,6 +19,7 @@ from hyperspace_tpu.config import HyperspaceConf
 from hyperspace_tpu.constants import States
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.index.cache import Cache, IndexCacheFactory
+from hyperspace_tpu.utils import file_utils, storage
 from hyperspace_tpu.index.factories import (IndexDataManagerFactory,
                                             IndexLogManagerFactory)
 from hyperspace_tpu.index.index_config import IndexConfig
@@ -169,12 +170,12 @@ class IndexCollectionManager(IndexManager):
         """List every index dir under the system path, read each latest log,
         filter by state (reference `IndexCollectionManager.scala:87-105`)."""
         root = self.path_resolver.system_path
-        if not os.path.isdir(root):
+        if not file_utils.is_dir(root):
             return []
         entries: List[IndexLogEntry] = []
-        for name in sorted(os.listdir(root)):
+        for name in sorted(storage.listdir_names(root)):
             index_path = os.path.join(root, name)
-            if not os.path.isdir(index_path):
+            if not file_utils.is_dir(index_path):
                 continue
             log_manager = self.log_manager_factory.create(index_path)
             try:

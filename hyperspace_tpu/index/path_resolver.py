@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import os
 
+from hyperspace_tpu.utils import file_utils, storage
+
 from hyperspace_tpu.config import HyperspaceConf
 from hyperspace_tpu.utils.name_utils import normalize_index_name
 
@@ -26,8 +28,8 @@ class PathResolver:
         """Case-insensitive directory match (reference `PathResolver.scala:39-58`)."""
         normalized = normalize_index_name(name)
         root = self.system_path
-        if os.path.isdir(root):
-            for entry in sorted(os.listdir(root)):
+        if file_utils.is_dir(root):
+            for entry in sorted(storage.listdir_names(root)):
                 if entry.lower() == normalized.lower():
                     return os.path.join(root, entry)
         return os.path.join(root, normalized)

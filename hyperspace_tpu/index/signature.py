@@ -59,6 +59,8 @@ class FileBasedSignatureProvider(LogicalPlanSignatureProvider):
     """
 
     def signature(self, plan: LogicalPlan) -> Optional[str]:
+        from hyperspace_tpu.utils import storage
+
         accumulate = ""
         saw_scan = False
         for leaf in plan.collect_leaves():
@@ -66,6 +68,29 @@ class FileBasedSignatureProvider(LogicalPlanSignatureProvider):
                 return None
             saw_scan = True
             for path in leaf.files():
+                if storage.is_url(path):
+                    fs, real = storage.get_fs(path)
+                    try:
+                        info = fs.info(real)
+                    except (OSError, FileNotFoundError):
+                        return None
+                    size = info.get("size", 0) or 0
+                    # Backends name their modification stamp differently
+                    # (S3 LastModified, GCS updated, ABFS last_modified,
+                    # memory created); the etag/generation participates
+                    # too so in-place rewrites that preserve size+time
+                    # still change the signature where the store exposes
+                    # content identity.
+                    mtime = next(
+                        (info[k] for k in ("mtime", "updated",
+                                           "last_modified", "LastModified",
+                                           "created") if info.get(k)), 0)
+                    etag = (info.get("etag") or info.get("ETag")
+                            or info.get("generation") or "")
+                    accumulate = md5_hex(
+                        accumulate + str(size) + str(mtime) + str(etag)
+                        + path)
+                    continue
                 try:
                     stat = os.stat(path)
                 except OSError:

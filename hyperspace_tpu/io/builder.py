@@ -58,7 +58,8 @@ def _write_sorted_runs(table, perm_chunks, starts, ends, path: str,
                 pass  # best-effort prefetch only
     starts, ends = np.asarray(starts), np.asarray(ends)
     written: List[str] = []
-    os.makedirs(path, exist_ok=True)
+    from hyperspace_tpu.utils import file_utils
+    file_utils.create_directory(path)
     multi = len(perm_chunks) > 1
     offset = 0
     for ci, chunk in enumerate(perm_chunks):
@@ -170,7 +171,8 @@ def write_bucketed_table(table, indexed_columns: Sequence[str],
                                           permutation_from_tree)
 
     if table.num_rows == 0:
-        os.makedirs(path, exist_ok=True)
+        from hyperspace_tpu.utils import file_utils
+        file_utils.create_directory(path)
         return []
     if key_batch is None:
         by_lower = {n.lower(): n for n in table.column_names}
@@ -211,7 +213,8 @@ def write_bucketed_batch(batch: columnar.ColumnBatch,
     from hyperspace_tpu.ops.build import build_permutation
 
     if batch.num_rows == 0:
-        os.makedirs(path, exist_ok=True)
+        from hyperspace_tpu.utils import file_utils
+        file_utils.create_directory(path)
         return []
     chunks, starts, ends = build_permutation(batch, indexed_columns,
                                              num_buckets)
@@ -243,7 +246,8 @@ def write_bucket_ordered(batch: columnar.ColumnBatch, lengths,
     build's output shape) as bucketed parquet files."""
     table = columnar.to_arrow(batch)
     written: List[str] = []
-    os.makedirs(path, exist_ok=True)
+    from hyperspace_tpu.utils import file_utils
+    file_utils.create_directory(path)
     offset = 0
     for b in range(num_buckets):
         count = int(lengths[b])

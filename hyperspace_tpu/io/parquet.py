@@ -17,6 +17,7 @@ import re
 from typing import Dict, List, Optional, Sequence
 
 from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.utils import storage
 from hyperspace_tpu.plan.nodes import BucketSpec
 from hyperspace_tpu.plan.schema import Schema
 
@@ -34,22 +35,29 @@ def bucket_of_file(path: str) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
+def _read_one(path: str, cols):
+    import pyarrow.parquet as pq
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        return pq.read_table(real, columns=cols, filesystem=fs)
+    return pq.read_table(path, columns=cols)
+
+
 def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
     """Read one or more parquet files/dirs into a single Arrow table, in
     path order. Files are read concurrently (pyarrow releases the GIL);
-    order is preserved by the map."""
-    import pyarrow.parquet as pq
+    order is preserved by the map. `scheme://` paths read through their
+    fsspec filesystem."""
     import pyarrow as pa
 
     if not paths:
         raise HyperspaceException("No parquet inputs to read.")
     cols = list(columns) if columns else None
     if len(paths) == 1:
-        return pq.read_table(paths[0], columns=cols)
+        return _read_one(paths[0], cols)
     from concurrent.futures import ThreadPoolExecutor
     with ThreadPoolExecutor(max_workers=8) as pool:
-        tables = list(pool.map(lambda p: pq.read_table(p, columns=cols),
-                               paths))
+        tables = list(pool.map(lambda p: _read_one(p, cols), paths))
     return pa.concat_tables(tables, promote_options="default")
 
 
@@ -57,11 +65,18 @@ def file_row_counts(paths: Sequence[str]) -> List[int]:
     """Per-file row counts from parquet footers (no data read)."""
     import pyarrow.parquet as pq
 
+    def meta_rows(p):
+        if storage.is_url(p):
+            fs, real = storage.get_fs(p)
+            with fs.open(real, "rb") as f:
+                return pq.read_metadata(f).num_rows
+        return pq.read_metadata(p).num_rows
+
     if len(paths) <= 1:
-        return [pq.read_metadata(p).num_rows for p in paths]
+        return [meta_rows(p) for p in paths]
     from concurrent.futures import ThreadPoolExecutor
     with ThreadPoolExecutor(max_workers=8) as pool:
-        return list(pool.map(lambda p: pq.read_metadata(p).num_rows, paths))
+        return list(pool.map(meta_rows, paths))
 
 
 def write_table(table, path: str) -> None:
@@ -75,37 +90,45 @@ def write_table(table, path: str) -> None:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    os.makedirs(os.path.dirname(path), exist_ok=True)
     string_cols = [f.name for f in table.schema
                    if pa.types.is_string(f.type) or pa.types.is_large_string(f.type)
                    or pa.types.is_dictionary(f.type)]
-    pq.write_table(table, path, use_dictionary=string_cols or False,
-                   write_statistics=False, compression="snappy")
+    kwargs = dict(use_dictionary=string_cols or False,
+                  write_statistics=False, compression="snappy")
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        fs.makedirs(os.path.dirname(real), exist_ok=True)
+        pq.write_table(table, real, filesystem=fs, **kwargs)
+        return
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    pq.write_table(table, path, **kwargs)
 
 
 def write_bucket_spec(directory: str, spec: BucketSpec, schema: Schema) -> None:
-    os.makedirs(directory, exist_ok=True)
-    with open(os.path.join(directory, BUCKET_SPEC_FILE), "w") as f:
-        json.dump({"bucketSpec": spec.to_dict(),
-                   "schema": [fld.to_dict() for fld in schema.fields]}, f,
-                  indent=2)
+    from hyperspace_tpu.utils import file_utils
+    payload = json.dumps({"bucketSpec": spec.to_dict(),
+                          "schema": [fld.to_dict() for fld in schema.fields]},
+                         indent=2)
+    file_utils.create_file(storage.join(directory, BUCKET_SPEC_FILE), payload)
 
 
 def read_bucket_spec(directory: str) -> Optional[BucketSpec]:
-    path = os.path.join(directory, BUCKET_SPEC_FILE)
-    if not os.path.exists(path):
+    from hyperspace_tpu.utils import file_utils
+    path = storage.join(directory, BUCKET_SPEC_FILE)
+    if not file_utils.exists(path):
         return None
-    with open(path) as f:
-        return BucketSpec.from_dict(json.load(f)["bucketSpec"])
+    return BucketSpec.from_dict(
+        json.loads(file_utils.read_contents(path))["bucketSpec"])
 
 
 def bucket_files(directory: str) -> Dict[int, List[str]]:
     """Map bucket id -> parquet files in a bucketed data dir (empty buckets
     have no files)."""
     out: Dict[int, List[str]] = {}
-    if not os.path.isdir(directory):
+    from hyperspace_tpu.utils import file_utils
+    if not file_utils.is_dir(directory):
         return out
-    for name in sorted(os.listdir(directory)):
+    for name in sorted(storage.listdir_names(directory)):
         bucket = bucket_of_file(name)
         if bucket is not None:
             out.setdefault(bucket, []).append(os.path.join(directory, name))

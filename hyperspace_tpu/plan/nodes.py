@@ -119,7 +119,8 @@ class Scan(LogicalPlan):
                  file_format: str = "parquet",
                  bucket_spec: Optional[BucketSpec] = None,
                  files: Optional[Sequence[str]] = None):
-        self.root_paths = [os.path.abspath(p) for p in root_paths]
+        from hyperspace_tpu.utils.storage import canonical
+        self.root_paths = [canonical(p) for p in root_paths]
         self._schema = schema
         self.file_format = file_format
         self.bucket_spec = bucket_spec
@@ -140,8 +141,19 @@ class Scan(LogicalPlan):
     def files(self) -> List[str]:
         """Enumerate data files under the root paths (cached per node)."""
         if self._files is None:
+            from hyperspace_tpu.utils import storage
             found: List[str] = []
             for root in self.root_paths:
+                if storage.is_url(root):
+                    fs, real = storage.get_fs(root)
+                    proto = storage.protocol_of(root)
+                    if fs.isfile(real):
+                        found.append(root)
+                    else:
+                        found.extend(
+                            proto + p for p in fs.find(real)
+                            if p.endswith("." + self.file_format))
+                    continue
                 if os.path.isfile(root):
                     found.append(root)
                 else:

@@ -37,29 +37,29 @@ class PlanAnalyzer:
         mode = get_display_mode(session.conf)
         buffer = BufferStream(mode)
 
-        with_lines = plan_with.tree_string().splitlines()
-        without_lines = plan_without.tree_string().splitlines()
-        # Highlight lines unique to each side (differing subtrees).
-        with_set, without_set = set(with_lines), set(without_lines)
+        with_lines: List[tuple] = []
+        without_lines: List[tuple] = []
+        PlanAnalyzer._lockstep_diff(plan_with, plan_without, 0,
+                                    with_lines, without_lines)
 
         buffer.write_line("=============================================================")
         buffer.write_line("Plan with indexes:")
         buffer.write_line("=============================================================")
-        for line in with_lines:
-            if line in without_set:
-                buffer.write_line(line)
-            else:
+        for line, highlighted in with_lines:
+            if highlighted:
                 buffer.highlight_line(line)
+            else:
+                buffer.write_line(line)
         buffer.write_line()
 
         buffer.write_line("=============================================================")
         buffer.write_line("Plan without indexes:")
         buffer.write_line("=============================================================")
-        for line in without_lines:
-            if line in with_set:
-                buffer.write_line(line)
-            else:
+        for line, highlighted in without_lines:
+            if highlighted:
                 buffer.highlight_line(line)
+            else:
+                buffer.write_line(line)
         buffer.write_line()
 
         buffer.write_line("=============================================================")
@@ -80,6 +80,51 @@ class PlanAnalyzer:
             buffer.write_line()
 
         return buffer.to_string()
+
+    # -- lockstep subtree diff -------------------------------------------
+    #
+    # Reference `PlanAnalyzer.scala:56-101`: both physical plans are
+    # walked in lockstep top-down; while paired nodes are equal the line
+    # prints plain and the walk recurses pairwise into the children, and
+    # at the first difference BOTH differing subtrees are emitted fully
+    # highlighted. Unlike a line-set diff, repeated identical operator
+    # lines (e.g. two `Sort [key]` nodes of which only one was elided)
+    # classify by POSITION, not by text membership.
+
+    @staticmethod
+    def _fmt(node: PhysicalNode, depth: int) -> str:
+        return ("  " * depth) + ("+- " if depth else "") + node.simple_string()
+
+    @staticmethod
+    def _node_equal(a: PhysicalNode, b: PhysicalNode) -> bool:
+        """Node-level equality; scans compare by root paths (reference
+        `PlanAnalyzer.scala:189-200` — FileSourceScanExec equality is
+        root-path equality)."""
+        if type(a) is not type(b):
+            return False
+        if isinstance(a, ScanExec):
+            return sorted(a.scan.root_paths) == sorted(b.scan.root_paths)
+        return a.simple_string() == b.simple_string()
+
+    @staticmethod
+    def _emit_subtree(node: PhysicalNode, depth: int, out: List[tuple],
+                      highlighted: bool) -> None:
+        out.append((PlanAnalyzer._fmt(node, depth), highlighted))
+        for c in node.children:
+            PlanAnalyzer._emit_subtree(c, depth + 1, out, highlighted)
+
+    @staticmethod
+    def _lockstep_diff(a: PhysicalNode, b: PhysicalNode, depth: int,
+                       out_a: List[tuple], out_b: List[tuple]) -> None:
+        if (PlanAnalyzer._node_equal(a, b)
+                and len(a.children) == len(b.children)):
+            out_a.append((PlanAnalyzer._fmt(a, depth), False))
+            out_b.append((PlanAnalyzer._fmt(b, depth), False))
+            for ca, cb in zip(a.children, b.children):
+                PlanAnalyzer._lockstep_diff(ca, cb, depth + 1, out_a, out_b)
+        else:
+            PlanAnalyzer._emit_subtree(a, depth, out_a, True)
+            PlanAnalyzer._emit_subtree(b, depth, out_b, True)
 
     @staticmethod
     def _indexes_used(plan: PhysicalNode, index_summaries: Sequence

@@ -1,9 +1,10 @@
-"""Filesystem utilities over the local/posix filesystem.
+"""Filesystem utilities: local/posix fast paths + fsspec URLs.
 
 Parity: reference `util/FileUtils.scala:37-116` (createFile, readContents,
 getDirectorySize, createDirectory, delete, save/loadByteArray) — the
-reference goes through the Hadoop FileSystem API; this build targets
-posix-visible paths (local disk, FUSE-mounted object stores). Atomicity
+reference goes through the Hadoop FileSystem API, which is what lets it
+run on HDFS/ABFS unchanged; here plain paths use os/posix directly and
+`scheme://` paths route through fsspec (`utils/storage.py`). Atomicity
 helpers used by the op log's optimistic concurrency live here too.
 """
 
@@ -13,19 +14,37 @@ import os
 import shutil
 import uuid
 
+from hyperspace_tpu.utils import storage
+
 
 def create_file(path: str, contents: str) -> None:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        fs.makedirs(os.path.dirname(real), exist_ok=True)
+        with fs.open(real, "wb") as f:
+            f.write(contents.encode("utf-8"))
+        return
     create_directory(os.path.dirname(path))
     with open(path, "w", encoding="utf-8") as f:
         f.write(contents)
 
 
 def read_contents(path: str) -> str:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        with fs.open(real, "rb") as f:
+            return f.read().decode("utf-8")
     with open(path, "r", encoding="utf-8") as f:
         return f.read()
 
 
 def get_directory_size(path: str) -> int:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        if not fs.exists(real):
+            return 0
+        return sum(info.get("size", 0) or 0
+                   for info in fs.find(real, detail=True).values())
     total = 0
     for root, _dirs, files in os.walk(path):
         for name in files:
@@ -34,24 +53,73 @@ def get_directory_size(path: str) -> int:
 
 
 def create_directory(path: str) -> None:
-    if path:
-        os.makedirs(path, exist_ok=True)
+    if not path:
+        return
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        fs.makedirs(real, exist_ok=True)
+        return
+    os.makedirs(path, exist_ok=True)
+
+
+def exists(path: str) -> bool:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        return fs.exists(real)
+    return os.path.exists(path)
+
+
+def is_dir(path: str) -> bool:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        return fs.isdir(real)
+    return os.path.isdir(path)
+
+
+def is_file(path: str) -> bool:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        return fs.isfile(real)
+    return os.path.isfile(path)
 
 
 def delete(path: str) -> None:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        if fs.exists(real):
+            fs.rm(real, recursive=True)
+        return
     if os.path.isdir(path):
         shutil.rmtree(path, ignore_errors=True)
     elif os.path.exists(path):
         os.remove(path)
 
 
+def remove_file(path: str) -> None:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        fs.rm_file(real)
+        return
+    os.remove(path)
+
+
 def save_byte_array(path: str, data: bytes) -> None:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        fs.makedirs(os.path.dirname(real), exist_ok=True)
+        with fs.open(real, "wb") as f:
+            f.write(data)
+        return
     create_directory(os.path.dirname(path))
     with open(path, "wb") as f:
         f.write(data)
 
 
 def load_byte_array(path: str) -> bytes:
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        with fs.open(real, "rb") as f:
+            return f.read()
     with open(path, "rb") as f:
         return f.read()
 
@@ -64,9 +132,20 @@ def atomic_write_if_absent(path: str, contents: str) -> bool:
     failure as "a concurrent writer won" (`index/IndexLogManager.scala:139-156`).
     POSIX rename overwrites, so the atomic publish here is `os.link` (hard
     link creation fails with EEXIST if the target exists) with an
-    O_CREAT|O_EXCL fallback for filesystems without hard links.
+    O_CREAT|O_EXCL fallback for filesystems without hard links. URL paths
+    use fsspec exclusive create (`storage.py` documents which backends
+    make that a true generation precondition).
     Returns True iff this caller won the write.
     """
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        fs.makedirs(os.path.dirname(real), exist_ok=True)
+        try:
+            with fs.open(real, "xb") as f:
+                f.write(contents.encode("utf-8"))
+            return True
+        except FileExistsError:
+            return False
     create_directory(os.path.dirname(path))
     tmp = path + ".temp" + uuid.uuid4().hex
     with open(tmp, "w", encoding="utf-8") as f:

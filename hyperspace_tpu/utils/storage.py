@@ -1,0 +1,68 @@
+"""Storage seam: one place that says whether a path is a URL and hands out
+its fsspec filesystem.
+
+The reference rides the Hadoop `FileSystem` API so HDFS/ABFS work for free
+(`util/FileUtils.scala:37-116`); here plain paths keep the fast os/posix
+implementations and anything with a `scheme://` routes through fsspec
+(`memory://` in tests; object stores in deployment). Only THIS module
+imports fsspec.
+
+OCC without rename (SURVEY hard part #5): the op log's write-if-absent
+maps to fsspec exclusive create (mode "xb"). Local and memory filesystems
+enforce it atomically; object-store backends are atomic exactly when the
+backend implements a create precondition (GCS `ifGenerationMatch`,
+S3 `If-None-Match`) — backends without one degrade to check-then-create,
+which is safe for single-writer deployments only.
+"""
+
+from __future__ import annotations
+
+import posixpath
+from typing import List, Tuple
+
+
+def is_url(path: str) -> bool:
+    return "://" in path
+
+
+def get_fs(path: str) -> Tuple[object, str]:
+    """(fsspec filesystem, path stripped of its protocol)."""
+    import fsspec
+    return fsspec.core.url_to_fs(path)
+
+
+def protocol_of(path: str) -> str:
+    return path.split("://", 1)[0] + "://"
+
+
+def join(base: str, *parts: str) -> str:
+    """Path join that never mangles a URL's double slash."""
+    import os
+    if is_url(base):
+        proto = protocol_of(base)
+        rest = base[len(proto):]
+        return proto + posixpath.join(rest, *parts)
+    return os.path.join(base, *parts)
+
+
+def canonical(path: str) -> str:
+    """Absolute/normalized form for plain paths; URLs pass through (their
+    identity is the string — os normalization would corrupt `://`)."""
+    import os
+    if is_url(path):
+        return path
+    return os.path.abspath(path)
+
+
+def listdir_names(path: str) -> List[str]:
+    """Base names of the direct children of a directory ([] if absent)."""
+    import os
+    if not is_url(path):
+        if not os.path.isdir(path):
+            return []
+        return os.listdir(path)
+    fs, real = get_fs(path)
+    if not fs.isdir(real):
+        return []
+    return [posixpath.basename(p.rstrip("/")) for p in fs.ls(real,
+                                                             detail=False)]

@@ -261,3 +261,102 @@ def test_bucket_pruning_e2e_filter_rule(tmp_path):
     sess.disable_hyperspace()
     without = q().collect().to_pandas().sort_values("x").reset_index(drop=True)
     assert with_idx.equals(without)
+
+
+# ---------------------------------------------------------------------------
+# Real ExchangeExec (hash repartition)
+# ---------------------------------------------------------------------------
+
+
+def test_exchange_materializes_hash_partitioning(tmp_path):
+    """Exchange output must be grouped by THE hash identity's partition id
+    (so it matches index bucket layouts), on both lanes."""
+    from hyperspace_tpu.engine.physical import ExchangeExec, ScanExec
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops.host_hash import host_bucket_ids
+    from hyperspace_tpu.plan.nodes import Scan
+    from hyperspace_tpu.plan.schema import Schema
+
+    rng = np.random.default_rng(2)
+    table = pa.table({"k": rng.integers(0, 100, 2000).astype(np.int64),
+                      "v": np.arange(2000, dtype=np.int64)})
+    src = tmp_path / "x"
+    src.mkdir()
+    pq.write_table(table, str(src / "p.parquet"))
+    scan = Scan([str(src)], Schema.from_arrow(table.schema))
+
+    class _Lane(ScanExec):
+        def __init__(self, scan, device):
+            super().__init__(scan, ["k", "v"])
+            self._device = device
+
+        def execute(self, bucket=None):
+            return columnar.from_arrow(
+                pq.read_table(str(src / "p.parquet")), self.out_schema,
+                device=self._device)
+
+    for device in (False, True):
+        ex = ExchangeExec(["k"], 16, _Lane(scan, device))
+        out, lengths = ex.execute_partitioned()
+        k = np.asarray(out.column("k").data)
+        expected_ids = host_bucket_ids([k], ["int64"], 16)
+        assert (np.diff(expected_ids) >= 0).all(), f"device={device}"
+        assert lengths.sum() == 2000
+        bounds = np.concatenate([[0], np.cumsum(lengths)])
+        for b in range(16):
+            seg = expected_ids[bounds[b]:bounds[b + 1]]
+            assert (seg == b).all()
+        # Multiset of values preserved.
+        assert sorted(np.asarray(out.column("v").data).tolist()) == \
+            sorted(table.column("v").to_pylist())
+
+
+def test_unindexed_device_join_via_partitioned_exchange(session, tmp_path):
+    """Device-lane unindexed join runs the co-partitioned path and matches
+    the pandas result."""
+    import pandas as pd
+    rng = np.random.default_rng(4)
+    lt = pa.table({"k": rng.integers(0, 500, 5000).astype(np.int64),
+                   "x": np.arange(5000, dtype=np.int64)})
+    rt = pa.table({"k": rng.integers(0, 500, 800).astype(np.int64),
+                   "y": np.arange(800, dtype=np.int64)})
+    lp, rp = tmp_path / "l", tmp_path / "r"
+    lp.mkdir(); rp.mkdir()
+    pq.write_table(lt, str(lp / "p.parquet"))
+    pq.write_table(rt, str(rp / "p.parquet"))
+    session.conf.set("spark.hyperspace.execution.min.device.rows", "0")
+    try:
+        ldf = session.read_parquet(str(lp))
+        rdf = session.read_parquet(str(rp))
+        got = (ldf.join(rdf, on="k").select("x", "y").collect().to_pandas()
+               .sort_values(["x", "y"]).reset_index(drop=True))
+    finally:
+        session.conf.unset("spark.hyperspace.execution.min.device.rows")
+    want = (lt.to_pandas().merge(rt.to_pandas(), on="k")[["x", "y"]]
+            .sort_values(["x", "y"]).reset_index(drop=True))
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_cross_dtype_key_join_correct_on_device(session, tmp_path):
+    """int32 x int64 join keys must not take the co-partitioned Exchange
+    branch (each side would hash with its own lane decomposition); the
+    general promoting path must return the correct matches."""
+    import pandas as pd
+    lt = pa.table({"k": pa.array(np.arange(100, dtype=np.int32)),
+                   "x": np.arange(100, dtype=np.int64)})
+    rt = pa.table({"k": pa.array(np.arange(50, dtype=np.int64)),
+                   "y": np.arange(50, dtype=np.int64)})
+    lp, rp = tmp_path / "cl", tmp_path / "cr"
+    lp.mkdir(); rp.mkdir()
+    pq.write_table(lt, str(lp / "p.parquet"))
+    pq.write_table(rt, str(rp / "p.parquet"))
+    session.conf.set("spark.hyperspace.execution.min.device.rows", "0")
+    try:
+        got = (session.read_parquet(str(lp))
+               .join(session.read_parquet(str(rp)), on="k")
+               .select("x", "y").collect().to_pandas()
+               .sort_values(["x", "y"]).reset_index(drop=True))
+    finally:
+        session.conf.unset("spark.hyperspace.execution.min.device.rows")
+    assert len(got) == 50
+    assert (got.x == got.y).all()

@@ -93,3 +93,92 @@ def test_explain_leaves_session_state(env):
     session.disable_hyperspace()
     hs.explain(query, redirect=lambda s: None)
     assert not session.is_hyperspace_enabled
+
+
+def test_lockstep_diff_classifies_repeated_lines_by_position():
+    """Two textually identical operators of which only one differs in its
+    subtree: the line-set diff mis-classified both; the lockstep walk
+    highlights by position (reference `PlanAnalyzer.scala:56-101`)."""
+    from hyperspace_tpu.plananalysis.analyzer import PlanAnalyzer
+
+    class Fake:
+        def __init__(self, label, children=()):
+            self.label = label
+            self._children = list(children)
+
+        @property
+        def children(self):
+            return self._children
+
+        def simple_string(self):
+            return self.label
+
+    # A: Join(Sort(X), Sort(B));  B: Join(Sort(A), Sort(B))
+    a = Fake("Join", [Fake("Sort", [Fake("X")]), Fake("Sort", [Fake("B")])])
+    b = Fake("Join", [Fake("Sort", [Fake("A")]), Fake("Sort", [Fake("B")])])
+    out_a, out_b = [], []
+    PlanAnalyzer._lockstep_diff(a, b, 0, out_a, out_b)
+    # Equal nodes print plain at every level; ONLY the differing leaf
+    # under the first Sort highlights — the second, textually identical,
+    # Sort subtree stays plain (a line-set diff cannot distinguish them).
+    assert [(l.strip("+- "), h) for l, h in out_a] == [
+        ("Join", False), ("Sort", False), ("X", True),
+        ("Sort", False), ("B", False)]
+    assert [(l.strip("+- "), h) for l, h in out_b] == [
+        ("Join", False), ("Sort", False), ("A", True),
+        ("Sort", False), ("B", False)]
+
+
+def test_explain_golden_strings(env, tmp_path):
+    """Golden explain output in plain/console/HTML modes (reference
+    `ExplainTest.scala`), with machine-specific paths normalized."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("goldIdx", ["clicks"], ["id"]))
+    query = df.filter(col("clicks") == 2).select("id")
+
+    import glob
+    import os
+    idx_root = glob.glob(str(tmp_path / "wh" / "indexes" / "goldIdx"
+                             ) + "/v__=*")[0]
+
+    def render():
+        out = []
+        hs.explain(query, redirect=out.append)
+        text = out[0]
+        text = text.replace(os.path.normpath(idx_root), "<INDEX>")
+        return text.replace(os.path.normpath(src), "<SRC>")
+
+    golden_plain = """\
+=============================================================
+Plan with indexes:
+=============================================================
+Project [id]
+  +- Filter ((col(clicks) = lit(2)))
+<----    +- Scan parquet [clicks, id] ['<INDEX>'], buckets=4, prunedBuckets=1/4---->
+
+=============================================================
+Plan without indexes:
+=============================================================
+Project [id]
+  +- Filter ((col(clicks) = lit(2)))
+<----    +- Scan parquet [id, clicks] ['<SRC>']---->
+
+=============================================================
+Indexes used:
+=============================================================
+goldIdx:<INDEX>
+
+"""
+    assert render() == golden_plain
+
+    session.conf.set("spark.hyperspace.explain.displayMode", "html")
+    html = render()
+    assert "<b style" in html and "<br>" in html
+    assert "Scan parquet [clicks, id] ['&lt;INDEX&gt;']" in html.replace(
+        "<INDEX>", "&lt;INDEX&gt;") or "<INDEX>" in html
+
+    session.conf.set("spark.hyperspace.explain.displayMode", "console")
+    text = render()
+    assert "\x1b[32m" in text  # ANSI green highlight
+    session.conf.unset("spark.hyperspace.explain.displayMode")

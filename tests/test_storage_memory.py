@@ -1,0 +1,98 @@
+"""Storage seam: the metadata layer and the full index lifecycle running
+against fsspec `memory://` (VERDICT r1 #10 — L0 must not be local-only;
+reference parity: Hadoop FileSystem API, `util/FileUtils.scala:37-116`)."""
+
+import uuid
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (Hyperspace, HyperspaceConf, HyperspaceSession,
+                            IndexConfig)
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from hyperspace_tpu.index.data_manager import IndexDataManagerImpl
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.utils import file_utils
+
+
+@pytest.fixture
+def mem_root():
+    root = f"memory://hs-{uuid.uuid4().hex}"
+    yield root
+    file_utils.delete(root)
+
+
+def test_log_manager_occ_on_memory(mem_root):
+    from fakes import make_entry
+    mgr = IndexLogManagerImpl(mem_root + "/idx")
+    e = make_entry(state=States.CREATING)
+    assert mgr.write_log(0, e)
+    # OCC: second writer for the same id loses.
+    assert not mgr.write_log(0, e)
+    assert mgr.get_latest_id() == 0
+    e2 = mgr.get_log(0)
+    assert e2.state == States.CREATING
+    e2.state = States.ACTIVE
+    assert mgr.write_log(1, e2)
+    mgr.create_latest_stable_log(1)
+    assert mgr.get_latest_stable_log().state == States.ACTIVE
+    mgr.delete_latest_stable_log()
+    # Falls back to scanning ids downward.
+    assert mgr.get_latest_stable_log().state == States.ACTIVE
+
+
+def test_data_manager_versions_on_memory(mem_root):
+    dm = IndexDataManagerImpl(mem_root + "/idx")
+    assert dm.get_latest_version_id() is None
+    for v in (0, 1, 5):
+        file_utils.create_file(dm.get_path(v) + "/marker.txt", "x")
+    assert dm.get_latest_version_id() == 5
+    dm.delete(5)
+    assert dm.get_latest_version_id() == 1
+
+
+def test_full_lifecycle_and_query_on_memory_warehouse(mem_root, tmp_path):
+    """create -> query (rules on == off) -> delete/restore/vacuum, with the
+    index warehouse AND the source data living on memory://."""
+    rng = np.random.default_rng(23)
+    n = 5000
+    table = pa.table({"k": rng.integers(0, 200, n).astype(np.int64),
+                      "x": np.arange(n, dtype=np.int64)})
+    src = mem_root + "/src"
+    # Write source parquet onto the memory filesystem.
+    local = tmp_path / "p.parquet"
+    pq.write_table(table, str(local))
+    file_utils.save_byte_array(src + "/part-0.parquet",
+                               local.read_bytes())
+
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": mem_root + "/wh",
+        "spark.hyperspace.index.num.buckets": "8"}))
+    hs = Hyperspace(sess)
+    df = sess.read_parquet(src)
+    hs.create_index(df, IndexConfig("memIdx", ["k"], ["x"]))
+    assert list(hs.indexes()["name"]) == ["memIdx"]
+
+    q = lambda: df.filter(col("k") == lit(7)).select("x")
+    sess.enable_hyperspace()
+    roots = [p for s in q()._optimized_plan().collect_leaves()
+             for p in s.root_paths]
+    assert any("v__=" in p and p.startswith("memory://") for p in roots), roots
+    got = q().collect().to_pandas().sort_values("x").reset_index(drop=True)
+    sess.disable_hyperspace()
+    want = q().collect().to_pandas().sort_values("x").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want)
+    kk = table.column("k").to_numpy()
+    assert len(got) == int((kk == 7).sum())
+
+    hs.delete_index("memIdx")
+    hs.restore_index("memIdx")
+    hs.delete_index("memIdx")
+    hs.vacuum_index("memIdx")
+    remaining = hs.indexes()
+    assert len(remaining) == 0
+    assert not file_utils.is_dir(mem_root + "/wh/indexes/memIdx/v__=0")

@@ -188,15 +188,8 @@ class FilterExec(PhysicalNode):
         batch = self.child.execute(bucket)
         if batch.num_rows == 0:
             return batch
-        # A host-lane batch stayed below min_device_rows precisely to skip
-        # device transfers — shipping it to the mesh in "auto" mode would
-        # pay them anyway. Explicit distribution.enabled=true still
-        # distributes (tests exercise the mesh path with tiny batches).
-        if batch.is_host and (self.conf is None
-                              or self.conf.distribution == "auto"):
-            mesh = None
-        else:
-            mesh = should_distribute(self.conf, batch.num_rows)
+        mesh = should_distribute(self.conf, batch.num_rows,
+                                 host_batch=batch.is_host)
         if mesh is not None:
             from hyperspace_tpu.parallel.scan import distributed_filter
             return distributed_filter(batch, self.condition, mesh)
@@ -308,23 +301,24 @@ class ExchangeExec(PhysicalNode):
             lengths = np.bincount(ids, minlength=self.num_partitions
                                   ).astype(np.int64)
             return batch.take(perm), lengths
-        from hyperspace_tpu.parallel.context import should_distribute
-        mesh = should_distribute(self.conf, batch.num_rows)
-        if mesh is not None:
-            # The reference's cluster shuffle: one lax.all_to_all over ICI.
-            from hyperspace_tpu.parallel.build import distributed_build
-            return distributed_build(batch, self.keys, self.num_partitions,
-                                     mesh)
         import jax
         import jax.numpy as jnp
 
-        from hyperspace_tpu.ops.hash_partition import bucket_ids
-        ids = bucket_ids(batch, self.keys, self.num_partitions)
+        from hyperspace_tpu.ops.pallas.partition_kernel import (
+            batch_partition, pallas_available)
+        if pallas_available():
+            # Fused Pallas kernel: ids + histogram in ONE HBM pass.
+            ids, lengths_dev = batch_partition(batch, self.keys,
+                                               self.num_partitions)
+            lengths = np.asarray(lengths_dev).astype(np.int64)
+        else:
+            from hyperspace_tpu.ops.hash_partition import bucket_ids
+            ids = bucket_ids(batch, self.keys, self.num_partitions)
+            lengths = np.asarray(jax.ops.segment_sum(
+                jnp.ones(batch.num_rows, dtype=jnp.int32), ids,
+                num_segments=self.num_partitions)).astype(np.int64)
         iota = jnp.arange(batch.num_rows, dtype=jnp.int32)
         _, perm = jax.lax.sort([ids, iota], num_keys=1, is_stable=True)
-        lengths = np.asarray(jax.ops.segment_sum(
-            jnp.ones(batch.num_rows, dtype=jnp.int32), ids,
-            num_segments=self.num_partitions)).astype(np.int64)
         return batch.take(perm), lengths
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
@@ -357,11 +351,12 @@ class AggregateExec(PhysicalNode):
     name = "Aggregate"
 
     def __init__(self, group_columns: Sequence[str], aggregates,
-                 out_schema: Schema, child: PhysicalNode):
+                 out_schema: Schema, child: PhysicalNode, conf=None):
         self.group_columns = list(group_columns)
         self.aggregates = list(aggregates)
         self.out_schema = out_schema
         self.child = child
+        self.conf = conf
 
     @property
     def children(self):
@@ -373,8 +368,19 @@ class AggregateExec(PhysicalNode):
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         from hyperspace_tpu.ops.aggregate import group_aggregate
-        return group_aggregate(self.child.execute(bucket),
-                               self.group_columns, self.aggregates,
+        from hyperspace_tpu.parallel.context import should_distribute
+        batch = self.child.execute(bucket)
+        mesh = None
+        if self.group_columns and batch.num_rows > 0:
+            mesh = should_distribute(self.conf, batch.num_rows,
+                                     host_batch=batch.is_host)
+        if mesh is not None:
+            from hyperspace_tpu.parallel.aggregate import (
+                distributed_group_aggregate)
+            return distributed_group_aggregate(batch, self.group_columns,
+                                               self.aggregates,
+                                               self.out_schema, mesh)
+        return group_aggregate(batch, self.group_columns, self.aggregates,
                                self.out_schema)
 
 
@@ -477,11 +483,10 @@ class SortMergeJoinExec(PhysicalNode):
             # transfers the lane exists to avoid.
             skewed = padded_skew(l_lengths, r_lengths, lbatch.num_rows,
                                  rbatch.num_rows)
-            host_sides = (lbatch.is_host and rbatch.is_host
-                          and (self.conf is None
-                               or self.conf.distribution == "auto"))
-            mesh = (None if skewed or host_sides
-                    else self._join_mesh(lbatch.num_rows + rbatch.num_rows))
+            mesh = (None if skewed
+                    else self._join_mesh(
+                        lbatch.num_rows + rbatch.num_rows,
+                        host_batch=lbatch.is_host and rbatch.is_host))
             if mesh is not None:
                 from hyperspace_tpu.ops.bucketed_join import (
                     assemble_join_output)
@@ -554,7 +559,7 @@ class SortMergeJoinExec(PhysicalNode):
                                self.right_keys, presorted=presort,
                                how=self.how)
 
-    def _join_mesh(self, total_rows: int):
+    def _join_mesh(self, total_rows: int, host_batch: bool = False):
         """Mesh for the distributed co-bucketed join, or None. Requires an
         inner join (the distributed index path has no outer expansion) and
         the bucket<->shard map (num_buckets divisible by mesh size)."""
@@ -562,7 +567,8 @@ class SortMergeJoinExec(PhysicalNode):
                                                      should_distribute)
         if self.how != "inner":
             return None
-        mesh = should_distribute(self.conf, total_rows)
+        mesh = should_distribute(self.conf, total_rows,
+                                 host_batch=host_batch)
         if mesh is None or self.num_buckets % mesh_size(mesh) != 0:
             return None
         return mesh
@@ -740,7 +746,8 @@ def plan_physical(plan: LogicalPlan,
                              if a.column != "*"})
         return AggregateExec(plan.group_columns, plan.aggregates,
                              plan.schema,
-                             plan_physical(plan.child, child_required, conf))
+                             plan_physical(plan.child, child_required, conf),
+                             conf=conf)
 
     if isinstance(plan, Sort):
         child_required = set(required) | set(plan.columns)

@@ -49,6 +49,7 @@ class HyperspaceSession:
                 with fs.open(real, "rb") as f:
                     schema = Schema.from_arrow(pq.read_schema(f))
                 return DataFrame(Scan(list(paths), schema), self)
+            # (local branch below probes with os paths)
             if os.path.isdir(probe):
                 candidates = sorted(
                     _glob.glob(os.path.join(probe, "**", "*.parquet"),

@@ -127,9 +127,9 @@ class IndexLogManagerImpl(IndexLogManager):
         if not file_utils.exists(path):
             return True
         try:
-            os.remove(path)
+            file_utils.remove_file(path)
             return True
-        except OSError:
+        except (OSError, FileNotFoundError):
             return False
 
     def write_log(self, log_id: int, entry: LogEntry) -> bool:

@@ -29,6 +29,11 @@ _NUMERIC_NP = {
     "date32": np.int32, "timestamp": np.int64,
 }
 
+# Logical dtype -> host numpy dtype, incl. the string code representation.
+# THE map for host-lane columns; aggregate lanes import it rather than
+# keeping copies.
+HOST_NP_DTYPES = {**_NUMERIC_NP, "string": np.int32}
+
 
 def _jnp():
     import jax.numpy as jnp

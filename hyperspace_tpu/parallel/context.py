@@ -41,15 +41,21 @@ def mesh_size(mesh) -> int:
     return mesh.shape[SHARD_AXIS]
 
 
-def should_distribute(conf, num_rows: Optional[int] = None):
+def should_distribute(conf, num_rows: Optional[int] = None,
+                      host_batch: bool = False):
     """Mesh to use for this operation, or None. In "auto" mode small
     batches stay single-chip — per-shard padding plus collective latency
-    dwarfs the work below `distribution.min.rows`; "true" distributes
-    regardless of size (tests use this to exercise the mesh paths)."""
+    dwarfs the work below `distribution.min.rows` — and HOST-lane batches
+    stay on the host (they avoided the device link on purpose;
+    distributing would pay it anyway). "true" distributes regardless
+    (tests use this to exercise the mesh paths). This is THE policy seam:
+    every operator with a mesh path answers the question here."""
     mesh = distribution_mesh(conf)
     if mesh is None:
         return None
     mode = conf.distribution if conf is not None else "auto"
+    if mode == "auto" and host_batch:
+        return None
     min_rows = (conf.distribution_min_rows if conf is not None
                 else constants.DISTRIBUTION_MIN_ROWS_DEFAULT)
     if mode == "auto" and num_rows is not None and num_rows < min_rows:

@@ -93,7 +93,9 @@ class PlanAnalyzer:
 
     @staticmethod
     def _fmt(node: PhysicalNode, depth: int) -> str:
-        return ("  " * depth) + ("+- " if depth else "") + node.simple_string()
+        # First line of tree_string at this depth — ONE source of truth
+        # for plan rendering, so highlighted and plain sections align.
+        return node.tree_string(depth).splitlines()[0]
 
     @staticmethod
     def _node_equal(a: PhysicalNode, b: PhysicalNode) -> bool:
@@ -109,9 +111,8 @@ class PlanAnalyzer:
     @staticmethod
     def _emit_subtree(node: PhysicalNode, depth: int, out: List[tuple],
                       highlighted: bool) -> None:
-        out.append((PlanAnalyzer._fmt(node, depth), highlighted))
-        for c in node.children:
-            PlanAnalyzer._emit_subtree(c, depth + 1, out, highlighted)
+        for line in node.tree_string(depth).splitlines():
+            out.append((line, highlighted))
 
     @staticmethod
     def _lockstep_diff(a: PhysicalNode, b: PhysicalNode, depth: int,

@@ -132,3 +132,29 @@ def test_distributed_filter_matches_single_chip(tmp_path):
     got = columnar.to_arrow(distributed_filter(batch, predicate, mesh))
     want = columnar.to_arrow(apply_filter(batch, predicate))
     pd.testing.assert_frame_equal(got.to_pandas(), want.to_pandas())
+
+
+def test_distributed_aggregate_query_e2e(dist_env):
+    """Aggregate query on the 8-device mesh (distribution forced on)
+    equals the single-chip result."""
+    import pandas as pd
+    session, hs, src = dist_env
+    df = session.read_parquet(src)
+
+    def run():
+        return (df.group_by("clicks").agg(("count", "*", "cnt"),
+                                          ("sum", "imprs", "si"),
+                                          ("avg", "score", "avs"))
+                .collect().to_pandas().sort_values("clicks")
+                .reset_index(drop=True))
+
+    session.conf.set("spark.hyperspace.distribution.enabled", "true")
+    session.conf.set("spark.hyperspace.execution.min.device.rows", "0")
+    try:
+        dist = run()
+    finally:
+        session.conf.set("spark.hyperspace.distribution.enabled", "false")
+        session.conf.unset("spark.hyperspace.execution.min.device.rows")
+    single = run()
+    pd.testing.assert_frame_equal(dist, single, check_dtype=False,
+                                  check_exact=False, rtol=1e-12)

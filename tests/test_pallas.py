@@ -39,3 +39,29 @@ def test_pallas_hash_ragged_tail():
         lanes = key_lanes(batch.column("k").data)
         got = np.asarray(hash_lanes_to_buckets(lanes, 8, interpret=True))
         assert (got == expected).all(), n
+
+
+def test_partition_kernel_matches_reference_interpret():
+    """Fused ids+histogram kernel == bucket_ids + bincount, bit-for-bit
+    (interpret mode on CPU; the TPU path runs the same kernel)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops import hash_partition
+    from hyperspace_tpu.ops.pallas.partition_kernel import batch_partition
+
+    rng = np.random.default_rng(41)
+    n = 70_000  # crosses multiple 256x128 tiles, last one ragged
+    table = pa.table({
+        "k": rng.integers(-2**60, 2**60, n).astype(np.int64),
+        "s": pa.array(["w%d" % (i % 211) for i in range(n)]),
+    })
+    batch = columnar.from_arrow(table)
+    for cols, B in ((["k"], 64), (["k", "s"], 200), (["s"], 16)):
+        ids, lengths = batch_partition(batch, cols, B, interpret=True)
+        ref_ids = np.asarray(hash_partition.bucket_ids(batch, cols, B))
+        assert (np.asarray(ids) == ref_ids).all(), (cols, B)
+        ref_len = np.bincount(ref_ids, minlength=B)
+        assert (np.asarray(lengths) == ref_len).all(), (cols, B)
+        assert int(np.asarray(lengths).sum()) == n

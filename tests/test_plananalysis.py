@@ -99,9 +99,10 @@ def test_lockstep_diff_classifies_repeated_lines_by_position():
     """Two textually identical operators of which only one differs in its
     subtree: the line-set diff mis-classified both; the lockstep walk
     highlights by position (reference `PlanAnalyzer.scala:56-101`)."""
+    from hyperspace_tpu.engine.physical import PhysicalNode
     from hyperspace_tpu.plananalysis.analyzer import PlanAnalyzer
 
-    class Fake:
+    class Fake(PhysicalNode):
         def __init__(self, label, children=()):
             self.label = label
             self._children = list(children)

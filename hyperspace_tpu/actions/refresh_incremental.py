@@ -46,22 +46,26 @@ def _link_or_copy(src: str, dst: str) -> None:
 class RefreshIncrementalAction(RefreshAction):
     """REFRESHING -> ACTIVE, writing only an appended-data delta."""
 
-    def appended_files(self) -> List[str]:
-        """Current source listing minus the files captured at build time."""
-        previous = set(self.previous_entry.source_file_list())
-        current = []
+    def _source_scans(self):
         from hyperspace_tpu.plan.nodes import Scan
-        for leaf in self.df.plan.collect_leaves():
-            if isinstance(leaf, Scan):
-                current.extend(leaf.files())
-        missing = previous - set(current)
+        return [leaf for leaf in self.df.plan.collect_leaves()
+                if isinstance(leaf, Scan)]
+
+    def appended_files(self) -> List[str]:
+        """Current source listing (over ALL scan leaves — the build-time
+        capture spans them too) minus the files captured at build time
+        (shared derivation: `index/source_delta.py`)."""
+        from hyperspace_tpu.index.source_delta import split_current
+        current = [f for scan in self._source_scans() for f in scan.files()]
+        appended, missing, _stored = split_current(self.previous_entry,
+                                                   current)
         if missing:
             raise HyperspaceException(
                 "Incremental refresh supports appended data only; "
                 f"{len(missing)} indexed file(s) were deleted or rewritten "
                 "— run a full refresh. Missing: "
                 + ", ".join(sorted(missing)[:3]))
-        return [f for f in current if f not in previous]
+        return appended
 
     def validate(self) -> None:
         super().validate()
@@ -70,14 +74,11 @@ class RefreshIncrementalAction(RefreshAction):
         # indexed files are byte-identical by recomputing the signature over
         # exactly the stored file set.
         from hyperspace_tpu.index.signature import SignatureProviderFactory
-        from hyperspace_tpu.plan.nodes import Scan
+        from hyperspace_tpu.index.source_delta import restricted_scan
         stored_sig = self.previous_entry.signature()
-        source_scan = None
-        for leaf in self.df.plan.collect_leaves():
-            if isinstance(leaf, Scan):
-                source_scan = leaf
-        restricted = Scan(source_scan.root_paths, source_scan.schema,
-                          files=sorted(self.previous_entry.source_file_list()))
+        restricted = restricted_scan(
+            self.previous_entry, self._source_scans()[-1],
+            self.previous_entry.source_file_list())
         provider = SignatureProviderFactory.create(stored_sig.provider)
         if provider.signature(restricted) != stored_sig.value:
             raise HyperspaceException(
@@ -108,10 +109,7 @@ class RefreshIncrementalAction(RefreshAction):
         if not appended:
             return  # metadata-only refresh (signature catches up)
         cfg = self.index_config
-        source_scan = None
-        for leaf in self.df.plan.collect_leaves():
-            if isinstance(leaf, Scan):
-                source_scan = leaf
+        source_scan = self._source_scans()[-1]
         delta_scan = Scan(source_scan.root_paths, source_scan.schema,
                           files=appended)
         columns = cfg.indexed_columns + cfg.included_columns

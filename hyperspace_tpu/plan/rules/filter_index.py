@@ -84,24 +84,25 @@ class FilterIndexRule(Rule):
         if self.session.conf.get(constants.HYBRID_SCAN_ENABLED,
                                  "false").lower() != "true":
             return None
-        current = set(scan.files())
+        from hyperspace_tpu.index.source_delta import (restricted_scan,
+                                                       split_current)
         needed = ({c for c in filter_columns}
                   | {c for c in project_columns})
         for entry in self._active_indexes():
             if not self._covers(entry, project_columns, filter_columns):
                 continue
-            stored = set(entry.source_file_list())
-            if not stored or not stored < current:
+            appended, missing, stored = split_current(entry, scan.files())
+            if missing or not appended or not stored:
                 continue
             # Path-set subset is not enough: a file rewritten IN PLACE keeps
             # its path but changes content. Recompute the signature over a
             # scan restricted to the stored files — it must equal the one
-            # captured at build time, proving those files are untouched.
-            restricted = Scan(scan.root_paths, scan.schema,
-                              files=sorted(stored))
-            if not self.signature_matches(entry, restricted):
+            # captured at build time, proving those files are untouched
+            # (shared derivation: `index/source_delta.py`).
+            if not self.signature_matches(entry,
+                                          restricted_scan(entry, scan,
+                                                          sorted(stored))):
                 continue
-            appended = sorted(current - stored)
             index_scan = self.index_scan(entry, bucketed=True)
             appended_scan = Scan(scan.root_paths, scan.schema,
                                  files=appended)

@@ -8,6 +8,12 @@ the covering indexes, and times each query three ways, warm best-of-N:
 Result equality across all three is asserted before timing is reported
 (the reference's E2E guarantee, `E2EHyperspaceRulesTests.scala:330-346`).
 
+Methodology note: both lanes run warm and in-memory — the framework
+serves repeat reads from its stamped decoded-read cache (`io/parquet.py`,
+invalidated on any file change) and the pandas lane keeps its DataFrames
+resident (tables are read once, outside the timer). Set
+HYPERSPACE_READ_CACHE_BYTES=0 to time the framework with cold reads.
+
 Prints exactly ONE JSON line:
   {"metric": "tpcds_q17_q25_q64_wall_s", "value": <rules-on total>,
    "vs_baseline": <pandas total / rules-on total>, "queries": {...}}
@@ -75,17 +81,16 @@ def main():
         index_build_s = time.perf_counter() - t0
         log(f"index build (7 indexes): {index_build_s:.1f}s")
 
-        def read_pdfs():
-            # The oracle pays its parquet reads inside the timer, exactly
-            # like the framework re-reads per query (and like bench.py's
-            # rung 2-4 CPU comparators).
-            return {n: pq.read_table(os.path.join(p, "part-0.parquet"))
-                    .to_pandas() for n, p in paths.items()}
+        # In-memory to in-memory: the pandas lane holds its DataFrames
+        # resident (read once, outside the timer), mirroring the
+        # framework's decoded-read cache serving the timed runs.
+        pdfs = {n: pq.read_table(os.path.join(p, "part-0.parquet"))
+                .to_pandas() for n, p in paths.items()}
 
         queries = {}
         tot_on = tot_off = tot_cpu = 0.0
         for name, (build, oracle) in QUERIES.items():
-            cpu_s, expected = best_of(lambda: oracle(read_pdfs()),
+            cpu_s, expected = best_of(lambda: oracle(pdfs),
                                       label=f"{name} pandas")
             sess.enable_hyperspace()
             build(dfs).collect()  # warm (compiles, file listings)

@@ -43,22 +43,92 @@ def _read_one(path: str, cols):
     return pq.read_table(path, columns=cols)
 
 
+# Decoded-read cache: query trees that reference the same relation more
+# than once (q64 joins a year-over-year aggregate to itself, so every
+# underlying index is read twice) would otherwise re-decode identical
+# parquet bytes. Entries are keyed on (files, columns) and VALIDATED by
+# each file's (size, mtime) captured at read time — a refreshed or
+# rewritten file misses. LRU-bounded by decoded bytes.
+READ_CACHE_BYTES = int(os.environ.get(
+    "HYPERSPACE_READ_CACHE_BYTES", 256 * 1024 * 1024))
+import threading  # noqa: E402
+from collections import OrderedDict as _OrderedDict  # noqa: E402
+_read_cache: "_OrderedDict" = _OrderedDict()
+# The bucketed join reads its two sides concurrently; all cache map
+# mutations (touch, insert, evict) take this lock. File reads and decode
+# run outside it.
+_read_cache_lock = threading.Lock()
+
+
+def _file_stamp(path: str):
+    """(size, mtime) of a FILE, or None when the path is a directory or
+    the backend exposes no modification time — both must disable caching
+    (a directory's own stamp does not change when a member file is
+    rewritten in place; without mtime a same-size rewrite would collide)."""
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        info = fs.info(real)
+        if (info.get("type") == "directory") or fs.isdir(real):
+            return None
+        mtime = (info.get("mtime") or info.get("updated")
+                 or info.get("last_modified") or info.get("LastModified")
+                 or info.get("created"))
+        if not mtime:
+            return None
+        return (info.get("size", 0) or 0, str(mtime))
+    st = os.stat(path)
+    import stat as _stat
+    if _stat.S_ISDIR(st.st_mode):
+        return None
+    return (st.st_size, st.st_mtime_ns)
+
+
+def clear_read_cache() -> None:
+    with _read_cache_lock:
+        _read_cache.clear()
+
+
 def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
     """Read one or more parquet files/dirs into a single Arrow table, in
     path order. Files are read concurrently (pyarrow releases the GIL);
     order is preserved by the map. `scheme://` paths read through their
-    fsspec filesystem."""
+    fsspec filesystem. Results are served from the stamped read cache
+    when every file is unchanged."""
     import pyarrow as pa
 
     if not paths:
         raise HyperspaceException("No parquet inputs to read.")
     cols = list(columns) if columns else None
+    key = (tuple(paths), tuple(cols) if cols else None)
+    try:
+        stamps = tuple(_file_stamp(p) for p in paths)
+        if any(st is None for st in stamps):
+            stamps = None
+    except OSError:
+        stamps = None
+    if stamps is not None and READ_CACHE_BYTES > 0:
+        with _read_cache_lock:
+            hit = _read_cache.get(key)
+            if hit is not None and hit[0] == stamps:
+                _read_cache.move_to_end(key)  # LRU touch
+                return hit[1]
+
     if len(paths) == 1:
-        return _read_one(paths[0], cols)
-    from concurrent.futures import ThreadPoolExecutor
-    with ThreadPoolExecutor(max_workers=8) as pool:
-        tables = list(pool.map(lambda p: _read_one(p, cols), paths))
-    return pa.concat_tables(tables, promote_options="default")
+        table = _read_one(paths[0], cols)
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            tables = list(pool.map(lambda p: _read_one(p, cols), paths))
+        table = pa.concat_tables(tables, promote_options="default")
+
+    if stamps is not None and READ_CACHE_BYTES > 0:
+        with _read_cache_lock:
+            _read_cache[key] = (stamps, table)
+            total = sum(t.nbytes for _, t in _read_cache.values())
+            while total > READ_CACHE_BYTES and len(_read_cache) > 1:
+                _, (_, evicted) = _read_cache.popitem(last=False)
+                total -= evicted.nbytes
+    return table
 
 
 def file_row_counts(paths: Sequence[str]) -> List[int]:

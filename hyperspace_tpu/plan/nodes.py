@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import glob
 import os
+from functools import cached_property
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
 
@@ -214,7 +215,10 @@ class Project(LogicalPlan):
 
     @property
     def schema(self) -> Schema:
-        return self.child.schema.select(self.columns)
+        memo = self.__dict__.get("_schema_memo")
+        if memo is None:
+            memo = self.__dict__["_schema_memo"] =                 self.child.schema.select(self.columns)
+        return memo
 
     def with_children(self, children):
         (child,) = children
@@ -269,7 +273,7 @@ class Aggregate(LogicalPlan):
     def children(self) -> List[LogicalPlan]:
         return [self.child]
 
-    @property
+    @cached_property
     def schema(self) -> Schema:
         from hyperspace_tpu.plan.schema import Field
         fields = [self.child.schema.field(c) for c in self.group_columns]
@@ -402,11 +406,12 @@ class Join(LogicalPlan):
     def children(self) -> List[LogicalPlan]:
         return [self.left, self.right]
 
-    @property
+    @cached_property
     def schema(self) -> Schema:
         """Left fields then right fields; duplicate names get a `_r` suffix
         on the right (matching the executor's output); outer joins make the
-        nullable side's fields nullable."""
+        nullable side's fields nullable. Memoized — nodes are immutable,
+        and deep query trees re-ask for ancestor schemas repeatedly."""
         from hyperspace_tpu.plan.schema import Field as SchemaField
         fields = list(self.left.schema.fields)
         left_names = {f.name.lower() for f in fields}

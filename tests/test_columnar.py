@@ -192,3 +192,31 @@ def test_host_and_device_builds_produce_identical_layout(tmp_path):
         assert (hk == dk).all(), f"bucket {f}: key order differs"
         assert sorted(h.column("x").to_pylist()) == \
             sorted(d.column("x").to_pylist())
+
+
+def test_read_cache_serves_and_invalidates(tmp_path):
+    """The decoded-read cache serves unchanged files and MISSES when a
+    file is rewritten in place (stamp mismatch) — correctness must never
+    depend on cache state."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from hyperspace_tpu.io import parquet as P
+
+    f = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"x": np.arange(5, dtype=np.int64)}), f)
+    P.clear_read_cache()
+    t1 = P.read_table([f])
+    t2 = P.read_table([f])
+    assert t2 is t1  # cache hit returns the same decoded table
+
+    import os, time
+    time.sleep(0.01)
+    pq.write_table(pa.table({"x": np.arange(9, dtype=np.int64)}), f)
+    t3 = P.read_table([f])
+    assert t3 is not t1 and t3.num_rows == 9  # stamp changed -> fresh read
+
+    # Column projection is part of the key.
+    t4 = P.read_table([f], columns=["x"])
+    assert t4.num_rows == 9
+    P.clear_read_cache()

@@ -79,6 +79,12 @@ class HyperspaceConf:
                 "auto").lower()
 
     @property
+    def trace_dir(self):
+        """Directory for XLA profiler traces of executed queries (None =
+        tracing off)."""
+        return self.get(constants.TRACE_DIR)
+
+    @property
     def min_device_rows(self) -> int:
         """Batches below this row count run on the host lane."""
         return self.get_int(constants.MIN_DEVICE_ROWS,

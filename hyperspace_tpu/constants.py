@@ -33,6 +33,11 @@ DISTRIBUTION_ENABLED_DEFAULT = "auto"
 DISTRIBUTION_MIN_ROWS = "spark.hyperspace.distribution.min.rows"
 DISTRIBUTION_MIN_ROWS_DEFAULT = 4096
 
+# XLA profiler integration: when set to a directory, every executed
+# query is captured as a profiler trace under it (one subdirectory per
+# query), viewable in TensorBoard/XProf/Perfetto. Empty (default) = off.
+TRACE_DIR = "spark.hyperspace.trace.dir"
+
 # Adaptive host/device execution lane: batches below this row count are
 # evaluated with host numpy, larger batches run on the accelerator. The
 # default is tuned for a high-latency (tunneled) device link where each

@@ -4,10 +4,20 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+import itertools
+import threading
+import uuid
+
 import hyperspace_tpu.engine  # noqa: F401  (x64 config)
 from hyperspace_tpu.engine.physical import PhysicalNode, plan_physical
 from hyperspace_tpu.io.columnar import ColumnBatch
 from hyperspace_tpu.plan.nodes import LogicalPlan
+
+# Profiler capture naming/serialization: jax permits one active profiler
+# session per process, and fast queries can share a wall-clock stamp.
+_trace_seq = itertools.count()
+_trace_run_id = uuid.uuid4().hex[:8]
+_trace_lock = threading.Lock()
 
 
 def compile_plan(plan: LogicalPlan,
@@ -24,4 +34,28 @@ def compile_plan(plan: LogicalPlan,
 def execute_plan(plan: LogicalPlan,
                  projection: Optional[Sequence[str]] = None,
                  conf=None) -> ColumnBatch:
-    return compile_plan(plan, projection, conf).execute()
+    physical = compile_plan(plan, projection, conf)
+    trace_dir = conf.trace_dir if conf is not None else None
+    if not trace_dir:
+        return physical.execute()
+    # Native tracing (SURVEY §5): one XLA profiler capture per executed
+    # query — device compute, transfers, and host gaps land in the same
+    # timeline; inspect with TensorBoard/XProf or Perfetto. Capture names
+    # use a process-unique counter (wall-clock ms collide for fast
+    # back-to-back queries, and jax allows one active profiler session).
+    import jax
+
+    seq = next(_trace_seq)
+    capture = f"{trace_dir.rstrip('/')}/query-{_trace_run_id}-{seq:05d}"
+    with _trace_lock:
+        with jax.profiler.trace(capture):
+            out = physical.execute()
+            # Materialize ALL device work inside the capture window —
+            # validity masks and dictionary hashes included, or their
+            # compute/transfers land after the capture closes.
+            for col in out.columns.values():
+                for arr in (col.data, col.validity,
+                            *(col.dict_hashes or ())):
+                    if hasattr(arr, "block_until_ready"):
+                        arr.block_until_ready()
+    return out

@@ -360,3 +360,19 @@ def test_cross_dtype_key_join_correct_on_device(session, tmp_path):
         session.conf.unset("spark.hyperspace.execution.min.device.rows")
     assert len(got) == 50
     assert (got.x == got.y).all()
+
+
+def test_trace_dir_captures_profile(session, sample_parquet, tmp_path):
+    """hyperspace.trace.dir: one XLA profiler capture per executed query."""
+    import glob
+    import os
+    df = session.read_parquet(sample_parquet)
+    trace_root = str(tmp_path / "traces")
+    session.conf.set("spark.hyperspace.trace.dir", trace_root)
+    try:
+        df.filter(col("clicks") > lit(1)).select("id").collect()
+    finally:
+        session.conf.unset("spark.hyperspace.trace.dir")
+    captures = glob.glob(os.path.join(trace_root, "query-*", "**", "*"),
+                         recursive=True)
+    assert captures, "no profiler artifacts written"

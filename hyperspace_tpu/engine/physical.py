@@ -324,6 +324,17 @@ class ExchangeExec(PhysicalNode):
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         return self.execute_partitioned(bucket)[0]
 
+    def execute_bucketed(self, num_buckets: int):
+        """An Exchange output satisfies the bucketed contract (batch in
+        partition order + lengths) — it is how the planner re-buckets ONE
+        side of a mismatched-bucket-count index join (the ranker's cost
+        model: ride the larger layout, reshuffle the smaller)."""
+        if num_buckets != self.num_partitions:
+            raise HyperspaceException(
+                f"Exchange partitions ({self.num_partitions}) != requested "
+                f"buckets ({num_buckets}).")
+        return self.execute_partitioned()
+
 
 class SortExec(PhysicalNode):
     name = "Sort"
@@ -787,12 +798,34 @@ def plan_physical(plan: LogicalPlan,
                     and [c.lower() for c in spec.bucket_columns]
                     == [k.lower() for k in keys])
 
+        def _key_dtypes_match() -> bool:
+            # Co-partitioning assumes both layouts hashed with the SAME
+            # lane decomposition; int32 vs int64 (or float32 vs float64)
+            # keys bucket equal values differently, so any bucketed path
+            # would silently drop matches — fall through to the general
+            # path, which promotes dtypes before encoding.
+            return all(plan.left.schema.field(lk).dtype
+                       == plan.right.schema.field(rk).dtype
+                       for lk, rk in zip(left_keys, right_keys))
+
         if (_covers(lspec, left_keys) and _covers(rspec, right_keys)
-                and lspec.num_buckets == rspec.num_buckets):
-            # Shuffle-free, sort-free bucketed SMJ — the indexed fast path.
+                and _key_dtypes_match()):
+            # Bucketed SMJ — the indexed fast path. With mismatched bucket
+            # counts (the ranker's fallback, reference
+            # `JoinIndexRanker.scala:40-55`) ONLY the coarser side is
+            # re-bucketed through Exchange to the finer count; the
+            # Exchange uses THE hash identity, so its output co-partitions
+            # with the other side's on-disk buckets.
+            target = max(lspec.num_buckets, rspec.num_buckets)
+            if lspec.num_buckets != target:
+                left_phys = ExchangeExec(left_keys, target, left_phys,
+                                         conf=conf)
+            elif rspec.num_buckets != target:
+                right_phys = ExchangeExec(right_keys, target, right_phys,
+                                          conf=conf)
             return SortMergeJoinExec(left_phys, right_phys, left_keys,
                                      right_keys, bucketed=True,
-                                     num_buckets=lspec.num_buckets,
+                                     num_buckets=target,
                                      how=plan.join_type, conf=conf)
         # General path: hash exchange + sort on each side.
         num_partitions = max(lspec.num_buckets if lspec else 0,

@@ -376,3 +376,98 @@ def test_trace_dir_captures_profile(session, sample_parquet, tmp_path):
     captures = glob.glob(os.path.join(trace_root, "query-*", "**", "*"),
                          recursive=True)
     assert captures, "no profiler artifacts written"
+
+
+def test_mismatched_bucket_counts_rebucket_one_side(tmp_path):
+    """Index pair with different bucket counts (the ranker's fallback):
+    the planner re-buckets ONLY the coarser side through Exchange and
+    runs the bucketed SMJ at the finer count; results equal rules-off."""
+    import pandas as pd
+    from hyperspace_tpu import Hyperspace, IndexConfig
+    from hyperspace_tpu.engine.physical import (ExchangeExec,
+                                                SortMergeJoinExec)
+
+    conf = HyperspaceConf({"hyperspace.warehouse.dir": str(tmp_path / "wh")})
+    sess = HyperspaceSession(conf)
+    hs = Hyperspace(sess)
+    rng = np.random.default_rng(19)
+    lt = pa.table({"k": rng.integers(0, 300, 4000).astype(np.int64),
+                   "x": np.arange(4000, dtype=np.int64)})
+    rt = pa.table({"k": rng.integers(0, 300, 900).astype(np.int64),
+                   "y": np.arange(900, dtype=np.int64)})
+    lp, rp = tmp_path / "l", tmp_path / "r"
+    lp.mkdir(); rp.mkdir()
+    pq.write_table(lt, str(lp / "p.parquet"))
+    pq.write_table(rt, str(rp / "p.parquet"))
+    ldf, rdf = sess.read_parquet(str(lp)), sess.read_parquet(str(rp))
+    sess.conf.set("spark.hyperspace.index.num.buckets", "16")
+    hs.create_index(ldf, IndexConfig("ml", ["k"], ["x"]))
+    sess.conf.set("spark.hyperspace.index.num.buckets", "4")
+    hs.create_index(rdf, IndexConfig("mr", ["k"], ["y"]))
+
+    q = lambda: (ldf.select("k", "x").join(rdf.select("k", "y"), on="k")
+                 .select("x", "y"))
+    sess.enable_hyperspace()
+    phys = q().explain_plans()[2]
+    smjs = [n for n in phys.collect() if isinstance(n, SortMergeJoinExec)]
+    assert smjs and smjs[0].bucketed and smjs[0].num_buckets == 16
+    exchanges = [n for n in phys.collect() if isinstance(n, ExchangeExec)]
+    assert len(exchanges) == 1 and exchanges[0].num_partitions == 16
+    # The exchanged side is the coarser (right) index.
+    assert any("mr" in p for s in exchanges[0].collect()
+               if hasattr(s, "scan") for p in s.scan.root_paths)
+
+    got = (q().collect().to_pandas().sort_values(["x", "y"])
+           .reset_index(drop=True))
+    sess.disable_hyperspace()
+    want = (q().collect().to_pandas().sort_values(["x", "y"])
+            .reset_index(drop=True))
+    pd.testing.assert_frame_equal(got, want)
+
+
+def test_cross_dtype_indexed_join_takes_general_path(tmp_path):
+    """Indexes bucketed over different key dtypes (int64 vs int32) must
+    NOT co-partition — their on-disk layouts hash with different lane
+    structures. The planner must fall to the promoting general path and
+    return correct results (with equal AND mismatched bucket counts)."""
+    import pandas as pd
+    from hyperspace_tpu import Hyperspace, IndexConfig
+    from hyperspace_tpu.engine.physical import SortMergeJoinExec
+
+    for lbuckets, rbuckets in ((8, 8), (16, 4)):
+        conf = HyperspaceConf({
+            "hyperspace.warehouse.dir": str(tmp_path / f"wh{lbuckets}"
+                                            / str(rbuckets))})
+        sess = HyperspaceSession(conf)
+        hs = Hyperspace(sess)
+        rng = np.random.default_rng(7)
+        lt = pa.table({"k": rng.integers(0, 200, 3000).astype(np.int64),
+                       "x": np.arange(3000, dtype=np.int64)})
+        rt = pa.table({"k": pa.array(rng.integers(0, 200, 500)
+                                     .astype(np.int32)),
+                       "y": np.arange(500, dtype=np.int64)})
+        lp = tmp_path / f"l{lbuckets}_{rbuckets}"
+        rp = tmp_path / f"r{lbuckets}_{rbuckets}"
+        lp.mkdir(); rp.mkdir()
+        pq.write_table(lt, str(lp / "p.parquet"))
+        pq.write_table(rt, str(rp / "p.parquet"))
+        ldf, rdf = sess.read_parquet(str(lp)), sess.read_parquet(str(rp))
+        sess.conf.set("spark.hyperspace.index.num.buckets", str(lbuckets))
+        hs.create_index(ldf, IndexConfig("xl", ["k"], ["x"]))
+        sess.conf.set("spark.hyperspace.index.num.buckets", str(rbuckets))
+        hs.create_index(rdf, IndexConfig("xr", ["k"], ["y"]))
+
+        q = lambda: (ldf.select("k", "x").join(rdf.select("k", "y"), on="k")
+                     .select("x", "y"))
+        sess.enable_hyperspace()
+        phys = q().explain_plans()[2]
+        smjs = [n for n in phys.collect()
+                if isinstance(n, SortMergeJoinExec)]
+        assert smjs and not smjs[0].bucketed, (lbuckets, rbuckets)
+        got = (q().collect().to_pandas().sort_values(["x", "y"])
+               .reset_index(drop=True))
+        sess.disable_hyperspace()
+        want = (q().collect().to_pandas().sort_values(["x", "y"])
+                .reset_index(drop=True))
+        pd.testing.assert_frame_equal(got, want)
+        assert len(got) > 0

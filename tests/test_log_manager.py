@@ -95,3 +95,38 @@ def test_get_log_raises_on_corrupt_entry(tmp_path):
         f.write("{not json")
     with pytest.raises(HyperspaceException):
         mgr.get_log(0)
+
+
+def test_occ_single_winner_across_processes(tmp_path):
+    """Optimistic concurrency across real PROCESSES: N workers race to
+    write the same log id; exactly one wins (reference
+    `IndexLogManager.scala:139-156` — atomic-rename semantics)."""
+    import subprocess
+    import sys
+
+    script = r"""
+import sys
+sys.path.insert(0, sys.argv[3])
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+sys.path.insert(0, sys.argv[4])
+from fakes import make_entry
+import time
+mgr = IndexLogManagerImpl(sys.argv[1])
+# Barrier-ish start: spin until the go-file appears, then race.
+import os
+while not os.path.exists(sys.argv[2]):
+    time.sleep(0.001)
+print(int(mgr.write_log(5, make_entry(state="CREATING"))))
+"""
+    import os
+    idx = str(tmp_path / "idx")
+    go = str(tmp_path / "go")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests_dir = os.path.join(repo, "tests")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, idx, go, repo, tests_dir],
+        stdout=subprocess.PIPE, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        for _ in range(6)]
+    (tmp_path / "go").write_text("1")
+    outs = [int(p.communicate(timeout=120)[0].strip()) for p in procs]
+    assert sum(outs) == 1, outs

@@ -443,6 +443,48 @@ class UnionExec(PhysicalNode):
         return columnar.concat_batches(non_empty)
 
 
+class ReusedExec(PhysicalNode):
+    """Common-subplan reuse (Spark's ReuseExchange/ReuseSubquery analog):
+    the planner routes every occurrence of an identical logical subtree
+    (same serialization, same required columns) through ONE shared node
+    that memoizes its executed batch. q64-style self-joins of an
+    aggregated subquery then compute it once. Physical plans are built
+    fresh per query, so the memo's lifetime is a single execution."""
+
+    name = "ReusedSubplan"
+
+    def __init__(self, child: PhysicalNode):
+        import threading
+        self.child = child
+        self._memo = None
+        self._memo_bucketed = {}
+        # A self-join submits both sides (the SAME instance) to the join's
+        # thread pool; without the lock both threads would fill the memo.
+        self._lock = threading.Lock()
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def simple_string(self) -> str:
+        return "ReusedSubplan"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        if bucket is not None:
+            return self.child.execute(bucket)
+        with self._lock:
+            if self._memo is None:
+                self._memo = self.child.execute()
+            return self._memo
+
+    def execute_bucketed(self, num_buckets: int):
+        with self._lock:
+            if num_buckets not in self._memo_bucketed:
+                self._memo_bucketed[num_buckets] = \
+                    self.child.execute_bucketed(num_buckets)
+            return self._memo_bucketed[num_buckets]
+
+
 class SortMergeJoinExec(PhysicalNode):
     name = "SortMergeJoin"
 
@@ -732,9 +774,85 @@ def plan_physical(plan: LogicalPlan,
                   conf=None) -> PhysicalNode:
     """Logical -> physical with projection pushdown into scans. `conf`
     carries the session's distribution settings to the operators that can
-    execute on the mesh (Filter scans, bucketed SMJ)."""
+    execute on the mesh (Filter scans, bucketed SMJ). Identical logical
+    subtrees (by fingerprint + required columns) compile to ONE shared
+    `ReusedExec` so repeated subqueries execute once."""
+    counts: dict = {}
+    keys: dict = {}
+
+    def _count(node):
+        key = _subtree_key(node, keys)
+        counts[key] = counts.get(key, 0) + 1
+        for c in node.children:
+            _count(c)
+
+    _count(plan)
+    return _plan_physical(plan, required, conf,
+                          {"counts": counts, "keys": keys, "built": {}})
+
+
+def _subtree_key(node: LogicalPlan, memo: dict) -> str:
+    """Bottom-up md5 fingerprint of a subtree: each node hashes its LOCAL
+    fields plus its children's fingerprints, so the whole walk is O(nodes)
+    instead of re-serializing every subtree per ancestor. Memoized by node
+    identity (nodes stay alive for the duration of planning)."""
+    import hashlib
+    import json as _json
+
+    k = memo.get(id(node))
+    if k is not None:
+        return k
+    local = node.to_dict()
+    for field in ("child", "children", "left", "right"):
+        local.pop(field, None)
+    payload = (type(node).__name__
+               + _json.dumps(local, sort_keys=True)
+               + "[" + ",".join(_subtree_key(c, memo)
+                                for c in node.children) + "]")
+    k = hashlib.md5(payload.encode()).hexdigest()
+    memo[id(node)] = k
+    return k
+
+
+def _is_prunable_chain(plan: LogicalPlan) -> bool:
+    """Project*/Scan chain over a bucketed scan with no Filter inside —
+    the shape `_apply_bucket_pruning` prunes FROM ABOVE. Sharing it would
+    either disable pruning or wrongly prune one consumer's rows with
+    another's condition, so such chains are never reused (their IO is
+    deduplicated by the decoded-read cache anyway)."""
+    node = plan
+    while isinstance(node, Project):
+        node = node.child
+    return isinstance(node, Scan) and node.bucket_spec is not None
+
+
+def _plan_physical(plan: LogicalPlan,
+                   required: Optional[Set[str]],
+                   conf, ctx) -> PhysicalNode:
     if required is None:
         required = set(plan.schema.names)
+
+    reuse_key = None
+    if plan.children and not _is_prunable_chain(plan):
+        # (leaves are covered by the decoded-read cache)
+        subtree = _subtree_key(plan, ctx["keys"])
+        if ctx["counts"].get(subtree, 0) > 1:
+            reuse_key = (subtree,
+                         frozenset(r.lower() for r in required))
+            shared = ctx["built"].get(reuse_key)
+            if shared is not None:
+                return shared
+
+    built = _plan_physical_node(plan, required, conf, ctx)
+    if reuse_key is not None:
+        built = ReusedExec(built)
+        ctx["built"][reuse_key] = built
+    return built
+
+
+def _plan_physical_node(plan: LogicalPlan,
+                        required: Set[str],
+                        conf, ctx) -> PhysicalNode:
 
     if isinstance(plan, Scan):
         return ScanExec(plan, _required_for(plan, required), conf=conf)
@@ -742,11 +860,12 @@ def plan_physical(plan: LogicalPlan,
     if isinstance(plan, Filter):
         child_required = set(required) | plan.condition.references()
         child = _apply_bucket_pruning(
-            plan.condition, plan_physical(plan.child, child_required, conf))
+            plan.condition,
+            _plan_physical(plan.child, child_required, conf, ctx))
         return FilterExec(plan.condition, child, conf=conf)
 
     if isinstance(plan, Project):
-        child = plan_physical(plan.child, set(plan.columns), conf)
+        child = _plan_physical(plan.child, set(plan.columns), conf, ctx)
         # Resolve names against the child schema but KEEP the declared order.
         resolved = [plan.child.schema.field(c).name for c in plan.columns]
         return ProjectExec(resolved, child)
@@ -757,16 +876,19 @@ def plan_physical(plan: LogicalPlan,
                              if a.column != "*"})
         return AggregateExec(plan.group_columns, plan.aggregates,
                              plan.schema,
-                             plan_physical(plan.child, child_required, conf),
+                             _plan_physical(plan.child, child_required,
+                                            conf, ctx),
                              conf=conf)
 
     if isinstance(plan, Sort):
         child_required = set(required) | set(plan.columns)
         return SortExec(plan.columns,
-                        plan_physical(plan.child, child_required, conf))
+                        _plan_physical(plan.child, child_required, conf,
+                                       ctx))
 
     if isinstance(plan, Limit):
-        return LimitExec(plan.n, plan_physical(plan.child, required, conf))
+        return LimitExec(plan.n,
+                         _plan_physical(plan.child, required, conf, ctx))
 
     if isinstance(plan, Union):
         # Children may expose different column orders for the same names
@@ -774,7 +896,7 @@ def plan_physical(plan: LogicalPlan,
         wanted = _required_for(plan, required)
         return UnionExec([
             ProjectExec([c.schema.field(n).name for n in wanted],
-                        plan_physical(c, set(wanted), conf))
+                        _plan_physical(c, set(wanted), conf, ctx))
             for c in plan.children])
 
     if isinstance(plan, Join):
@@ -787,8 +909,8 @@ def plan_physical(plan: LogicalPlan,
                          | set(left_keys))
         right_required = ({n for n in required if plan.right.schema.contains(n)}
                           | set(right_keys))
-        left_phys = plan_physical(plan.left, left_required, conf)
-        right_phys = plan_physical(plan.right, right_required, conf)
+        left_phys = _plan_physical(plan.left, left_required, conf, ctx)
+        right_phys = _plan_physical(plan.right, right_required, conf, ctx)
 
         lspec = _underlying_bucket_spec(plan.left)
         rspec = _underlying_bucket_spec(plan.right)

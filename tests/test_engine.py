@@ -471,3 +471,37 @@ def test_cross_dtype_indexed_join_takes_general_path(tmp_path):
                 .reset_index(drop=True))
         pd.testing.assert_frame_equal(got, want)
         assert len(got) > 0
+
+
+def test_common_subplan_reuse(tmp_path):
+    """An identical subtree referenced twice (q64-style self-join of an
+    aggregate) compiles to ONE shared ReusedExec and executes once."""
+    import pandas as pd
+    from hyperspace_tpu.engine.executor import compile_plan
+    from hyperspace_tpu.engine.physical import ReusedExec
+
+    sess = HyperspaceSession(HyperspaceConf())
+    rng = np.random.default_rng(3)
+    t = pa.table({"k": rng.integers(0, 50, 2000).astype(np.int64),
+                  "v": rng.random(2000)})
+    src = tmp_path / "s"
+    src.mkdir()
+    pq.write_table(t, str(src / "p.parquet"))
+    df = sess.read_parquet(str(src))
+    agg = df.group_by("k").agg(("sum", "v", "sv"), ("count", "*", "cnt"))
+    joined = agg.join(agg, on="k").select("k", "sv", "sv_r", "cnt", "cnt_r")
+
+    phys = compile_plan(joined.plan, conf=sess.conf)
+    reused = [n for n in phys.collect() if isinstance(n, ReusedExec)]
+    assert reused, "no shared subplan detected"
+    # Both join sides route through the SAME instance.
+    ids = {id(n) for n in reused
+           if any("Aggregate" in c.simple_string() for c in n.collect())}
+    assert len(ids) == 1, f"aggregate subplan not shared: {len(ids)}"
+
+    got = joined.collect().to_pandas().sort_values("k").reset_index(drop=True)
+    ref = (t.to_pandas().groupby("k")
+           .agg(sv=("v", "sum"), cnt=("k", "size")).reset_index())
+    assert np.allclose(got.sv, ref.sv) and np.allclose(got.sv_r, ref.sv)
+    assert (got.cnt.to_numpy() == ref.cnt.to_numpy()).all()
+    assert (got.cnt_r.to_numpy() == ref.cnt.to_numpy()).all()

@@ -61,6 +61,8 @@ class DataFrame:
                          self.session)
 
     def sort(self, *columns: str) -> "DataFrame":
+        """ORDER BY. Plain names sort ascending (nulls first); prefix a
+        name with "-" for descending (nulls last): df.sort("a", "-b")."""
         return DataFrame(Sort(list(columns), self.plan), self.session)
 
     order_by = sort

@@ -881,7 +881,9 @@ def _plan_physical_node(plan: LogicalPlan,
                              conf=conf)
 
     if isinstance(plan, Sort):
-        child_required = set(required) | set(plan.columns)
+        from hyperspace_tpu.plan.nodes import sort_direction
+        child_required = (set(required)
+                          | {sort_direction(c)[0] for c in plan.columns})
         return SortExec(plan.columns,
                         _plan_physical(plan.child, child_required, conf,
                                        ctx))

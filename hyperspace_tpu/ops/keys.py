@@ -35,8 +35,22 @@ def _float_order_bits(data, int_dtype, uint_dtype, sign_bit):
     return bits ^ mask
 
 
+def _can_bitcast64() -> bool:
+    """TPU backends emulate 64-bit types by splitting into 32-bit pairs,
+    and that x64 rewrite has no lowering for 64-bit bitcast-convert — so
+    the IEEE bit transform for float64 only compiles on cpu/gpu."""
+    import jax
+    try:
+        return jax.default_backend() not in ("tpu", "axon")
+    except Exception:
+        return True
+
+
 def key_lanes(data) -> List:
-    """Decompose one key array into order-preserving 32-bit lanes."""
+    """Decompose one key array into order-preserving 32-bit lanes.
+    float64 falls back to ONE raw f64 lane on backends that cannot
+    bitcast 64-bit types (`lax.sort` compares floats natively; numeric
+    order equals the bit transform's total order except NaN placement)."""
     import jax
     import jax.numpy as jnp
 
@@ -46,6 +60,27 @@ def key_lanes(data) -> List:
         lo = (data & 0xFFFFFFFF).astype(jnp.uint32)
         return [hi, lo]
     if dtype == jnp.float64:
+        if not _can_bitcast64():
+            # TPU x64 emulation has no 64-bit bitcast AND demotes raw f64
+            # comparisons, so exact order lanes must come from HOST bits.
+            # Concrete arrays pay one device->host read of the key column;
+            # inside a compiled program there is no correct lowering —
+            # fail loudly rather than mis-sort.
+            import numpy as np
+
+            from jax.core import Tracer
+            if isinstance(data, Tracer):
+                from hyperspace_tpu.exceptions import HyperspaceException
+                raise HyperspaceException(
+                    "float64 sort/bucket keys are not supported inside "
+                    "compiled programs on TPU backends (no exact 64-bit "
+                    "decomposition); use an integer or string key, or run "
+                    "on the host lane.")
+            from hyperspace_tpu.ops.host_hash import _float_order_bits as _h
+            bits = _h(np.asarray(data), np.uint64, 64)
+            return [jnp.asarray((bits >> np.uint64(32)).astype(np.uint32)),
+                    jnp.asarray((bits & np.uint64(0xFFFFFFFF))
+                                .astype(np.uint32))]
         bits = _float_order_bits(data, jnp.int64, jnp.uint64, 64)
         return [(bits >> 32).astype(jnp.uint32),
                 (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)]

@@ -20,12 +20,46 @@ from typing import List, Optional, Sequence, Tuple
 from hyperspace_tpu.io.columnar import ColumnBatch
 
 
+def _descend(lane, xp):
+    """Map a sort lane to its DESCENDING-order equivalent: float lanes
+    negate; integer/bool lanes convert to the unsigned order-preserving
+    form then bitwise-invert. Applied to the validity lane too, which
+    flips null placement to nulls-last — Spark's default for descending
+    keys."""
+    import numpy as _np
+
+    dt = lane.dtype
+    if xp.issubdtype(dt, xp.floating):
+        return -lane
+    if dt == bool:
+        u = lane.astype(xp.uint32)
+    elif xp.issubdtype(dt, xp.signedinteger):
+        # Reinterpret (not convert): signed->unsigned value conversion of
+        # negatives is backend-defined on TPU, the bit pattern is not.
+        if xp is _np:
+            u = lane.view(_np.uint32) ^ _np.uint32(0x80000000)
+        else:
+            import jax
+            u = jax.lax.bitcast_convert_type(
+                lane.astype(xp.int32), xp.uint32) ^ xp.uint32(0x80000000)
+    else:
+        u = lane.astype(xp.uint32)
+    return ~u
+
+
 def _key_operands(batch: ColumnBatch, by: Sequence[str]) -> List:
+    import jax.numpy as jnp
+
     from hyperspace_tpu.ops.keys import column_sort_lanes
+    from hyperspace_tpu.plan.nodes import sort_direction
     operands = []
-    for name in by:
+    for spec in by:
+        name, desc = sort_direction(spec)
         # 32-bit order-preserving lanes (validity first: nulls-first order).
-        operands.extend(column_sort_lanes(batch.column(name)))
+        lanes = column_sort_lanes(batch.column(name))
+        if desc:
+            lanes = [_descend(lane, jnp) for lane in lanes]
+        operands.extend(lanes)
     return operands
 
 
@@ -38,9 +72,14 @@ def sort_permutation(batch: ColumnBatch, by: Sequence[str],
         import numpy as np
 
         from hyperspace_tpu.ops.keys import host_column_sort_lanes
+        from hyperspace_tpu.plan.nodes import sort_direction
         operands = []
-        for name in by:
-            operands.extend(host_column_sort_lanes(batch.column(name)))
+        for spec in by:
+            name, desc = sort_direction(spec)
+            lanes = host_column_sort_lanes(batch.column(name))
+            if desc:
+                lanes = [_descend(lane, np) for lane in lanes]
+            operands.extend(lanes)
         # np.lexsort's primary key is the LAST operand.
         return np.lexsort(tuple(reversed(operands))).astype(np.int32)
     import jax

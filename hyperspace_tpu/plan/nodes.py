@@ -306,8 +306,18 @@ class Aggregate(LogicalPlan):
         return f"Aggregate [{', '.join(self.group_columns)}] [{aggs}]"
 
 
+def sort_direction(column: str):
+    """Parse a sort spec: "name" -> (name, False); "-name" -> (name, True)
+    (descending). Descending follows Spark's default null placement:
+    ascending is nulls-first, descending is nulls-last."""
+    if column.startswith("-"):
+        return column[1:], True
+    return column, False
+
+
 class Sort(LogicalPlan):
-    """ORDER BY (ascending, nulls first — the engine's sort order)."""
+    """ORDER BY. Plain column names sort ascending (nulls first); a
+    leading "-" sorts that column descending (nulls last)."""
 
     def __init__(self, columns: Sequence[str], child: LogicalPlan):
         self.columns = list(columns)
@@ -330,7 +340,9 @@ class Sort(LogicalPlan):
                 "child": self.child.to_dict()}
 
     def simple_string(self) -> str:
-        return f"Sort [{', '.join(self.columns)}]"
+        parts = [f"{name} DESC" if desc else name
+                 for name, desc in map(sort_direction, self.columns)]
+        return f"Sort [{', '.join(parts)}]"
 
 
 class Limit(LogicalPlan):

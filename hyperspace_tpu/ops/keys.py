@@ -47,10 +47,10 @@ def _can_bitcast64() -> bool:
 
 
 def key_lanes(data) -> List:
-    """Decompose one key array into order-preserving 32-bit lanes.
-    float64 falls back to ONE raw f64 lane on backends that cannot
-    bitcast 64-bit types (`lax.sort` compares floats natively; numeric
-    order equals the bit transform's total order except NaN placement)."""
+    """Decompose one key array into order-preserving 32-bit lanes. On
+    backends that cannot bitcast 64-bit types (TPU x64 emulation),
+    float64 lanes come from HOST bit decomposition for concrete arrays
+    and raise for tracers — see the float64 branch."""
     import jax
     import jax.numpy as jnp
 
@@ -76,11 +76,8 @@ def key_lanes(data) -> List:
                     "compiled programs on TPU backends (no exact 64-bit "
                     "decomposition); use an integer or string key, or run "
                     "on the host lane.")
-            from hyperspace_tpu.ops.host_hash import _float_order_bits as _h
-            bits = _h(np.asarray(data), np.uint64, 64)
-            return [jnp.asarray((bits >> np.uint64(32)).astype(np.uint32)),
-                    jnp.asarray((bits & np.uint64(0xFFFFFFFF))
-                                .astype(np.uint32))]
+            return [jnp.asarray(lane)
+                    for lane in host_key_lanes(np.asarray(data))]
         bits = _float_order_bits(data, jnp.int64, jnp.uint64, 64)
         return [(bits >> 32).astype(jnp.uint32),
                 (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)]

@@ -21,16 +21,15 @@ from hyperspace_tpu.io.columnar import ColumnBatch
 
 
 def _descend(lane, xp):
-    """Map a sort lane to its DESCENDING-order equivalent: float lanes
-    negate; integer/bool lanes convert to the unsigned order-preserving
-    form then bitwise-invert. Applied to the validity lane too, which
-    flips null placement to nulls-last — Spark's default for descending
-    keys."""
+    """Map a sort lane to its DESCENDING-order equivalent: convert to the
+    unsigned order-preserving form, then bitwise-invert. Applied to the
+    validity lane too, which flips null placement to nulls-last —
+    Spark's default for descending keys."""
     import numpy as _np
 
     dt = lane.dtype
-    if xp.issubdtype(dt, xp.floating):
-        return -lane
+    # (No float lanes exist: float keys always decompose to uint32
+    # bit-transform lanes, on every backend.)
     if dt == bool:
         u = lane.astype(xp.uint32)
     elif xp.issubdtype(dt, xp.signedinteger):

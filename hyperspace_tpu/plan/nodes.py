@@ -322,6 +322,14 @@ class Sort(LogicalPlan):
     def __init__(self, columns: Sequence[str], child: LogicalPlan):
         self.columns = list(columns)
         self.child = child
+        for spec in self.columns:
+            name, desc = sort_direction(spec)
+            if desc and child.schema.contains(spec):
+                # A column literally named "-x" would silently alias
+                # column "x" descending; fail loudly instead.
+                raise HyperspaceException(
+                    f"Ambiguous sort spec {spec!r}: a column with that "
+                    "literal name exists; rename it to sort by it.")
 
     @property
     def children(self) -> List[LogicalPlan]:

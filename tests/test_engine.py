@@ -510,7 +510,6 @@ def test_common_subplan_reuse(tmp_path):
 def test_descending_sort_both_lanes(session, tmp_path):
     """df.sort("-col"): descending with nulls LAST (Spark's desc default),
     identical on host and device lanes, mixed asc/desc."""
-    import pandas as pd
     t = pa.table({
         "a": pa.array([3, 1, None, 2, 1], type=pa.int64()),
         "b": pa.array([1.5, None, 2.5, 0.5, 3.5], type=pa.float64()),

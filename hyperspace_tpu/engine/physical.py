@@ -305,8 +305,8 @@ class ExchangeExec(PhysicalNode):
         import jax.numpy as jnp
 
         from hyperspace_tpu.ops.pallas.partition_kernel import (
-            batch_partition, pallas_available)
-        if pallas_available():
+            batch_partition, kernel_supported)
+        if kernel_supported(self.num_partitions):
             # Fused Pallas kernel: ids + histogram in ONE HBM pass.
             ids, lengths_dev = batch_partition(batch, self.keys,
                                                self.num_partitions)

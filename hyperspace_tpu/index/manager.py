@@ -9,7 +9,6 @@ IndexSummary rows), `index/CachingIndexCollectionManager.scala:37-99`
 from __future__ import annotations
 
 import logging
-import os
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
@@ -174,7 +173,7 @@ class IndexCollectionManager(IndexManager):
             return []
         entries: List[IndexLogEntry] = []
         for name in sorted(storage.listdir_names(root)):
-            index_path = os.path.join(root, name)
+            index_path = storage.join(root, name)
             if not file_utils.is_dir(index_path):
                 continue
             log_manager = self.log_manager_factory.create(index_path)

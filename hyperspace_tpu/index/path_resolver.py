@@ -8,7 +8,6 @@ new indexes.
 
 from __future__ import annotations
 
-import os
 
 from hyperspace_tpu.utils import file_utils, storage
 
@@ -31,5 +30,5 @@ class PathResolver:
         if file_utils.is_dir(root):
             for entry in sorted(storage.listdir_names(root)):
                 if entry.lower() == normalized.lower():
-                    return os.path.join(root, entry)
-        return os.path.join(root, normalized)
+                    return storage.join(root, entry)
+        return storage.join(root, normalized)

@@ -24,6 +24,14 @@ from hyperspace_tpu.plan.schema import Schema
 BUCKET_FILE_RE = re.compile(r"part-(\d{5})(?:-[A-Za-z0-9]+)?\.parquet$")
 BUCKET_SPEC_FILE = "_bucket_spec.json"
 
+# Version of THE bucket hash identity (`ops/hash_partition` + float-lane
+# normalization in `ops/keys.py`). Bumped whenever the row -> bucket map
+# of existing layouts would change (v2: -0.0/NaN float normalization). A
+# data dir written under a different version reports no bucket spec, so
+# readers treat it as unbucketed (correct, just unaccelerated) instead of
+# silently mis-bucketing point lookups and co-partitioned joins.
+BUCKET_HASH_VERSION = 2
+
 
 def bucket_file_name(bucket: int, suffix: Optional[str] = None) -> str:
     tag = f"-{suffix}" if suffix else ""
@@ -83,6 +91,16 @@ def _file_stamp(path: str):
     return (st.st_size, st.st_mtime_ns)
 
 
+def _stamps(paths: Sequence[str]):
+    """Tuple of per-file stamps, or None when any file is unstampable
+    (directory, no mtime, stat failure) — which disables caching."""
+    try:
+        stamps = tuple(_file_stamp(p) for p in paths)
+    except OSError:
+        return None
+    return None if any(st is None for st in stamps) else stamps
+
+
 def clear_read_cache() -> None:
     with _read_cache_lock:
         _read_cache.clear()
@@ -100,12 +118,7 @@ def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
         raise HyperspaceException("No parquet inputs to read.")
     cols = list(columns) if columns else None
     key = (tuple(paths), tuple(cols) if cols else None)
-    try:
-        stamps = tuple(_file_stamp(p) for p in paths)
-        if any(st is None for st in stamps):
-            stamps = None
-    except OSError:
-        stamps = None
+    stamps = _stamps(paths)
     if stamps is not None and READ_CACHE_BYTES > 0:
         with _read_cache_lock:
             hit = _read_cache.get(key)
@@ -122,6 +135,12 @@ def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
         table = pa.concat_tables(tables, promote_options="default")
 
     if stamps is not None and READ_CACHE_BYTES > 0:
+        # Re-stat after the read: a file rewritten DURING the read would
+        # otherwise cache new (or torn, for multi-file concat) bytes under
+        # the old stamp, and the stale entry would keep validating until
+        # the file changed again. Insert only when nothing moved.
+        if _stamps(paths) != stamps:
+            return table
         with _read_cache_lock:
             _read_cache[key] = (stamps, table)
             total = sum(t.nbytes for _, t in _read_cache.values())
@@ -177,6 +196,7 @@ def write_table(table, path: str) -> None:
 def write_bucket_spec(directory: str, spec: BucketSpec, schema: Schema) -> None:
     from hyperspace_tpu.utils import file_utils
     payload = json.dumps({"bucketSpec": spec.to_dict(),
+                          "hashVersion": BUCKET_HASH_VERSION,
                           "schema": [fld.to_dict() for fld in schema.fields]},
                          indent=2)
     file_utils.create_file(storage.join(directory, BUCKET_SPEC_FILE), payload)
@@ -187,8 +207,12 @@ def read_bucket_spec(directory: str) -> Optional[BucketSpec]:
     path = storage.join(directory, BUCKET_SPEC_FILE)
     if not file_utils.exists(path):
         return None
-    return BucketSpec.from_dict(
-        json.loads(file_utils.read_contents(path))["bucketSpec"])
+    payload = json.loads(file_utils.read_contents(path))
+    if payload.get("hashVersion", 1) != BUCKET_HASH_VERSION:
+        # Layout written under a different hash identity: expose it as
+        # unbucketed so reads stay correct (no pruning/co-partitioning).
+        return None
+    return BucketSpec.from_dict(payload["bucketSpec"])
 
 
 def bucket_files(directory: str) -> Dict[int, List[str]]:
@@ -201,5 +225,5 @@ def bucket_files(directory: str) -> Dict[int, List[str]]:
     for name in sorted(storage.listdir_names(directory)):
         bucket = bucket_of_file(name)
         if bucket is not None:
-            out.setdefault(bucket, []).append(os.path.join(directory, name))
+            out.setdefault(bucket, []).append(storage.join(directory, name))
     return out

@@ -31,6 +31,11 @@ def _combine(h1: np.ndarray, h2: np.ndarray) -> np.ndarray:
 
 
 def _float_order_bits(data: np.ndarray, uint_dtype, sign_bit: int):
+    # Normalize first (-0.0 -> +0.0, NaNs -> one canonical NaN) so lane
+    # identity equals numeric equality on every path; see the device
+    # twin's docstring (`ops/keys.py::_float_order_bits`).
+    data = np.where(data == 0, np.zeros((), data.dtype), data)
+    data = np.where(np.isnan(data), np.full((), np.nan, data.dtype), data)
     bits = data.view(np.int64 if sign_bit == 64 else np.int32).astype(uint_dtype)
     sign = (bits >> uint_dtype(sign_bit - 1)) & uint_dtype(1)
     mask = np.where(sign == 1, ~uint_dtype(0), uint_dtype(1) << uint_dtype(sign_bit - 1))

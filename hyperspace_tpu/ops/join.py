@@ -22,8 +22,7 @@ from __future__ import annotations
 from typing import List, Sequence, Tuple
 
 from hyperspace_tpu.exceptions import HyperspaceException
-from hyperspace_tpu.io.columnar import (ColumnBatch, DeviceColumn,
-                                        unify_string_columns)
+from hyperspace_tpu.io.columnar import ColumnBatch
 
 
 def encode_join_keys(left: ColumnBatch, right: ColumnBatch,
@@ -37,51 +36,14 @@ def encode_join_keys(left: ColumnBatch, right: ColumnBatch,
     (validity is the leading sub-key, `ops/sort.py`), the sentinels land at
     the front of an already key-sorted batch and preserve the sortedness
     invariant `merge_join_indices` relies on.
-    """
-    import jax
-    import jax.numpy as jnp
 
-    if len(left_keys) != len(right_keys) or not left_keys:
-        raise HyperspaceException("Join requires matching key column lists.")
-    n, m = left.num_rows, right.num_rows
-    operands = []
-    l_valid = jnp.ones(n, dtype=bool)
-    r_valid = jnp.ones(m, dtype=bool)
-    for lk, rk in zip(left_keys, right_keys):
-        lcol, rcol = left.column(lk), right.column(rk)
-        if lcol.is_string != rcol.is_string:
-            raise HyperspaceException(
-                f"Join key type mismatch: {lk} vs {rk}")
-        if lcol.is_string:
-            lcol, rcol = unify_string_columns(lcol, rcol)
-        if lcol.validity is not None:
-            l_valid = l_valid & lcol.validity
-        if rcol.validity is not None:
-            r_valid = r_valid & rcol.validity
-        ldata, rdata = lcol.data, rcol.data
-        if ldata.dtype != rdata.dtype:
-            common = jnp.promote_types(ldata.dtype, rdata.dtype)
-            ldata = ldata.astype(common)
-            rdata = rdata.astype(common)
-        operands.append(jnp.concatenate([ldata, rdata]))
-    iota = jnp.arange(n + m, dtype=jnp.int32)
-    # Validity participates as the leading sort key so group ids stay
-    # consistent with the nulls-first physical sort order.
-    validity_key = jnp.concatenate([l_valid, r_valid])
-    sorted_ops = jax.lax.sort([validity_key, *operands, iota],
-                              num_keys=1 + len(operands), is_stable=True)
-    perm = sorted_ops[-1]
-    keys_sorted = sorted_ops[:-1]
-    differs = jnp.zeros(n + m, dtype=jnp.int32)
-    for k in keys_sorted:
-        differs = differs | jnp.concatenate(
-            [jnp.zeros(1, dtype=jnp.int32),
-             (k[1:] != k[:-1]).astype(jnp.int32)])
-    group_sorted = jnp.cumsum(differs, dtype=jnp.int32)
-    groups = jnp.zeros(n + m, dtype=jnp.int32).at[perm].set(group_sorted)
-    l_ids = jnp.where(l_valid, groups[:n], jnp.int32(-1))
-    r_ids = jnp.where(r_valid, groups[n:], jnp.int32(-2))
-    return l_ids, r_ids
+    There is exactly ONE device key-identity implementation — the 32-bit
+    lane encoder in `ops/bucketed_join.encode_group_ids` (normalized float
+    order bits: -0.0 == 0.0, NaN == NaN) — so the global and bucketed
+    join paths can never diverge on which tuples compare equal.
+    """
+    from hyperspace_tpu.ops.bucketed_join import encode_group_ids
+    return encode_group_ids(left, right, left_keys, right_keys)
 
 
 def merge_join_indices(left_ids, right_ids, how: str = "inner") -> Tuple:

@@ -26,9 +26,18 @@ from hyperspace_tpu.io.columnar import DeviceColumn
 
 def _float_order_bits(data, int_dtype, uint_dtype, sign_bit):
     """IEEE total-order transform: monotone map float -> unsigned int
-    (negatives flip all bits; positives set the sign bit)."""
+    (negatives flip all bits; positives set the sign bit).
+
+    Floats are normalized first — -0.0 -> +0.0 and every NaN bit pattern
+    -> one canonical quiet NaN — so sort order, bucket hash, and join/group
+    key identity agree with numeric equality on every lane (Spark's
+    NormalizeFloatingNumbers; NaNs group together and sort last)."""
     import jax
     import jax.numpy as jnp
+    zero = jnp.zeros((), data.dtype)
+    data = jnp.where(data == zero, zero, data)
+    data = jnp.where(jnp.isnan(data), jnp.full((), jnp.nan, data.dtype),
+                     data)
     bits = jax.lax.bitcast_convert_type(data, int_dtype).astype(uint_dtype)
     sign = (bits >> (sign_bit - 1)) & uint_dtype(1)
     mask = jnp.where(sign == 1, ~uint_dtype(0), uint_dtype(1) << (sign_bit - 1))

@@ -24,6 +24,19 @@ from hyperspace_tpu.ops.pallas.hash_kernel import pallas_available  # noqa: F401
 
 _BLOCK_ROWS = 256
 _LANES = 128
+# Rows per histogram accumulation sub-block: bounds the one-hot
+# intermediate at _HIST_SUB * _LANES * hist_cols int32s (1 MB at 256
+# bucket columns).
+_HIST_SUB = 8
+# Above this bucket count even the sub-blocked accumulator churns VMEM;
+# callers should take the two-pass jnp path instead (`kernel_supported`).
+MAX_KERNEL_BUCKETS = 1024
+
+
+def kernel_supported(num_buckets: int) -> bool:
+    """True when the fused kernel path is appropriate for this bucket
+    count (and Pallas is available on the backend)."""
+    return pallas_available() and num_buckets <= MAX_KERNEL_BUCKETS
 
 
 def _kernel(num_buckets: int, n_lanes: int, *refs):
@@ -48,11 +61,24 @@ def _kernel(num_buckets: int, n_lanes: int, *refs):
     bucket = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
     ids_ref[:] = bucket
     valid = valid_ref[:] != 0
-    # One-hot histogram over the tile; padding rows count toward no bucket.
+    # One-hot histogram accumulated over row sub-blocks: a full-tile
+    # one-hot would materialize [256, 128, hist_cols] (32 MB of int32 at
+    # 200+ buckets if the reduction is not fused — over a core's ~16 MB
+    # VMEM); per-sub-block the intermediate is bounded at
+    # _HIST_SUB*128*hist_cols. Padding rows count toward no bucket.
+    import jax
     masked = jnp.where(valid, bucket, jnp.int32(num_buckets))
     b_range = jnp.arange(hist_ref.shape[1], dtype=jnp.int32)
-    onehot = (masked[:, :, None] == b_range[None, None, :])
-    hist_ref[:] = jnp.sum(onehot, axis=(0, 1), dtype=jnp.int32)[None, :]
+
+    def body(i, acc):
+        rows = jax.lax.dynamic_slice_in_dim(masked, i * _HIST_SUB,
+                                            _HIST_SUB, axis=0)
+        onehot = (rows[:, :, None] == b_range[None, None, :])
+        return acc + jnp.sum(onehot, axis=(0, 1), dtype=jnp.int32)
+
+    hist = jax.lax.fori_loop(0, _BLOCK_ROWS // _HIST_SUB, body,
+                             jnp.zeros(hist_ref.shape[1], dtype=jnp.int32))
+    hist_ref[:] = hist[None, :]
 
 
 def partition_ids_and_histogram(lanes: Sequence, num_buckets: int,

@@ -14,6 +14,40 @@ from hyperspace_tpu.plan.schema import Schema
 logger = logging.getLogger(__name__)
 
 
+_layout_hash_memo: dict = {}
+
+
+def _layout_hash_current(root: str) -> bool:
+    """True when the bucketed layout at `root` was written under the
+    CURRENT bucket-hash identity (`io/parquet.BUCKET_HASH_VERSION`).
+    Index data dirs (`v__=N`) are immutable, so definitive answers are
+    memoized; a TRANSIENT storage error answers False for this query only
+    (unbucketed = correct, just unaccelerated) without poisoning the memo.
+    Every real build writes the sidecar, so a sidecar carrying an older
+    (or no) hashVersion means a stale layout; a MISSING sidecar means a
+    fabricated/test entry and trusts the log entry."""
+    cached = _layout_hash_memo.get(root)
+    if cached is not None:
+        return cached
+    from hyperspace_tpu.io import parquet
+    from hyperspace_tpu.utils import file_utils
+    from hyperspace_tpu.utils.storage import join as _join
+    try:
+        if not file_utils.exists(_join(root, parquet.BUCKET_SPEC_FILE)):
+            result = True
+        else:
+            result = parquet.read_bucket_spec(root) is not None
+    except Exception as exc:
+        logger.warning("Unreadable bucket spec at %s: %s", root, exc)
+        return False  # transient: do not memoize
+    if len(_layout_hash_memo) < 4096:
+        _layout_hash_memo[root] = result
+    return result
+
+
+_layout_hash_current.cache_clear = _layout_hash_memo.clear  # test seam
+
+
 class Rule:
     """A logical plan rewrite rule (the reference's Catalyst
     `Rule[LogicalPlan]` analog)."""
@@ -68,7 +102,12 @@ class Rule:
 
         schema = Schema.from_json(entry.schema_json)
         bucket_spec = None
-        if bucketed:
+        if bucketed and _layout_hash_current(entry.content.root):
+            # The sidecar records which bucket-hash identity wrote the
+            # layout; a dir written under an older identity (e.g. before
+            # the float -0.0/NaN normalization) must read as unbucketed —
+            # correct, just unaccelerated — or point lookups and
+            # co-partitioned joins would silently miss rows.
             bucket_spec = BucketSpec(entry.num_buckets,
                                      tuple(entry.indexed_columns),
                                      tuple(entry.indexed_columns))

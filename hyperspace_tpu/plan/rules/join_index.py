@@ -44,7 +44,10 @@ class JoinIndexRule(Rule):
             return plan
 
     def _rewrite(self, node: LogicalPlan) -> LogicalPlan:
-        if not isinstance(node, Join) or node.join_type != "inner":
+        # The reference rule matches ANY `Join(l, r, Some(cond))` with a
+        # supported equi condition (`JoinIndexRule.scala:55-71`) — outer
+        # equi-joins are index-served too.
+        if not isinstance(node, Join):
             return node
         join = node
         mapping = self._column_mapping(join)

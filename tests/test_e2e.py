@@ -218,3 +218,49 @@ def test_filter_rewrite_preserves_column_order(env):
     plain, indexed = run_with_and_without(session, query, ["id"])
     assert list(plain.columns) == list(indexed.columns) == df.columns
     pd.testing.assert_frame_equal(plain, indexed)
+
+
+def test_stale_hash_version_layout_reads_unbucketed(env, tmp_path):
+    """An index data dir written under an older bucket-hash identity must
+    be served UNBUCKETED (correct results, no pruning) rather than
+    mis-bucketing point lookups against the new hash."""
+    import json
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import IndexConfig
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.plan.rules import base as rules_base
+    from hyperspace_tpu.utils import file_utils
+
+    sess, hs, _ = env
+    src = tmp_path / "src_stale"
+    src.mkdir()
+    pq.write_table(pa.table({"id": np.arange(500, dtype=np.int64),
+                             "v": np.arange(500, dtype=np.int64) * 3}),
+                   str(src / "p.parquet"))
+    df = sess.read_parquet(str(src))
+    hs.create_index(df, IndexConfig("idx_stale", ["id"], ["v"]))
+
+    # Forge an OLD hash version into the sidecar.
+    entry = [e for e in hs.indexes().to_dict("records")
+             if e["name"] == "idx_stale"][0]
+    root = entry["indexLocation"]
+    spec_path = root + "/_bucket_spec.json"
+    payload = json.loads(file_utils.read_contents(spec_path))
+    payload["hashVersion"] = 1
+    file_utils.delete(spec_path)
+    file_utils.create_file(spec_path, json.dumps(payload))
+    rules_base._layout_hash_current.cache_clear()
+
+    sess.enable_hyperspace()
+    q = df.filter(col("id") == lit(123)).select("id", "v")
+    opt = q._optimized_plan()
+    scans = [leaf for leaf in opt.collect_leaves()]
+    assert any("v__=" in p for s in scans for p in s.root_paths)
+    assert all(s.bucket_spec is None for s in scans)  # stale -> unbucketed
+    got = q.collect().to_pandas()
+    assert got.values.tolist() == [[123, 369]]
+    rules_base._layout_hash_current.cache_clear()

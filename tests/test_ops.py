@@ -477,3 +477,59 @@ def test_host_join_empty_sides():
     assert len(li) == 0 and len(ri) == 0
     li, ri = host_join_indices(right, left, ["k"], ["k"], how="inner")
     assert len(li) == 0
+
+
+def test_float_key_negative_zero_and_nan_uniform_across_lanes():
+    """-0.0 joins 0.0 and NaN joins NaN identically on every path: the
+    host packed fast path (raw float compare), the host lane-encoded
+    path, and the device encode (normalized order bits) — the advisor's
+    round-2 medium finding."""
+    lk = np.array([-0.0, 0.0, np.nan, 1.5])
+    rk = np.array([0.0, np.nan, 1.5, 2.0])
+    left = batch_of(k=lk, a=np.arange(4))
+    right = batch_of(k=rk, b=np.arange(4))
+
+    # Host packed path (single numeric null-free key).
+    li, ri = join.host_join_indices(left, right, ["k"], ["k"])
+    packed_pairs = sorted(zip(li.tolist(), ri.tolist()))
+    # -0.0 matches 0.0 (rows 0,1 -> right 0); NaN matches NaN (2 -> 1);
+    # 1.5 -> 2.
+    assert packed_pairs == [(0, 0), (1, 0), (2, 1), (3, 2)]
+
+    # Host lane-encoded path (forced by adding a second key).
+    left2 = batch_of(k=lk, k2=pa.array(["x"] * 4), a=np.arange(4))
+    right2 = batch_of(k=rk, k2=pa.array(["x"] * 4), b=np.arange(4))
+    li2, ri2 = join.host_join_indices(left2, right2, ["k", "k2"],
+                                      ["k", "k2"])
+    assert sorted(zip(li2.tolist(), ri2.tolist())) == packed_pairs
+
+    # Device encode: group ids of -0.0/0.0 equal; NaNs equal across sides.
+    dl = columnar.from_arrow(pa.table({"k": lk}))
+    dr = columnar.from_arrow(pa.table({"k": rk}))
+    out = join.sort_merge_join(dl, dr, ["k"], ["k"])
+    assert out.num_rows == 4
+
+    # Bucket hash identity: -0.0 and 0.0 land in the same bucket on the
+    # host mirror (device parity is pinned by
+    # test_host_bucket_ids_match_device).
+    from hyperspace_tpu.ops.host_hash import host_bucket_ids
+    ids = host_bucket_ids([np.array([-0.0, 0.0, np.nan, np.nan])],
+                          ["float64"], 16)
+    assert ids[0] == ids[1] and ids[2] == ids[3]
+
+
+def test_float_group_by_negative_zero_one_group():
+    from hyperspace_tpu.ops.aggregate import group_aggregate
+    from hyperspace_tpu.plan.nodes import Aggregate, AggSpec, Scan
+    from hyperspace_tpu.plan.schema import Schema
+
+    table = pa.table({"k": np.array([-0.0, 0.0, -0.0]),
+                      "v": np.array([1, 2, 3], dtype=np.int64)})
+    batch = columnar.from_arrow(table)
+    schema = Schema.from_arrow(table.schema)
+    out_schema = Aggregate(["k"], [AggSpec("sum", "v", "sv")],
+                           Scan(["/nx"], schema)).schema
+    out = group_aggregate(batch, ["k"], [AggSpec("sum", "v", "sv")],
+                          out_schema)
+    assert out.num_rows == 1
+    assert int(np.asarray(out.column("sv").data)[0]) == 6

@@ -76,6 +76,8 @@ class ExpressionCompiler:
     def value(self, e: E.Expression) -> Tuple[object, Optional[object]]:
         """Compile to (array, validity|None). Strings yield their codes and
         may only feed comparisons handled in `predicate`."""
+        if isinstance(e, E.Alias):
+            return self.value(e.child)
         if isinstance(e, E.Column):
             col, validity = _col_and_validity(self.batch, e.name)
             return col.data, validity
@@ -86,9 +88,77 @@ class ExpressionCompiler:
             rv, rval = self.value(e.right)
             ops = {"add": self.xp.add, "sub": self.xp.subtract,
                    "mul": self.xp.multiply, "div": self.xp.divide}
+            if type(e).op == "div":
+                lv = self.xp.asarray(lv).astype(self.xp.float64)
             out = ops[type(e).op](lv, rv)
             return out, self._merge_validity(lval, rval)
         raise HyperspaceException(f"Unsupported value expression: {e!r}")
+
+    def string_column(self, e: E.Expression) -> Optional[DeviceColumn]:
+        """Evaluate a string-VALUED expression to a dict-encoded column
+        (sorted dictionary, so code-space comparisons stay valid), or None
+        when `e` is not string-valued. Substr transforms the DICTIONARY —
+        O(dictionary), not O(rows) — then re-sorts and remaps codes."""
+        if isinstance(e, E.Alias):
+            return self.string_column(e.child)
+        if isinstance(e, E.Column):
+            col = self.batch.column(e.name)
+            return col if col.is_string else None
+        if isinstance(e, E.Literal) and isinstance(e.value, str):
+            # Constant string column (q5/q33/q56-style channel tags): a
+            # one-entry dictionary with all codes 0.
+            from hyperspace_tpu.io.columnar import (_split_hashes,
+                                                    _string_hash64)
+            d = np.array([e.value])
+            n = self.batch.num_rows
+            host = self.xp is np
+            codes = self.xp.zeros(n, dtype=np.int32)
+            return DeviceColumn(codes, "string", None, d,
+                                _split_hashes(_string_hash64(d),
+                                              device=not host))
+        if isinstance(e, E.Substr):
+            child = self.string_column(e.child)
+            if child is None:
+                raise HyperspaceException(
+                    f"SUBSTR over non-string expression: {e.child!r}")
+            return self._substr(child, e.start, e.length)
+        return None
+
+    def _substr(self, col: DeviceColumn, start: int,
+                length: int) -> DeviceColumn:
+        from hyperspace_tpu.io.columnar import (_split_hashes,
+                                                _string_hash64)
+        d = col.dictionary
+        sliced = np.array([v[start - 1:start - 1 + length] for v in d])
+        new_dict, inverse = np.unique(sliced, return_inverse=True)
+        remap = inverse.astype(np.int32)
+        if col.is_host:
+            codes = remap[np.asarray(col.data)]
+        else:
+            import jax.numpy as jnp
+            codes = jnp.take(jnp.asarray(remap), col.data)
+        hashes = _split_hashes(_string_hash64(new_dict),
+                               device=not col.is_host)
+        return DeviceColumn(codes, "string", col.validity, new_dict, hashes)
+
+    def value_column(self, e: E.Expression, out_dtype: str) -> DeviceColumn:
+        """Evaluate a value expression to a full DeviceColumn of the given
+        logical dtype (the projection entry point)."""
+        from hyperspace_tpu.io.columnar import HOST_NP_DTYPES
+        s = self.string_column(e)
+        if s is not None:
+            if out_dtype != "string":
+                raise HyperspaceException(
+                    f"Expression {e!r} is string-valued; expected "
+                    f"{out_dtype}.")
+            return s
+        data, validity = self.value(e)
+        np_dtype = HOST_NP_DTYPES[out_dtype]
+        data = self.xp.asarray(data)
+        if data.ndim == 0:  # literal broadcast
+            data = self.xp.full(self.batch.num_rows, data)
+        return DeviceColumn(data.astype(np_dtype), out_dtype,
+                            validity=validity)
 
     @staticmethod
     def _merge_validity(a, b):
@@ -190,27 +260,45 @@ class ExpressionCompiler:
 
     def _comparison(self, e):
         op = type(e).op
-        lcol = self._column_of(e.left)
-        rcol = self._column_of(e.right)
-        # string column vs string literal -> code-space range test
-        if lcol is not None and lcol.is_string and isinstance(e.right, E.Literal):
-            mask = _string_literal_compare(op, lcol, str(e.right.value),
+        ls = (None if isinstance(e.left, E.Literal)
+              else self.string_column(e.left))
+        rs = (None if isinstance(e.right, E.Literal)
+              else self.string_column(e.right))
+        # string expression vs string literal -> code-space range test
+        if ls is not None and isinstance(e.right, E.Literal):
+            mask = _string_literal_compare(op, ls, str(e.right.value),
                                            self.xp)
-            return self._with_validity(mask, lcol.validity, None)
-        if rcol is not None and rcol.is_string and isinstance(e.left, E.Literal):
+            return self._with_validity(mask, ls.validity, None)
+        if rs is not None and isinstance(e.left, E.Literal):
             flipped = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
                        "eq": "eq", "ne": "ne"}[op]
-            mask = _string_literal_compare(flipped, rcol,
+            mask = _string_literal_compare(flipped, rs,
                                            str(e.left.value), self.xp)
-            return self._with_validity(mask, rcol.validity, None)
-        if (lcol is not None and lcol.is_string) or (rcol is not None and rcol.is_string):
+            return self._with_validity(mask, rs.validity, None)
+        if ls is not None and rs is not None:
+            # String col-to-col compare: remap both onto one merged sorted
+            # dictionary, then compare codes (order-preserving).
+            lc, rc = self._unified_codes(ls, rs)
+            mask = getattr(self.xp.asarray(lc), _CMP[op])(rc)
+            return self._with_validity(mask, ls.validity, rs.validity)
+        if ls is not None or rs is not None:
             raise HyperspaceException(
-                "String column-to-column comparison is not supported in "
-                "filters; use a join.")
+                f"Cannot compare a string expression with a non-string "
+                f"operand: {e!r}")
         lv, lval = self.value(e.left)
         rv, rval = self.value(e.right)
         mask = getattr(self.xp.asarray(lv), _CMP[op])(rv)
         return self._with_validity(mask, lval, rval)
+
+    def _unified_codes(self, a: DeviceColumn, b: DeviceColumn):
+        from hyperspace_tpu.io.columnar import _merged_dictionary
+        host = self.xp is np
+        _, (ra, rb), _ = _merged_dictionary([a.dictionary, b.dictionary],
+                                            device=not host)
+        if host:
+            return ra[np.asarray(a.data)], rb[np.asarray(b.data)]
+        import jax.numpy as jnp
+        return jnp.take(ra, a.data), jnp.take(rb, b.data)
 
     @staticmethod
     def _with_validity(mask, lval, rval):

@@ -37,15 +37,39 @@ class DataFrame:
         return DataFrame(Filter(condition, self.plan), self.session)
 
     where = filter
+    # HAVING is a filter over an aggregate's output (SQL surface parity);
+    # the engine plans it as FilterExec(AggregateExec(...)).
+    having = filter
 
-    def select(self, *columns: str) -> "DataFrame":
+    def select(self, *columns) -> "DataFrame":
+        """Projection. Entries are column names or named expressions:
+        `df.select("a", (col("x") * col("y")).alias("xy"))`."""
         names = [c for col in columns
                  for c in (col if isinstance(col, (list, tuple)) else [col])]
         return DataFrame(Project(names, self.plan), self.session)
 
+    def with_column(self, name: str, expression: E.Expression) -> "DataFrame":
+        """Append a computed column; replacing an existing one keeps its
+        position (Spark withColumn semantics)."""
+        alias = E.Alias(expression, name)
+        entries: list = []
+        replaced = False
+        for c in self.schema.names:
+            if c.lower() == name.lower():
+                entries.append(alias)
+                replaced = True
+            else:
+                entries.append(c)
+        if not replaced:
+            entries.append(alias)
+        return DataFrame(Project(entries, self.plan), self.session)
+
     def join(self, other: "DataFrame",
              on: Union[E.Expression, str, Sequence[str]],
              how: str = "inner") -> "DataFrame":
+        how = {"semi": "left_semi", "anti": "left_anti",
+               "left": "left_outer", "right": "right_outer",
+               "full": "full_outer", "outer": "full_outer"}.get(how, how)
         if isinstance(on, str):
             on = [on]
         if isinstance(on, (list, tuple)):
@@ -130,10 +154,17 @@ class GroupedData:
         for spec in specs:
             if not isinstance(spec, (tuple, list)) or len(spec) not in (2, 3):
                 raise HyperspaceException(
-                    "Aggregation spec must be (func, column[, alias]).")
+                    "Aggregation spec must be (func, column[, alias]); the "
+                    "column may be a name or a value Expression.")
             func, column = spec[0], spec[1]
-            alias = spec[2] if len(spec) == 3 else (
-                f"{func}_{column}" if column != "*" else func)
+            if len(spec) == 3:
+                alias = spec[2]
+            elif isinstance(column, E.Expression):
+                raise HyperspaceException(
+                    "Expression aggregations need an explicit alias: "
+                    "(func, expr, alias).")
+            else:
+                alias = f"{func}_{column}" if column != "*" else func
             parsed.append(AggSpec(func, column, alias))
         for alias, spec in named.items():
             if not isinstance(spec, (tuple, list)) or len(spec) != 2:

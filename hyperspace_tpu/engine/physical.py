@@ -231,25 +231,60 @@ class FilterExec(PhysicalNode):
 
 
 class ProjectExec(PhysicalNode):
+    """Projection over (out_name, source) entries, where source is a plain
+    child column name (pass-through) or a value Expression compiled by the
+    same XLA-fused compiler filters use. Computed entries preserve row
+    order, so the bucketed contract (batch + lengths) carries through."""
+
     name = "Project"
 
-    def __init__(self, columns: Sequence[str], child: PhysicalNode):
-        self.columns = list(columns)
+    def __init__(self, entries, child: PhysicalNode):
+        # Accept bare name strings (pass-through) or (out_name, source)
+        # pairs; `source` is a child column name or an Expression.
+        self.entries = [(e, e) if isinstance(e, str) else (e[0], e[1])
+                        for e in entries]
         self.child = child
+
+    @property
+    def columns(self) -> List[str]:
+        """Output names (the view older callers and the plan display use)."""
+        return [name for name, _ in self.entries]
 
     @property
     def children(self):
         return [self.child]
 
     def simple_string(self) -> str:
-        return f"Project [{', '.join(self.columns)}]"
+        parts = [name if isinstance(src, str) and src == name
+                 else f"{src!r} AS {name}" for name, src in self.entries]
+        return f"Project [{', '.join(parts)}]"
+
+    def _project(self, batch: columnar.ColumnBatch) -> columnar.ColumnBatch:
+        if all(isinstance(src, str) for _, src in self.entries):
+            return batch.select([src for _, src in self.entries])
+        from hyperspace_tpu.engine.compiler import ExpressionCompiler
+        from hyperspace_tpu.plan.expr import infer_dtype
+        from hyperspace_tpu.plan.schema import Field
+        compiler = ExpressionCompiler(batch)
+        fields: List[Field] = []
+        columns = {}
+        for name, src in self.entries:
+            if isinstance(src, str):
+                f = batch.schema.field(src)
+                columns[name] = batch.column(src)
+                fields.append(Field(name, f.dtype, f.nullable))
+            else:
+                dtype = infer_dtype(src, batch.schema)
+                columns[name] = compiler.value_column(src, dtype)
+                fields.append(Field(name, dtype, True))
+        return columnar.ColumnBatch(Schema(fields), columns)
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
-        return self.child.execute(bucket).select(self.columns)
+        return self._project(self.child.execute(bucket))
 
     def execute_bucketed(self, num_buckets: int):
         batch, lengths = self.child.execute_bucketed(num_buckets)
-        return batch.select(self.columns), lengths
+        return self._project(batch), lengths
 
 
 class ExchangeExec(PhysicalNode):
@@ -377,10 +412,37 @@ class AggregateExec(PhysicalNode):
         aggs = ", ".join(f"{a.func}({a.column})" for a in self.aggregates)
         return f"Aggregate [{', '.join(self.group_columns)}] [{aggs}]"
 
+    def _materialize_inputs(self, batch: columnar.ColumnBatch):
+        """Evaluate expression aggregation inputs (sum(x*y)) into temp
+        columns so the segment reducers see plain columns; returns
+        (augmented batch, rewritten specs)."""
+        from hyperspace_tpu.plan.nodes import AggSpec
+        if not any(getattr(s, "is_expression", False)
+                   for s in self.aggregates):
+            return batch, self.aggregates
+        from hyperspace_tpu.engine.compiler import ExpressionCompiler
+        from hyperspace_tpu.plan.expr import infer_dtype
+        from hyperspace_tpu.plan.schema import Field
+        compiler = ExpressionCompiler(batch)
+        fields = list(batch.schema.fields)
+        columns = dict(batch.columns)
+        specs = []
+        for i, spec in enumerate(self.aggregates):
+            if not spec.is_expression:
+                specs.append(spec)
+                continue
+            dtype = infer_dtype(spec.column, batch.schema)
+            name = f"__agg_in_{i}"
+            columns[name] = compiler.value_column(spec.column, dtype)
+            fields.append(Field(name, dtype, True))
+            specs.append(AggSpec(spec.func, name, spec.alias))
+        return columnar.ColumnBatch(Schema(fields), columns), specs
+
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         from hyperspace_tpu.ops.aggregate import group_aggregate
         from hyperspace_tpu.parallel.context import should_distribute
         batch = self.child.execute(bucket)
+        batch, specs = self._materialize_inputs(batch)
         mesh = None
         if self.group_columns and batch.num_rows > 0:
             mesh = should_distribute(self.conf, batch.num_rows,
@@ -389,9 +451,9 @@ class AggregateExec(PhysicalNode):
             from hyperspace_tpu.parallel.aggregate import (
                 distributed_group_aggregate)
             return distributed_group_aggregate(batch, self.group_columns,
-                                               self.aggregates,
+                                               specs,
                                                self.out_schema, mesh)
-        return group_aggregate(batch, self.group_columns, self.aggregates,
+        return group_aggregate(batch, self.group_columns, specs,
                                self.out_schema)
 
 
@@ -514,6 +576,18 @@ class SortMergeJoinExec(PhysicalNode):
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         from hyperspace_tpu.ops.join import sort_merge_join
+        if self.how in ("left_semi", "left_anti"):
+            # Membership joins: no expansion, no output from the right —
+            # one encode + searchsorted bracket per left row, then a
+            # single left-side gather. (No Exchange/Sort wrappers: the
+            # planner builds semi/anti sides bare.)
+            from hyperspace_tpu.ops.join import semi_anti_indices
+            lbatch = self.left.execute(bucket)
+            rbatch = self.right.execute(bucket)
+            idx = semi_anti_indices(lbatch, rbatch, self.left_keys,
+                                    self.right_keys,
+                                    anti=self.how == "left_anti")
+            return lbatch.take(idx)
         if self.bucketed:
             # Co-partitioned bucket joins, batched into ONE compiled program
             # (`ops/bucketed_join.py`): zero shuffle, zero global sort, no
@@ -865,15 +939,22 @@ def _plan_physical_node(plan: LogicalPlan,
         return FilterExec(plan.condition, child, conf=conf)
 
     if isinstance(plan, Project):
-        child = _plan_physical(plan.child, set(plan.columns), conf, ctx)
-        # Resolve names against the child schema but KEEP the declared order.
-        resolved = [plan.child.schema.field(c).name for c in plan.columns]
-        return ProjectExec(resolved, child)
+        child = _plan_physical(plan.child, plan.references(), conf, ctx)
+        # Resolve names against the child schema but KEEP the declared
+        # order; computed entries carry their expression.
+        entries = []
+        for c in plan.columns:
+            if isinstance(c, str):
+                f = plan.child.schema.field(c)
+                entries.append((f.name, f.name))
+            else:
+                entries.append((c.name, c.child))
+        return ProjectExec(entries, child)
 
     if isinstance(plan, Aggregate):
-        child_required = (set(plan.group_columns)
-                          | {a.column for a in plan.aggregates
-                             if a.column != "*"})
+        child_required = set(plan.group_columns)
+        for a in plan.aggregates:
+            child_required |= a.references()
         return AggregateExec(plan.group_columns, plan.aggregates,
                              plan.schema,
                              _plan_physical(plan.child, child_required,
@@ -897,16 +978,26 @@ def _plan_physical_node(plan: LogicalPlan,
         # (index schema vs source schema): normalize through a Project.
         wanted = _required_for(plan, required)
         return UnionExec([
-            ProjectExec([c.schema.field(n).name for n in wanted],
+            ProjectExec([(c.schema.field(n).name, c.schema.field(n).name)
+                         for n in wanted],
                         _plan_physical(c, set(wanted), conf, ctx))
             for c in plan.children])
 
     if isinstance(plan, Join):
-        if plan.join_type not in ("inner", "left_outer", "right_outer"):
-            raise HyperspaceException(
-                f"Join type {plan.join_type} not yet supported by the executor.")
         left_keys, right_keys = _join_keys(plan.condition, plan.left.schema,
                                            plan.right.schema)
+        if plan.join_type in ("left_semi", "left_anti"):
+            # Membership join: the right side contributes only its keys,
+            # and no Exchange/Sort wrapping is needed (the executor's
+            # searchsorted membership probe sorts nothing but ids).
+            left_required = ({n for n in required
+                              if plan.left.schema.contains(n)}
+                             | set(left_keys))
+            return SortMergeJoinExec(
+                _plan_physical(plan.left, left_required, conf, ctx),
+                _plan_physical(plan.right, set(right_keys), conf, ctx),
+                left_keys, right_keys, bucketed=False,
+                how=plan.join_type, conf=conf)
         left_required = ({n for n in required if plan.left.schema.contains(n)}
                          | set(left_keys))
         right_required = ({n for n in required if plan.right.schema.contains(n)}

@@ -311,25 +311,57 @@ def compact_index(prev_entry, data_manager, out_path: str) -> List[str]:
     """Merge-compact the current data version's runs (base + incremental
     delta runs living side by side in one `v__=N` dir) into one
     fully-sorted file per bucket at `out_path` (OptimizeAction's op; the
-    reference has no compaction — its roadmap item, exceeded here)."""
-    from hyperspace_tpu.ops.sort import sort_batch
+    reference has no compaction — its roadmap item,
+    `/root/reference/ROADMAP.md:66-75`, exceeded here).
+
+    All buckets compact through ONE compiled program (`ops/merge.py`):
+    every bucket's runs are batch-sorted on a padded [B, L] bucket axis,
+    only key lanes cross the link, and the host streams the permuted
+    payload out per bucket — no per-bucket compile, no per-bucket sync.
+    Below the device-amortization row count the permutation comes from a
+    host lexsort with identical layout semantics.
+    """
+    from hyperspace_tpu.ops.merge import (bucket_sort_permutation,
+                                          host_bucket_sort_permutation)
 
     indexed = prev_entry.indexed_columns
     num_buckets = prev_entry.num_buckets
     per_bucket = dict(parquet.bucket_files(prev_entry.content.root))
     if not per_bucket:
         raise HyperspaceException("No index data files found to compact.")
-    schema = None
-    written: List[str] = []
-    os.makedirs(out_path, exist_ok=True)
-    for bucket in sorted(per_bucket):
-        table = parquet.read_table(per_bucket[bucket])
-        batch = columnar.from_arrow(table)
-        schema = batch.schema
-        merged = sort_batch(batch, indexed)
-        out = os.path.join(out_path, parquet.bucket_file_name(bucket))
-        parquet.write_table(columnar.to_arrow(merged), out)
-        written.append(out)
+    # ONE ordered read of every run, bucket-major, VERSION order within a
+    # bucket: base runs (no delta suffix, chunk suffixes keep name order)
+    # then delta runs by delta number — so equal keys keep their append
+    # order and the stable sort reproduces the tie order a full rebuild
+    # over (base files + appended files) produces.
+    import re as _re
+
+    def _run_order(path: str):
+        name = os.path.basename(path)
+        m = _re.search(r"-delta(\d+)", name)
+        return (int(m.group(1)) if m else 0, name)
+
+    ordered = [(b, f) for b in sorted(per_bucket)
+               for f in sorted(per_bucket[b], key=_run_order)]
+    counts = parquet.file_row_counts([f for _, f in ordered])
+    lengths = np.zeros(num_buckets, dtype=np.int64)
+    for (b, _), c in zip(ordered, counts):
+        lengths[b] += c
+    table = parquet.read_table([f for _, f in ordered])
+    from hyperspace_tpu.plan.schema import Schema
+    schema = Schema.from_arrow(table.schema)
+
+    names = [schema.field(c).name for c in indexed]
+    if table.num_rows < BUILD_MIN_DEVICE_ROWS:
+        key_batch = columnar.from_arrow(table.select(names), device=False)
+        chunks, starts, ends = host_bucket_sort_permutation(
+            key_batch, names, lengths)
+    else:
+        key_batch = columnar.from_arrow(table.select(names))
+        chunks, starts, ends = bucket_sort_permutation(key_batch, names,
+                                                       lengths)
+    written = _write_sorted_runs(table, chunks, starts, ends, out_path,
+                                 file_suffix=None)
     spec = BucketSpec(num_buckets, tuple(indexed), tuple(indexed))
     parquet.write_bucket_spec(out_path, spec, schema)
     return written

@@ -326,13 +326,30 @@ def bucketed_sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                              left_keys: Sequence[str],
                              right_keys: Sequence[str],
                              how: str = "inner") -> ColumnBatch:
-    """Full bucketed join over concat-in-bucket-order sides."""
+    """Full bucketed join over concat-in-bucket-order sides. full_outer =
+    the left_outer expansion plus one appended row per unmatched right
+    row (both sides share one hash layout, so membership is global)."""
     if how == "right_outer":
         ri, li = bucketed_join_indices(right, left, np.asarray(r_lengths),
                                        np.asarray(l_lengths), right_keys,
                                        left_keys, how="left_outer")
     else:
-        li, ri = bucketed_join_indices(left, right, np.asarray(l_lengths),
-                                       np.asarray(r_lengths), left_keys,
-                                       right_keys, how=how)
+        li, ri = bucketed_join_indices(
+            left, right, np.asarray(l_lengths), np.asarray(r_lengths),
+            left_keys, right_keys,
+            how="left_outer" if how == "full_outer" else how)
+        if how == "full_outer":
+            # Unmatched right rows come straight from the match indices —
+            # no key re-encode (a matched right row always appears in ri).
+            from hyperspace_tpu.ops.join import unmatched_right_from_indices
+            extra = unmatched_right_from_indices(ri, right.num_rows)
+            if isinstance(ri, np.ndarray):
+                li = np.concatenate(
+                    [li, np.full(len(extra), -1, dtype=np.int32)])
+                ri = np.concatenate([ri, extra])
+            else:
+                import jax.numpy as jnp
+                li = jnp.concatenate(
+                    [li, jnp.full(extra.shape[0], -1, dtype=jnp.int32)])
+                ri = jnp.concatenate([ri, extra])
     return assemble_join_output(left, right, li, ri, how=how)

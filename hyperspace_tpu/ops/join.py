@@ -73,14 +73,90 @@ def merge_join_indices(left_ids, right_ids, how: str = "inner") -> Tuple:
     return left_idx.astype(jnp.int32), right_idx.astype(jnp.int32)
 
 
+def unmatched_right_from_indices(ri, num_right: int):
+    """Right-row indices absent from a join's right index vector `ri` —
+    the rows a FULL OUTER join appends after its left_outer expansion.
+    Derived by scatter from the ALREADY-COMPUTED match indices, so the
+    keys are never re-encoded. Works on host (numpy) and device arrays;
+    the device path costs one host sync to size the output."""
+    import numpy as np_
+
+    if isinstance(ri, np_.ndarray):
+        matched = np_.zeros(num_right, dtype=bool)
+        hit = ri[ri >= 0]
+        matched[hit] = True
+        return np_.nonzero(~matched)[0].astype(np_.int32)
+    import jax.numpy as jnp
+
+    hit = ri >= 0
+    matched = jnp.zeros(num_right, dtype=bool).at[
+        jnp.where(hit, ri, 0)].max(hit)
+    count = int(jnp.sum(~matched))  # host sync
+    if count == 0:
+        return jnp.zeros(0, dtype=jnp.int32)
+    (idx,) = jnp.nonzero(~matched, size=count, fill_value=0)
+    return idx.astype(jnp.int32)
+
+
+def semi_anti_indices(left: ColumnBatch, right: ColumnBatch,
+                      left_keys: Sequence[str], right_keys: Sequence[str],
+                      anti: bool = False):
+    """Left-row indices for LEFT SEMI (has >= 1 match) or LEFT ANTI
+    (NOT EXISTS: no match; null-key left rows are emitted) joins. Host
+    batches compute in numpy; device batches in one XLA program + one
+    host sync."""
+    import numpy as np_
+
+    if left.num_rows == 0:
+        return np_.zeros(0, dtype=np_.int32)
+    if left.is_host and right.is_host:
+        if right.num_rows == 0:
+            matched = np_.zeros(left.num_rows, dtype=bool)
+        else:
+            packed = _packed_keys(left, right, left_keys, right_keys)
+            if packed is not None:
+                lv, rv = packed
+                rs = np_.sort(rv)
+                matched = (np_.searchsorted(rs, lv, side="left")
+                           < np_.searchsorted(rs, lv, side="right"))
+            else:
+                l_ids, r_ids = _host_encode_join_keys(
+                    left, right, left_keys, right_keys)
+                rs = np_.sort(r_ids)
+                matched = (np_.searchsorted(rs, l_ids, side="left")
+                           < np_.searchsorted(rs, l_ids, side="right"))
+        mask = ~matched if anti else matched
+        return np_.nonzero(mask)[0].astype(np_.int32)
+    import jax.numpy as jnp
+
+    if right.num_rows == 0:
+        if anti:
+            return jnp.arange(left.num_rows, dtype=jnp.int32)
+        return jnp.zeros(0, dtype=jnp.int32)
+    l_ids, r_ids = encode_join_keys(left, right, left_keys, right_keys)
+    rs = jnp.sort(r_ids)
+    matched = (jnp.searchsorted(rs, l_ids, side="left")
+               < jnp.searchsorted(rs, l_ids, side="right"))
+    mask = ~matched if anti else matched
+    count = int(jnp.sum(mask))  # host sync
+    if count == 0:
+        return jnp.zeros(0, dtype=jnp.int32)
+    (idx,) = jnp.nonzero(mask, size=count, fill_value=0)
+    return idx.astype(jnp.int32)
+
+
 def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                     left_keys: Sequence[str], right_keys: Sequence[str],
                     presorted: bool = False, how: str = "inner"):
-    """Join of two batches on equi-keys (inner / left_outer / right_outer).
+    """Join of two batches on equi-keys (inner / left_outer / right_outer
+    / full_outer).
 
     If `presorted` is False, both sides are sorted by their group ids first
     (the plain path); bucketed index scans pass presorted=True and skip the
     sort — the observable saving the rewrite rules buy.
+
+    full_outer = the left_outer expansion plus one appended row per
+    unmatched right row (the index-pair machinery both outer sides share).
 
     Output column names are left's then right's; duplicate names get a
     `_r` suffix on the right.
@@ -92,12 +168,19 @@ def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
     if left.is_host and right.is_host:
         # Adaptive host lane: both sides host-resident (small reads) —
         # the whole join runs in numpy, no device round-trips.
+        import numpy as np_
         if how == "right_outer":
             ri, li = host_join_indices(right, left, right_keys, left_keys,
                                        how="left_outer")
         else:
-            li, ri = host_join_indices(left, right, left_keys, right_keys,
-                                       how=how)
+            li, ri = host_join_indices(
+                left, right, left_keys, right_keys,
+                how="left_outer" if how == "full_outer" else how)
+            if how == "full_outer":
+                extra = unmatched_right_from_indices(ri, right.num_rows)
+                li = np_.concatenate(
+                    [li, np_.full(len(extra), -1, dtype=np_.int32)])
+                ri = np_.concatenate([ri, extra])
         return assemble_join_output(left, right, li, ri, how=how)
 
     l_ids, r_ids = encode_join_keys(left, right, left_keys, right_keys)
@@ -111,7 +194,13 @@ def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
     if how == "right_outer":
         ri, li = merge_join_indices(r_ids, l_ids, how="left_outer")
     else:
-        li, ri = merge_join_indices(l_ids, r_ids, how=how)
+        li, ri = merge_join_indices(
+            l_ids, r_ids, how="left_outer" if how == "full_outer" else how)
+        if how == "full_outer":
+            extra = unmatched_right_from_indices(ri, right.num_rows)
+            li = jnp.concatenate(
+                [li, jnp.full(extra.shape[0], -1, dtype=jnp.int32)])
+            ri = jnp.concatenate([ri, extra])
     return assemble_join_output(left, right, li, ri, how=how)
 
 

@@ -1,0 +1,161 @@
+"""Device k-way merge-compaction: every bucket's runs, ONE compiled program.
+
+OptimizeAction compacts the base + incremental delta runs living side by
+side in one `v__=N` dir into a single fully-sorted file per bucket
+(reference roadmap `/root/reference/ROADMAP.md:66-75` — the surveyed
+reference has only full rebuild). The naive implementation loops buckets in
+Python and re-sorts each on the device — one fresh XLA compile per novel
+bucket shape (tens of seconds on a remote-compile TPU toolchain) and a
+blocking sync per bucket.
+
+Here compaction is ONE batched program over a padded [B, L] layout, the
+same trick the bucketed join uses (`ops/bucketed_join.py`):
+
+1. key columns decompose into order-preserving 32-bit lanes
+   (`ops/keys.py`) — already staged on device;
+2. each bucket's rows (its runs concatenated in file order) are gathered
+   into a [B, L] matrix, L = next power of two of the largest bucket so
+   repeated compactions reuse the compile; padding slots carry a trailing
+   pad flag that sorts last;
+3. one batched stable `lax.sort` along the row axis orders every bucket at
+   once;
+4. the per-bucket orderings are flattened back into a single global row
+   permutation, split into link-overlap chunks for the D2H fetch.
+
+Why a batched SORT rather than a literal k-way merge loop: on TPU,
+`lax.sort` IS the merge primitive — a data-dependent heap/merge loop
+serializes on the scalar unit and defeats the VPU, while the bitonic-family
+batched sort runs fully vectorized across all buckets simultaneously. The
+asymptotic O(L log^2 L) vs O(L log k) trade buys one compile, zero scalar
+control flow, and bucket-parallel execution; the runs' pre-sortedness
+still helps (a stable sort over nearly-sorted lanes does minimal data
+movement in the final permutation application, which is where the real
+cost — the payload gather — lives, and that runs on the host in Arrow).
+
+The payload never touches the device (the `_perm_core` lesson,
+`ops/build.py`): only key lanes go over the link, and the host applies the
+permutation chunk-by-chunk while later chunks are still in flight.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import hyperspace_tpu._jax_config  # noqa: F401
+from hyperspace_tpu.ops import keys as keymod
+from hyperspace_tpu.ops.build import LINK_CHUNK_ROWS, LINK_CHUNKS
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(2, (int(n) - 1).bit_length())
+
+
+@partial(__import__("jax").jit, static_argnames=("n_chunks",))
+def _bucket_sort_core(lanes, l_idx, l_valid, flat_pick, n_chunks: int):
+    """Batched within-bucket sort permutation.
+
+    lanes: tuple of [N] 32-bit key lanes (validity leading when present);
+    l_idx/l_valid: [B, L] padded gather matrix + mask into the
+    concat-in-bucket-order row space; flat_pick: [N] int32 positions of the
+    valid cells in the row-major [B*L] flattening, in bucket order.
+    Returns the [N] row permutation split into n_chunks contiguous slices.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, L = l_idx.shape
+    pad = (~l_valid).astype(jnp.int32)  # 0 = real row, 1 = padding
+    operands = [pad]
+    for lane in lanes:
+        gathered = jnp.take(lane, l_idx)
+        # Padding rows ride the pad flag (leading key); their lane values
+        # are the safe-gather duplicates and never affect real ordering.
+        operands.append(gathered)
+    pos = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    results = jax.lax.sort([*operands, pos], num_keys=len(operands),
+                           is_stable=True, dimension=1)
+    pos_sorted = results[-1]
+    # original row index occupying sorted slot (b, j)
+    orig = jnp.take_along_axis(l_idx, pos_sorted, axis=1).reshape(-1)
+    perm = jnp.take(orig, flat_pick)
+    n = perm.shape[0]
+    base = n // n_chunks
+    chunks = tuple(
+        jax.lax.slice(perm, (i * base,),
+                      ((i + 1) * base if i < n_chunks - 1 else n,))
+        for i in range(n_chunks))
+    return chunks
+
+
+def _padded_layout(lengths: np.ndarray, width: int):
+    """[B, width] gather matrix + validity into a concat-in-bucket-order
+    row space (the `ops/bucketed_join.py` layout; padding slots point at a
+    real row for safe gathers)."""
+    starts = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+    j = np.arange(width)[None, :]
+    valid = j < lengths[:, None]
+    idx = np.where(valid, starts[:, None] + np.minimum(
+        j, np.maximum(lengths[:, None] - 1, 0)), 0)
+    return idx.astype(np.int32), valid
+
+
+def bucket_sort_permutation(key_batch, sort_columns: Sequence[str],
+                            lengths: np.ndarray) -> Tuple[List, np.ndarray,
+                                                          np.ndarray]:
+    """Permutation that sorts every bucket of a concat-in-bucket-order
+    batch by `sort_columns`, computed in ONE compiled program across all
+    buckets. `key_batch` needs only the key columns resident on device.
+
+    Returns (device perm chunks, starts, ends) shaped exactly like
+    `ops/build.build_permutation`, so `io/builder._write_sorted_runs`
+    consumes the result unchanged.
+    """
+    lengths = np.asarray(lengths, dtype=np.int64)
+    n = int(lengths.sum())
+    B = len(lengths)
+    L = next_pow2(max(1, int(lengths.max(initial=0))))
+
+    lanes: List = []
+    for name in sort_columns:
+        lanes.extend(keymod.column_sort_lanes(key_batch.column(name)))
+
+    l_idx, l_valid = _padded_layout(lengths, L)
+    # Valid-cell positions in the row-major [B*L] flattening, bucket order:
+    # after the in-row sort, the first lengths[b] slots of row b hold its
+    # sorted rows (padding sorts last).
+    row_base = np.repeat(np.arange(B, dtype=np.int64) * L, lengths)
+    within = np.concatenate([np.arange(c, dtype=np.int64)
+                             for c in lengths]) if n else np.zeros(
+                                 0, dtype=np.int64)
+    flat_pick = (row_base + within).astype(np.int32)
+
+    import jax.numpy as jnp
+    n_chunks = LINK_CHUNKS if n >= LINK_CHUNK_ROWS else 1
+    n_chunks = max(1, min(n_chunks, max(n, 1)))
+    chunks = _bucket_sort_core(tuple(lanes), jnp.asarray(l_idx),
+                               jnp.asarray(l_valid),
+                               jnp.asarray(flat_pick), n_chunks)
+    ends = np.cumsum(lengths)
+    starts = ends - lengths
+    return list(chunks), starts, ends
+
+
+def host_bucket_sort_permutation(key_batch, sort_columns: Sequence[str],
+                                 lengths: np.ndarray):
+    """Host (numpy) twin: stable lexsort keyed (bucket, *sort lanes) —
+    below the device-amortization row count a fresh XLA compile can never
+    pay for itself (`io/builder.BUILD_MIN_DEVICE_ROWS`)."""
+    lengths = np.asarray(lengths, dtype=np.int64)
+    bucket_of_row = np.repeat(np.arange(len(lengths), dtype=np.int64),
+                              lengths)
+    sort_keys: List = [bucket_of_row]
+    for name in sort_columns:
+        sort_keys.extend(keymod.host_column_sort_lanes(
+            key_batch.column(name)))
+    perm = np.lexsort(tuple(reversed(sort_keys))).astype(np.int64)
+    ends = np.cumsum(lengths)
+    starts = ends - lengths
+    return [perm], starts, ends

@@ -92,6 +92,21 @@ class Expression:
     def is_not_null(self) -> "IsNotNull":
         return IsNotNull(self)
 
+    def alias(self, name: str) -> "Alias":
+        """Name this expression as a projection output column:
+        `df.select(col("a"), (col("x") * col("y")).alias("xy"))`."""
+        return Alias(self, name)
+
+    def substr(self, start: int, length: int) -> "Substr":
+        """SQL SUBSTR(col, start, length) — 1-based start, on string
+        expressions."""
+        return Substr(self, start, length)
+
+    def between(self, low, high) -> "Expression":
+        """SQL BETWEEN: low <= self <= high (inclusive)."""
+        return And(GreaterThanOrEqual(self, _wrap(low)),
+                   LessThanOrEqual(self, _wrap(high)))
+
 
 def _wrap(value) -> "Expression":
     if isinstance(value, Expression):
@@ -239,6 +254,64 @@ class IsNotNull(_Unary):
     op = "is_not_null"
 
 
+class Alias(Expression):
+    """A named projection output (Spark's `Alias`). Only meaningful as a
+    top-level entry of a Project/select list."""
+
+    op = "alias"
+
+    def __init__(self, child: Expression, name: str):
+        if not isinstance(child, Expression):
+            raise HyperspaceException("alias() wraps an Expression.")
+        self.child = child
+        self.name = name
+
+    @property
+    def children(self) -> List[Expression]:
+        return [self.child]
+
+    def to_dict(self) -> dict:
+        return {"op": "alias", "name": self.name,
+                "child": self.child.to_dict()}
+
+    @staticmethod
+    def _from_dict(d: dict) -> "Alias":
+        return Alias(Expression.from_dict(d["child"]), d["name"])
+
+    def __repr__(self):
+        return f"({self.child!r} AS {self.name})"
+
+
+class Substr(Expression):
+    """SUBSTR(string expr, start, length); start is 1-based (SQL)."""
+
+    op = "substr"
+
+    def __init__(self, child: Expression, start: int, length: int):
+        if start < 1 or length < 0:
+            raise HyperspaceException(
+                "SUBSTR start is 1-based and length must be >= 0.")
+        self.child = child
+        self.start = int(start)
+        self.length = int(length)
+
+    @property
+    def children(self) -> List[Expression]:
+        return [self.child]
+
+    def to_dict(self) -> dict:
+        return {"op": "substr", "start": self.start, "length": self.length,
+                "child": self.child.to_dict()}
+
+    @staticmethod
+    def _from_dict(d: dict) -> "Substr":
+        return Substr(Expression.from_dict(d["child"]), d["start"],
+                      d["length"])
+
+    def __repr__(self):
+        return f"substr({self.child!r}, {self.start}, {self.length})"
+
+
 class In(Expression):
     def __init__(self, child: Expression, values: Sequence[Expression]):
         self.child = child
@@ -271,7 +344,53 @@ _REGISTRY: Dict[str, Any] = {
     "and": And, "or": Or, "not": Not,
     "add": Add, "sub": Sub, "mul": Mul, "div": Div,
     "is_null": IsNull, "is_not_null": IsNotNull, "in": In,
+    "alias": Alias, "substr": Substr,
 }
+
+
+_BOOL_OPS = (EqualTo, NotEqualTo, LessThan, LessThanOrEqual, GreaterThan,
+             GreaterThanOrEqual, And, Or, Not, IsNull, IsNotNull, In)
+
+
+def infer_dtype(expr: Expression, schema) -> str:
+    """Logical output dtype of a value expression against a child schema
+    (the typing rules the engine's compiler implements: ints accumulate as
+    int64, any float operand promotes to float64, Div always yields
+    float64)."""
+    if isinstance(expr, Alias):
+        return infer_dtype(expr.child, schema)
+    if isinstance(expr, Column):
+        return schema.field(expr.name).dtype
+    if isinstance(expr, Literal):
+        v = expr.value
+        if isinstance(v, bool):
+            return "bool"
+        if isinstance(v, int):
+            return "int64"
+        if isinstance(v, float):
+            return "float64"
+        if isinstance(v, str):
+            return "string"
+        raise HyperspaceException(f"Untyped literal: {v!r}")
+    if isinstance(expr, Substr):
+        if infer_dtype(expr.child, schema) != "string":
+            raise HyperspaceException("SUBSTR requires a string operand.")
+        return "string"
+    if isinstance(expr, Div):
+        return "float64"
+    if isinstance(expr, (Add, Sub, Mul)):
+        l = infer_dtype(expr.left, schema)
+        r = infer_dtype(expr.right, schema)
+        if "string" in (l, r):
+            raise HyperspaceException(
+                f"Arithmetic over string operands: {expr!r}")
+        floats = {"float32", "float64"}
+        if l in floats or r in floats:
+            return "float64"
+        return "int64"
+    if isinstance(expr, _BOOL_OPS):
+        return "bool"
+    raise HyperspaceException(f"Cannot infer dtype of: {expr!r}")
 
 
 def col(name: str) -> Column:

@@ -205,19 +205,66 @@ class Filter(LogicalPlan):
 
 
 class Project(LogicalPlan):
-    def __init__(self, columns: Sequence[str], child: LogicalPlan):
-        self.columns = list(columns)
+    """Projection. Entries are plain column names (pass-through) or
+    `Alias(expr, name)` computed columns — the reference rides Catalyst's
+    `Project(projectList: Seq[NamedExpression], ...)`; this engine
+    evaluates computed entries with the same XLA-fused compiler filters
+    use (`engine/compiler.py`)."""
+
+    def __init__(self, columns: Sequence, child: LogicalPlan):
+        from hyperspace_tpu.plan.expr import Alias, Expression
+        entries = []
+        for c in columns:
+            if isinstance(c, str) or isinstance(c, Alias):
+                entries.append(c)
+            elif isinstance(c, Expression):
+                raise HyperspaceException(
+                    f"Projection expression needs a name: use "
+                    f".alias(...) on {c!r}.")
+            else:
+                raise HyperspaceException(f"Bad projection entry: {c!r}")
+        self.columns = entries
         self.child = child
 
     @property
     def children(self) -> List[LogicalPlan]:
         return [self.child]
 
+    def output_names(self) -> List[str]:
+        return [c if isinstance(c, str) else c.name for c in self.columns]
+
+    def references(self) -> set:
+        """Source column names this projection reads (plain entries
+        reference themselves)."""
+        out: set = set()
+        for c in self.columns:
+            if isinstance(c, str):
+                out.add(c)
+            else:
+                out |= c.references()
+        return out
+
+    def is_simple(self) -> bool:
+        """True when every entry is a plain column name (the shape the
+        rewrite rules and bucketed chains reason about)."""
+        return all(isinstance(c, str) for c in self.columns)
+
     @property
     def schema(self) -> Schema:
         memo = self.__dict__.get("_schema_memo")
         if memo is None:
-            memo = self.__dict__["_schema_memo"] =                 self.child.schema.select(self.columns)
+            from hyperspace_tpu.plan.expr import infer_dtype
+            from hyperspace_tpu.plan.schema import Field
+            fields = []
+            for c in self.columns:
+                if isinstance(c, str):
+                    fields.append(self.child.schema.field(c))
+                else:
+                    fields.append(Field(c.name,
+                                        infer_dtype(c.child,
+                                                    self.child.schema),
+                                        True))
+            memo = self.__dict__["_schema_memo"] = Schema(fields)
         return memo
 
     def with_children(self, children):
@@ -225,11 +272,14 @@ class Project(LogicalPlan):
         return Project(self.columns, child)
 
     def to_dict(self) -> dict:
-        return {"node": "project", "columns": list(self.columns),
+        return {"node": "project",
+                "columns": [c if isinstance(c, str) else c.to_dict()
+                            for c in self.columns],
                 "child": self.child.to_dict()}
 
     def simple_string(self) -> str:
-        return f"Project [{', '.join(self.columns)}]"
+        parts = [c if isinstance(c, str) else repr(c) for c in self.columns]
+        return f"Project [{', '.join(parts)}]"
 
 
 _AGG_FUNCS = ("sum", "count", "min", "max", "avg", "stddev")
@@ -237,22 +287,45 @@ _AGG_FUNCS = ("sum", "count", "min", "max", "avg", "stddev")
 
 @dataclass(frozen=True)
 class AggSpec:
-    """One aggregation: func over column (column "*" for count(*))."""
+    """One aggregation: func over an input (a column name, "*" for
+    count(*), or a value Expression — e.g. sum(x * y))."""
 
     func: str
-    column: str
+    column: object  # str | Expression
     alias: str
 
     def __post_init__(self):
         if self.func not in _AGG_FUNCS:
             raise HyperspaceException(f"Unsupported aggregate: {self.func}")
 
+    @property
+    def is_expression(self) -> bool:
+        from hyperspace_tpu.plan.expr import Expression
+        return isinstance(self.column, Expression)
+
+    def references(self) -> set:
+        if self.is_expression:
+            return self.column.references()
+        return set() if self.column == "*" else {self.column}
+
+    def input_dtype(self, child_schema) -> str:
+        from hyperspace_tpu.plan.expr import infer_dtype
+        if self.is_expression:
+            return infer_dtype(self.column, child_schema)
+        return child_schema.field(self.column).dtype
+
     def to_dict(self) -> dict:
-        return {"func": self.func, "column": self.column, "alias": self.alias}
+        column = (self.column.to_dict() if self.is_expression
+                  else self.column)
+        return {"func": self.func, "column": column, "alias": self.alias}
 
     @staticmethod
     def from_dict(d: dict) -> "AggSpec":
-        return AggSpec(d["func"], d["column"], d["alias"])
+        from hyperspace_tpu.plan.expr import Expression
+        column = d["column"]
+        if isinstance(column, dict):
+            column = Expression.from_dict(column)
+        return AggSpec(d["func"], column, d["alias"])
 
 
 class Aggregate(LogicalPlan):
@@ -283,11 +356,11 @@ class Aggregate(LogicalPlan):
             elif spec.func in ("avg", "stddev"):
                 dtype = "float64"
             elif spec.func == "sum":
-                src = self.child.schema.field(spec.column).dtype
+                src = spec.input_dtype(self.child.schema)
                 dtype = ("float64" if src in ("float32", "float64")
                          else "int64")
             else:  # min/max keep the input type
-                dtype = self.child.schema.field(spec.column).dtype
+                dtype = spec.input_dtype(self.child.schema)
             fields.append(Field(spec.alias, dtype, True))
         return Schema(fields)
 
@@ -412,10 +485,14 @@ class Union(LogicalPlan):
         return f"Union ({len(self._children)} children)"
 
 
+_JOIN_TYPES = ("inner", "left_outer", "right_outer", "full_outer",
+               "left_semi", "left_anti")
+
+
 class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  condition: Expression, join_type: str = "inner"):
-        if join_type not in ("inner", "left_outer", "right_outer", "full_outer"):
+        if join_type not in _JOIN_TYPES:
             raise HyperspaceException(f"Unsupported join type: {join_type}")
         self.left = left
         self.right = right
@@ -430,9 +507,12 @@ class Join(LogicalPlan):
     def schema(self) -> Schema:
         """Left fields then right fields; duplicate names get a `_r` suffix
         on the right (matching the executor's output); outer joins make the
-        nullable side's fields nullable. Memoized — nodes are immutable,
-        and deep query trees re-ask for ancestor schemas repeatedly."""
+        nullable side's fields nullable; semi/anti joins output the left
+        side only. Memoized — nodes are immutable, and deep query trees
+        re-ask for ancestor schemas repeatedly."""
         from hyperspace_tpu.plan.schema import Field as SchemaField
+        if self.join_type in ("left_semi", "left_anti"):
+            return self.left.schema
         fields = list(self.left.schema.fields)
         left_names = {f.name.lower() for f in fields}
         if self.join_type in ("right_outer", "full_outer"):

@@ -47,8 +47,10 @@ class FilterIndexRule(Rule):
             return node  # already an index scan
 
         filter_columns = sorted(filt.condition.references())
-        project_columns = (list(project.columns) if project is not None
-                           else scan.schema.names)
+        # Coverage is judged on the SOURCE columns a projection reads —
+        # computed entries (Alias expressions) contribute their references.
+        project_columns = (sorted(project.references())
+                           if project is not None else scan.schema.names)
 
         index = self._find_covering_index(filt, scan, project_columns,
                                           filter_columns)

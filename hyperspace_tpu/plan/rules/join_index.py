@@ -118,18 +118,39 @@ class JoinIndexRule(Rule):
 
     @staticmethod
     def _referenced_columns(plan: LogicalPlan) -> List[str]:
-        """All columns the side needs: its output plus every expression
-        reference inside (reference `:446-457`)."""
-        needed = {n.lower() for n in plan.schema.names}
+        """BASE-relation columns the side needs (reference `:446-457`):
+        the output resolved top-down through projections — computed
+        entries contribute their references, not their alias names — plus
+        every filter/sort/aggregate reference along the chain."""
+        from hyperspace_tpu.plan.nodes import (Aggregate, Filter as FilterNode,
+                                               Limit, Project as ProjectNode,
+                                               Scan as ScanNode, Sort,
+                                               sort_direction)
 
-        def visit(node: LogicalPlan) -> LogicalPlan:
-            from hyperspace_tpu.plan.nodes import Filter as FilterNode
+        def walk(node: LogicalPlan, required: set) -> set:
+            if isinstance(node, ScanNode):
+                return {r.lower() for r in required}
             if isinstance(node, FilterNode):
-                needed.update(c.lower() for c in node.condition.references())
-            return node
+                return walk(node.child,
+                            set(required) | node.condition.references())
+            if isinstance(node, ProjectNode):
+                return walk(node.child, node.references())
+            if isinstance(node, Aggregate):
+                req = set(node.group_columns)
+                for a in node.aggregates:
+                    req |= a.references()
+                return walk(node.child, req)
+            if isinstance(node, Sort):
+                return walk(node.child, set(required)
+                            | {sort_direction(c)[0] for c in node.columns})
+            if isinstance(node, Limit):
+                return walk(node.child, required)
+            out = {r.lower() for r in required}
+            for c in node.children:
+                out |= walk(c, set(c.schema.names))
+            return out
 
-        plan.transform_up(visit)
-        return sorted(needed)
+        return sorted(walk(plan, set(plan.schema.names)))
 
     def _usable_indexes(self, plan: LogicalPlan, join_cols: Sequence[str]
                         ) -> List[IndexLogEntry]:

@@ -41,7 +41,8 @@ def plan_from_dict(d: dict) -> LogicalPlan:
         return Filter(Expression.from_dict(d["condition"]),
                       plan_from_dict(d["child"]))
     if node == "project":
-        return Project(d["columns"], plan_from_dict(d["child"]))
+        return Project([c if isinstance(c, str) else Expression.from_dict(c)
+                        for c in d["columns"]], plan_from_dict(d["child"]))
     if node == "union":
         return Union([plan_from_dict(c) for c in d["children"]])
     if node == "aggregate":

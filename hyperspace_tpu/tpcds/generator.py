@@ -30,7 +30,8 @@ _BASE = {
 
 TABLES = ("store_sales", "store_returns", "catalog_sales",
           "catalog_returns", "date_dim", "store", "item", "customer",
-          "promotion")
+          "promotion", "customer_demographics", "household_demographics",
+          "customer_address", "time_dim")
 
 _QUARTERS = ["%dQ%d" % (y, q) for y in range(1998, 2004)
              for q in range(1, 5)]
@@ -49,6 +50,8 @@ def _date_dim(n_dates: int):
         "d_date_sk": sk,
         "d_year": year.astype(np.int64),
         "d_moy": np.minimum(moy, 12).astype(np.int64),
+        "d_dom": (1 + (day % 365) % 31).astype(np.int64),
+        "d_dow": (day % 7).astype(np.int64),
         "d_qoy": np.minimum(qoy, 4).astype(np.int64),
         "d_quarter_name": quarter_name,
     }
@@ -76,12 +79,22 @@ def generate(out_dir: str, scale: float = 1.0,
     tables["store"] = {
         "s_store_sk": np.arange(1, n_store + 1, dtype=np.int64),
         "s_store_id": np.array(["S%04d" % i for i in range(n_store)]),
-        "s_store_name": np.array(["store_%d" % (i % 7) for i in range(n_store)]),
+        # q96 filters s_store_name = 'ese' (real TPC-DS store names are
+        # spelled-out digit fragments); give a third of stores that name.
+        "s_store_name": np.array([["ese", "store_%d" % (i % 7),
+                                   "ation"][i % 3]
+                                  for i in range(n_store)]),
+        "s_number_employees": (200 + 17 * np.arange(n_store) % 110
+                               ).astype(np.int64),
+        "s_city": np.array([["Midway", "Fairview", "Oakdale", "Riverside",
+                             "Centerville"][i % 5] for i in range(n_store)]),
         "s_state": np.array([["TN", "CA", "WA", "NY", "TX"][i % 5]
                              for i in range(n_store)]),
         "s_zip": np.array(["%05d" % (35000 + 13 * i) for i in range(n_store)]),
     }
 
+    _CATEGORIES = ["Books", "Home", "Electronics", "Jewelry", "Sports",
+                   "Music", "Women", "Men", "Children", "Shoes"]
     tables["item"] = {
         "i_item_sk": np.arange(1, n_item + 1, dtype=np.int64),
         "i_item_id": np.array(["I%08d" % (i % (n_item // 2 + 1))
@@ -89,14 +102,26 @@ def generate(out_dir: str, scale: float = 1.0,
         "i_item_desc": np.array(["desc_%d" % (i % 997) for i in range(n_item)]),
         "i_product_name": np.array(["prod_%d" % i for i in range(n_item)]),
         "i_current_price": np.round(rng.uniform(0.5, 100.0, n_item), 2),
+        "i_brand_id": (1001001 + (np.arange(n_item) % 60) * 1000
+                       ).astype(np.int64),
+        "i_brand": np.array(["brand_%02d" % (i % 60) for i in range(n_item)]),
+        "i_category_id": (1 + np.arange(n_item) % 10).astype(np.int64),
+        "i_category": np.array([_CATEGORIES[i % 10] for i in range(n_item)]),
+        "i_manufact_id": (1 + np.arange(n_item) % 200).astype(np.int64),
+        "i_manufact": np.array(["manufact_%03d" % (i % 200)
+                                for i in range(n_item)]),
+        "i_manager_id": (1 + np.arange(n_item) % 100).astype(np.int64),
         "i_color": np.array([["red", "blue", "green", "plum", "puff",
                               "misty", "navy", "orange"][i % 8]
                              for i in range(n_item)]),
     }
 
+    n_addr = 1000  # ss_addr_sk / c_current_addr_sk domain
     tables["customer"] = {
         "c_customer_sk": np.arange(1, n_cust + 1, dtype=np.int64),
         "c_customer_id": np.array(["C%010d" % i for i in range(n_cust)]),
+        "c_current_addr_sk": rng.integers(1, n_addr + 1,
+                                          n_cust).astype(np.int64),
         "c_first_name": np.array(["fn_%d" % (i % 400) for i in range(n_cust)]),
         "c_last_name": np.array(["ln_%d" % (i % 700) for i in range(n_cust)]),
     }
@@ -104,6 +129,47 @@ def generate(out_dir: str, scale: float = 1.0,
     tables["promotion"] = {
         "p_promo_sk": np.arange(1, n_promo + 1, dtype=np.int64),
         "p_promo_id": np.array(["P%06d" % i for i in range(n_promo)]),
+        "p_channel_email": np.array([["N", "Y"][i % 2]
+                                     for i in range(n_promo)]),
+        "p_channel_event": np.array([["N", "N", "Y"][i % 3]
+                                     for i in range(n_promo)]),
+    }
+
+    # Demographic / address / time dimensions (fixed-size, like TPC-DS).
+    n_demo = 1000  # ss_cdemo_sk / ss_hdemo_sk domain
+    _GENDERS = ["M", "F"]
+    _MARITAL = ["M", "S", "D", "W", "U"]
+    _EDU = ["Primary", "Secondary", "College", "2 yr Degree",
+            "4 yr Degree", "Advanced Degree", "Unknown"]
+    tables["customer_demographics"] = {
+        "cd_demo_sk": np.arange(1, n_demo + 1, dtype=np.int64),
+        "cd_gender": np.array([_GENDERS[i % 2] for i in range(n_demo)]),
+        "cd_marital_status": np.array([_MARITAL[(i // 2) % 5]
+                                       for i in range(n_demo)]),
+        "cd_education_status": np.array([_EDU[(i // 10) % 7]
+                                         for i in range(n_demo)]),
+    }
+    tables["household_demographics"] = {
+        "hd_demo_sk": np.arange(1, n_demo + 1, dtype=np.int64),
+        "hd_dep_count": (np.arange(n_demo) % 10).astype(np.int64),
+        "hd_vehicle_count": (np.arange(n_demo) % 6 - 1).astype(np.int64),
+    }
+    _CITIES = ["%s_%02d" % (base, i) for base in
+               ("Springfield", "Greenville", "Franklin", "Clinton")
+               for i in range(15)]
+    tables["customer_address"] = {
+        "ca_address_sk": np.arange(1, n_addr + 1, dtype=np.int64),
+        "ca_city": np.array([_CITIES[i % len(_CITIES)]
+                             for i in range(n_addr)]),
+        "ca_zip": np.array(["%05d" % (10000 + 37 * i % 90000)
+                            for i in range(n_addr)]),
+    }
+    # Seconds 08:00:00 .. 20:59:59 (the selling day q96 probes).
+    t_sk = np.arange(8 * 3600, 21 * 3600, dtype=np.int64)
+    tables["time_dim"] = {
+        "t_time_sk": t_sk,
+        "t_hour": (t_sk // 3600).astype(np.int64),
+        "t_minute": ((t_sk % 3600) // 60).astype(np.int64),
     }
 
     # -- store_sales ------------------------------------------------------
@@ -120,11 +186,13 @@ def generate(out_dir: str, scale: float = 1.0,
     ss_price = np.round(rng.uniform(1.0, 300.0, n_ss), 2)
     tables["store_sales"] = {
         "ss_sold_date_sk": ss_sold_date,
+        "ss_sold_time_sk": rng.integers(8 * 3600, 21 * 3600,
+                                        n_ss).astype(np.int64),
         "ss_item_sk": ss_item,
         "ss_customer_sk": ss_cust,
-        "ss_cdemo_sk": rng.integers(1, 1000, n_ss).astype(np.int64),
-        "ss_hdemo_sk": rng.integers(1, 1000, n_ss).astype(np.int64),
-        "ss_addr_sk": rng.integers(1, 1000, n_ss).astype(np.int64),
+        "ss_cdemo_sk": rng.integers(1, n_demo + 1, n_ss).astype(np.int64),
+        "ss_hdemo_sk": rng.integers(1, n_demo + 1, n_ss).astype(np.int64),
+        "ss_addr_sk": rng.integers(1, n_addr + 1, n_ss).astype(np.int64),
         "ss_store_sk": ss_store,
         "ss_promo_sk": rng.integers(1, n_promo + 1, n_ss).astype(np.int64),
         "ss_ticket_number": ss_ticket,
@@ -132,6 +200,12 @@ def generate(out_dir: str, scale: float = 1.0,
         "ss_wholesale_cost": np.round(ss_price * 0.6, 2),
         "ss_list_price": np.round(ss_price * 1.2, 2),
         "ss_sales_price": ss_price,
+        "ss_ext_sales_price": np.round(ss_price * ss_qty, 2),
+        "ss_ext_list_price": np.round(ss_price * 1.2 * ss_qty, 2),
+        "ss_ext_tax": np.round(ss_price * ss_qty * 0.08, 2),
+        "ss_coupon_amt": np.round(
+            np.where(rng.random(n_ss) < 0.3,
+                     rng.uniform(0.0, 20.0, n_ss), 0.0), 2),
         "ss_net_profit": np.round(ss_price * ss_qty * 0.1
                                   - rng.uniform(0, 50, n_ss), 2),
     }

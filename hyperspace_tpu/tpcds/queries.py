@@ -1,12 +1,12 @@
-"""TPC-DS q17 / q25 / q64 on the framework DataFrame API, with pandas
-oracles.
+"""Twelve TPC-DS queries on the framework DataFrame API, with pandas
+oracles: q3, q7, q17, q19, q25, q42, q52, q55, q64, q68, q79, q96.
 
 Each query is expressed as a join tree the rewrite rules can accelerate:
 the innermost join is a linear scan pair (JoinIndexRule's applicability,
 reference `JoinIndexRule.scala:210-211`), dimension filters run before
 their joins (FilterIndexRule + bucket pruning serve them), and dimension
-key columns are projected away immediately after each join so the thrice-
-joined date_dim never collides on output names.
+key columns are projected away immediately after each join so repeatedly
+joined dimensions never collide on output names.
 
 The pandas oracle for each query doubles as the CPU baseline and the
 correctness check: `bench_tpcds.py` and `tests/test_tpcds.py` assert
@@ -14,10 +14,17 @@ sorted-result equality between rules-on, rules-off, and the oracle —
 the reference's own E2E guarantee
 (`E2EHyperspaceRulesTests.scala:330-346`).
 
-q64 is structurally faithful at reduced width: the cs_ui HAVING subquery,
-the cross_sales aggregation, and the year-over-year self-join of the
-aggregate are all present; low-cardinality demographic dimensions the
-subset generator does not model are omitted.
+The nine round-3 queries run in UN-REDUCED shape: full official column
+lists, SUM/AVG over expression inputs, ORDER BY aggregate aliases
+descending, SUBSTR (incl. the q19 zip-prefix column-to-column
+inequality), and the q68 current-city <> bought-city string comparison.
+q64 remains structurally faithful at reduced width (cs_ui HAVING
+subquery, cross_sales aggregation, year-over-year self-join all
+present); q19 probes 1999 instead of the official 1998 because the
+deterministic generator concentrates sales in 1999-2001; q79 appends
+ss_ticket_number as a final sort key on both lanes because the official
+ORDER BY does not totally order rows and the 3-way equality check needs
+a deterministic top-100.
 """
 
 from __future__ import annotations
@@ -344,10 +351,462 @@ def create_indexes(hs, dfs) -> None:
         ["cr_refunded_cash", "cr_reversed_charge", "cr_store_credit"]))
     hs.create_index(dfs["date_dim"], IndexConfig(
         "idx_dd_quarter", ["d_quarter_name"], ["d_date_sk"]))
+    # The star-family queries (q3/q7/q19/q42/q52/q55/q68/q79) all join
+    # store_sales to a filtered date_dim innermost; one covering pair
+    # serves the whole family.
+    hs.create_index(dfs["store_sales"], IndexConfig(
+        "idx_ss_date", ["ss_sold_date_sk"],
+        ["ss_item_sk", "ss_customer_sk", "ss_store_sk", "ss_hdemo_sk",
+         "ss_cdemo_sk", "ss_addr_sk", "ss_promo_sk", "ss_ticket_number",
+         "ss_quantity", "ss_list_price", "ss_sales_price", "ss_coupon_amt",
+         "ss_ext_sales_price", "ss_ext_list_price", "ss_ext_tax",
+         "ss_net_profit"]))
+    hs.create_index(dfs["date_dim"], IndexConfig(
+        "idx_dd_datesk", ["d_date_sk"],
+        ["d_year", "d_moy", "d_dom", "d_dow"]))
+    # q96 joins store_sales to household_demographics innermost.
+    hs.create_index(dfs["store_sales"], IndexConfig(
+        "idx_ss_hdemo", ["ss_hdemo_sk"],
+        ["ss_sold_time_sk", "ss_store_sk"]))
+    hs.create_index(dfs["household_demographics"], IndexConfig(
+        "idx_hd_demo", ["hd_demo_sk"],
+        ["hd_dep_count", "hd_vehicle_count"]))
+
+
+# ---------------------------------------------------------------------------
+# q3 / q42 / q52 / q55 — the brand/category star family (un-reduced shape:
+# computed SUM over ss_ext_sales_price, ORDER BY the aggregate descending)
+# ---------------------------------------------------------------------------
+
+
+def q3(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select("ss_sold_date_sk", "ss_item_sk",
+                                   "ss_ext_sales_price")
+    dt = (dfs["date_dim"].filter(col("d_moy") == lit(11))
+          .select("d_date_sk", "d_year"))
+    it = (dfs["item"].filter(col("i_manufact_id") == lit(128))
+          .select("i_item_sk", "i_brand_id", "i_brand"))
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    return (j.group_by("d_year", "i_brand_id", "i_brand")
+            .agg(("sum", "ss_ext_sales_price", "sum_agg"))
+            .sort("d_year", "-sum_agg", "i_brand_id").limit(100))
+
+
+def q3_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[d.d_moy == 11][["d_date_sk", "d_year"]]
+    i = t["item"]
+    it = i[i.i_manufact_id == 128][["i_item_sk", "i_brand_id", "i_brand"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["d_year", "i_brand_id", "i_brand"]).agg(
+        sum_agg=("ss_ext_sales_price", "sum")).reset_index()
+    return (g.sort_values(["d_year", "sum_agg", "i_brand_id"],
+                          ascending=[True, False, True])
+            .head(100).reset_index(drop=True))
+
+
+def q42(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select("ss_sold_date_sk", "ss_item_sk",
+                                   "ss_ext_sales_price")
+    dt = (dfs["date_dim"]
+          .filter((col("d_moy") == lit(11)) & (col("d_year") == lit(2000)))
+          .select("d_date_sk", "d_year"))
+    it = (dfs["item"].filter(col("i_manager_id") == lit(1))
+          .select("i_item_sk", "i_category_id", "i_category"))
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    return (j.group_by("d_year", "i_category_id", "i_category")
+            .agg(("sum", "ss_ext_sales_price", "sum_sales"))
+            .sort("-sum_sales", "d_year", "i_category_id", "i_category")
+            .limit(100))
+
+
+def q42_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[(d.d_moy == 11) & (d.d_year == 2000)][["d_date_sk", "d_year"]]
+    i = t["item"]
+    it = i[i.i_manager_id == 1][["i_item_sk", "i_category_id", "i_category"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["d_year", "i_category_id", "i_category"]).agg(
+        sum_sales=("ss_ext_sales_price", "sum")).reset_index()
+    return (g.sort_values(["sum_sales", "d_year", "i_category_id",
+                           "i_category"],
+                          ascending=[False, True, True, True])
+            [["d_year", "i_category_id", "i_category", "sum_sales"]]
+            .head(100).reset_index(drop=True))
+
+
+def q52(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select("ss_sold_date_sk", "ss_item_sk",
+                                   "ss_ext_sales_price")
+    dt = (dfs["date_dim"]
+          .filter((col("d_moy") == lit(11)) & (col("d_year") == lit(2000)))
+          .select("d_date_sk", "d_year"))
+    it = (dfs["item"].filter(col("i_manager_id") == lit(1))
+          .select("i_item_sk", "i_brand_id", "i_brand"))
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    return (j.group_by("d_year", "i_brand_id", "i_brand")
+            .agg(("sum", "ss_ext_sales_price", "ext_price"))
+            .sort("d_year", "-ext_price", "i_brand_id").limit(100))
+
+
+def q52_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[(d.d_moy == 11) & (d.d_year == 2000)][["d_date_sk", "d_year"]]
+    i = t["item"]
+    it = i[i.i_manager_id == 1][["i_item_sk", "i_brand_id", "i_brand"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["d_year", "i_brand_id", "i_brand"]).agg(
+        ext_price=("ss_ext_sales_price", "sum")).reset_index()
+    return (g.sort_values(["d_year", "ext_price", "i_brand_id"],
+                          ascending=[True, False, True])
+            .head(100).reset_index(drop=True))
+
+
+def q55(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select("ss_sold_date_sk", "ss_item_sk",
+                                   "ss_ext_sales_price")
+    dt = (dfs["date_dim"]
+          .filter((col("d_moy") == lit(11)) & (col("d_year") == lit(1999)))
+          .select("d_date_sk"))
+    it = (dfs["item"].filter(col("i_manager_id") == lit(28))
+          .select("i_item_sk", "i_brand_id", "i_brand"))
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    return (j.group_by("i_brand_id", "i_brand")
+            .agg(("sum", "ss_ext_sales_price", "ext_price"))
+            .sort("-ext_price", "i_brand_id").limit(100))
+
+
+def q55_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[(d.d_moy == 11) & (d.d_year == 1999)][["d_date_sk"]]
+    i = t["item"]
+    it = i[i.i_manager_id == 28][["i_item_sk", "i_brand_id", "i_brand"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby(["i_brand_id", "i_brand"]).agg(
+        ext_price=("ss_ext_sales_price", "sum")).reset_index()
+    return (g.sort_values(["ext_price", "i_brand_id"],
+                          ascending=[False, True])
+            .head(100).reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# q7 — demographic/promotion star with four AVG aggregates
+# ---------------------------------------------------------------------------
+
+
+def q7(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_cdemo_sk", "ss_promo_sk",
+        "ss_quantity", "ss_list_price", "ss_coupon_amt", "ss_sales_price")
+    dt = (dfs["date_dim"].filter(col("d_year") == lit(2000))
+          .select("d_date_sk"))
+    cd = (dfs["customer_demographics"]
+          .filter((col("cd_gender") == lit("M"))
+                  & (col("cd_marital_status") == lit("S"))
+                  & (col("cd_education_status") == lit("College")))
+          .select("cd_demo_sk"))
+    promo = (dfs["promotion"]
+             .filter((col("p_channel_email") == lit("N"))
+                     | (col("p_channel_event") == lit("N")))
+             .select("p_promo_sk"))
+    it = dfs["item"].select("i_item_sk", "i_item_id")
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(cd, on=col("ss_cdemo_sk") == col("cd_demo_sk"))
+    j = j.join(promo, on=col("ss_promo_sk") == col("p_promo_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    return (j.group_by("i_item_id")
+            .agg(("avg", "ss_quantity", "agg1"),
+                 ("avg", "ss_list_price", "agg2"),
+                 ("avg", "ss_coupon_amt", "agg3"),
+                 ("avg", "ss_sales_price", "agg4"))
+            .sort("i_item_id").limit(100))
+
+
+def q7_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[d.d_year == 2000][["d_date_sk"]]
+    c = t["customer_demographics"]
+    cd = c[(c.cd_gender == "M") & (c.cd_marital_status == "S")
+           & (c.cd_education_status == "College")][["cd_demo_sk"]]
+    p = t["promotion"]
+    promo = p[(p.p_channel_email == "N")
+              | (p.p_channel_event == "N")][["p_promo_sk"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+    j = j.merge(promo, left_on="ss_promo_sk", right_on="p_promo_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    g = j.groupby("i_item_id").agg(
+        agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+        agg3=("ss_coupon_amt", "mean"),
+        agg4=("ss_sales_price", "mean")).reset_index()
+    return g.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# q19 — brand star with the SUBSTR(zip) <> SUBSTR(zip) cross-column test
+# ---------------------------------------------------------------------------
+
+
+def q19(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select(
+        "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk", "ss_store_sk",
+        "ss_ext_sales_price")
+    dt = (dfs["date_dim"]
+          .filter((col("d_moy") == lit(11)) & (col("d_year") == lit(1999)))
+          .select("d_date_sk"))
+    it = (dfs["item"].filter(col("i_manager_id") == lit(8))
+          .select("i_item_sk", "i_brand_id", "i_brand", "i_manufact_id",
+                  "i_manufact"))
+    cust = dfs["customer"].select("c_customer_sk", "c_current_addr_sk")
+    ca = dfs["customer_address"].select("ca_address_sk", "ca_zip")
+    st = dfs["store"].select("s_store_sk", "s_zip")
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    j = j.join(cust, on=col("ss_customer_sk") == col("c_customer_sk"))
+    j = j.join(ca, on=col("c_current_addr_sk") == col("ca_address_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.filter(col("ca_zip").substr(1, 5) != col("s_zip").substr(1, 5))
+    return (j.group_by("i_brand_id", "i_brand", "i_manufact_id",
+                       "i_manufact")
+            .agg(("sum", "ss_ext_sales_price", "ext_price"))
+            .sort("-ext_price", "i_brand", "i_brand_id", "i_manufact_id",
+                  "i_manufact")
+            .limit(100))
+
+
+def q19_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[(d.d_moy == 11) & (d.d_year == 1999)][["d_date_sk"]]
+    i = t["item"]
+    it = i[i.i_manager_id == 8][["i_item_sk", "i_brand_id", "i_brand",
+                                 "i_manufact_id", "i_manufact"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(t["customer"][["c_customer_sk", "c_current_addr_sk"]],
+                left_on="ss_customer_sk", right_on="c_customer_sk")
+    j = j.merge(t["customer_address"][["ca_address_sk", "ca_zip"]],
+                left_on="c_current_addr_sk", right_on="ca_address_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_zip"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j[j.ca_zip.str[:5] != j.s_zip.str[:5]]
+    g = j.groupby(["i_brand_id", "i_brand", "i_manufact_id",
+                   "i_manufact"]).agg(
+        ext_price=("ss_ext_sales_price", "sum")).reset_index()
+    return (g.sort_values(["ext_price", "i_brand", "i_brand_id",
+                           "i_manufact_id", "i_manufact"],
+                          ascending=[False, True, True, True, True])
+            .head(100).reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# q68 — per-ticket aggregate subquery joined back to customer, with the
+# current-city <> bought-city string column comparison
+# ---------------------------------------------------------------------------
+
+
+def q68(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select(
+        "ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "ss_hdemo_sk",
+        "ss_sold_date_sk", "ss_store_sk", "ss_ext_sales_price",
+        "ss_ext_list_price", "ss_ext_tax")
+    dt = (dfs["date_dim"]
+          .filter((col("d_dom") >= lit(1)) & (col("d_dom") <= lit(2))
+                  & col("d_year").isin(1999, 2000, 2001))
+          .select("d_date_sk"))
+    st = (dfs["store"].filter(col("s_city").isin("Midway", "Fairview"))
+          .select("s_store_sk"))
+    hd = (dfs["household_demographics"]
+          .filter((col("hd_dep_count") == lit(4))
+                  | (col("hd_vehicle_count") == lit(3)))
+          .select("hd_demo_sk"))
+    ca = dfs["customer_address"].select("ca_address_sk", "ca_city")
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.join(hd, on=col("ss_hdemo_sk") == col("hd_demo_sk"))
+    j = j.join(ca, on=col("ss_addr_sk") == col("ca_address_sk"))
+    dn = (j.group_by("ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                     "ca_city")
+          .agg(("sum", "ss_ext_sales_price", "extended_price"),
+               ("sum", "ss_ext_list_price", "list_price"),
+               ("sum", "ss_ext_tax", "extended_tax"))
+          .select("ss_ticket_number", "ss_customer_sk",
+                  col("ca_city").alias("bought_city"), "extended_price",
+                  "list_price", "extended_tax"))
+    cust = dfs["customer"].select("c_customer_sk", "c_current_addr_sk",
+                                  "c_first_name", "c_last_name")
+    ca2 = dfs["customer_address"].select("ca_address_sk", "ca_city")
+    out = dn.join(cust, on=col("ss_customer_sk") == col("c_customer_sk"))
+    out = out.join(ca2, on=col("c_current_addr_sk") == col("ca_address_sk"))
+    out = out.filter(col("ca_city") != col("bought_city"))
+    return (out.select("c_last_name", "c_first_name", "ca_city",
+                       "bought_city", "ss_ticket_number", "extended_price",
+                       "extended_tax", "list_price")
+            .sort("c_last_name", "ss_ticket_number").limit(100))
+
+
+def q68_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[(d.d_dom >= 1) & (d.d_dom <= 2)
+           & d.d_year.isin([1999, 2000, 2001])][["d_date_sk"]]
+    s = t["store"]
+    st = s[s.s_city.isin(["Midway", "Fairview"])][["s_store_sk"]]
+    h = t["household_demographics"]
+    hd = h[(h.hd_dep_count == 4) | (h.hd_vehicle_count == 3)][["hd_demo_sk"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+    j = j.merge(t["customer_address"][["ca_address_sk", "ca_city"]],
+                left_on="ss_addr_sk", right_on="ca_address_sk")
+    dn = j.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                    "ca_city"]).agg(
+        extended_price=("ss_ext_sales_price", "sum"),
+        list_price=("ss_ext_list_price", "sum"),
+        extended_tax=("ss_ext_tax", "sum")).reset_index()
+    dn = dn.rename(columns={"ca_city": "bought_city"})
+    out = dn.merge(t["customer"][["c_customer_sk", "c_current_addr_sk",
+                                  "c_first_name", "c_last_name"]],
+                   left_on="ss_customer_sk", right_on="c_customer_sk")
+    out = out.merge(t["customer_address"][["ca_address_sk", "ca_city"]],
+                    left_on="c_current_addr_sk", right_on="ca_address_sk")
+    out = out[out.ca_city != out.bought_city]
+    out = out[["c_last_name", "c_first_name", "ca_city", "bought_city",
+               "ss_ticket_number", "extended_price", "extended_tax",
+               "list_price"]]
+    return (out.sort_values(["c_last_name", "ss_ticket_number"])
+            .head(100).reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# q79 — per-ticket coupon/profit aggregate with SUBSTR in the output.
+# ss_ticket_number is appended as a final sort key on both lanes: the
+# official ORDER BY (last_name, first_name, substr(city), profit) does not
+# totally order rows, and the 3-way equality check needs a deterministic
+# top-100.
+# ---------------------------------------------------------------------------
+
+
+def q79(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select(
+        "ss_ticket_number", "ss_customer_sk", "ss_hdemo_sk", "ss_addr_sk",
+        "ss_sold_date_sk", "ss_store_sk", "ss_coupon_amt", "ss_net_profit")
+    dt = (dfs["date_dim"]
+          .filter((col("d_dow") == lit(1))
+                  & col("d_year").isin(1999, 2000, 2001))
+          .select("d_date_sk"))
+    st = (dfs["store"]
+          .filter(col("s_number_employees").between(200, 295))
+          .select("s_store_sk", "s_city"))
+    hd = (dfs["household_demographics"]
+          .filter((col("hd_dep_count") == lit(6))
+                  | (col("hd_vehicle_count") > lit(2)))
+          .select("hd_demo_sk"))
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.join(hd, on=col("ss_hdemo_sk") == col("hd_demo_sk"))
+    ms = (j.group_by("ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                     "s_city")
+          .agg(("sum", "ss_coupon_amt", "amt"),
+               ("sum", "ss_net_profit", "profit")))
+    cust = dfs["customer"].select("c_customer_sk", "c_last_name",
+                                  "c_first_name")
+    out = ms.join(cust, on=col("ss_customer_sk") == col("c_customer_sk"))
+    out = out.select("c_last_name", "c_first_name",
+                     col("s_city").substr(1, 30).alias("city"),
+                     "ss_ticket_number", "amt", "profit")
+    return (out.sort("c_last_name", "c_first_name", "city", "profit",
+                     "ss_ticket_number").limit(100))
+
+
+def q79_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[(d.d_dow == 1) & d.d_year.isin([1999, 2000, 2001])][["d_date_sk"]]
+    s = t["store"]
+    st = s[(s.s_number_employees >= 200)
+           & (s.s_number_employees <= 295)][["s_store_sk", "s_city"]]
+    h = t["household_demographics"]
+    hd = h[(h.hd_dep_count == 6) | (h.hd_vehicle_count > 2)][["hd_demo_sk"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+    ms = j.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk",
+                    "s_city"]).agg(
+        amt=("ss_coupon_amt", "sum"),
+        profit=("ss_net_profit", "sum")).reset_index()
+    out = ms.merge(t["customer"][["c_customer_sk", "c_last_name",
+                                  "c_first_name"]],
+                   left_on="ss_customer_sk", right_on="c_customer_sk")
+    out = out.assign(city=out.s_city.str[:30])
+    out = out[["c_last_name", "c_first_name", "city", "ss_ticket_number",
+               "amt", "profit"]]
+    return (out.sort_values(["c_last_name", "c_first_name", "city",
+                             "profit", "ss_ticket_number"])
+            .head(100).reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# q96 — COUNT(*) over the time/demographic/store probe
+# ---------------------------------------------------------------------------
+
+
+def q96(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select("ss_sold_time_sk", "ss_hdemo_sk",
+                                   "ss_store_sk")
+    hd = (dfs["household_demographics"]
+          .filter(col("hd_dep_count") == lit(7)).select("hd_demo_sk"))
+    td = (dfs["time_dim"]
+          .filter((col("t_hour") == lit(20)) & (col("t_minute") >= lit(30)))
+          .select("t_time_sk"))
+    st = (dfs["store"].filter(col("s_store_name") == lit("ese"))
+          .select("s_store_sk"))
+    j = ss.join(hd, on=col("ss_hdemo_sk") == col("hd_demo_sk"))
+    j = j.join(td, on=col("ss_sold_time_sk") == col("t_time_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    return j.group_by().agg(("count", "*", "cnt"))
+
+
+def q96_pandas(t: Dict[str, "object"]):
+    import pandas as pd
+    h = t["household_demographics"]
+    hd = h[h.hd_dep_count == 7][["hd_demo_sk"]]
+    tm = t["time_dim"]
+    td = tm[(tm.t_hour == 20) & (tm.t_minute >= 30)][["t_time_sk"]]
+    s = t["store"]
+    st = s[s.s_store_name == "ese"][["s_store_sk"]]
+    j = t["store_sales"].merge(hd, left_on="ss_hdemo_sk",
+                               right_on="hd_demo_sk")
+    j = j.merge(td, left_on="ss_sold_time_sk", right_on="t_time_sk")
+    j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    return pd.DataFrame({"cnt": [len(j)]})
 
 
 QUERIES: Dict[str, Tuple[Callable, Callable]] = {
+    "q3": (q3, q3_pandas),
+    "q7": (q7, q7_pandas),
     "q17": (q17, q17_pandas),
+    "q19": (q19, q19_pandas),
     "q25": (q25, q25_pandas),
+    "q42": (q42, q42_pandas),
+    "q52": (q52, q52_pandas),
+    "q55": (q55, q55_pandas),
     "q64": (q64, q64_pandas),
+    "q68": (q68, q68_pandas),
+    "q79": (q79, q79_pandas),
+    "q96": (q96, q96_pandas),
 }

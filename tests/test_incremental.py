@@ -220,3 +220,73 @@ def test_hybrid_plan_roundtrips_file_restriction(env):
     scan = Scan([src], Schema([Field("id", "int64")]), files=files)
     restored = plan_from_json(plan_to_json(scan))
     assert restored.files() == files
+
+
+def test_optimize_is_one_batched_program_and_matches_rebuild(env, tmp_path,
+                                                             monkeypatch):
+    """The VERDICT round-3 'done' bar for OptimizeAction: a 64-bucket
+    index with 4 delta runs compacts through ONE compiled device program
+    (no per-bucket dispatch), and the output layout is byte-equal to a
+    full rebuild of the same source."""
+    import hyperspace_tpu.io.builder as builder_mod
+    import hyperspace_tpu.ops.merge as merge_mod
+
+    session, hs, _ = env
+    rng = np.random.default_rng(5)
+    src = tmp_path / "opt64_src"
+    src.mkdir()
+
+    def rows(start, n, seed):
+        r = np.random.default_rng(seed)
+        return pa.table({
+            "k": r.integers(0, 40, n).astype(np.int64),
+            "v": r.random(n),
+            "id": np.arange(start, start + n, dtype=np.int64)})
+
+    pq.write_table(rows(0, 600, 1), str(src / "part-0-base.parquet"))
+    session.conf.set("hyperspace.index.num.buckets", 64)
+    df = session.read_parquet(str(src))
+    hs.create_index(df, IndexConfig("opt64", ["k"], ["v", "id"]))
+    for i in range(4):  # 4 appended slices -> 4 incremental delta runs
+        pq.write_table(rows(1000 * (i + 1), 150, 10 + i),
+                       str(src / f"part-1-extra{i}.parquet"))
+        hs.refresh_index("opt64", mode="incremental")
+
+    # Force the device lane and count compiled-program entry points.
+    calls = {"device": 0, "host": 0}
+    real_dev = merge_mod.bucket_sort_permutation
+    real_host = merge_mod.host_bucket_sort_permutation
+
+    def count_dev(*a, **k):
+        calls["device"] += 1
+        return real_dev(*a, **k)
+
+    def count_host(*a, **k):
+        calls["host"] += 1
+        return real_host(*a, **k)
+
+    monkeypatch.setattr(builder_mod, "BUILD_MIN_DEVICE_ROWS", 0)
+    monkeypatch.setattr(merge_mod, "bucket_sort_permutation", count_dev)
+    monkeypatch.setattr(merge_mod, "host_bucket_sort_permutation",
+                        count_host)
+
+    hs.optimize_index("opt64")
+    assert calls == {"device": 1, "host": 0}, calls
+
+    # Byte-equality with a full rebuild over the identical source (a
+    # FRESH DataFrame: the original one's scan caches the pre-append
+    # file listing).
+    hs.create_index(session.read_parquet(str(src)),
+                    IndexConfig("opt64_rebuild", ["k"], ["v", "id"]))
+    opt_dir = os.path.join(session.conf.system_path, "opt64", "v__=5")
+    reb_dir = os.path.join(session.conf.system_path, "opt64_rebuild",
+                           "v__=0")
+    opt_files = sorted(f for f in os.listdir(opt_dir)
+                       if f.endswith(".parquet"))
+    reb_files = sorted(f for f in os.listdir(reb_dir)
+                       if f.endswith(".parquet"))
+    assert opt_files == reb_files and opt_files
+    for f in opt_files:
+        with open(os.path.join(opt_dir, f), "rb") as a, \
+                open(os.path.join(reb_dir, f), "rb") as b:
+            assert a.read() == b.read(), f"byte mismatch in {f}"

@@ -302,6 +302,121 @@ def rung4_hybrid(sess, hs, left, work):
     return dev_s, cpu_s
 
 
+# ---------------------------------------------------------------------------
+# Rung 4b — hybrid JOIN: left side served from index UNION appended files
+# ---------------------------------------------------------------------------
+
+
+def rung4b_hybrid_join(sess, hs, rdf, work):
+    import pyarrow.parquet as pq
+    from hyperspace_tpu.plan.expr import col
+
+    # The hybrid dir (rung 4) already has: an index built over part-0 and
+    # an appended part-1. Join it against the rung-3 right index.
+    hdir = os.path.join(work, "hybrid")
+    hdf = sess.read_parquet(hdir)
+    q_df = (hdf.select("key", "id")
+            .join(rdf.select("key", "val"),
+                  on=col("key") == col("key")).select("id", "val"))
+
+    sess.enable_hyperspace()
+    plan = q_df._optimized_plan()
+    from hyperspace_tpu.plan.nodes import Union as UnionNode
+    found_union = [False]
+
+    def _see(node):
+        if isinstance(node, UnionNode):
+            found_union[0] = True
+        return node
+
+    plan.transform_up(_see)
+    assert found_union[0], "rung4b left side not hybrid-served"
+
+    def q():
+        return q_df.collect()
+
+    q()
+    dev_s = best_of(q, label="rung4b device")
+    sess.disable_hyperspace()
+
+    lfiles = sorted(os.path.join(hdir, f) for f in os.listdir(hdir))
+    rfiles = [os.path.join(work, "right", f)
+              for f in os.listdir(os.path.join(work, "right"))]
+
+    def cpu():
+        lt = pq.read_table(lfiles, columns=["key", "id"]).to_pandas()
+        rt = pq.read_table(rfiles, columns=["key", "val"]).to_pandas()
+        return lt.merge(rt, on="key")[["id", "val"]]
+
+    cpu_s = best_of(cpu, runs=3, label="rung4b cpu")
+    return dev_s, cpu_s
+
+
+# ---------------------------------------------------------------------------
+# Rung 5 — Optimize merge-compaction vs full refresh
+# ---------------------------------------------------------------------------
+
+
+def rung5_compaction(sess, hs, work):
+    """Index maintenance after appends: incremental refresh (delta-only
+    build) + Optimize merge-compaction, against a full refresh of the
+    grown source. Every timed run starts COLD-CACHE (maintenance reads
+    fresh files in production), and each timed optimize compacts a
+    genuinely multi-run version (an untimed append+incremental precedes
+    it)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from hyperspace_tpu import IndexConfig
+    from hyperspace_tpu.io.parquet import clear_read_cache
+
+    cdir = os.path.join(work, "compact_src")
+    os.makedirs(cdir)
+    rng = np.random.default_rng(13)
+    n = max(N_ROWS // 2, 1000)
+    pq.write_table(pa.table({
+        "key": rng.integers(0, n // 4, n).astype(np.int64),
+        "score": rng.random(n).astype(np.float64),
+    }), os.path.join(cdir, "part-0.parquet"))
+    cdf = sess.read_parquet(cdir)
+    hs.create_index(cdf, IndexConfig("bench_opt", ["key"], ["score"]))
+    slice_no = [0]
+
+    def append_slice():
+        i = slice_no[0]
+        slice_no[0] += 1
+        pq.write_table(pa.table({
+            "key": rng.integers(0, n // 4, n // 20).astype(np.int64),
+            "score": rng.random(n // 20).astype(np.float64),
+        }), os.path.join(cdir, f"part-extra{i}.parquet"))
+
+    inc_s = float("inf")
+    opt_s = float("inf")
+    for i in range(3):
+        append_slice()
+        clear_read_cache()
+        t0 = time.perf_counter()
+        hs.refresh_index("bench_opt", mode="incremental")
+        dt = time.perf_counter() - t0
+        log(f"  rung5 incremental refresh run {i}: {dt:.3f}s")
+        inc_s = min(inc_s, dt)
+        clear_read_cache()
+        t0 = time.perf_counter()
+        hs.optimize_index("bench_opt")
+        dt = time.perf_counter() - t0
+        log(f"  rung5 optimize run {i}: {dt:.3f}s")
+        opt_s = min(opt_s, dt)
+
+    full_s = float("inf")
+    for i in range(2):
+        clear_read_cache()
+        t0 = time.perf_counter()
+        hs.refresh_index("bench_opt", mode="full")
+        dt = time.perf_counter() - t0
+        log(f"  rung5 full refresh run {i}: {dt:.3f}s")
+        full_s = min(full_s, dt)
+    return inc_s, opt_s, full_s
+
+
 def main():
     work = tempfile.mkdtemp(prefix="hs_bench_")
     try:
@@ -331,6 +446,13 @@ def main():
         log(f"rung3: device {dev3:.3f}s vs cpu {cpu3:.3f}s (x{cpu3 / dev3:.2f})")
         dev4, cpu4 = rung4_hybrid(sess, hs, left, work)
         log(f"rung4: device {dev4:.3f}s vs cpu {cpu4:.3f}s (x{cpu4 / dev4:.2f})")
+        dev4b, cpu4b = rung4b_hybrid_join(sess, hs, rdf, work)
+        log(f"rung4b: device {dev4b:.3f}s vs cpu {cpu4b:.3f}s "
+            f"(x{cpu4b / dev4b:.2f})")
+        inc5, opt5, full5 = rung5_compaction(sess, hs, work)
+        log(f"rung5: incremental {inc5:.3f}s, optimize {opt5:.3f}s vs "
+            f"full refresh {full5:.3f}s (optimize x{full5 / opt5:.2f}, "
+            f"incremental x{full5 / inc5:.2f})")
 
         result = {
             "metric": "covering_index_build_rows_per_sec_chip",
@@ -350,6 +472,15 @@ def main():
                 "4_hybrid_scan": {"device_s": round(dev4, 3),
                                   "cpu_s": round(cpu4, 3),
                                   "vs_baseline": round(cpu4 / dev4, 3)},
+                "4b_hybrid_join": {"device_s": round(dev4b, 3),
+                                   "cpu_s": round(cpu4b, 3),
+                                   "vs_baseline": round(cpu4b / dev4b, 3)},
+                "5_compaction": {"incremental_refresh_s": round(inc5, 3),
+                                 "optimize_s": round(opt5, 3),
+                                 "full_refresh_s": round(full5, 3),
+                                 "vs_baseline": round(full5 / opt5, 3),
+                                 "incremental_vs_full": round(
+                                     full5 / inc5, 3)},
             },
         }
         print(json.dumps(result))

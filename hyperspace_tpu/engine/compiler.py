@@ -86,11 +86,18 @@ class ExpressionCompiler:
         if isinstance(e, (E.Add, E.Sub, E.Mul, E.Div)):
             lv, lval = self.value(e.left)
             rv, rval = self.value(e.right)
+            # Widen BEFORE computing (infer_dtype's rule: ints accumulate
+            # as int64, any float promotes to float64, Div is float64) —
+            # narrow int32/int16 operands must not wrap at their own
+            # width.
+            lv = self.xp.asarray(lv)
+            rv = self.xp.asarray(rv)
+            floats = (type(e).op == "div"
+                      or lv.dtype.kind == "f" or rv.dtype.kind == "f")
+            wide = self.xp.float64 if floats else self.xp.int64
             ops = {"add": self.xp.add, "sub": self.xp.subtract,
                    "mul": self.xp.multiply, "div": self.xp.divide}
-            if type(e).op == "div":
-                lv = self.xp.asarray(lv).astype(self.xp.float64)
-            out = ops[type(e).op](lv, rv)
+            out = ops[type(e).op](lv.astype(wide), rv.astype(wide))
             return out, self._merge_validity(lval, rval)
         raise HyperspaceException(f"Unsupported value expression: {e!r}")
 

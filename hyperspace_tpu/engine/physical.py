@@ -109,16 +109,24 @@ class ScanExec(PhysicalNode):
             files = self.scan.files()
         if not files:
             return _empty_batch(self.out_schema)
-        table = parquet.read_table(files, columns=self.columns)
         # Adaptive lane: small reads (e.g. a pruned point-filter bucket)
         # stay in host memory — a device round-trip (~100 ms tunneled)
         # would dwarf the work. Downstream jnp operators promote host
-        # batches to the device transparently when they need it.
+        # batches to the device transparently when they need it. Host
+        # batches come through the stamped decoded-batch cache.
         from hyperspace_tpu.constants import MIN_DEVICE_ROWS_DEFAULT
         min_dev = (self.conf.min_device_rows if self.conf is not None
                    else MIN_DEVICE_ROWS_DEFAULT)
-        host = bucket is None and table.num_rows < min_dev
-        batch = columnar.from_arrow(table, self.out_schema, device=not host)
+        # Footer row counts only gate the lane choice, which per-bucket
+        # reads don't make — keep the metadata pass off that hot path.
+        host = (bucket is None
+                and sum(parquet.file_row_counts(files)) < min_dev)
+        if host:
+            batch = parquet.read_host_batch(files, self.columns,
+                                            self.out_schema)
+        else:
+            table = parquet.read_table(files, columns=self.columns)
+            batch = columnar.from_arrow(table, self.out_schema, device=True)
         if bucket is not None and len(files) > 1:
             # Multiple sorted runs in one bucket (incremental deltas): the
             # concat is not globally sorted — restore order on device.
@@ -156,14 +164,16 @@ class ScanExec(PhysicalNode):
         counts = parquet.file_row_counts([f for _, f in ordered])
         for (b, _), c in zip(ordered, counts):
             lengths[b] += c
-        table = parquet.read_table([f for _, f in ordered],
-                                   columns=self.columns)
+        files = [f for _, f in ordered]
         from hyperspace_tpu.constants import MIN_DEVICE_ROWS_DEFAULT
         min_dev = (self.conf.min_device_rows if self.conf is not None
                    else MIN_DEVICE_ROWS_DEFAULT)
-        host = table.num_rows < min_dev
+        if int(lengths.sum()) < min_dev:
+            return parquet.read_host_batch(files, self.columns,
+                                           self.out_schema), lengths
+        table = parquet.read_table(files, columns=self.columns)
         return columnar.from_arrow(table, self.out_schema,
-                                   device=not host), lengths
+                                   device=True), lengths
 
 
 class FilterExec(PhysicalNode):
@@ -504,6 +514,50 @@ class UnionExec(PhysicalNode):
             return non_empty[0]
         return columnar.concat_batches(non_empty)
 
+    def execute_bucketed(self, num_buckets: int):
+        """Hybrid scan as a bucketed source: each child produces the
+        (batch, lengths) contract — the index side from its on-disk
+        layout, the appended side through the ExchangeExec the planner
+        wrapped it in — and the parts are interleaved bucket-major so the
+        combined batch satisfies the layout the batched join expects."""
+        import numpy as np
+
+        parts = [c.execute_bucketed(num_buckets) for c in self._children]
+        if len(parts) == 1:
+            return parts[0]
+        batches = [b for b, _ in parts]
+        total_lengths = np.zeros(num_buckets, dtype=np.int64)
+        for _, l in parts:
+            total_lengths += np.asarray(l, dtype=np.int64)
+        non_empty = [b for b in batches if b.num_rows > 0]
+        if not non_empty:
+            return batches[0], total_lengths
+        combined = (non_empty[0] if len(non_empty) == 1
+                    else columnar.concat_batches(batches))
+        if len(non_empty) == 1:
+            return combined, total_lengths
+        # Interleave: rows of bucket b from every part become contiguous.
+        base = np.concatenate(
+            [[0], np.cumsum([b.num_rows for b in batches])])
+        part_offsets = [np.concatenate([[0], np.cumsum(
+            np.asarray(l, dtype=np.int64))]) for _, l in parts]
+        total = int(total_lengths.sum())
+        perm = np.empty(total, dtype=np.int64)
+        pos = 0
+        for bkt in range(num_buckets):
+            for pi in range(len(parts)):
+                cnt = int(part_offsets[pi][bkt + 1]
+                          - part_offsets[pi][bkt])
+                if cnt:
+                    start = base[pi] + part_offsets[pi][bkt]
+                    perm[pos:pos + cnt] = np.arange(start, start + cnt)
+                    pos += cnt
+        idx = perm.astype(np.int32)
+        if not combined.is_host:
+            import jax.numpy as jnp
+            idx = jnp.asarray(idx)
+        return combined.take(idx), total_lengths
+
 
 class ReusedExec(PhysicalNode):
     """Common-subplan reuse (Spark's ReuseExchange/ReuseSubquery analog):
@@ -554,7 +608,7 @@ class SortMergeJoinExec(PhysicalNode):
                  left_keys: Sequence[str], right_keys: Sequence[str],
                  bucketed: bool, num_buckets: int = 0,
                  out_schema: Optional[Schema] = None, how: str = "inner",
-                 conf=None):
+                 conf=None, out_columns: Optional[Set[str]] = None):
         self.left = left
         self.right = right
         self.left_keys = list(left_keys)
@@ -564,6 +618,10 @@ class SortMergeJoinExec(PhysicalNode):
         self.out_schema = out_schema
         self.how = how
         self.conf = conf
+        # Late projection: lowered OUTPUT column names the consumer needs;
+        # assembly gathers only these (keys and dropped payload are never
+        # materialized through the match expansion).
+        self.out_columns = out_columns
 
     @property
     def children(self):
@@ -619,14 +677,23 @@ class SortMergeJoinExec(PhysicalNode):
                     assemble_join_output)
                 from hyperspace_tpu.parallel.join import (
                     distributed_bucketed_join_indices)
-                li, ri = distributed_bucketed_join_indices(
-                    lbatch, rbatch, l_lengths, r_lengths, self.left_keys,
-                    self.right_keys, mesh)
+                if self.how == "right_outer":
+                    ri, li = distributed_bucketed_join_indices(
+                        rbatch, lbatch, r_lengths, l_lengths,
+                        self.right_keys, self.left_keys, mesh,
+                        how="left_outer")
+                else:
+                    li, ri = distributed_bucketed_join_indices(
+                        lbatch, rbatch, l_lengths, r_lengths,
+                        self.left_keys, self.right_keys, mesh,
+                        how=self.how)
                 return assemble_join_output(lbatch, rbatch, li, ri,
-                                            how=self.how)
+                                            how=self.how,
+                                            columns=self.out_columns)
             return bucketed_sort_merge_join(lbatch, rbatch, l_lengths,
                                             r_lengths, self.left_keys,
-                                            self.right_keys, how=self.how)
+                                            self.right_keys, how=self.how,
+                                            columns=self.out_columns)
         # General path: the planner wrapped each side in
         # Sort(Exchange(...)). Both are unwrapped here and the join picks
         # the physical strategy:
@@ -673,7 +740,8 @@ class SortMergeJoinExec(PhysicalNode):
             rpart, rlen = rex.partition(rbatch)
             return bucketed_sort_merge_join(lpart, rpart, llen, rlen,
                                             self.left_keys, self.right_keys,
-                                            how=self.how)
+                                            how=self.how,
+                                            columns=self.out_columns)
         presort = (lkeys is not None and rkeys is not None
                    and not lbatch.is_host and not rbatch.is_host)
         if presort:
@@ -684,15 +752,16 @@ class SortMergeJoinExec(PhysicalNode):
                 rbatch = sort_batch(rbatch, rkeys)
         return sort_merge_join(lbatch, rbatch, self.left_keys,
                                self.right_keys, presorted=presort,
-                               how=self.how)
+                               how=self.how, columns=self.out_columns)
 
     def _join_mesh(self, total_rows: int, host_batch: bool = False):
         """Mesh for the distributed co-bucketed join, or None. Requires an
-        inner join (the distributed index path has no outer expansion) and
-        the bucket<->shard map (num_buckets divisible by mesh size)."""
+        inner/one-sided-outer join (full_outer's appended-right pass is
+        single-chip only) and the bucket<->shard map (num_buckets
+        divisible by mesh size)."""
         from hyperspace_tpu.parallel.context import (mesh_size,
                                                      should_distribute)
-        if self.how != "inner":
+        if self.how not in ("inner", "left_outer", "right_outer"):
             return None
         mesh = should_distribute(self.conf, total_rows,
                                  host_batch=host_batch)
@@ -797,6 +866,45 @@ def _apply_bucket_pruning(condition: E.Expression, child: PhysicalNode):
     return child
 
 
+def _hoist_union(plan: LogicalPlan) -> LogicalPlan:
+    """Pull a Union above Filter/Project wrappers (both distribute over
+    union row-wise) so join-over-union distribution can see it."""
+    if isinstance(plan, (Project, Filter)):
+        child = _hoist_union(plan.child)
+        if isinstance(child, Union):
+            return Union([plan.with_children([c])
+                          for c in child.children])
+    return plan
+
+
+def _chain_has_bucketed_scan(node: PhysicalNode) -> bool:
+    while isinstance(node, (ProjectExec, FilterExec, ReusedExec)):
+        node = node.child
+    return isinstance(node, ScanExec) and node.scan.bucket_spec is not None
+
+
+def _bucketize_union_children(node: PhysicalNode, keys: List[str],
+                              num_buckets: int, conf) -> None:
+    """Descend a join side's Project/Filter chain; if it feeds a UnionExec
+    (hybrid scan), wrap each child that does NOT ride a bucketed layout in
+    an ExchangeExec over the join keys — the appended slice then arrives
+    co-partitioned with the index buckets. Idempotent (a shared/reused
+    union may be visited by both sides of a self-join)."""
+    while isinstance(node, (ProjectExec, FilterExec, ReusedExec)):
+        node = node.child
+    if not isinstance(node, UnionExec):
+        return
+    wrapped = []
+    for c in node._children:
+        if _chain_has_bucketed_scan(c) or (
+                isinstance(c, ExchangeExec)
+                and c.num_partitions == num_buckets):
+            wrapped.append(c)
+        else:
+            wrapped.append(ExchangeExec(keys, num_buckets, c, conf=conf))
+    node._children = wrapped
+
+
 def _join_keys(condition: E.Expression, left_schema: Schema,
                right_schema: Schema) -> Tuple[List[str], List[str]]:
     """Extract equi-join key pairs from an AND-of-equalities condition
@@ -825,14 +933,19 @@ def _join_keys(condition: E.Expression, left_schema: Schema,
 
 def _underlying_bucket_spec(plan: LogicalPlan) -> Optional[BucketSpec]:
     """The bucket spec of the scan feeding a linear Filter/Project chain —
-    filters and projections preserve bucketing and intra-bucket order."""
+    filters and projections preserve bucketing and intra-bucket order. A
+    Union whose FIRST child rides a bucketed layout (hybrid scan: index
+    data UNION appended files) reports that spec; the planner re-buckets
+    the remaining children through ExchangeExec at execution time."""
     node = plan
     while True:
         if isinstance(node, Scan):
             return node.bucket_spec
-        if isinstance(node, (Filter, Project)) :
+        if isinstance(node, (Filter, Project)):
             node = node.child
             continue
+        if isinstance(node, Union):
+            return _underlying_bucket_spec(node.children[0])
         return None
 
 
@@ -955,6 +1068,10 @@ def _plan_physical_node(plan: LogicalPlan,
         child_required = set(plan.group_columns)
         for a in plan.aggregates:
             child_required |= a.references()
+        if not child_required:
+            # Bare count(*): a ColumnBatch carries its row count only
+            # through its columns, so read at least one.
+            child_required = {plan.child.schema.names[0]}
         return AggregateExec(plan.group_columns, plan.aggregates,
                              plan.schema,
                              _plan_physical(plan.child, child_required,
@@ -984,6 +1101,33 @@ def _plan_physical_node(plan: LogicalPlan,
             for c in plan.children])
 
     if isinstance(plan, Join):
+        # Join-over-union distribution: (A UNION B) JOIN R executes as
+        # (A JOIN R) UNION (B JOIN R) when the join type distributes over
+        # that side. The hybrid-scan Union then keeps its index part on
+        # the native bucketed fast path while only the (small) appended
+        # part pays a general join; the shared right subtree executes
+        # once via ReusedExec. Filter/Project wrappers themselves
+        # distribute over Union, so the union is hoisted through them
+        # first.
+        left_h = _hoist_union(plan.left)
+        right_h = _hoist_union(plan.right)
+        if (isinstance(left_h, Union)
+                and plan.join_type in ("inner", "left_outer", "left_semi",
+                                       "left_anti")):
+            branches = len(left_h.children)
+            k = _subtree_key(plan.right, ctx["keys"])
+            ctx["counts"][k] = ctx["counts"].get(k, 0) + branches - 1
+            return _plan_physical_node(
+                Union([Join(c, plan.right, plan.condition, plan.join_type)
+                       for c in left_h.children]), required, conf, ctx)
+        if (isinstance(right_h, Union)
+                and plan.join_type in ("inner", "right_outer")):
+            branches = len(right_h.children)
+            k = _subtree_key(plan.left, ctx["keys"])
+            ctx["counts"][k] = ctx["counts"].get(k, 0) + branches - 1
+            return _plan_physical_node(
+                Union([Join(plan.left, c, plan.condition, plan.join_type)
+                       for c in right_h.children]), required, conf, ctx)
         left_keys, right_keys = _join_keys(plan.condition, plan.left.schema,
                                            plan.right.schema)
         if plan.join_type in ("left_semi", "left_anti"):
@@ -998,10 +1142,18 @@ def _plan_physical_node(plan: LogicalPlan,
                 _plan_physical(plan.right, set(right_keys), conf, ctx),
                 left_keys, right_keys, bucketed=False,
                 how=plan.join_type, conf=conf)
+        out_columns = {n.lower() for n in required}
         left_required = ({n for n in required if plan.left.schema.contains(n)}
                          | set(left_keys))
         right_required = ({n for n in required if plan.right.schema.contains(n)}
                           | set(right_keys))
+        # A duplicate right column surfaces as `<name>_r` in the join
+        # output; map such required names back to the right-side source.
+        for n in required:
+            base = n[:-2] if n.lower().endswith("_r") else None
+            if (base and plan.right.schema.contains(base)
+                    and plan.left.schema.contains(base)):
+                right_required.add(base)
         left_phys = _plan_physical(plan.left, left_required, conf, ctx)
         right_phys = _plan_physical(plan.right, right_required, conf, ctx)
 
@@ -1038,10 +1190,16 @@ def _plan_physical_node(plan: LogicalPlan,
             elif rspec.num_buckets != target:
                 right_phys = ExchangeExec(right_keys, target, right_phys,
                                           conf=conf)
+            # Hybrid-scan sides: re-bucket the appended (unbucketed) Union
+            # children through THE hash Exchange so they co-partition with
+            # the index layout.
+            _bucketize_union_children(left_phys, left_keys, target, conf)
+            _bucketize_union_children(right_phys, right_keys, target, conf)
             return SortMergeJoinExec(left_phys, right_phys, left_keys,
                                      right_keys, bucketed=True,
                                      num_buckets=target,
-                                     how=plan.join_type, conf=conf)
+                                     how=plan.join_type, conf=conf,
+                                     out_columns=out_columns)
         # General path: hash exchange + sort on each side.
         num_partitions = max(lspec.num_buckets if lspec else 0,
                              rspec.num_buckets if rspec else 0, 200)
@@ -1054,6 +1212,7 @@ def _plan_physical_node(plan: LogicalPlan,
                                                          conf=conf))
         return SortMergeJoinExec(left_sorted, right_sorted, left_keys,
                                  right_keys, bucketed=False,
-                                 how=plan.join_type, conf=conf)
+                                 how=plan.join_type, conf=conf,
+                                 out_columns=out_columns)
 
     raise HyperspaceException(f"Cannot plan node: {plan!r}")

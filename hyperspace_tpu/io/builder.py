@@ -307,6 +307,36 @@ def write_index(df, indexed_columns: Sequence[str],
     return written
 
 
+_MERGE_KEY_DTYPES = ("int64", "int32", "int16", "int8", "date32",
+                     "timestamp", "bool")
+
+
+def _merge_path_permutation(table, ordered, counts, names, schema,
+                            num_buckets):
+    """The compaction fast path: single null-free integer key -> a TRUE
+    merge of each bucket's sorted runs (no re-sort of the base run,
+    `ops/merge.host_merge_runs_permutation`). None when the shape doesn't
+    qualify (multi-key, strings, floats — float lane order differs from
+    raw order — or a nullable key); callers fall back to the batched
+    sort."""
+    if len(names) != 1 or schema.field(names[0]).dtype not in \
+            _MERGE_KEY_DTYPES:
+        return None
+    col = table.column(names[0])
+    if col.null_count:
+        return None
+    from hyperspace_tpu.ops.merge import host_merge_runs_permutation
+    key = col.to_numpy(zero_copy_only=False)
+    # run_bounds indexed by BUCKET ID (empty list for absent buckets) so
+    # the writer's starts/ends line up with bucket file numbering.
+    run_bounds = [[] for _ in range(num_buckets)]
+    offset = 0
+    for (b, _), c in zip(ordered, counts):
+        run_bounds[b].append((offset, offset + c))
+        offset += c
+    return host_merge_runs_permutation(key, run_bounds)
+
+
 def compact_index(prev_entry, data_manager, out_path: str) -> List[str]:
     """Merge-compact the current data version's runs (base + incremental
     delta runs living side by side in one `v__=N` dir) into one
@@ -352,7 +382,11 @@ def compact_index(prev_entry, data_manager, out_path: str) -> List[str]:
     schema = Schema.from_arrow(table.schema)
 
     names = [schema.field(c).name for c in indexed]
-    if table.num_rows < BUILD_MIN_DEVICE_ROWS:
+    merge_perm = _merge_path_permutation(table, ordered, counts, names,
+                                         schema, num_buckets)
+    if merge_perm is not None:
+        chunks, starts, ends = merge_perm
+    elif table.num_rows < BUILD_MIN_DEVICE_ROWS:
         key_batch = columnar.from_arrow(table.select(names), device=False)
         chunks, starts, ends = host_bucket_sort_permutation(
             key_batch, names, lengths)

@@ -17,7 +17,11 @@ from typing import Optional
 logger = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SO_PATH = os.path.join(_HERE, "libhyperspace_host.so")
+# ABI version in the filename: a .so built from older sources simply
+# never matches the load path (no in-place overwrite of a possibly
+# mmapped stale library, no dlopen returning the cached stale handle).
+_ABI_VERSION = 2
+_SO_PATH = os.path.join(_HERE, f"libhyperspace_host_v{_ABI_VERSION}.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
@@ -27,7 +31,7 @@ def _build() -> bool:
     src = os.path.join(_HERE, "hyperspace_host.cpp")
     try:
         subprocess.run(
-            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
              "-o", _SO_PATH, src],
             check=True, capture_output=True, timeout=120)
         return True
@@ -53,8 +57,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 fn.restype = None
                 fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                ctypes.c_int64, ctypes.c_void_p]
+            lib.bucketed_merge_join_count_i64.restype = None
+            lib.bucketed_merge_join_count_i64.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+                ctypes.c_int, ctypes.c_void_p]
+            lib.bucketed_merge_join_fill_i64.restype = None
+            lib.bucketed_merge_join_fill_i64.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+                ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p]
             _lib = lib
-        except OSError as exc:
+        except (OSError, AttributeError) as exc:
+            # AttributeError = missing symbol (a hand-built .so from other
+            # sources at the versioned path): fall back to numpy.
             logger.warning("Native host library load failed: %s", exc)
         return _lib
 
@@ -103,3 +120,45 @@ def string_hash64(values) -> Optional["numpy.ndarray"]:
     if values.dtype.kind != "U":
         values = values.astype(object)
     return arrow_string_hash64(pa.array(values, type=pa.string()))
+
+
+def bucketed_merge_join_i64(lkey, rkey, lbounds, rbounds,
+                            left_outer: bool = False):
+    """Multithreaded per-bucket sorted merge join over int64 keys in the
+    bucket-major index layout. `lbounds`/`rbounds` are the B+1 cumulative
+    bucket boundaries; both sides must be sorted within each bucket.
+    Returns (li, ri) int32 row-index pairs (ri -1 for unmatched left rows
+    under left_outer), or None when the native library is unavailable —
+    callers fall back to the numpy path (`ops/join.py`)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    lkey = np.ascontiguousarray(lkey, dtype=np.int64)
+    rkey = np.ascontiguousarray(rkey, dtype=np.int64)
+    lbounds = np.ascontiguousarray(lbounds, dtype=np.int64)
+    rbounds = np.ascontiguousarray(rbounds, dtype=np.int64)
+    B = len(lbounds) - 1
+    n_threads = min(os.cpu_count() or 1, 16)
+    counts = np.zeros(B, dtype=np.int64)
+
+    def p(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    lib.bucketed_merge_join_count_i64(
+        p(lkey), p(rkey), p(lbounds), p(rbounds), ctypes.c_int64(B),
+        ctypes.c_int(1 if left_outer else 0), ctypes.c_int(n_threads),
+        p(counts))
+    offsets = np.zeros(B, dtype=np.int64)
+    if B > 1:
+        np.cumsum(counts[:-1], out=offsets[1:])
+    total = int(counts.sum())
+    li = np.empty(total, dtype=np.int32)
+    ri = np.empty(total, dtype=np.int32)
+    if total:
+        lib.bucketed_merge_join_fill_i64(
+            p(lkey), p(rkey), p(lbounds), p(rbounds), ctypes.c_int64(B),
+            ctypes.c_int(1 if left_outer else 0), ctypes.c_int(n_threads),
+            p(offsets), p(li), p(ri))
+    return li, ri

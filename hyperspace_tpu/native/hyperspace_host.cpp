@@ -11,6 +11,139 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Per-bucket sorted merge join over int64 keys laid out bucket-major
+// (both sides sorted within each bucket — the covering-index layout).
+// Classic run-merge: for each run of equal left keys, bracket the equal
+// right run once; inner emits the cross product, left_outer emits one
+// (i, -1) row per unmatched left row.
+
+struct JoinInputs {
+    const int64_t* lk;
+    const int64_t* rk;
+    const int64_t* lb;  // B+1 cumulative left bucket bounds
+    const int64_t* rb;  // B+1 cumulative right bucket bounds
+    int left_outer;
+};
+
+void count_range(const JoinInputs& in, int64_t b0, int64_t b1,
+                 int64_t* counts) {
+    for (int64_t b = b0; b < b1; ++b) {
+        int64_t i = in.lb[b], le = in.lb[b + 1];
+        int64_t j = in.rb[b], re = in.rb[b + 1];
+        int64_t cnt = 0;
+        while (i < le) {
+            const int64_t k = in.lk[i];
+            while (j < re && in.rk[j] < k) ++j;
+            int64_t j2 = j;
+            while (j2 < re && in.rk[j2] == k) ++j2;
+            int64_t i2 = i;
+            while (i2 < le && in.lk[i2] == k) ++i2;
+            const int64_t m = j2 - j;
+            cnt += m ? m * (i2 - i) : (in.left_outer ? (i2 - i) : 0);
+            i = i2;
+            j = j2;
+        }
+        counts[b] = cnt;
+    }
+}
+
+void fill_range(const JoinInputs& in, int64_t b0, int64_t b1,
+                const int64_t* offsets, int32_t* li, int32_t* ri) {
+    for (int64_t b = b0; b < b1; ++b) {
+        int64_t i = in.lb[b], le = in.lb[b + 1];
+        int64_t j = in.rb[b], re = in.rb[b + 1];
+        int64_t o = offsets[b];
+        while (i < le) {
+            const int64_t k = in.lk[i];
+            while (j < re && in.rk[j] < k) ++j;
+            int64_t j2 = j;
+            while (j2 < re && in.rk[j2] == k) ++j2;
+            int64_t i2 = i;
+            while (i2 < le && in.lk[i2] == k) ++i2;
+            if (j2 > j) {
+                for (int64_t a = i; a < i2; ++a) {
+                    for (int64_t c = j; c < j2; ++c) {
+                        li[o] = static_cast<int32_t>(a);
+                        ri[o] = static_cast<int32_t>(c);
+                        ++o;
+                    }
+                }
+            } else if (in.left_outer) {
+                for (int64_t a = i; a < i2; ++a) {
+                    li[o] = static_cast<int32_t>(a);
+                    ri[o] = -1;
+                    ++o;
+                }
+            }
+            i = i2;
+            j = j2;
+        }
+    }
+}
+
+// Contiguous bucket ranges balanced by left-row mass.
+std::vector<int64_t> split_buckets(const int64_t* lb, int64_t B,
+                                   int n_threads) {
+    std::vector<int64_t> cuts;
+    cuts.push_back(0);
+    const int64_t total = lb[B];
+    for (int t = 1; t < n_threads; ++t) {
+        const int64_t want = total * t / n_threads;
+        int64_t b = cuts.back();
+        while (b < B && lb[b] < want) ++b;
+        cuts.push_back(b);
+    }
+    cuts.push_back(B);
+    return cuts;
+}
+
+template <typename Fn>
+void run_threaded(const int64_t* lb, int64_t B, int n_threads, Fn fn) {
+    if (n_threads <= 1 || B <= 1) {
+        fn(0, B);
+        return;
+    }
+    auto cuts = split_buckets(lb, B, n_threads);
+    std::vector<std::thread> workers;
+    for (size_t t = 0; t + 1 < cuts.size(); ++t) {
+        if (cuts[t + 1] > cuts[t]) {
+            workers.emplace_back(fn, cuts[t], cuts[t + 1]);
+        }
+    }
+    for (auto& w : workers) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+void bucketed_merge_join_count_i64(const int64_t* lk, const int64_t* rk,
+                                   const int64_t* lb, const int64_t* rb,
+                                   int64_t B, int left_outer,
+                                   int n_threads, int64_t* counts) {
+    JoinInputs in{lk, rk, lb, rb, left_outer};
+    run_threaded(lb, B, n_threads, [&](int64_t b0, int64_t b1) {
+        count_range(in, b0, b1, counts);
+    });
+}
+
+void bucketed_merge_join_fill_i64(const int64_t* lk, const int64_t* rk,
+                                  const int64_t* lb, const int64_t* rb,
+                                  int64_t B, int left_outer, int n_threads,
+                                  const int64_t* offsets, int32_t* li,
+                                  int32_t* ri) {
+    JoinInputs in{lk, rk, lb, rb, left_outer};
+    run_threaded(lb, B, n_threads, [&](int64_t b0, int64_t b1) {
+        fill_range(in, b0, b1, offsets, li, ri);
+    });
+}
+
+}  // extern "C"
 
 extern "C" {
 

@@ -273,9 +273,9 @@ def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
                         r_idx, total, int(l_pos.shape[1]))
 
 
-def _gather_side(batch: ColumnBatch, idx, may_unmatch: bool = True):
-    """Gather rows by index; index -1 (unmatched outer row) yields null.
-    Host-lane batches with host indices gather in numpy.
+def _gather_side(batch: ColumnBatch, idx, names, may_unmatch: bool = True):
+    """Gather `names` columns of rows by index; index -1 (unmatched outer
+    row) yields null. Host-lane batches with host indices gather in numpy.
 
     `may_unmatch=False` (inner-join sides) skips the unmatched handling —
     on device arrays a data-dependent `any()` would cost a blocking
@@ -285,10 +285,11 @@ def _gather_side(batch: ColumnBatch, idx, may_unmatch: bool = True):
     else:
         import jax.numpy as xp
 
+    narrowed = batch.select(names)
     if not may_unmatch or idx.shape[0] == 0:
-        return batch.take(idx)
+        return narrowed.take(idx)
     unmatched = idx < 0
-    out = batch.take(xp.clip(idx, 0, None))
+    out = narrowed.take(xp.clip(idx, 0, None))
     columns = {}
     for name, col in out.columns.items():
         validity = (col.validity & ~unmatched
@@ -299,33 +300,61 @@ def _gather_side(batch: ColumnBatch, idx, may_unmatch: bool = True):
 
 
 def assemble_join_output(left: ColumnBatch, right: ColumnBatch,
-                         li, ri, how: str = "left_outer") -> ColumnBatch:
+                         li, ri, how: str = "left_outer",
+                         columns=None) -> ColumnBatch:
     """Gather both sides by index pairs into the joined batch; -1 on either
     side (unmatched outer row) yields null columns for that side. Duplicate
     output names get a `_r` suffix on the right. `how` statically bounds
     which sides can hold -1 (inner: neither; left_outer: right only;
-    right_outer: left only) so no data-dependent device sync is needed."""
+    right_outer: left only) so no data-dependent device sync is needed.
+
+    `columns` (lowered OUTPUT names) enables late projection: only the
+    listed output columns are gathered — a join used under a projection
+    never materializes the join keys or other dropped payload."""
     from hyperspace_tpu.plan.schema import Field, Schema
 
-    left_out = _gather_side(left, li,
-                            may_unmatch=how in ("right_outer", "full_outer"))
-    right_out = _gather_side(right, ri,
-                             may_unmatch=how in ("left_outer", "full_outer"))
-    fields = list(left_out.schema.fields)
-    columns = dict(left_out.columns)
-    left_names = {f.name.lower() for f in fields}
+    left_names = {f.name.lower() for f in left.schema.fields}
+    plan = []  # (out_name, side, source_name, dtype)
+    for f in left.schema.fields:
+        if columns is None or f.name.lower() in columns:
+            plan.append((f.name, "l", f.name, f.dtype))
     for f in right.schema.fields:
-        name = f.name if f.name.lower() not in left_names else f.name + "_r"
-        fields.append(Field(name, f.dtype, True))
-        columns[name] = right_out.columns[f.name]
-    return ColumnBatch(Schema(fields), columns)
+        out = f.name if f.name.lower() not in left_names else f.name + "_r"
+        if columns is None or out.lower() in columns:
+            plan.append((out, "r", f.name, f.dtype))
+
+    if not plan:
+        # A consumer needing no columns at all (count(*) over the join)
+        # still needs the row count, which a ColumnBatch carries only
+        # through its columns — keep one.
+        f = left.schema.fields[0]
+        plan.append((f.name, "l", f.name, f.dtype))
+    lwanted = [src for _, side, src, _ in plan if side == "l"]
+    rwanted = [src for _, side, src, _ in plan if side == "r"]
+    left_out = _gather_side(left, li, lwanted,
+                            may_unmatch=how in ("right_outer", "full_outer"))
+    right_out = _gather_side(right, ri, rwanted,
+                             may_unmatch=how in ("left_outer", "full_outer"))
+    fields = []
+    out_columns = {}
+    for out, side, src, dtype in plan:
+        if side == "l":
+            fields.append(Field(out, dtype,
+                                left.schema.field(src).nullable
+                                or how in ("right_outer", "full_outer")))
+            out_columns[out] = left_out.columns[src]
+        else:
+            fields.append(Field(out, dtype, True))
+            out_columns[out] = right_out.columns[src]
+    return ColumnBatch(Schema(fields), out_columns)
 
 
 def bucketed_sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                              l_lengths: np.ndarray, r_lengths: np.ndarray,
                              left_keys: Sequence[str],
                              right_keys: Sequence[str],
-                             how: str = "inner") -> ColumnBatch:
+                             how: str = "inner",
+                             columns=None) -> ColumnBatch:
     """Full bucketed join over concat-in-bucket-order sides. full_outer =
     the left_outer expansion plus one appended row per unmatched right
     row (both sides share one hash layout, so membership is global)."""
@@ -352,4 +381,5 @@ def bucketed_sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                 li = jnp.concatenate(
                     [li, jnp.full(extra.shape[0], -1, dtype=jnp.int32)])
                 ri = jnp.concatenate([ri, extra])
-    return assemble_join_output(left, right, li, ri, how=how)
+    return assemble_join_output(left, right, li, ri, how=how,
+                                columns=columns)

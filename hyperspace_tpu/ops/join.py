@@ -147,7 +147,8 @@ def semi_anti_indices(left: ColumnBatch, right: ColumnBatch,
 
 def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                     left_keys: Sequence[str], right_keys: Sequence[str],
-                    presorted: bool = False, how: str = "inner"):
+                    presorted: bool = False, how: str = "inner",
+                    columns=None):
     """Join of two batches on equi-keys (inner / left_outer / right_outer
     / full_outer).
 
@@ -181,7 +182,8 @@ def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                 li = np_.concatenate(
                     [li, np_.full(len(extra), -1, dtype=np_.int32)])
                 ri = np_.concatenate([ri, extra])
-        return assemble_join_output(left, right, li, ri, how=how)
+        return assemble_join_output(left, right, li, ri, how=how,
+                                    columns=columns)
 
     l_ids, r_ids = encode_join_keys(left, right, left_keys, right_keys)
     if not presorted:
@@ -201,7 +203,8 @@ def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
             li = jnp.concatenate(
                 [li, jnp.full(extra.shape[0], -1, dtype=jnp.int32)])
             ri = jnp.concatenate([ri, extra])
-    return assemble_join_output(left, right, li, ri, how=how)
+    return assemble_join_output(left, right, li, ri, how=how,
+                                columns=columns)
 
 
 # ---------------------------------------------------------------------------
@@ -423,20 +426,43 @@ def host_bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
     lb = np.concatenate([[0], np.cumsum(l_lengths)]).astype(np.int64)
     rb = np.concatenate([[0], np.cumsum(r_lengths)]).astype(np.int64)
 
-    # Right side must be sorted within each bucket (multi-run buckets from
-    # incremental refresh are concatenated unsorted): one vectorized check;
-    # repair with a per-bucket stable sort of the SMALL side only.
-    in_bucket = np.ones(len(rkey) - 1, dtype=bool) if len(rkey) > 1 else None
-    r_perm = None
-    if in_bucket is not None:
-        boundary = rb[1:-1]  # positions where a new bucket starts
-        boundary = boundary[(boundary > 0) & (boundary < len(rkey))]
+    def _unsorted_within(key, bounds):
+        if len(key) <= 1:
+            return False
+        in_bucket = np.ones(len(key) - 1, dtype=bool)
+        boundary = bounds[1:-1]
+        boundary = boundary[(boundary > 0) & (boundary < len(key))]
         in_bucket[boundary - 1] = False
-        if not (rkey[1:][in_bucket] >= rkey[:-1][in_bucket]).all():
-            bucket_of = np.searchsorted(rb[1:], np.arange(len(rkey)),
-                                        side="right")
-            r_perm = np.lexsort((rkey, bucket_of)).astype(np.int64)
-            rkey = rkey[r_perm]
+        return not (key[1:][in_bucket] >= key[:-1][in_bucket]).all()
+
+    # Sides must be sorted within each bucket (multi-run buckets from
+    # incremental refresh are concatenated unsorted): one vectorized check
+    # per side; repair with a per-bucket stable sort.
+    r_perm = None
+    if _unsorted_within(rkey, rb):
+        bucket_of = np.searchsorted(rb[1:], np.arange(len(rkey)),
+                                    side="right")
+        r_perm = np.lexsort((rkey, bucket_of)).astype(np.int64)
+        rkey = rkey[r_perm]
+
+    # Native lane: multithreaded C++ per-bucket merge join emits the
+    # (li, ri) pairs directly — no searchsorted pass, no numpy expansion
+    # (the host lane's two dominant costs at millions of rows). Requires
+    # the LEFT side sorted within buckets too (the index layout's
+    # guarantee; repaired above only for the right), so check-and-fall-
+    # through when it is not.
+    if (lkey.dtype == np.int64 and rkey.dtype == np.int64
+            and not _unsorted_within(lkey, lb)):
+        from hyperspace_tpu import native
+        pairs = native.bucketed_merge_join_i64(
+            lkey, rkey, lb, rb, left_outer=(how == "left_outer"))
+        if pairs is not None:
+            li, ri = pairs
+            if r_perm is not None and len(ri):
+                ri = np.where(ri >= 0,
+                              r_perm[np.clip(ri, 0, None)], -1
+                              ).astype(np.int32)
+            return li, ri
 
     lo = np.empty(len(lkey), dtype=np.int64)
     hi = np.empty(len(lkey), dtype=np.int64)

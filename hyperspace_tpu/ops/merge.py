@@ -47,10 +47,9 @@ import numpy as np
 import hyperspace_tpu._jax_config  # noqa: F401
 from hyperspace_tpu.ops import keys as keymod
 from hyperspace_tpu.ops.build import LINK_CHUNK_ROWS, LINK_CHUNKS
-
-
-def next_pow2(n: int) -> int:
-    return 1 << max(2, (int(n) - 1).bit_length())
+# ONE padded-layout builder and pow2 rounding for every [B, L] consumer
+# (join, distributed join, compaction) — they must stay in lockstep.
+from hyperspace_tpu.ops.bucketed_join import _padded_layout, next_pow2
 
 
 @partial(__import__("jax").jit, static_argnames=("n_chunks",))
@@ -88,18 +87,6 @@ def _bucket_sort_core(lanes, l_idx, l_valid, flat_pick, n_chunks: int):
                       ((i + 1) * base if i < n_chunks - 1 else n,))
         for i in range(n_chunks))
     return chunks
-
-
-def _padded_layout(lengths: np.ndarray, width: int):
-    """[B, width] gather matrix + validity into a concat-in-bucket-order
-    row space (the `ops/bucketed_join.py` layout; padding slots point at a
-    real row for safe gathers)."""
-    starts = np.concatenate([[0], np.cumsum(lengths)[:-1]])
-    j = np.arange(width)[None, :]
-    valid = j < lengths[:, None]
-    idx = np.where(valid, starts[:, None] + np.minimum(
-        j, np.maximum(lengths[:, None] - 1, 0)), 0)
-    return idx.astype(np.int32), valid
 
 
 def bucket_sort_permutation(key_batch, sort_columns: Sequence[str],
@@ -141,6 +128,62 @@ def bucket_sort_permutation(key_batch, sort_columns: Sequence[str],
     ends = np.cumsum(lengths)
     starts = ends - lengths
     return list(chunks), starts, ends
+
+
+def host_merge_runs_permutation(key: np.ndarray, run_bounds):
+    """True k-way MERGE permutation for the common compaction shape: per
+    bucket, one large sorted base run plus small sorted-ish delta runs,
+    over a single null-free integer key column.
+
+    Per bucket the deltas are stable-sorted together (tiny), their insert
+    positions into the base run found with ONE searchsorted (side='right'
+    — appended rows follow equal-key base rows, the same tie order a
+    stable sort of base-then-deltas produces), and the output permutation
+    assembled by prefix counting. O(n + k log k + k log n) per bucket with
+    NO re-sort of the base run — the asymptotic win a re-sorting
+    compaction gives up. Falls back to a bucket-local stable sort when a
+    base run is not actually sorted.
+
+    `run_bounds`: per bucket, list of (start, end) global row ranges of
+    its runs in version order (base first). Returns ([perm], starts, ends)
+    in the writer's shape.
+    """
+    lengths = np.array([sum(e - s for s, e in runs)
+                        for runs in run_bounds], dtype=np.int64)
+    total = int(lengths.sum())
+    perm = np.empty(total, dtype=np.int64)
+    out = 0
+    for runs in run_bounds:
+        n_bucket = sum(e - s for s, e in runs)
+        if n_bucket == 0:
+            continue
+        (b0, b1) = runs[0]
+        base = key[b0:b1]
+        if len(runs) == 1:
+            perm[out:out + n_bucket] = np.arange(b0, b1)
+            out += n_bucket
+            continue
+        d_idx = np.concatenate([np.arange(s, e) for s, e in runs[1:]])
+        if len(base) and not (base[1:] >= base[:-1]).all():
+            # Base run unexpectedly unsorted: bucket-local stable sort.
+            all_idx = np.concatenate([np.arange(b0, b1), d_idx])
+            perm[out:out + n_bucket] = all_idx[
+                np.argsort(key[all_idx], kind="stable")]
+            out += n_bucket
+            continue
+        d_sorted = d_idx[np.argsort(key[d_idx], kind="stable")]
+        pos = np.searchsorted(base, key[d_sorted], side="right")
+        nb, kd = len(base), len(d_sorted)
+        # base row i lands at i + #{deltas inserted at or before i}
+        shift = np.cumsum(np.bincount(pos, minlength=nb + 1))[:nb]
+        local = np.empty(n_bucket, dtype=np.int64)
+        local[np.arange(nb) + shift] = np.arange(b0, b1)
+        local[pos + np.arange(kd)] = d_sorted
+        perm[out:out + n_bucket] = local
+        out += n_bucket
+    ends = np.cumsum(lengths)
+    starts = ends - lengths
+    return [perm], starts, ends
 
 
 def host_bucket_sort_permutation(key_batch, sort_columns: Sequence[str],

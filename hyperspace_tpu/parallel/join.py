@@ -3,13 +3,19 @@
 The single-chip batched bucket join (`ops/bucketed_join.py`) is already
 expressed over a leading bucket axis [B, L]; distributing it is a matter of
 SHARDING THAT AXIS over the mesh and letting XLA's SPMD partitioner place
-the per-bucket sorts and searchsorted lookups chip-locally — the jax-native
-"annotate shardings, let XLA insert collectives" recipe. Because bucket b of
-both sides lives on the same shard (bucket % n_shards), the match phase
-runs with ZERO inter-chip traffic; only the final ragged expansion
-all-gathers its (small) counts — the claim the JoinIndexRanker's
-equal-bucket preference encodes (reference
-`index/rankers/JoinIndexRanker.scala:40-55`).
+the per-bucket work chip-locally — the jax-native "annotate shardings, let
+XLA insert collectives" recipe. Because bucket b of both sides lives on the
+same shard (bucket % n_shards), the match phase runs with ZERO inter-chip
+traffic — the claim the JoinIndexRanker's equal-bucket preference encodes
+(reference `index/rankers/JoinIndexRanker.scala:40-55`).
+
+Group encoding is SHARD-LOCAL: matching only ever happens within a bucket,
+so key tuples need consistent ids only within each bucket. Both sides'
+rows of one bucket are gathered into a combined padded [B, Ll+Lr] matrix
+and sorted per bucket (one batched `lax.sort` along the row axis, sharded
+over buckets); adjacent-difference ids within each bucket row replace the
+round-2 design's REPLICATED global sort over all rows — the scaling
+bottleneck the round-2 review called out.
 
 When bucket counts differ (the ranker's fallback), `rebucket` routes the
 smaller side through the build pipeline's all_to_all to the larger side's
@@ -18,28 +24,168 @@ bucket count first — the "one-sided re-bucket" cost model.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from functools import partial
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
 import hyperspace_tpu._jax_config  # noqa: F401
-from hyperspace_tpu.io.columnar import ColumnBatch
-from hyperspace_tpu.ops.bucketed_join import (_match_core, _expand_core,
-                                              _padded_layout, encode_group_ids,
-                                              next_pow2)
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import ColumnBatch, unify_string_columns
+from hyperspace_tpu.ops import keys as keymod
+from hyperspace_tpu.ops.bucketed_join import _padded_layout, next_pow2
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS, replicated, shard_rows
+
+_I32_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+def _side_lanes(left: ColumnBatch, right: ColumnBatch,
+                left_keys: Sequence[str], right_keys: Sequence[str]):
+    """Per-key 32-bit lane pairs plus per-row key validity for both sides
+    (the shared decomposition, `ops/keys.py` — no cross-side encode)."""
+    import jax.numpy as jnp
+
+    if len(left_keys) != len(right_keys) or not left_keys:
+        raise HyperspaceException("Join requires matching key column lists.")
+    n, m = left.num_rows, right.num_rows
+    l_lanes: List = []
+    r_lanes: List = []
+    l_ok = jnp.ones(n, dtype=bool)
+    r_ok = jnp.ones(m, dtype=bool)
+    for lk, rk in zip(left_keys, right_keys):
+        lcol, rcol = left.column(lk), right.column(rk)
+        if lcol.is_string != rcol.is_string:
+            raise HyperspaceException(f"Join key type mismatch: {lk} vs {rk}")
+        if lcol.is_string:
+            lcol, rcol = unify_string_columns(lcol, rcol)
+        if lcol.validity is not None:
+            l_ok = l_ok & lcol.validity
+        if rcol.validity is not None:
+            r_ok = r_ok & rcol.validity
+        ldata, rdata = lcol.data, rcol.data
+        if ldata.dtype != rdata.dtype:
+            common = jnp.promote_types(ldata.dtype, rdata.dtype)
+            ldata = ldata.astype(common)
+            rdata = rdata.astype(common)
+        for ll, rl in zip(keymod.key_lanes(ldata), keymod.key_lanes(rdata)):
+            l_lanes.append(ll)
+            r_lanes.append(rl)
+    return tuple(l_lanes), tuple(r_lanes), l_ok, r_ok
+
+
+@partial(__import__("jax").jit, static_argnames=("left_outer",))
+def _dist_match_core(l_lanes, r_lanes, l_ok, r_ok, l_idx, l_valid, r_idx,
+                     r_valid, left_outer: bool = False):
+    """Shard-local per-bucket match over the combined [B, Ll+Lr] layout.
+
+    Per bucket: gather both sides' key lanes, ONE stable sort by
+    (pad, null, *lanes, side, slot), adjacent-difference group ids (null
+    keys force their own group, so they never match), then per-element
+    right-run brackets via a composite (id, side) searchsorted. Every op
+    after the gathers is batched over the bucket axis — sharded over the
+    mesh with zero collectives.
+
+    Returns (counts [B*T], starts [B*T], rlo [B, T], rcnt [B, T],
+    pos_sorted [B, T]) for `_dist_expand_core`.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, Ll = l_idx.shape
+    Lr = r_idx.shape[1]
+    T = Ll + Lr
+
+    pad = jnp.concatenate([~l_valid, ~r_valid], axis=1).astype(jnp.int32)
+    null = jnp.concatenate(
+        [jnp.where(l_valid, ~jnp.take(l_ok, l_idx), False),
+         jnp.where(r_valid, ~jnp.take(r_ok, r_idx), False)],
+        axis=1).astype(jnp.int32)
+    side = jnp.broadcast_to(
+        jnp.concatenate([jnp.zeros(Ll, jnp.int32),
+                         jnp.ones(Lr, jnp.int32)]), (B, T))
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    lanes2d = [jnp.concatenate([jnp.take(ll, l_idx), jnp.take(rl, r_idx)],
+                               axis=1)
+               for ll, rl in zip(l_lanes, r_lanes)]
+    results = jax.lax.sort([pad, null, *lanes2d, side, pos],
+                           num_keys=3 + len(lanes2d), is_stable=True,
+                           dimension=1)
+    pad_s, null_s = results[0], results[1]
+    lanes_s = results[2:-2]
+    side_s = results[-2]
+    pos_s = results[-1]
+
+    differs = jnp.ones((B, 1), dtype=jnp.int32)
+    rest = jnp.zeros((B, T - 1), dtype=jnp.int32)
+    for k in lanes_s:
+        rest = rest | (k[:, 1:] != k[:, :-1]).astype(jnp.int32)
+    # Null-key elements never share a group with anything.
+    rest = rest | null_s[:, 1:] | null_s[:, :-1]
+    rest = rest | pad_s[:, 1:] | pad_s[:, :-1]
+    ids = jnp.cumsum(jnp.concatenate([differs, rest], axis=1),
+                     axis=1, dtype=jnp.int32)
+
+    # Right-run bracket per element: composite (id, side) is sorted within
+    # each bucket row because side is a trailing sort key.
+    composite = ids * 2 + side_s
+    want = ids * 2 + 1
+    rlo = jax.vmap(lambda c, w: jnp.searchsorted(c, w, side="left"))(
+        composite, want)
+    rhi = jax.vmap(lambda c, w: jnp.searchsorted(c, w, side="right"))(
+        composite, want)
+    rcnt = rhi - rlo
+
+    is_left = (side_s == 0) & (pad_s == 0)
+    matchable = is_left & (null_s == 0)
+    counts = jnp.where(matchable, rcnt, 0)
+    if left_outer:
+        # Every REAL left element (incl. null keys) emits at least one row.
+        counts = jnp.maximum(counts, is_left.astype(counts.dtype))
+    flat = counts.reshape(-1)
+    starts = jnp.cumsum(flat) - flat
+    return flat, starts, rlo, jnp.where(matchable, rcnt, 0), pos_s
+
+
+@partial(__import__("jax").jit, static_argnames=("total", "T", "Ll"))
+def _dist_expand_core(starts, rcnt, rlo, pos_s, l_idx, r_idx,
+                      total: int, T: int, Ll: int):
+    """Expand (bucket, sorted slot, offset) -> original row index pairs;
+    slots with zero true matches (left_outer reservations) emit right -1."""
+    import jax.numpy as jnp
+
+    slots = jnp.arange(total, dtype=starts.dtype)
+    row = jnp.searchsorted(starts, slots, side="right") - 1
+    b = (row // T).astype(jnp.int32)
+    j = (row % T).astype(jnp.int32)
+    offset = (slots - jnp.take(starts, row)).astype(jnp.int32)
+    l_slot = pos_s[b, j]
+    li = l_idx[b, l_slot]
+    matched = offset < rcnt[b, j]
+    r_sorted_idx = jnp.clip(rlo[b, j] + offset, 0, T - 1)
+    r_slot = pos_s[b, r_sorted_idx] - Ll
+    ri = jnp.where(matched, r_idx[b, jnp.clip(r_slot, 0, None)],
+                   jnp.int32(-1))
+    return li, ri
 
 
 def distributed_bucketed_join_indices(
         left: ColumnBatch, right: ColumnBatch,
         l_lengths: np.ndarray, r_lengths: np.ndarray,
-        left_keys: Sequence[str], right_keys: Sequence[str], mesh) -> Tuple:
+        left_keys: Sequence[str], right_keys: Sequence[str], mesh,
+        how: str = "inner") -> Tuple:
     """As `ops.bucketed_join.bucketed_join_indices`, but with the padded
-    [B, L] forms sharded over the mesh's bucket axis. Requires num_buckets
-    divisible by the mesh size (the bucket<->shard map)."""
+    [B, T] forms sharded over the mesh's bucket axis and the group encode
+    computed per bucket (shard-local — no replicated global sort).
+    Requires num_buckets divisible by the mesh size (the bucket<->shard
+    map). `how` is inner or left_outer (callers swap sides for
+    right_outer)."""
     import jax
     import jax.numpy as jnp
 
+    if how not in ("inner", "left_outer"):
+        raise HyperspaceException(
+            f"Distributed bucketed join supports inner/left_outer; "
+            f"got {how}.")
     num_buckets = len(l_lengths)
     n_shards = mesh.shape[SHARD_AXIS]
     if num_buckets % n_shards != 0:
@@ -47,7 +193,8 @@ def distributed_bucketed_join_indices(
             f"num_buckets ({num_buckets}) must be divisible by mesh size "
             f"({n_shards}).")
 
-    l_ids, r_ids = encode_group_ids(left, right, left_keys, right_keys)
+    l_lanes, r_lanes, l_ok, r_ok = _side_lanes(left, right, left_keys,
+                                               right_keys)
     Ll = next_pow2(max(1, int(np.asarray(l_lengths).max(initial=0))))
     Lr = next_pow2(max(1, int(np.asarray(r_lengths).max(initial=0))))
     l_idx, l_valid = _padded_layout(np.asarray(l_lengths), Ll)
@@ -60,17 +207,20 @@ def distributed_bucketed_join_indices(
     l_valid = put(jnp.asarray(l_valid), bucket_sharding)
     r_idx = put(jnp.asarray(r_idx), bucket_sharding)
     r_valid = put(jnp.asarray(r_valid), bucket_sharding)
-    l_ids = put(l_ids, repl)
-    r_ids = put(r_ids, repl)
+    l_lanes = tuple(put(x, repl) for x in l_lanes)
+    r_lanes = tuple(put(x, repl) for x in r_lanes)
+    l_ok = put(l_ok, repl)
+    r_ok = put(r_ok, repl)
 
-    counts, starts, lo_c, l_pos, r_pos, _real = _match_core(
-        l_ids, r_ids, l_idx, l_valid, r_idx, r_valid)
+    counts, starts, rlo, rcnt, pos_s = _dist_match_core(
+        l_lanes, r_lanes, l_ok, r_ok, l_idx, l_valid, r_idx, r_valid,
+        left_outer=(how == "left_outer"))
     total = int(jnp.sum(counts))
     if total == 0:
         empty = jnp.zeros(0, dtype=jnp.int32)
         return empty, empty
-    return _expand_core(starts, counts, lo_c, l_pos, r_pos, l_idx, r_idx,
-                        total, Ll)
+    return _dist_expand_core(starts, rcnt, rlo, pos_s, l_idx, r_idx,
+                             total, Ll + Lr, Ll)
 
 
 def rebucket(batch: ColumnBatch, key_columns: Sequence[str],

@@ -65,20 +65,40 @@ class JoinIndexRule(Rule):
         pair = self._best_index_pair(join, mapping)
         if pair is None:
             return node
-        left_index, right_index = pair
-        logger.info("JoinIndexRule: applying indexes %s, %s",
-                    left_index.name, right_index.name)
+        (left_index, left_appended), (right_index, right_appended) = pair
+        logger.info("JoinIndexRule: applying indexes %s%s, %s%s",
+                    left_index.name,
+                    f" (+{len(left_appended)} appended)" if left_appended
+                    else "",
+                    right_index.name,
+                    f" (+{len(right_appended)} appended)" if right_appended
+                    else "")
 
-        def swap(side_plan: LogicalPlan, entry: IndexLogEntry) -> LogicalPlan:
-            replacement = self.index_scan(entry, bucketed=True)
+        def swap(side_plan: LogicalPlan, entry: IndexLogEntry,
+                 appended) -> LogicalPlan:
+            replacement: LogicalPlan = self.index_scan(entry, bucketed=True)
+            if appended:
+                # Hybrid scan (join path): index data UNION the appended
+                # source files, re-bucketed at execution time through the
+                # planner's ExchangeExec so the bucketed SMJ still applies
+                # (reference roadmap, Hybrid Scan item).
+                from hyperspace_tpu.plan.nodes import Project, Union
+                scan = self._base_scan(side_plan)
+                appended_scan = Scan(scan.root_paths, scan.schema,
+                                     files=appended)
+                needed = self._referenced_columns(side_plan)
+                names = [f.name for f in replacement.schema.fields
+                         if f.name.lower() in set(needed)]
+                replacement = Union([Project(names, replacement),
+                                     Project(names, appended_scan)])
 
             def f(n: LogicalPlan) -> LogicalPlan:
                 return replacement if isinstance(n, Scan) else n
 
             return side_plan.transform_up(f)
 
-        return Join(swap(join.left, left_index),
-                    swap(join.right, right_index),
+        return Join(swap(join.left, left_index, left_appended),
+                    swap(join.right, right_index, right_appended),
                     join.condition, join.join_type)
 
     # -- applicability ----------------------------------------------------
@@ -153,13 +173,24 @@ class JoinIndexRule(Rule):
         return sorted(walk(plan, set(plan.schema.names)))
 
     def _usable_indexes(self, plan: LogicalPlan, join_cols: Sequence[str]
-                        ) -> List[IndexLogEntry]:
-        """Signature-matching ACTIVE indexes whose indexed columns are
+                        ) -> List[Tuple[IndexLogEntry, Optional[List[str]]]]:
+        """(entry, appended_files|None) candidates for one join side:
+        signature-matching ACTIVE indexes whose indexed columns are
         set-equal to the join columns and that cover the side's referenced
-        columns (reference `:328-353, 399-409, 515-524`)."""
+        columns (reference `:328-353, 399-409, 515-524`). With hybrid scan
+        enabled, an index over a source that has only GROWN since build
+        time (stored files untouched, new files appended) is usable too,
+        carrying the appended slice."""
+        from hyperspace_tpu import constants
+        from hyperspace_tpu.index.source_delta import (restricted_scan,
+                                                       split_current)
+
+        hybrid = (self.session.conf.get(constants.HYBRID_SCAN_ENABLED,
+                                        "false").lower() == "true")
         referenced = set(self._referenced_columns(plan))
         join_set = {c.lower() for c in join_cols}
-        out = []
+        scan = self._base_scan(plan)
+        out: List[Tuple[IndexLogEntry, Optional[List[str]]]] = []
         for entry in self._active_indexes():
             indexed = [c.lower() for c in entry.indexed_columns]
             if set(indexed) != join_set:
@@ -168,13 +199,21 @@ class JoinIndexRule(Rule):
                        (entry.indexed_columns + entry.included_columns)}
             if not referenced <= covered:
                 continue
-            if not self.signature_matches(entry, plan):
+            if self.signature_matches(entry, plan):
+                out.append((entry, None))
                 continue
-            out.append(entry)
+            if not hybrid or scan is None:
+                continue
+            appended, missing, stored = split_current(entry, scan.files())
+            if missing or not appended or not stored:
+                continue
+            if self.signature_matches(entry,
+                                      restricted_scan(entry, scan,
+                                                      sorted(stored))):
+                out.append((entry, appended))
         return out
 
-    def _best_index_pair(self, join: Join, mapping: Dict[str, str]
-                         ) -> Optional[Tuple[IndexLogEntry, IndexLogEntry]]:
+    def _best_index_pair(self, join: Join, mapping: Dict[str, str]):
         left_join_cols = list(mapping.keys())
         right_join_cols = [mapping[c] for c in left_join_cols]
         left_candidates = self._usable_indexes(join.left, left_join_cols)
@@ -182,13 +221,18 @@ class JoinIndexRule(Rule):
         if not left_candidates or not right_candidates:
             return None
         compatible = []
-        for li in left_candidates:
-            for ri in right_candidates:
+        for li, la in left_candidates:
+            for ri, ra in right_candidates:
                 if self._compatible(li, ri, mapping):
-                    compatible.append((li, ri))
+                    compatible.append(((li, la), (ri, ra)))
         if not compatible:
             return None
-        return JoinIndexRanker.rank(compatible)[0]
+        ranked = JoinIndexRanker.rank([(l[0], r[0]) for l, r in compatible])
+        best = ranked[0]
+        for pair in compatible:
+            if pair[0][0] is best[0] and pair[1][0] is best[1]:
+                return pair
+        return compatible[0]
 
     @staticmethod
     def _compatible(left_index: IndexLogEntry, right_index: IndexLogEntry,

@@ -324,53 +324,64 @@ def q64_pandas(t: Dict[str, "object"]):
 # ---------------------------------------------------------------------------
 
 
-def create_indexes(hs, dfs) -> None:
-    """The covering indexes the three queries can use: the ss JOIN sr
-    pairs for JoinIndexRule (both key orders used by q17/q25 vs q64), the
-    cs_ui pair for q64, and the date_dim quarter filter for
-    FilterIndexRule + bucket pruning."""
+_STAR_FAMILY = ("q3", "q7", "q19", "q42", "q52", "q55", "q68", "q79")
+
+# index name -> (table, IndexConfig args, queries that can use it)
+_INDEX_DEFS = (
+    ("idx_ss_ret", "store_sales",
+     (["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
+      ["ss_sold_date_sk", "ss_store_sk", "ss_quantity", "ss_net_profit"]),
+     ("q17", "q25")),
+    ("idx_sr_ret", "store_returns",
+     (["sr_customer_sk", "sr_item_sk", "sr_ticket_number"],
+      ["sr_returned_date_sk", "sr_return_quantity", "sr_net_loss"]),
+     ("q17", "q25")),
+    ("idx_ss_ticket", "store_sales",
+     (["ss_item_sk", "ss_ticket_number"],
+      ["ss_sold_date_sk", "ss_customer_sk", "ss_store_sk",
+       "ss_wholesale_cost", "ss_list_price"]),
+     ("q64",)),
+    ("idx_sr_ticket", "store_returns",
+     (["sr_item_sk", "sr_ticket_number"], []), ("q64",)),
+    ("idx_cs_order", "catalog_sales",
+     (["cs_item_sk", "cs_order_number"], ["cs_ext_list_price"]), ("q64",)),
+    ("idx_cr_order", "catalog_returns",
+     (["cr_item_sk", "cr_order_number"],
+      ["cr_refunded_cash", "cr_reversed_charge", "cr_store_credit"]),
+     ("q64",)),
+    ("idx_dd_quarter", "date_dim",
+     (["d_quarter_name"], ["d_date_sk"]), ("q17",)),
+    # The star family all joins store_sales to a filtered date_dim
+    # innermost; one covering pair serves the whole family.
+    ("idx_ss_date", "store_sales",
+     (["ss_sold_date_sk"],
+      ["ss_item_sk", "ss_customer_sk", "ss_store_sk", "ss_hdemo_sk",
+       "ss_cdemo_sk", "ss_addr_sk", "ss_promo_sk", "ss_ticket_number",
+       "ss_quantity", "ss_list_price", "ss_sales_price", "ss_coupon_amt",
+       "ss_ext_sales_price", "ss_ext_list_price", "ss_ext_tax",
+       "ss_net_profit"]),
+     _STAR_FAMILY),
+    ("idx_dd_datesk", "date_dim",
+     (["d_date_sk"], ["d_year", "d_moy", "d_dom", "d_dow"]), _STAR_FAMILY),
+    # q96 joins store_sales to household_demographics innermost.
+    ("idx_ss_hdemo", "store_sales",
+     (["ss_hdemo_sk"], ["ss_sold_time_sk", "ss_store_sk"]), ("q96",)),
+    ("idx_hd_demo", "household_demographics",
+     (["hd_demo_sk"], ["hd_dep_count", "hd_vehicle_count"]), ("q96",)),
+)
+
+
+def create_indexes(hs, dfs, queries=None) -> None:
+    """Build the covering indexes the given queries (default: all) can
+    use — each query family's innermost-join pair plus the dimension
+    filter indexes for FilterIndexRule + bucket pruning."""
     from hyperspace_tpu import IndexConfig
 
-    hs.create_index(dfs["store_sales"], IndexConfig(
-        "idx_ss_ret", ["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
-        ["ss_sold_date_sk", "ss_store_sk", "ss_quantity", "ss_net_profit"]))
-    hs.create_index(dfs["store_returns"], IndexConfig(
-        "idx_sr_ret", ["sr_customer_sk", "sr_item_sk", "sr_ticket_number"],
-        ["sr_returned_date_sk", "sr_return_quantity", "sr_net_loss"]))
-    hs.create_index(dfs["store_sales"], IndexConfig(
-        "idx_ss_ticket", ["ss_item_sk", "ss_ticket_number"],
-        ["ss_sold_date_sk", "ss_customer_sk", "ss_store_sk",
-         "ss_wholesale_cost", "ss_list_price"]))
-    hs.create_index(dfs["store_returns"], IndexConfig(
-        "idx_sr_ticket", ["sr_item_sk", "sr_ticket_number"], []))
-    hs.create_index(dfs["catalog_sales"], IndexConfig(
-        "idx_cs_order", ["cs_item_sk", "cs_order_number"],
-        ["cs_ext_list_price"]))
-    hs.create_index(dfs["catalog_returns"], IndexConfig(
-        "idx_cr_order", ["cr_item_sk", "cr_order_number"],
-        ["cr_refunded_cash", "cr_reversed_charge", "cr_store_credit"]))
-    hs.create_index(dfs["date_dim"], IndexConfig(
-        "idx_dd_quarter", ["d_quarter_name"], ["d_date_sk"]))
-    # The star-family queries (q3/q7/q19/q42/q52/q55/q68/q79) all join
-    # store_sales to a filtered date_dim innermost; one covering pair
-    # serves the whole family.
-    hs.create_index(dfs["store_sales"], IndexConfig(
-        "idx_ss_date", ["ss_sold_date_sk"],
-        ["ss_item_sk", "ss_customer_sk", "ss_store_sk", "ss_hdemo_sk",
-         "ss_cdemo_sk", "ss_addr_sk", "ss_promo_sk", "ss_ticket_number",
-         "ss_quantity", "ss_list_price", "ss_sales_price", "ss_coupon_amt",
-         "ss_ext_sales_price", "ss_ext_list_price", "ss_ext_tax",
-         "ss_net_profit"]))
-    hs.create_index(dfs["date_dim"], IndexConfig(
-        "idx_dd_datesk", ["d_date_sk"],
-        ["d_year", "d_moy", "d_dom", "d_dow"]))
-    # q96 joins store_sales to household_demographics innermost.
-    hs.create_index(dfs["store_sales"], IndexConfig(
-        "idx_ss_hdemo", ["ss_hdemo_sk"],
-        ["ss_sold_time_sk", "ss_store_sk"]))
-    hs.create_index(dfs["household_demographics"], IndexConfig(
-        "idx_hd_demo", ["hd_demo_sk"],
-        ["hd_dep_count", "hd_vehicle_count"]))
+    wanted = None if queries is None else set(queries)
+    for name, table, (indexed, included), used_by in _INDEX_DEFS:
+        if wanted is not None and not (wanted & set(used_by)):
+            continue
+        hs.create_index(dfs[table], IndexConfig(name, indexed, included))
 
 
 # ---------------------------------------------------------------------------

@@ -188,3 +188,45 @@ def test_distributed_aggregate_int64_exact(mesh):
                                  out_schema)).to_pandas()
     assert int(d.sy[0]) == int(s.sy[0]) == 8 * big + 8
     assert int(d.mny[0]) == big and int(d.mxy[0]) == big + 2
+
+
+def test_distributed_left_outer_join_with_nulls(mesh):
+    """Mesh left_outer: unmatched and null-key left rows emit right -1;
+    matches equal pandas. Exercises the shard-local per-bucket encode's
+    null-group forcing."""
+    rng = np.random.default_rng(9)
+    lk = rng.integers(0, 30, 400).astype(np.float64)
+    lk[::17] = np.nan  # null keys via mask below
+    lmask = ~np.isnan(lk)
+    left = columnar.from_arrow(pa.table({
+        "k": pa.array(np.where(lmask, lk, 0).astype(np.int64),
+                      mask=~lmask),
+        "x": rng.random(400)}))
+    right = columnar.from_arrow(pa.table({
+        "k": rng.integers(10, 50, 150).astype(np.int64),
+        "y": rng.random(150)}))
+    lb, ll = distributed_build(left, ["k"], 16, mesh)
+    rb, rl = distributed_build(right, ["k"], 16, mesh)
+    li, ri = distributed_bucketed_join_indices(lb, rb, ll, rl, ["k"], ["k"],
+                                               mesh, how="left_outer")
+    li, ri = np.asarray(li), np.asarray(ri)
+    lkey = np.asarray(lb.column("k").data)
+    lval = (np.asarray(lb.column("k").validity)
+            if lb.column("k").validity is not None
+            else np.ones(len(lkey), bool))
+    rkey = np.asarray(rb.column("k").data)
+    # pandas oracle over the built layouts
+    lpd = pd.DataFrame({"k": np.where(lval, lkey, -999),
+                        "li": np.arange(len(lkey)),
+                        "valid": lval})
+    rpd = pd.DataFrame({"k": rkey, "ri": np.arange(len(rkey))})
+    matched = lpd[lpd.valid].merge(rpd, on="k")
+    exp_pairs = set(zip(matched.li.tolist(), matched.ri.tolist()))
+    got_matched = {(int(a), int(b)) for a, b in zip(li, ri) if b >= 0}
+    assert got_matched == exp_pairs
+    # every left row appears at least once; unmatched exactly once with -1
+    got_left_counts = pd.Series(li).value_counts()
+    assert set(got_left_counts.index) == set(range(len(lkey)))
+    unmatched_left = set(range(len(lkey))) - set(matched.li)
+    for row in unmatched_left:
+        assert got_left_counts[row] == 1

@@ -195,3 +195,36 @@ def test_with_column_replace_keeps_position(sess, tables):
     assert out.columns == ["k", "x", "q", "s"]
     out2 = df.with_column("z", col("q") + lit(1))
     assert out2.columns == ["k", "x", "q", "s", "z"]
+
+
+def test_narrow_int_arithmetic_widens_to_int64(sess, tmp_path):
+    pq.write_table(pa.table({"a": np.array([100000, 3], dtype=np.int32),
+                             "b": np.array([100000, 4], dtype=np.int32)}),
+                   str(tmp_path / "narrow.parquet"))
+    df = sess.read_parquet(str(tmp_path / "narrow.parquet"))
+    out = df.select((col("a") * col("b")).alias("p")).collect().to_pandas()
+    assert out["p"].tolist() == [10_000_000_000, 12]
+
+
+def test_suffixed_column_reference_above_join(sess, tables):
+    """Filtering/selecting a `_r`-suffixed duplicate column above a join
+    must resolve through projection pruning."""
+    _, _, lp, rp = tables
+    l, r = sess.read_parquet(lp), sess.read_parquet(rp)
+    # both sides carry `k`; the right copy surfaces as k_r
+    q = (l.select("k", "x").join(r.select("k", "y"),
+                                 on=col("k") == col("k"))
+         .filter(col("k_r") > lit(20)).select("x"))
+    got = q.collect().to_pandas()
+    lpdf = pd.read_parquet(lp)
+    rpdf = pd.read_parquet(rp)
+    j = lpdf[["k", "x"]].merge(rpdf[["k", "y"]], on="k")
+    exp = j[j.k > 20][["x"]]
+    assert len(got) == len(exp)
+
+
+def test_bare_count_star(sess, tables):
+    _, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    out = df.group_by().agg(("count", "*", "cnt")).collect().to_pandas()
+    assert out["cnt"].tolist() == [300]

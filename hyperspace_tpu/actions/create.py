@@ -60,6 +60,21 @@ class CreateActionBase(Action):
                 files.extend(leaf.files())
         return files
 
+    def lineage_enabled(self) -> bool:
+        """Per-row lineage opt-in (`spark.hyperspace.index.lineage.enabled`;
+        extension — the reference's v0.2 direction)."""
+        return (self.conf.get(constants.LINEAGE_ENABLED, "false")
+                or "false").lower() == "true"
+
+    def _lineage_ids(self, files: List[str]) -> Optional[dict]:
+        """{source file path: stable lineage id} for this build, or None
+        when lineage is off. Fresh builds number files 0..n-1; incremental
+        refresh overrides this to keep surviving files' ids stable (their
+        rows are carried forward verbatim)."""
+        if not self.lineage_enabled():
+            return None
+        return {f: i for i, f in enumerate(files)}
+
     def get_index_log_entry(self, df, index_config: IndexConfig,
                             path: str) -> IndexLogEntry:
         """Build the full metadata record (reference `CreateActionBase.scala:38-87`):
@@ -75,6 +90,21 @@ class CreateActionBase(Action):
         columns = index_config.indexed_columns + index_config.included_columns
         schema = df.schema.select(columns)
         source_file_list = self.source_files(df)
+        lineage_ids = self._lineage_ids(source_file_list)
+        file_infos = None
+        if lineage_ids is not None:
+            from hyperspace_tpu.index.log_entry import FileInfo
+            from hyperspace_tpu.index.signature import file_stamp
+            from hyperspace_tpu.io.builder import lineage_schema
+            file_infos = []
+            for f in source_file_list:
+                stamp = file_stamp(f)
+                if stamp is None:
+                    raise HyperspaceException(
+                        f"Cannot stat source file for lineage: {f}")
+                file_infos.append(FileInfo(f, stamp[0], stamp[1],
+                                           lineage_ids[f]))
+            schema = lineage_schema(schema)
         entry = IndexLogEntry(
             name=index_config.index_name,
             derived_dataset=CoveringIndex(
@@ -90,7 +120,8 @@ class CreateActionBase(Action):
                         [Signature(provider.name(), signature_value)])),
                 data=[Hdfs(Content(root="", directories=[
                     Directory(path="", files=source_file_list,
-                              fingerprint=NoOpFingerprint())]))]),
+                              fingerprint=NoOpFingerprint(),
+                              file_infos=file_infos)]))]),
             extra={})
         return entry
 
@@ -104,7 +135,8 @@ class CreateActionBase(Action):
         from hyperspace_tpu.io.builder import write_index
         write_index(df, list(index_config.indexed_columns),
                     list(index_config.included_columns),
-                    self.num_buckets(), path, conf=self.conf)
+                    self.num_buckets(), path, conf=self.conf,
+                    lineage_ids=self._lineage_ids(self.source_files(df)))
 
 
 class CreateAction(CreateActionBase):

@@ -62,6 +62,13 @@ class RefreshAction(CreateActionBase):
         can't silently change the join-compatibility key."""
         return self.previous_entry.num_buckets
 
+    def lineage_enabled(self) -> bool:
+        """Lineage is a property of the index once set at creation: a full
+        refresh preserves it regardless of the current conf (turning it ON
+        via conf for a rebuilt index is allowed — a rebuild rewrites every
+        row, so fresh ids are consistent)."""
+        return self.previous_entry.has_lineage or super().lineage_enabled()
+
     def validate(self) -> None:
         """Reference `RefreshAction.scala:64-70`: state must be ACTIVE."""
         if self.previous_entry.state != States.ACTIVE:

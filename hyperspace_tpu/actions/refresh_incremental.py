@@ -1,14 +1,20 @@
-"""Incremental refresh — index only the appended source files.
+"""Incremental refresh — index only the source delta.
 
 The surveyed reference has full rebuild only (`RefreshAction`); incremental
 refresh is its roadmap (`ROADMAP.md:66-75`) and this build's baseline
 ladder requires it. Semantics:
 
-- validate: state ACTIVE, and the stored source file set must be a SUBSET
-  of the current listing (appends only; deletions/rewrites need a full
-  refresh — surfaced in the error).
-- op: the new `v__=N+1` dir hard-links every bucket file of the previous
-  version (zero-copy on posix; falls back to copy), then the device build
+- validate: state ACTIVE, and the source delta must be servable:
+  * appends are always servable;
+  * DELETIONS are servable when the previous version carries per-row
+    lineage (`_hs_file_id` + per-file stamps, lineage-enabled builds) —
+    the carried-forward runs are filtered per bucket, which preserves
+    their sort order (no source re-read, no re-shuffle, no re-sort);
+  * in-place rewrites are never servable — full refresh (surfaced in the
+    error with the exact reason).
+- op: the new `v__=N+1` dir carries every bucket run of the previous
+  version forward (hard-links when no rows are dropped — zero-copy on
+  posix; a lineage-filtered rewrite otherwise), then the device build
   pipeline indexes ONLY the appended files, writing per-bucket delta runs
   with a `-delta` suffix into the same dir. Versions stay immutable +
   self-contained; readers handle multi-run buckets natively (the batched
@@ -20,7 +26,7 @@ from __future__ import annotations
 
 import os
 import shutil
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from hyperspace_tpu.config import HyperspaceConf
 from hyperspace_tpu.constants import States
@@ -44,35 +50,89 @@ def _link_or_copy(src: str, dst: str) -> None:
 
 
 class RefreshIncrementalAction(RefreshAction):
-    """REFRESHING -> ACTIVE, writing only an appended-data delta."""
+    """REFRESHING -> ACTIVE, writing only a source-delta update."""
 
     def _source_scans(self):
         from hyperspace_tpu.plan.nodes import Scan
         return [leaf for leaf in self.df.plan.collect_leaves()
                 if isinstance(leaf, Scan)]
 
-    def appended_files(self) -> List[str]:
-        """Current source listing (over ALL scan leaves — the build-time
-        capture spans them too) minus the files captured at build time
-        (shared derivation: `index/source_delta.py`)."""
-        from hyperspace_tpu.index.source_delta import split_current
-        current = [f for scan in self._source_scans() for f in scan.files()]
+    def _current_files(self) -> List[str]:
+        return [f for scan in self._source_scans() for f in scan.files()]
+
+    def source_delta(self) -> Tuple[List[str], List[int]]:
+        """(appended files, deleted lineage ids) of the current listing vs
+        the build-time capture. Per-file stamps (lineage-enabled previous
+        version) classify every file individually — deletions become ids
+        to exclude; without stamps only appends are servable (shared
+        derivation: `index/source_delta.py`). Memoized for the action's
+        lifetime: validate() and op() see ONE consistent snapshot and the
+        per-file stat pass runs once, not once per phase."""
+        cached = getattr(self, "_delta", None)
+        if cached is not None:
+            return cached
+        from hyperspace_tpu.index.source_delta import (classify_current,
+                                                       split_current)
+        current = self._current_files()
+        delta = classify_current(self.previous_entry, current)
+        if delta is not None:
+            appended, deleted_ids, modified = delta
+            if modified:
+                raise HyperspaceException(
+                    "Incremental refresh cannot serve in-place rewrites; "
+                    f"{len(modified)} indexed file(s) were modified — run "
+                    "a full refresh. Modified: "
+                    + ", ".join(sorted(modified)[:3]))
+            self._delta = (appended, deleted_ids)
+            return self._delta
         appended, missing, _stored = split_current(self.previous_entry,
                                                    current)
         if missing:
             raise HyperspaceException(
-                "Incremental refresh supports appended data only; "
-                f"{len(missing)} indexed file(s) were deleted or rewritten "
-                "— run a full refresh. Missing: "
+                "Incremental refresh without lineage supports appended "
+                f"data only; {len(missing)} indexed file(s) were deleted "
+                "or rewritten — run a full refresh (or recreate the index "
+                "with spark.hyperspace.index.lineage.enabled=true to make "
+                "deletions servable). Missing: "
                 + ", ".join(sorted(missing)[:3]))
-        return appended
+        self._delta = (appended, [])
+        return self._delta
+
+    def appended_files(self) -> List[str]:
+        return self.source_delta()[0]
+
+    def lineage_enabled(self) -> bool:
+        """Lineage continues iff the previous version carries it — the
+        conf cannot retrofit ids onto carried-forward runs, and dropping
+        them would corrupt the per-file identity story mid-index."""
+        prev = self.previous_entry
+        return prev.has_lineage and prev.source_file_infos() is not None
+
+    def _lineage_ids(self, files: List[str]) -> Optional[dict]:
+        """Surviving files keep their build-time ids (their rows are
+        carried forward verbatim); appended files get fresh ids past the
+        previous maximum."""
+        if not self.lineage_enabled():
+            return None
+        infos = self.previous_entry.source_file_infos()
+        next_id = max((fi.id for fi in infos.values()), default=-1) + 1
+        out = {}
+        for f in files:
+            if f in infos:
+                out[f] = infos[f].id
+            else:
+                out[f] = next_id
+                next_id += 1
+        return out
 
     def validate(self) -> None:
         super().validate()
-        self.appended_files()  # raises on deletions
-        # A file rewritten in place keeps its path: verify the previously
-        # indexed files are byte-identical by recomputing the signature over
-        # exactly the stored file set.
+        self.source_delta()  # raises on un-servable deltas
+        if self.lineage_enabled():
+            return  # classify_current verified every survivor per file
+        # Pre-lineage path: a file rewritten in place keeps its path —
+        # verify the previously indexed files are byte-identical by
+        # recomputing the aggregate signature over exactly the stored set.
         from hyperspace_tpu.index.signature import SignatureProviderFactory
         from hyperspace_tpu.index.source_delta import restricted_scan
         stored_sig = self.previous_entry.signature()
@@ -85,35 +145,65 @@ class RefreshIncrementalAction(RefreshAction):
                 "Incremental refresh supports appended data only; previously "
                 "indexed files were modified in place — run a full refresh.")
 
-    def op(self) -> None:
-        from hyperspace_tpu.engine.dataframe import DataFrame
+    def _carry_previous_runs(self, out_dir: str,
+                             deleted_ids: List[int]) -> None:
+        """Bring the previous version's bucket runs into `out_dir`.
+        Without deletions every run hard-links (zero-copy). With
+        deletions, runs containing a deleted file's rows are rewritten
+        with those rows filtered out — a pure mask on the lineage column,
+        so the run's sort order (and therefore the whole bucketed layout)
+        is preserved without touching a sort kernel."""
+        import numpy as np
+        import pyarrow as pa
+
+        from hyperspace_tpu.constants import LINEAGE_COLUMN
         from hyperspace_tpu.io import parquet
-        from hyperspace_tpu.io.builder import write_bucketed_batch
-        from hyperspace_tpu.engine.executor import execute_plan
-        from hyperspace_tpu.plan.nodes import Scan
+
+        prev_root = self.previous_entry.content.root
+        deleted_arr = np.asarray(sorted(deleted_ids), dtype=np.int64)
+        for _bucket, files in sorted(parquet.bucket_files(prev_root).items()):
+            for f in files:
+                dst = os.path.join(out_dir, os.path.basename(f))
+                if not len(deleted_arr):
+                    _link_or_copy(f, dst)
+                    continue
+                table = parquet.read_table([f])
+                ids = table.column(LINEAGE_COLUMN).combine_chunks() \
+                    .to_numpy(zero_copy_only=False)
+                keep = ~np.isin(ids, deleted_arr)
+                if keep.all():
+                    _link_or_copy(f, dst)
+                elif keep.any():
+                    parquet.write_table(table.filter(pa.array(keep)), dst)
+                # else: every row dropped -> no file (empty-bucket parity
+                # with the full build, which writes no file either).
+
+    def op(self) -> None:
+        from hyperspace_tpu.io import parquet
+        from hyperspace_tpu.io.builder import write_bucketed_table
 
         from hyperspace_tpu.utils import file_utils
         out_dir = self.index_data_path
         prev_root = self.previous_entry.content.root
+        appended, deleted_ids = self.source_delta()
         file_utils.create_directory(out_dir)
-        # Carry the previous version's runs forward (zero-copy links).
-        for _bucket, files in sorted(parquet.bucket_files(prev_root).items()):
-            for f in files:
-                _link_or_copy(f, os.path.join(out_dir, os.path.basename(f)))
+        self._carry_previous_runs(out_dir, deleted_ids)
         spec_path = os.path.join(prev_root, parquet.BUCKET_SPEC_FILE)
         if file_utils.exists(spec_path):
             _link_or_copy(spec_path,
                           os.path.join(out_dir, parquet.BUCKET_SPEC_FILE))
 
-        appended = self.appended_files()
         if not appended:
-            return  # metadata-only refresh (signature catches up)
+            return  # metadata-only refresh (signature/file set catches up)
         cfg = self.index_config
         source_scan = self._source_scans()[-1]
-        delta_scan = Scan(source_scan.root_paths, source_scan.schema,
-                          files=appended)
         columns = cfg.indexed_columns + cfg.included_columns
-        batch = execute_plan(delta_scan, projection=columns)
+        names = [source_scan.schema.field(c).name for c in columns]
+        table = parquet.read_table(appended, columns=names)
+        lineage_ids = self._lineage_ids(appended)
+        if lineage_ids is not None:
+            from hyperspace_tpu.io.builder import append_lineage_column
+            table = append_lineage_column(table, appended, lineage_ids)
         delta_version = os.path.basename(out_dir).split("=")[-1]
-        write_bucketed_batch(batch, cfg.indexed_columns, self.num_buckets(),
+        write_bucketed_table(table, cfg.indexed_columns, self.num_buckets(),
                              out_dir, file_suffix=f"delta{delta_version}")

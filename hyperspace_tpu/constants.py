@@ -24,6 +24,16 @@ INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
 
 HYBRID_SCAN_ENABLED = "spark.hyperspace.index.hybridscan.enabled"
 
+# Per-row lineage (extension; the reference's v0.2 direction): when enabled
+# at build time, every index row carries the id of the source file it came
+# from (`LINEAGE_COLUMN`, internal — never surfaced in query results) and
+# the log entry stores per-file (size, stamp, id) records. Hybrid scan can
+# then serve queries over a source with DELETED files by excluding those
+# rows, and incremental refresh handles deletions as a per-bucket lineage
+# filter instead of a full rebuild.
+LINEAGE_ENABLED = "spark.hyperspace.index.lineage.enabled"
+LINEAGE_COLUMN = "_hs_file_id"
+
 # Mesh distribution of the data plane (no reference analog — Spark owns the
 # cluster there; here the "cluster" is the jax device mesh). Values:
 # "auto" (default: distribute when >1 device is visible), "true", "false".

@@ -852,11 +852,15 @@ def _prune_buckets(condition: E.Expression,
 
 
 def _apply_bucket_pruning(condition: E.Expression, child: PhysicalNode):
-    """Descend Project chains — and Union fan-outs (hybrid scan: index
-    UNION appended files) — to each ScanExec and attach the allowed bucket
-    set derived from the filter condition (no-op on unbucketed scans)."""
+    """Descend Project/Filter chains — and Union fan-outs (hybrid scan:
+    index UNION appended files) — to each ScanExec and attach the allowed
+    bucket set derived from the filter condition (no-op on unbucketed
+    scans). Descending through an intermediate Filter (e.g. the hybrid
+    lineage exclusion) is sound: pruning only drops buckets no row of
+    which can satisfy the OUTER condition, and inner filters only remove
+    more rows."""
     node = child
-    while isinstance(node, ProjectExec):
+    while isinstance(node, (ProjectExec, FilterExec)):
         node = node.child
     if isinstance(node, UnionExec):
         for c in node.children:

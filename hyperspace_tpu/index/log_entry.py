@@ -39,21 +39,52 @@ class NoOpFingerprint:
 
 
 @dataclass
+class FileInfo:
+    """Per-file identity stamp + lineage id (extension: the surveyed
+    reference stores bare paths; per-file (size, stamp) records with stable
+    ids are its v0.2 lineage direction — they let hybrid scan classify each
+    current file as untouched / appended / deleted and serve queries over a
+    source with deletions by excluding that file's index rows)."""
+
+    name: str
+    size: int
+    stamp: str  # mtime_ns locally; mtime+etag/generation on object stores
+    id: int
+
+    def to_list(self) -> list:
+        return [self.name, self.size, self.stamp, self.id]
+
+    @staticmethod
+    def from_list(x: list) -> "FileInfo":
+        return FileInfo(x[0], int(x[1]), str(x[2]), int(x[3]))
+
+
+@dataclass
 class Directory:
-    """A directory of index/source files (reference `IndexLogEntry.scala:33-36`)."""
+    """A directory of index/source files (reference `IndexLogEntry.scala:33-36`).
+
+    `file_infos` (optional) carries per-file stamps + lineage ids; when
+    absent the serialized shape is byte-identical to the reference spec."""
 
     path: str
     files: List[str] = field(default_factory=list)
     fingerprint: NoOpFingerprint = field(default_factory=NoOpFingerprint)
+    file_infos: Optional[List[FileInfo]] = None
 
     def to_dict(self) -> dict:
-        return {"path": self.path, "files": list(self.files),
-                "fingerprint": self.fingerprint.to_dict()}
+        d = {"path": self.path, "files": list(self.files),
+             "fingerprint": self.fingerprint.to_dict()}
+        if self.file_infos is not None:
+            d["fileInfos"] = [fi.to_list() for fi in self.file_infos]
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "Directory":
+        infos = d.get("fileInfos")
         return Directory(d["path"], list(d.get("files", [])),
-                         NoOpFingerprint.from_dict(d.get("fingerprint", {})))
+                         NoOpFingerprint.from_dict(d.get("fingerprint", {})),
+                         None if infos is None
+                         else [FileInfo.from_list(x) for x in infos])
 
 
 @dataclass
@@ -307,6 +338,32 @@ class IndexLogEntry(LogEntry):
                 for f in directory.files:
                     files.append(f if "/" in f else (base.rstrip("/") + "/" + f if base else f))
         return files
+
+    def source_file_infos(self) -> Optional[Dict[str, FileInfo]]:
+        """{absolute path: FileInfo} when per-file lineage stamps were
+        captured at build time (lineage-enabled builds); None otherwise
+        (including partially-stamped entries, which are treated as
+        stampless rather than trusted)."""
+        out: Dict[str, FileInfo] = {}
+        for hdfs in self.source.data:
+            root = hdfs.content.root
+            for directory in hdfs.content.directories:
+                if directory.file_infos is None:
+                    return None
+                base = directory.path or root
+                for fi in directory.file_infos:
+                    path = (fi.name if "/" in fi.name else
+                            (base.rstrip("/") + "/" + fi.name
+                             if base else fi.name))
+                    out[path] = fi
+        return out if out else None
+
+    @property
+    def has_lineage(self) -> bool:
+        """True when the index data carries the per-row lineage column."""
+        from hyperspace_tpu.constants import LINEAGE_COLUMN
+        from hyperspace_tpu.plan.schema import Schema
+        return Schema.from_json(self.schema_json).contains(LINEAGE_COLUMN)
 
     def to_dict(self) -> dict:
         d = {

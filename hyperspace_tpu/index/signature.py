@@ -21,6 +21,42 @@ from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 from hyperspace_tpu.utils.hashing import md5_hex
 
 
+def file_stamp(path: str):
+    """(size, stamp) identity of one file, or None if it is missing.
+
+    The stamp folds the backend's modification time — plus etag/generation
+    where the store exposes content identity — exactly as the signature
+    fold below consumes it, so `md5(acc + str(size) + stamp + path)`
+    reproduces the historical signature byte-for-byte. The same (size,
+    stamp) pairs are persisted per file by lineage-enabled builds
+    (`index/log_entry.FileInfo`) for per-file delta classification."""
+    from hyperspace_tpu.utils import storage
+
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        try:
+            info = fs.info(real)
+        except (OSError, FileNotFoundError):
+            return None
+        size = info.get("size", 0) or 0
+        # Backends name their modification stamp differently (S3
+        # LastModified, GCS updated, ABFS last_modified, memory created);
+        # the etag/generation participates too so in-place rewrites that
+        # preserve size+time still change the identity where the store
+        # exposes content hashes.
+        mtime = next((info[k] for k in ("mtime", "updated", "last_modified",
+                                        "LastModified", "created")
+                      if info.get(k)), 0)
+        etag = (info.get("etag") or info.get("ETag")
+                or info.get("generation") or "")
+        return int(size), str(mtime) + str(etag)
+    try:
+        stat = os.stat(path)
+    except OSError:
+        return None
+    return int(stat.st_size), str(int(stat.st_mtime_ns))
+
+
 class LogicalPlanSignatureProvider(ABC):
     @classmethod
     def name(cls) -> str:
@@ -59,8 +95,6 @@ class FileBasedSignatureProvider(LogicalPlanSignatureProvider):
     """
 
     def signature(self, plan: LogicalPlan) -> Optional[str]:
-        from hyperspace_tpu.utils import storage
-
         accumulate = ""
         saw_scan = False
         for leaf in plan.collect_leaves():
@@ -68,33 +102,9 @@ class FileBasedSignatureProvider(LogicalPlanSignatureProvider):
                 return None
             saw_scan = True
             for path in leaf.files():
-                if storage.is_url(path):
-                    fs, real = storage.get_fs(path)
-                    try:
-                        info = fs.info(real)
-                    except (OSError, FileNotFoundError):
-                        return None
-                    size = info.get("size", 0) or 0
-                    # Backends name their modification stamp differently
-                    # (S3 LastModified, GCS updated, ABFS last_modified,
-                    # memory created); the etag/generation participates
-                    # too so in-place rewrites that preserve size+time
-                    # still change the signature where the store exposes
-                    # content identity.
-                    mtime = next(
-                        (info[k] for k in ("mtime", "updated",
-                                           "last_modified", "LastModified",
-                                           "created") if info.get(k)), 0)
-                    etag = (info.get("etag") or info.get("ETag")
-                            or info.get("generation") or "")
-                    accumulate = md5_hex(
-                        accumulate + str(size) + str(mtime) + str(etag)
-                        + path)
-                    continue
-                try:
-                    stat = os.stat(path)
-                except OSError:
+                stamp = file_stamp(path)
+                if stamp is None:
                     return None
-                accumulate = md5_hex(
-                    accumulate + str(stat.st_size) + str(int(stat.st_mtime_ns)) + path)
+                size, tag = stamp
+                accumulate = md5_hex(accumulate + str(size) + tag + path)
         return accumulate if saw_scan else None

@@ -29,6 +29,32 @@ def split_current(entry: IndexLogEntry, current_files: Iterable[str]
     return appended, missing, stored
 
 
+def classify_current(entry: IndexLogEntry, current_files: Iterable[str]):
+    """Per-file delta classification for lineage-enabled indexes:
+    (appended, deleted_ids, modified) where `appended` are current files
+    not captured at build time, `deleted_ids` the lineage ids of captured
+    files no longer listed, and `modified` captured files whose (size,
+    stamp) identity changed in place. None when the entry carries no
+    per-file stamps (pre-lineage builds fall back to the aggregate
+    signature over `restricted_scan`).
+
+    Unlike the aggregate path this works when captured files are GONE —
+    survivors are verified individually, so hybrid scan can exclude the
+    deleted files' rows instead of losing the index."""
+    from hyperspace_tpu.index.signature import file_stamp
+
+    infos = entry.source_file_infos()
+    if infos is None or not entry.has_lineage:
+        return None
+    current = set(current_files)
+    appended = sorted(current - infos.keys())
+    deleted_ids = sorted(fi.id for p, fi in infos.items()
+                         if p not in current)
+    modified = sorted(p for p, fi in infos.items() if p in current
+                      and file_stamp(p) != (fi.size, fi.stamp))
+    return appended, deleted_ids, modified
+
+
 def restricted_scan(entry: IndexLogEntry, scan: Scan,
                     stored: Sequence[str]) -> Scan:
     """The scan narrowed to EXACTLY the build-time file set. Recomputing

@@ -259,15 +259,48 @@ def write_bucket_ordered(batch: columnar.ColumnBatch, lengths,
     return written
 
 
+def lineage_schema(schema):
+    """`schema` extended with the non-nullable int64 lineage column.
+    Paired with `append_lineage_column` (below) so the LOGGED index schema
+    and the WRITTEN data can never disagree on the column's shape."""
+    from hyperspace_tpu.constants import LINEAGE_COLUMN
+    from hyperspace_tpu.plan.schema import Field, Schema
+
+    return Schema(list(schema.fields)
+                  + [Field(LINEAGE_COLUMN, "int64", False)])
+
+
+def append_lineage_column(table, files: Sequence[str], lineage_ids: dict):
+    """Append the per-row `_hs_file_id` column to an Arrow table read by
+    concatenating `files` in order: rows from file F carry lineage_ids[F].
+    THE one materialization of row lineage — create, full refresh, and
+    incremental refresh all route through it, so id-to-row assignment can
+    never diverge between build paths."""
+    import pyarrow as pa
+
+    from hyperspace_tpu.constants import LINEAGE_COLUMN
+
+    counts = parquet.file_row_counts(files)
+    col = np.repeat(np.asarray([lineage_ids[f] for f in files],
+                               dtype=np.int64), counts)
+    return table.append_column(LINEAGE_COLUMN,
+                               pa.array(col, type=pa.int64()))
+
+
 def write_index(df, indexed_columns: Sequence[str],
                 included_columns: Sequence[str], num_buckets: int,
-                path: str, conf=None) -> List[str]:
+                path: str, conf=None, lineage_ids=None) -> List[str]:
     """THE index build job (reference `CreateActionBase.scala:99-120`).
 
     With a multi-device mesh active (`parallel/context.py`) the build runs
     the mesh-sharded all_to_all pipeline — the reference's cluster-wide
     `repartition(numBuckets, indexedCols)` shuffle
-    (`CreateActionBase.scala:110-111`) expressed as XLA collectives."""
+    (`CreateActionBase.scala:110-111`) expressed as XLA collectives.
+
+    `lineage_ids` ({source file path: id}, lineage-enabled builds) appends
+    the per-row `_hs_file_id` column: rows read from file F carry
+    lineage_ids[F]. Payload-only — bucket hash and sort keys are untouched.
+    """
     from hyperspace_tpu.engine.executor import execute_plan
     from hyperspace_tpu.parallel.context import should_distribute
 
@@ -280,11 +313,19 @@ def write_index(df, indexed_columns: Sequence[str],
 
     columns = list(indexed_columns) + list(included_columns)
     source = _plain_scan_source(df.plan)
+    if source is None and lineage_ids is not None:
+        # CreateAction.validate admits only plain file scans, so this is a
+        # programming error, not a user-reachable state.
+        raise HyperspaceException(
+            "Lineage requires a plain file-scan source.")
     if source is not None:
         files, scan_schema = source
         names = [scan_schema.field(c).name for c in columns]
         table = parquet.read_table(files, columns=names)
         schema = scan_schema.select(columns)
+        if lineage_ids is not None:
+            table = append_lineage_column(table, files, lineage_ids)
+            schema = lineage_schema(schema)
         mesh = should_distribute(conf, table.num_rows)
         if mesh is not None:
             written = build_distributed(mesh, columnar.from_arrow(table,

@@ -66,18 +66,18 @@ def _kernel(num_buckets: int, n_lanes: int, *refs):
     # 200+ buckets if the reduction is not fused — over a core's ~16 MB
     # VMEM); per-sub-block the intermediate is bounded at
     # _HIST_SUB*128*hist_cols. Padding rows count toward no bucket.
-    import jax
     masked = jnp.where(valid, bucket, jnp.int32(num_buckets))
     b_range = jnp.arange(hist_ref.shape[1], dtype=jnp.int32)
 
-    def body(i, acc):
-        rows = jax.lax.dynamic_slice_in_dim(masked, i * _HIST_SUB,
-                                            _HIST_SUB, axis=0)
+    # STATIC slices in an unrolled loop: `lax.dynamic_slice` on a value
+    # has no Mosaic TC lowering (found the hard way on real hardware —
+    # interpret-mode tests pass either way), and the trip count is a
+    # compile-time constant anyway.
+    hist = jnp.zeros(hist_ref.shape[1], dtype=jnp.int32)
+    for i in range(_BLOCK_ROWS // _HIST_SUB):
+        rows = masked[i * _HIST_SUB:(i + 1) * _HIST_SUB]
         onehot = (rows[:, :, None] == b_range[None, None, :])
-        return acc + jnp.sum(onehot, axis=(0, 1), dtype=jnp.int32)
-
-    hist = jax.lax.fori_loop(0, _BLOCK_ROWS // _HIST_SUB, body,
-                             jnp.zeros(hist_ref.shape[1], dtype=jnp.int32))
+        hist = hist + jnp.sum(onehot, axis=(0, 1), dtype=jnp.int32)
     hist_ref[:] = hist[None, :]
 
 

@@ -113,5 +113,15 @@ class Rule:
                                      tuple(entry.indexed_columns))
         return Scan([entry.content.root], schema, bucket_spec=bucket_spec)
 
+    @staticmethod
+    def lineage_exclusion(deleted_ids):
+        """`_hs_file_id NOT IN (deleted...)` predicate excluding the index
+        rows of deleted source files (hybrid scan over deletes; lineage-
+        enabled builds only)."""
+        from hyperspace_tpu import constants
+        from hyperspace_tpu.plan import expr as E
+        return ~E.Column(constants.LINEAGE_COLUMN).isin(
+            *[int(i) for i in deleted_ids])
+
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
         raise NotImplementedError

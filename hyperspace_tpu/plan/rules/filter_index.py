@@ -76,9 +76,11 @@ class FilterIndexRule(Rule):
                             project_columns: Sequence[str],
                             filter_columns: Sequence[str]):
         """Hybrid Scan (extension; reference roadmap): when the index covers
-        the columns but the source has grown since build time (stored file
-        set is a strict subset of the current listing), serve the query from
-        index data UNION the appended files — no refresh required. Gated on
+        the columns but the source has CHANGED since build time, serve the
+        query from index data anyway — appended files ride along as a
+        UNION branch, and (for lineage-enabled indexes) deleted files'
+        rows are excluded by a `_hs_file_id NOT IN (...)` filter pushed
+        onto the index scan. No refresh required. Gated on
         `spark.hyperspace.index.hybridscan.enabled`."""
         from hyperspace_tpu import constants
         from hyperspace_tpu.plan.nodes import Union
@@ -86,33 +88,48 @@ class FilterIndexRule(Rule):
         if self.session.conf.get(constants.HYBRID_SCAN_ENABLED,
                                  "false").lower() != "true":
             return None
-        from hyperspace_tpu.index.source_delta import (restricted_scan,
+        from hyperspace_tpu.index.source_delta import (classify_current,
+                                                       restricted_scan,
                                                        split_current)
         needed = ({c for c in filter_columns}
                   | {c for c in project_columns})
         for entry in self._active_indexes():
             if not self._covers(entry, project_columns, filter_columns):
                 continue
-            appended, missing, stored = split_current(entry, scan.files())
-            if missing or not appended or not stored:
-                continue
-            # Path-set subset is not enough: a file rewritten IN PLACE keeps
-            # its path but changes content. Recompute the signature over a
-            # scan restricted to the stored files — it must equal the one
-            # captured at build time, proving those files are untouched
-            # (shared derivation: `index/source_delta.py`).
-            if not self.signature_matches(entry,
-                                          restricted_scan(entry, scan,
-                                                          sorted(stored))):
-                continue
-            index_scan = self.index_scan(entry, bucketed=True)
-            appended_scan = Scan(scan.root_paths, scan.schema,
-                                 files=appended)
-            needed_cols = [f.name for f in index_scan.schema.fields
+            delta = classify_current(entry, scan.files())
+            if delta is not None:
+                appended, deleted_ids, modified = delta
+                # In-place rewrites invalidate the index rows of that file
+                # with no way to tell which rows changed — decline.
+                if modified or not (appended or deleted_ids):
+                    continue
+            else:
+                # Pre-lineage entry: per-file stamps absent, so deletions
+                # are un-servable and untouched-survivor proof falls back
+                # to the aggregate signature over the stored file set.
+                # (Path-set subset alone misses in-place rewrites.)
+                appended, missing, stored = split_current(entry, scan.files())
+                deleted_ids = []
+                if missing or not appended or not stored:
+                    continue
+                if not self.signature_matches(entry,
+                                              restricted_scan(entry, scan,
+                                                              sorted(stored))):
+                    continue
+            index_source = self.index_scan(entry, bucketed=True)
+            if deleted_ids:
+                index_source = Filter(self.lineage_exclusion(deleted_ids),
+                                      index_source)
+            needed_cols = [f.name for f in index_source.schema.fields
                            if f.name.lower() in {c.lower() for c in needed}]
             logger.info("FilterIndexRule: hybrid scan with index %s "
-                        "(+%d appended files)", entry.name, len(appended))
-            return Union([Project(needed_cols, index_scan),
+                        "(+%d appended files, -%d deleted files)",
+                        entry.name, len(appended), len(deleted_ids))
+            if not appended:
+                return Project(needed_cols, index_source)
+            appended_scan = Scan(scan.root_paths, scan.schema,
+                                 files=appended)
+            return Union([Project(needed_cols, index_source),
                           Project(needed_cols, appended_scan)])
         return None
 

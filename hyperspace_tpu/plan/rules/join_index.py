@@ -65,40 +65,59 @@ class JoinIndexRule(Rule):
         pair = self._best_index_pair(join, mapping)
         if pair is None:
             return node
-        (left_index, left_appended), (right_index, right_appended) = pair
-        logger.info("JoinIndexRule: applying indexes %s%s, %s%s",
+        ((left_index, left_appended, left_deleted),
+         (right_index, right_appended, right_deleted)) = pair
+        logger.info("JoinIndexRule: applying indexes %s%s%s, %s%s%s",
                     left_index.name,
                     f" (+{len(left_appended)} appended)" if left_appended
                     else "",
+                    f" (-{len(left_deleted)} deleted)" if left_deleted
+                    else "",
                     right_index.name,
                     f" (+{len(right_appended)} appended)" if right_appended
+                    else "",
+                    f" (-{len(right_deleted)} deleted)" if right_deleted
                     else "")
 
         def swap(side_plan: LogicalPlan, entry: IndexLogEntry,
-                 appended) -> LogicalPlan:
+                 appended, deleted_ids) -> LogicalPlan:
+            from hyperspace_tpu.plan.nodes import Filter, Project, Union
             replacement: LogicalPlan = self.index_scan(entry, bucketed=True)
-            if appended:
-                # Hybrid scan (join path): index data UNION the appended
+            if deleted_ids:
+                # Deleted source files (lineage-enabled index): exclude
+                # their rows right above the bucketed scan — filters
+                # preserve bucketing, so the SMJ path is kept.
+                replacement = Filter(self.lineage_exclusion(deleted_ids),
+                                     replacement)
+            if appended or deleted_ids or entry.has_lineage:
+                # Hybrid scan (join path): index data (UNION the appended
                 # source files, re-bucketed at execution time through the
                 # planner's ExchangeExec so the bucketed SMJ still applies
-                # (reference roadmap, Hybrid Scan item).
-                from hyperspace_tpu.plan.nodes import Project, Union
+                # — reference roadmap, Hybrid Scan item). The Project also
+                # drops the internal lineage column from the join input —
+                # needed even on an exact match of a lineage-enabled index,
+                # or `_hs_file_id` would leak into the join output schema.
                 scan = self._base_scan(side_plan)
-                appended_scan = Scan(scan.root_paths, scan.schema,
-                                     files=appended)
                 needed = self._referenced_columns(side_plan)
+                # Filter preserves its child's schema, so `replacement`
+                # still exposes the index scan's fields here.
                 names = [f.name for f in replacement.schema.fields
                          if f.name.lower() in set(needed)]
-                replacement = Union([Project(names, replacement),
-                                     Project(names, appended_scan)])
+                branches = [Project(names, replacement)]
+                if appended:
+                    branches.append(Project(names, Scan(
+                        scan.root_paths, scan.schema, files=appended)))
+                replacement = (Union(branches) if len(branches) > 1
+                               else branches[0])
 
             def f(n: LogicalPlan) -> LogicalPlan:
                 return replacement if isinstance(n, Scan) else n
 
             return side_plan.transform_up(f)
 
-        return Join(swap(join.left, left_index, left_appended),
-                    swap(join.right, right_index, right_appended),
+        return Join(swap(join.left, left_index, left_appended, left_deleted),
+                    swap(join.right, right_index, right_appended,
+                         right_deleted),
                     join.condition, join.join_type)
 
     # -- applicability ----------------------------------------------------
@@ -172,17 +191,17 @@ class JoinIndexRule(Rule):
 
         return sorted(walk(plan, set(plan.schema.names)))
 
-    def _usable_indexes(self, plan: LogicalPlan, join_cols: Sequence[str]
-                        ) -> List[Tuple[IndexLogEntry, Optional[List[str]]]]:
-        """(entry, appended_files|None) candidates for one join side:
-        signature-matching ACTIVE indexes whose indexed columns are
-        set-equal to the join columns and that cover the side's referenced
-        columns (reference `:328-353, 399-409, 515-524`). With hybrid scan
-        enabled, an index over a source that has only GROWN since build
-        time (stored files untouched, new files appended) is usable too,
-        carrying the appended slice."""
+    def _usable_indexes(self, plan: LogicalPlan, join_cols: Sequence[str]):
+        """(entry, appended_files|None, deleted_ids) candidates for one
+        join side: signature-matching ACTIVE indexes whose indexed columns
+        are set-equal to the join columns and that cover the side's
+        referenced columns (reference `:328-353, 399-409, 515-524`). With
+        hybrid scan enabled, an index over a CHANGED source is usable too:
+        appended files ride along as a union branch, and (lineage-enabled
+        indexes) deleted files' rows are excluded by a lineage filter."""
         from hyperspace_tpu import constants
-        from hyperspace_tpu.index.source_delta import (restricted_scan,
+        from hyperspace_tpu.index.source_delta import (classify_current,
+                                                       restricted_scan,
                                                        split_current)
 
         hybrid = (self.session.conf.get(constants.HYBRID_SCAN_ENABLED,
@@ -190,7 +209,7 @@ class JoinIndexRule(Rule):
         referenced = set(self._referenced_columns(plan))
         join_set = {c.lower() for c in join_cols}
         scan = self._base_scan(plan)
-        out: List[Tuple[IndexLogEntry, Optional[List[str]]]] = []
+        out = []
         for entry in self._active_indexes():
             indexed = [c.lower() for c in entry.indexed_columns]
             if set(indexed) != join_set:
@@ -200,9 +219,16 @@ class JoinIndexRule(Rule):
             if not referenced <= covered:
                 continue
             if self.signature_matches(entry, plan):
-                out.append((entry, None))
+                out.append((entry, None, []))
                 continue
             if not hybrid or scan is None:
+                continue
+            delta = classify_current(entry, scan.files())
+            if delta is not None:
+                appended, deleted_ids, modified = delta
+                if modified or not (appended or deleted_ids):
+                    continue
+                out.append((entry, appended or None, deleted_ids))
                 continue
             appended, missing, stored = split_current(entry, scan.files())
             if missing or not appended or not stored:
@@ -210,7 +236,7 @@ class JoinIndexRule(Rule):
             if self.signature_matches(entry,
                                       restricted_scan(entry, scan,
                                                       sorted(stored))):
-                out.append((entry, appended))
+                out.append((entry, appended, []))
         return out
 
     def _best_index_pair(self, join: Join, mapping: Dict[str, str]):
@@ -221,10 +247,10 @@ class JoinIndexRule(Rule):
         if not left_candidates or not right_candidates:
             return None
         compatible = []
-        for li, la in left_candidates:
-            for ri, ra in right_candidates:
-                if self._compatible(li, ri, mapping):
-                    compatible.append(((li, la), (ri, ra)))
+        for lc in left_candidates:
+            for rc in right_candidates:
+                if self._compatible(lc[0], rc[0], mapping):
+                    compatible.append((lc, rc))
         if not compatible:
             return None
         ranked = JoinIndexRanker.rank([(l[0], r[0]) for l, r in compatible])

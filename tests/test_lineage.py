@@ -1,0 +1,280 @@
+"""Per-row lineage: hybrid scan and incremental refresh over DELETED
+source files (extension; the surveyed reference stores bare paths — per-
+file stamps + a `_hs_file_id` row column are its v0.2 lineage direction).
+
+Layers mirror the suite's test strategy: metadata round-trip pinning,
+rule-level behavior via explain plans, and E2E rules-on == rules-off
+equality over mutated sources.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.constants import LINEAGE_COLUMN
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.facade import Hyperspace
+from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.plan.expr import col
+
+
+def _write_part(src, i, n=100):
+    ids = np.arange(i * 1000, i * 1000 + n, dtype=np.int64)
+    table = pa.table({
+        "k": (ids % 17).astype(np.int64),
+        "id": ids,
+        "val": (ids * 2).astype(np.int64),
+    })
+    pq.write_table(table, os.path.join(src, f"part-{i}.parquet"))
+
+
+@pytest.fixture
+def env(tmp_path):
+    conf = HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": 4,
+        "spark.hyperspace.index.lineage.enabled": "true",
+        "spark.hyperspace.index.hybridscan.enabled": "true",
+    })
+    session = HyperspaceSession(conf)
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    for i in range(3):
+        _write_part(src, i)
+    return session, Hyperspace(session), src
+
+
+def _sorted(df):
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def _assert_equal_on_off(session, query):
+    session.enable_hyperspace()
+    on = _sorted(query.to_pandas())
+    session.disable_hyperspace()
+    off = _sorted(query.to_pandas())
+    pd.testing.assert_frame_equal(on, off, check_dtype=False)
+    return on
+
+
+def _index_roots(session, query):
+    session.enable_hyperspace()
+    _, optimized, _ = query.explain_plans()
+    return [r for leaf in optimized.collect_leaves()
+            for r in leaf.root_paths]
+
+
+# -- build-time metadata ---------------------------------------------------
+
+def test_lineage_build_metadata_and_column(env):
+    session, hs, src = env
+    hs.create_index(session.read_parquet(src),
+                    IndexConfig("lin", ["k"], ["id", "val"]))
+    [entry] = [e for e in
+               Hyperspace.get_context(session).index_collection_manager
+               .get_indexes(["ACTIVE"])]
+    infos = entry.source_file_infos()
+    assert infos is not None and len(infos) == 3
+    assert sorted(fi.id for fi in infos.values()) == [0, 1, 2]
+    for path, fi in infos.items():
+        assert os.path.isfile(path)
+        assert fi.size == os.stat(path).st_size
+    assert entry.has_lineage
+
+    # Every index data file carries the lineage column; its values are
+    # exactly the stored file ids.
+    root = entry.content.root
+    seen = set()
+    for f in os.listdir(root):
+        if f.endswith(".parquet"):
+            t = pq.read_table(os.path.join(root, f))
+            assert LINEAGE_COLUMN in t.column_names
+            seen |= set(t.column(LINEAGE_COLUMN).to_pylist())
+    assert seen == {0, 1, 2}
+
+    # The internal column never leaks into query results.
+    query = session.read_parquet(src).filter(col("k") == 3)
+    session.enable_hyperspace()
+    got = query.to_pandas()
+    assert LINEAGE_COLUMN not in got.columns
+    assert list(got.columns) == ["k", "id", "val"]
+
+
+def test_lineage_metadata_roundtrip():
+    from hyperspace_tpu.index.log_entry import (Directory, FileInfo,
+                                                IndexLogEntry)
+    d = Directory(path="/d", files=["a", "b"],
+                  file_infos=[FileInfo("a", 10, "123", 0),
+                              FileInfo("b", 20, "456", 1)])
+    back = Directory.from_dict(d.to_dict())
+    assert back == d
+    # Stampless directories keep the reference-parity wire shape.
+    bare = Directory(path="/d", files=["a"])
+    assert "fileInfos" not in bare.to_dict()
+
+
+# -- filter path -----------------------------------------------------------
+
+def test_filter_hybrid_scan_survives_delete(env):
+    session, hs, src = env
+    hs.create_index(session.read_parquet(src),
+                    IndexConfig("lin", ["k"], ["id", "val"]))
+    os.remove(os.path.join(src, "part-1.parquet"))
+
+    query = session.read_parquet(src).filter(col("k") == 3).select("id", "val")
+    roots = _index_roots(session, query)
+    assert len(roots) == 1 and "v__=0" in roots[0], \
+        "deletion should stay index-served via lineage exclusion"
+    on = _assert_equal_on_off(session, query)
+    assert (on["id"] // 1000 != 1).all()
+
+
+def test_filter_hybrid_scan_delete_plus_append(env):
+    session, hs, src = env
+    hs.create_index(session.read_parquet(src),
+                    IndexConfig("lin", ["k"], ["id", "val"]))
+    os.remove(os.path.join(src, "part-0.parquet"))
+    _write_part(src, 7)  # appended after build
+
+    query = session.read_parquet(src).filter(col("k") == 5).select("id")
+    roots = _index_roots(session, query)
+    assert any("v__=0" in r for r in roots)  # index branch
+    assert any("src" in r for r in roots)    # appended branch
+    _assert_equal_on_off(session, query)
+
+
+def test_modified_file_declines_hybrid(env):
+    session, hs, src = env
+    hs.create_index(session.read_parquet(src),
+                    IndexConfig("lin", ["k"], ["id", "val"]))
+    _write_part(src, 1, n=50)  # in-place rewrite: same path, new content
+
+    query = session.read_parquet(src).filter(col("k") == 3).select("id")
+    roots = _index_roots(session, query)
+    assert all("v__=0" not in r for r in roots), \
+        "an in-place rewrite must not be index-served"
+    _assert_equal_on_off(session, query)
+
+
+# -- join path -------------------------------------------------------------
+
+def test_join_hybrid_scan_survives_delete(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("jl", ["k"], ["id"]))
+    hs.create_index(df, IndexConfig("jr", ["k"], ["val"]))
+    os.remove(os.path.join(src, "part-2.parquet"))
+
+    df2 = session.read_parquet(src)
+    query = df2.select("k", "id").join(df2.select("k", "val"), on="k")
+    roots = _index_roots(session, query)
+    assert any("v__=0" in r for r in roots), \
+        "join over a deleted source should stay index-served"
+    _assert_equal_on_off(session, query)
+
+
+def test_join_exact_match_lineage_not_leaked(env):
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("jl", ["k"], ["id"]))
+    hs.create_index(df, IndexConfig("jr", ["k"], ["val"]))
+
+    df2 = session.read_parquet(src)
+    query = df2.select("k", "id").join(df2.select("k", "val"), on="k")
+    roots = _index_roots(session, query)
+    assert any("v__=0" in r for r in roots)
+    session.enable_hyperspace()
+    got = query.to_pandas()
+    assert LINEAGE_COLUMN not in got.columns
+    _assert_equal_on_off(session, query)
+
+
+# -- incremental refresh ---------------------------------------------------
+
+def test_incremental_refresh_deletion(env):
+    session, hs, src = env
+    hs.create_index(session.read_parquet(src),
+                    IndexConfig("lin", ["k"], ["id", "val"]))
+    os.remove(os.path.join(src, "part-1.parquet"))
+    hs.refresh_index("lin", mode="incremental")
+
+    v1 = os.path.join(session.conf.system_path, "lin", "v__=1")
+    assert os.path.isdir(v1)
+    # The new version's rows exclude exactly the deleted file's id.
+    ids = set()
+    for f in os.listdir(v1):
+        if f.endswith(".parquet"):
+            ids |= set(pq.read_table(os.path.join(v1, f))
+                       .column(LINEAGE_COLUMN).to_pylist())
+    assert ids == {0, 2}
+
+    query = session.read_parquet(src).filter(col("k") == 4).select("id")
+    roots = _index_roots(session, query)
+    assert len(roots) == 1 and "v__=1" in roots[0]
+    _assert_equal_on_off(session, query)
+
+
+def test_incremental_refresh_delete_and_append(env):
+    session, hs, src = env
+    hs.create_index(session.read_parquet(src),
+                    IndexConfig("lin", ["k"], ["id", "val"]))
+    os.remove(os.path.join(src, "part-0.parquet"))
+    _write_part(src, 9)
+    hs.refresh_index("lin", mode="incremental")
+
+    [entry] = [e for e in
+               Hyperspace.get_context(session).index_collection_manager
+               .get_indexes(["ACTIVE"])]
+    infos = entry.source_file_infos()
+    by_name = {os.path.basename(p): fi.id for p, fi in infos.items()}
+    # Survivors keep their build-time ids; the appended file gets a fresh
+    # one PAST the previous maximum (deleted ids are never reused — rows
+    # carrying them were just filtered out).
+    assert by_name["part-1.parquet"] == 1
+    assert by_name["part-2.parquet"] == 2
+    assert by_name["part-9.parquet"] == 3
+
+    query = session.read_parquet(src).filter(col("k") == 2).select("id", "val")
+    roots = _index_roots(session, query)
+    assert len(roots) == 1 and "v__=1" in roots[0]
+    _assert_equal_on_off(session, query)
+
+
+def test_incremental_refresh_without_lineage_still_rejects_delete(tmp_path):
+    conf = HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": 4,
+    })
+    session = HyperspaceSession(conf)
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    for i in range(2):
+        _write_part(src, i)
+    hs = Hyperspace(session)
+    hs.create_index(session.read_parquet(src),
+                    IndexConfig("nolin", ["k"], ["id"]))
+    os.remove(os.path.join(src, "part-0.parquet"))
+    with pytest.raises(HyperspaceException, match="lineage"):
+        hs.refresh_index("nolin", mode="incremental")
+
+
+def test_full_refresh_preserves_lineage(env):
+    session, hs, src = env
+    hs.create_index(session.read_parquet(src),
+                    IndexConfig("lin", ["k"], ["id", "val"]))
+    # Conf flips off — the index property is sticky across full refresh.
+    session.conf.set("spark.hyperspace.index.lineage.enabled", "false")
+    os.remove(os.path.join(src, "part-1.parquet"))
+    hs.refresh_index("lin")
+    [entry] = [e for e in
+               Hyperspace.get_context(session).index_collection_manager
+               .get_indexes(["ACTIVE"])]
+    assert entry.has_lineage
+    infos = entry.source_file_infos()
+    assert infos is not None and len(infos) == 2

@@ -99,7 +99,57 @@ class ExpressionCompiler:
                    "mul": self.xp.multiply, "div": self.xp.divide}
             out = ops[type(e).op](lv.astype(wide), rv.astype(wide))
             return out, self._merge_validity(lval, rval)
+        if isinstance(e, E.CaseWhen):
+            return self._case_when(e)
         raise HyperspaceException(f"Unsupported value expression: {e!r}")
+
+    def _case_when(self, e: "E.CaseWhen"):
+        """Numeric/bool CASE: one fused chain of `where`s, evaluated last
+        branch first so the FIRST matching WHEN wins (SQL). A condition
+        that is NULL does not match (Kleene not-true). Rows no branch
+        matches take the ELSE value, or NULL when there is none — the
+        conditional-aggregation idiom (`sum(CASE WHEN ... THEN x END)`)
+        relies on sum/avg skipping those NULLs."""
+        from hyperspace_tpu.plan.expr import infer_dtype
+
+        xp = self.xp
+        n = self.batch.num_rows
+        out_dtype = infer_dtype(e, self.batch.schema)
+        if out_dtype == "string":
+            raise HyperspaceException(
+                "String-valued CASE is not supported yet.")
+        wide = {"bool": xp.bool_, "int64": xp.int64,
+                "float64": xp.float64}[out_dtype]
+
+        def as_wide(v):
+            arr = xp.asarray(v)
+            if arr.ndim == 0:
+                arr = xp.full(n, arr)
+            return arr.astype(wide)
+
+        def as_mask(v):
+            if v is None:
+                return xp.ones(n, dtype=bool)
+            arr = xp.asarray(v)
+            return xp.full(n, arr) if arr.ndim == 0 else arr
+
+        if e.otherwise_value is not None:
+            data, validity = self.value(e.otherwise_value)
+            data, validity = as_wide(data), as_mask(validity)
+        else:
+            data = xp.zeros(n, dtype=wide)
+            validity = xp.zeros(n, dtype=bool)
+        for cond, val in reversed(e.branches):
+            t, _known = self.predicate3(cond)
+            v_data, v_valid = self.value(val)
+            data = xp.where(t, as_wide(v_data), data)
+            validity = xp.where(t, as_mask(v_valid), validity)
+        # all-valid result -> drop the mask (the common no-null fast path)
+        if e.otherwise_value is not None:
+            host_valid = validity if isinstance(validity, np.ndarray) else None
+            if host_valid is not None and host_valid.all():
+                return data, None
+        return data, validity
 
     def string_column(self, e: E.Expression) -> Optional[DeviceColumn]:
         """Evaluate a string-VALUED expression to a dict-encoded column

@@ -337,6 +337,70 @@ class In(Expression):
         return f"{self.child!r} IN {[v.value for v in self.values]}"
 
 
+class CaseWhen(Expression):
+    """SQL `CASE WHEN cond THEN value [WHEN ...] [ELSE value] END`.
+    First matching branch wins; no match and no ELSE yields NULL (the
+    conditional-aggregation idiom most TPC-DS pivots use:
+    `sum(CASE WHEN d_day_name = 'Sunday' THEN ss_sales_price END)` —
+    sum/avg skip the NULLs)."""
+
+    op = "case"
+
+    def __init__(self, branches: Sequence[tuple],
+                 otherwise: Optional[Expression] = None):
+        if not branches:
+            raise HyperspaceException("CASE needs at least one WHEN branch.")
+        self.branches = [(c, v) for c, v in branches]
+        for c, v in self.branches:
+            if not isinstance(c, Expression) or not isinstance(v, Expression):
+                raise HyperspaceException(
+                    "CASE branches must pair (condition, value) expressions.")
+        self.otherwise_value = otherwise
+
+    def when(self, condition: "Expression", value) -> "CaseWhen":
+        return CaseWhen(self.branches + [(condition, _wrap(value))],
+                        self.otherwise_value)
+
+    def otherwise(self, value) -> "CaseWhen":
+        return CaseWhen(self.branches, _wrap(value))
+
+    @property
+    def children(self) -> List[Expression]:
+        out: List[Expression] = []
+        for c, v in self.branches:
+            out.extend((c, v))
+        if self.otherwise_value is not None:
+            out.append(self.otherwise_value)
+        return out
+
+    def to_dict(self) -> dict:
+        return {"op": "case",
+                "branches": [[c.to_dict(), v.to_dict()]
+                             for c, v in self.branches],
+                "otherwise": (self.otherwise_value.to_dict()
+                              if self.otherwise_value is not None else None)}
+
+    @staticmethod
+    def _from_dict(d: dict) -> "CaseWhen":
+        other = d.get("otherwise")
+        return CaseWhen(
+            [(Expression.from_dict(c), Expression.from_dict(v))
+             for c, v in d["branches"]],
+            Expression.from_dict(other) if other is not None else None)
+
+    def __repr__(self):
+        parts = " ".join(f"WHEN {c!r} THEN {v!r}" for c, v in self.branches)
+        tail = (f" ELSE {self.otherwise_value!r}"
+                if self.otherwise_value is not None else "")
+        return f"CASE {parts}{tail} END"
+
+
+def when(condition: Expression, value) -> CaseWhen:
+    """Start a CASE chain: `when(cond, v).when(cond2, v2).otherwise(v3)`
+    (PySpark's `F.when` shape)."""
+    return CaseWhen([(condition, _wrap(value))])
+
+
 _REGISTRY: Dict[str, Any] = {
     "column": Column, "literal": Literal,
     "eq": EqualTo, "ne": NotEqualTo, "lt": LessThan, "le": LessThanOrEqual,
@@ -344,7 +408,7 @@ _REGISTRY: Dict[str, Any] = {
     "and": And, "or": Or, "not": Not,
     "add": Add, "sub": Sub, "mul": Mul, "div": Div,
     "is_null": IsNull, "is_not_null": IsNotNull, "in": In,
-    "alias": Alias, "substr": Substr,
+    "alias": Alias, "substr": Substr, "case": CaseWhen,
 }
 
 
@@ -388,6 +452,19 @@ def infer_dtype(expr: Expression, schema) -> str:
         if l in floats or r in floats:
             return "float64"
         return "int64"
+    if isinstance(expr, CaseWhen):
+        outs = [infer_dtype(v, schema) for _, v in expr.branches]
+        if expr.otherwise_value is not None:
+            outs.append(infer_dtype(expr.otherwise_value, schema))
+        if all(o == "string" for o in outs):
+            return "string"
+        if "string" in outs:
+            raise HyperspaceException(
+                f"CASE branches mix string and numeric values: {expr!r}")
+        if all(o == "bool" for o in outs):
+            return "bool"
+        floats = {"float32", "float64"}
+        return "float64" if any(o in floats for o in outs) else "int64"
     if isinstance(expr, _BOOL_OPS):
         return "bool"
     raise HyperspaceException(f"Cannot infer dtype of: {expr!r}")

@@ -46,12 +46,15 @@ def _date_dim(n_dates: int):
     qoy = 1 + (moy - 1) // 3
     quarter_name = np.array(["%dQ%d" % (y, q) for y, q in
                              zip(year, np.minimum(qoy, 4))])
+    _DAYS = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+             "Friday", "Saturday"]
     return {
         "d_date_sk": sk,
         "d_year": year.astype(np.int64),
         "d_moy": np.minimum(moy, 12).astype(np.int64),
         "d_dom": (1 + (day % 365) % 31).astype(np.int64),
         "d_dow": (day % 7).astype(np.int64),
+        "d_day_name": np.array([_DAYS[d] for d in (day % 7)]),
         "d_qoy": np.minimum(qoy, 4).astype(np.int64),
         "d_quarter_name": quarter_name,
     }
@@ -91,6 +94,19 @@ def generate(out_dir: str, scale: float = 1.0,
         "s_state": np.array([["TN", "CA", "WA", "NY", "TX"][i % 5]
                              for i in range(n_store)]),
         "s_zip": np.array(["%05d" % (35000 + 13 * i) for i in range(n_store)]),
+        # q50's full select list (street/county/company identity columns).
+        "s_company_id": np.ones(n_store, dtype=np.int64),
+        "s_street_number": np.array(["%d" % (100 + 7 * i)
+                                     for i in range(n_store)]),
+        "s_street_name": np.array([["Main", "Oak", "Park", "First"][i % 4]
+                                   for i in range(n_store)]),
+        "s_street_type": np.array([["St", "Ave", "Blvd"][i % 3]
+                                   for i in range(n_store)]),
+        "s_suite_number": np.array(["Suite %d" % (10 * i)
+                                    for i in range(n_store)]),
+        "s_county": np.array([["Williamson County", "Ziebach County"][i % 2]
+                              for i in range(n_store)]),
+        "s_gmt_offset": np.full(n_store, -5.0),
     }
 
     _CATEGORIES = ["Books", "Home", "Electronics", "Jewelry", "Sports",
@@ -157,12 +173,16 @@ def generate(out_dir: str, scale: float = 1.0,
     _CITIES = ["%s_%02d" % (base, i) for base in
                ("Springfield", "Greenville", "Franklin", "Clinton")
                for i in range(15)]
+    _STATES = ["TX", "OH", "KY", "GA", "NM", "VA", "MO", "ND", "IN", "SC"]
     tables["customer_address"] = {
         "ca_address_sk": np.arange(1, n_addr + 1, dtype=np.int64),
         "ca_city": np.array([_CITIES[i % len(_CITIES)]
                              for i in range(n_addr)]),
         "ca_zip": np.array(["%05d" % (10000 + 37 * i % 90000)
                             for i in range(n_addr)]),
+        "ca_state": np.array([_STATES[i % len(_STATES)]
+                              for i in range(n_addr)]),
+        "ca_country": np.array(["United States"] * n_addr),
     }
     # Seconds 08:00:00 .. 20:59:59 (the selling day q96 probes).
     t_sk = np.arange(8 * 3600, 21 * 3600, dtype=np.int64)
@@ -198,6 +218,7 @@ def generate(out_dir: str, scale: float = 1.0,
         "ss_ticket_number": ss_ticket,
         "ss_quantity": ss_qty,
         "ss_wholesale_cost": np.round(ss_price * 0.6, 2),
+        "ss_ext_wholesale_cost": np.round(ss_price * 0.6 * ss_qty, 2),
         "ss_list_price": np.round(ss_price * 1.2, 2),
         "ss_sales_price": ss_price,
         "ss_ext_sales_price": np.round(ss_price * ss_qty, 2),
@@ -240,12 +261,21 @@ def generate(out_dir: str, scale: float = 1.0,
         n_dates).astype(np.int64)
     cs_qty = rng.integers(1, 100, n_cs).astype(np.int64)
     cs_order = np.arange(1, n_cs + 1, dtype=np.int64)
+    cs_price = np.round(rng.uniform(1.0, 300.0, n_cs), 2)
     tables["catalog_sales"] = {
         "cs_sold_date_sk": cs_date,
         "cs_bill_customer_sk": cs_cust,
+        "cs_bill_cdemo_sk": rng.integers(1, n_demo + 1,
+                                         n_cs).astype(np.int64),
         "cs_item_sk": cs_item,
+        "cs_promo_sk": rng.integers(1, n_promo + 1, n_cs).astype(np.int64),
         "cs_order_number": cs_order,
         "cs_quantity": cs_qty,
+        "cs_list_price": np.round(cs_price * 1.2, 2),
+        "cs_sales_price": cs_price,
+        "cs_coupon_amt": np.round(
+            np.where(rng.random(n_cs) < 0.3,
+                     rng.uniform(0.0, 20.0, n_cs), 0.0), 2),
         "cs_ext_list_price": np.round(rng.uniform(5.0, 500.0, n_cs), 2),
         "cs_net_profit": np.round(rng.uniform(-50.0, 300.0, n_cs), 2),
     }

@@ -1,5 +1,6 @@
-"""Twelve TPC-DS queries on the framework DataFrame API, with pandas
-oracles: q3, q7, q17, q19, q25, q42, q52, q55, q64, q68, q79, q96.
+"""Eighteen TPC-DS queries on the framework DataFrame API, with pandas
+oracles: q3, q7, q13, q15, q17, q19, q25, q26, q42, q43, q48, q50, q52,
+q55, q64, q68, q79, q96.
 
 Each query is expressed as a join tree the rewrite rules can accelerate:
 the innermost join is a linear scan pair (JoinIndexRule's applicability,
@@ -14,10 +15,17 @@ sorted-result equality between rules-on, rules-off, and the oracle —
 the reference's own E2E guarantee
 (`E2EHyperspaceRulesTests.scala:330-346`).
 
-The nine round-3 queries run in UN-REDUCED shape: full official column
+The round-3 queries run in UN-REDUCED shape: full official column
 lists, SUM/AVG over expression inputs, ORDER BY aggregate aliases
 descending, SUBSTR (incl. the q19 zip-prefix column-to-column
 inequality), and the q68 current-city <> bought-city string comparison.
+The six late-round-3 additions cover the remaining official idioms:
+OR-of-band disjuncts applied above the star joins (q13, q48 — the
+official text embeds the identical equi-join in every disjunct;
+extracting it is standard planner normalization), SUBSTR-IN zip probes
+(q15), the catalog twin of q7 (q26), and SUM(CASE WHEN ...) pivots
+(q43 weekday columns, q50 return-lag buckets over the ss-sr ticket
+identity join).
 q64 remains structurally faithful at reduced width (cs_ui HAVING
 subquery, cross_sales aggregation, year-over-year self-join all
 present); q19 probes 1999 instead of the official 1998 because the
@@ -324,18 +332,19 @@ def q64_pandas(t: Dict[str, "object"]):
 # ---------------------------------------------------------------------------
 
 
-_STAR_FAMILY = ("q3", "q7", "q19", "q42", "q52", "q55", "q68", "q79")
+_STAR_FAMILY = ("q3", "q7", "q13", "q19", "q42", "q43", "q48", "q52",
+                "q55", "q68", "q79")
 
 # index name -> (table, IndexConfig args, queries that can use it)
 _INDEX_DEFS = (
     ("idx_ss_ret", "store_sales",
      (["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
       ["ss_sold_date_sk", "ss_store_sk", "ss_quantity", "ss_net_profit"]),
-     ("q17", "q25")),
+     ("q17", "q25", "q50")),
     ("idx_sr_ret", "store_returns",
      (["sr_customer_sk", "sr_item_sk", "sr_ticket_number"],
       ["sr_returned_date_sk", "sr_return_quantity", "sr_net_loss"]),
-     ("q17", "q25")),
+     ("q17", "q25", "q50")),
     ("idx_ss_ticket", "store_sales",
      (["ss_item_sk", "ss_ticket_number"],
       ["ss_sold_date_sk", "ss_customer_sk", "ss_store_sk",
@@ -359,10 +368,19 @@ _INDEX_DEFS = (
        "ss_cdemo_sk", "ss_addr_sk", "ss_promo_sk", "ss_ticket_number",
        "ss_quantity", "ss_list_price", "ss_sales_price", "ss_coupon_amt",
        "ss_ext_sales_price", "ss_ext_list_price", "ss_ext_tax",
-       "ss_net_profit"]),
+       "ss_ext_wholesale_cost", "ss_net_profit"]),
      _STAR_FAMILY),
     ("idx_dd_datesk", "date_dim",
-     (["d_date_sk"], ["d_year", "d_moy", "d_dom", "d_dow"]), _STAR_FAMILY),
+     (["d_date_sk"],
+      ["d_year", "d_moy", "d_dom", "d_dow", "d_qoy", "d_day_name"]),
+     _STAR_FAMILY + ("q15", "q26")),
+    # q15 / q26 join catalog_sales to a filtered date_dim innermost.
+    ("idx_cs_date", "catalog_sales",
+     (["cs_sold_date_sk"],
+      ["cs_bill_customer_sk", "cs_bill_cdemo_sk", "cs_item_sk",
+       "cs_promo_sk", "cs_quantity", "cs_list_price", "cs_sales_price",
+       "cs_coupon_amt"]),
+     ("q15", "q26")),
     # q96 joins store_sales to household_demographics innermost.
     ("idx_ss_hdemo", "store_sales",
      (["ss_hdemo_sk"], ["ss_sold_time_sk", "ss_store_sk"]), ("q96",)),
@@ -807,13 +825,421 @@ def q96_pandas(t: Dict[str, "object"]):
     return pd.DataFrame({"cnt": [len(j)]})
 
 
+# ---------------------------------------------------------------------------
+# q13 / q48 — the OR-of-bands family: demographic and address disjuncts over
+# value ranges, applied AFTER the star joins (the official shape embeds the
+# same equi-join in every disjunct; extracting it is the standard planner
+# normalization and what Spark itself executes)
+# ---------------------------------------------------------------------------
+
+
+def q13(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select(
+        "ss_sold_date_sk", "ss_store_sk", "ss_cdemo_sk", "ss_hdemo_sk",
+        "ss_addr_sk", "ss_quantity", "ss_sales_price", "ss_ext_sales_price",
+        "ss_ext_wholesale_cost", "ss_net_profit")
+    dt = (dfs["date_dim"].filter(col("d_year") == lit(2001))
+          .select("d_date_sk"))
+    st = dfs["store"].select("s_store_sk")
+    cd = dfs["customer_demographics"].select(
+        "cd_demo_sk", "cd_marital_status", "cd_education_status")
+    hd = dfs["household_demographics"].select("hd_demo_sk", "hd_dep_count")
+    ca = (dfs["customer_address"]
+          .filter(col("ca_country") == lit("United States"))
+          .select("ca_address_sk", "ca_state"))
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.join(cd, on=col("ss_cdemo_sk") == col("cd_demo_sk"))
+    j = j.join(hd, on=col("ss_hdemo_sk") == col("hd_demo_sk"))
+    j = j.join(ca, on=col("ss_addr_sk") == col("ca_address_sk"))
+    demo = (((col("cd_marital_status") == lit("M"))
+             & (col("cd_education_status") == lit("Advanced Degree"))
+             & col("ss_sales_price").between(lit(100.0), lit(150.0))
+             & (col("hd_dep_count") == lit(3)))
+            | ((col("cd_marital_status") == lit("S"))
+               & (col("cd_education_status") == lit("College"))
+               & col("ss_sales_price").between(lit(50.0), lit(100.0))
+               & (col("hd_dep_count") == lit(1)))
+            | ((col("cd_marital_status") == lit("W"))
+               & (col("cd_education_status") == lit("2 yr Degree"))
+               & col("ss_sales_price").between(lit(150.0), lit(200.0))
+               & (col("hd_dep_count") == lit(1))))
+    addr = ((col("ca_state").isin("TX", "OH")
+             & col("ss_net_profit").between(lit(100), lit(200)))
+            | (col("ca_state").isin("OR", "NM", "KY")
+               & col("ss_net_profit").between(lit(150), lit(300)))
+            | (col("ca_state").isin("VA", "TX", "MS")
+               & col("ss_net_profit").between(lit(50), lit(250))))
+    return (j.filter(demo & addr)
+            .agg(("avg", "ss_quantity", "avg_qty"),
+                 ("avg", "ss_ext_sales_price", "avg_esp"),
+                 ("avg", "ss_ext_wholesale_cost", "avg_ewc"),
+                 ("sum", "ss_ext_wholesale_cost", "sum_ewc")))
+
+
+def q13_pandas(t: Dict[str, "object"]):
+    import pandas as pd
+
+    d = t["date_dim"]
+    dt = d[d.d_year == 2001][["d_date_sk"]]
+    ca = t["customer_address"]
+    ca = ca[ca.ca_country == "United States"][["ca_address_sk", "ca_state"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(t["store"][["s_store_sk"]], left_on="ss_store_sk",
+                right_on="s_store_sk")
+    j = j.merge(t["customer_demographics"][
+        ["cd_demo_sk", "cd_marital_status", "cd_education_status"]],
+        left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+    j = j.merge(t["household_demographics"][["hd_demo_sk", "hd_dep_count"]],
+                left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+    j = j.merge(ca, left_on="ss_addr_sk", right_on="ca_address_sk")
+    demo = (((j.cd_marital_status == "M")
+             & (j.cd_education_status == "Advanced Degree")
+             & j.ss_sales_price.between(100.0, 150.0)
+             & (j.hd_dep_count == 3))
+            | ((j.cd_marital_status == "S")
+               & (j.cd_education_status == "College")
+               & j.ss_sales_price.between(50.0, 100.0)
+               & (j.hd_dep_count == 1))
+            | ((j.cd_marital_status == "W")
+               & (j.cd_education_status == "2 yr Degree")
+               & j.ss_sales_price.between(150.0, 200.0)
+               & (j.hd_dep_count == 1)))
+    addr = ((j.ca_state.isin(["TX", "OH"])
+             & j.ss_net_profit.between(100, 200))
+            | (j.ca_state.isin(["OR", "NM", "KY"])
+               & j.ss_net_profit.between(150, 300))
+            | (j.ca_state.isin(["VA", "TX", "MS"])
+               & j.ss_net_profit.between(50, 250)))
+    j = j[demo & addr]
+    return pd.DataFrame({
+        "avg_qty": [j.ss_quantity.mean()],
+        "avg_esp": [j.ss_ext_sales_price.mean()],
+        "avg_ewc": [j.ss_ext_wholesale_cost.mean()],
+        "sum_ewc": [j.ss_ext_wholesale_cost.sum()]})
+
+
+def q48(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select(
+        "ss_sold_date_sk", "ss_store_sk", "ss_cdemo_sk", "ss_addr_sk",
+        "ss_quantity", "ss_sales_price", "ss_net_profit")
+    dt = (dfs["date_dim"].filter(col("d_year") == lit(2000))
+          .select("d_date_sk"))
+    st = dfs["store"].select("s_store_sk")
+    cd = dfs["customer_demographics"].select(
+        "cd_demo_sk", "cd_marital_status", "cd_education_status")
+    ca = (dfs["customer_address"]
+          .filter(col("ca_country") == lit("United States"))
+          .select("ca_address_sk", "ca_state"))
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.join(cd, on=col("ss_cdemo_sk") == col("cd_demo_sk"))
+    j = j.join(ca, on=col("ss_addr_sk") == col("ca_address_sk"))
+    demo = (((col("cd_marital_status") == lit("M"))
+             & (col("cd_education_status") == lit("4 yr Degree"))
+             & col("ss_sales_price").between(lit(100.0), lit(150.0)))
+            | ((col("cd_marital_status") == lit("D"))
+               & (col("cd_education_status") == lit("2 yr Degree"))
+               & col("ss_sales_price").between(lit(50.0), lit(100.0)))
+            | ((col("cd_marital_status") == lit("S"))
+               & (col("cd_education_status") == lit("College"))
+               & col("ss_sales_price").between(lit(150.0), lit(200.0))))
+    addr = ((col("ca_state").isin("CO", "OH", "TX")
+             & col("ss_net_profit").between(lit(0), lit(2000)))
+            | (col("ca_state").isin("OR", "MN", "KY")
+               & col("ss_net_profit").between(lit(150), lit(3000)))
+            | (col("ca_state").isin("VA", "CA", "MS")
+               & col("ss_net_profit").between(lit(50), lit(25000))))
+    return j.filter(demo & addr).agg(("sum", "ss_quantity", "sum_qty"))
+
+
+def q48_pandas(t: Dict[str, "object"]):
+    import pandas as pd
+
+    d = t["date_dim"]
+    dt = d[d.d_year == 2000][["d_date_sk"]]
+    ca = t["customer_address"]
+    ca = ca[ca.ca_country == "United States"][["ca_address_sk", "ca_state"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(t["store"][["s_store_sk"]], left_on="ss_store_sk",
+                right_on="s_store_sk")
+    j = j.merge(t["customer_demographics"][
+        ["cd_demo_sk", "cd_marital_status", "cd_education_status"]],
+        left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+    j = j.merge(ca, left_on="ss_addr_sk", right_on="ca_address_sk")
+    demo = (((j.cd_marital_status == "M")
+             & (j.cd_education_status == "4 yr Degree")
+             & j.ss_sales_price.between(100.0, 150.0))
+            | ((j.cd_marital_status == "D")
+               & (j.cd_education_status == "2 yr Degree")
+               & j.ss_sales_price.between(50.0, 100.0))
+            | ((j.cd_marital_status == "S")
+               & (j.cd_education_status == "College")
+               & j.ss_sales_price.between(150.0, 200.0)))
+    addr = ((j.ca_state.isin(["CO", "OH", "TX"])
+             & j.ss_net_profit.between(0, 2000))
+            | (j.ca_state.isin(["OR", "MN", "KY"])
+               & j.ss_net_profit.between(150, 3000))
+            | (j.ca_state.isin(["VA", "CA", "MS"])
+               & j.ss_net_profit.between(50, 25000)))
+    j = j[demo & addr]
+    return pd.DataFrame({"sum_qty": [j.ss_quantity.sum()]})
+
+
+# ---------------------------------------------------------------------------
+# q15 — catalog zip/state/price disjunct with SUBSTR over ca_zip
+# ---------------------------------------------------------------------------
+
+
+def q15(dfs: Dict[str, "object"]):
+    cs = dfs["catalog_sales"].select(
+        "cs_sold_date_sk", "cs_bill_customer_sk", "cs_sales_price")
+    dt = (dfs["date_dim"]
+          .filter((col("d_qoy") == lit(2)) & (col("d_year") == lit(2001)))
+          .select("d_date_sk"))
+    cu = dfs["customer"].select("c_customer_sk", "c_current_addr_sk")
+    ca = dfs["customer_address"].select("ca_address_sk", "ca_state",
+                                        "ca_zip")
+    j = cs.join(dt, on=col("cs_sold_date_sk") == col("d_date_sk"))
+    j = j.join(cu, on=col("cs_bill_customer_sk") == col("c_customer_sk"))
+    j = j.join(ca, on=col("c_current_addr_sk") == col("ca_address_sk"))
+    cond = (col("ca_zip").substr(1, 5).isin(
+        "85669", "86197", "88274", "83405", "86475", "85392", "85460",
+        "80348", "81792")
+        | col("ca_state").isin("CA", "WA", "GA")
+        | (col("cs_sales_price") > lit(500.0)))
+    return (j.filter(cond)
+            .group_by("ca_zip")
+            .agg(("sum", "cs_sales_price", "sum_sales"))
+            .sort("ca_zip").limit(100))
+
+
+def q15_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[(d.d_qoy == 2) & (d.d_year == 2001)][["d_date_sk"]]
+    j = t["catalog_sales"].merge(dt, left_on="cs_sold_date_sk",
+                                 right_on="d_date_sk")
+    j = j.merge(t["customer"][["c_customer_sk", "c_current_addr_sk"]],
+                left_on="cs_bill_customer_sk", right_on="c_customer_sk")
+    j = j.merge(t["customer_address"][["ca_address_sk", "ca_state",
+                                       "ca_zip"]],
+                left_on="c_current_addr_sk", right_on="ca_address_sk")
+    cond = (j.ca_zip.str[:5].isin(
+        ["85669", "86197", "88274", "83405", "86475", "85392", "85460",
+         "80348", "81792"])
+        | j.ca_state.isin(["CA", "WA", "GA"])
+        | (j.cs_sales_price > 500.0))
+    g = j[cond].groupby("ca_zip").agg(
+        sum_sales=("cs_sales_price", "sum")).reset_index()
+    return g.sort_values("ca_zip").head(100).reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# q26 — the catalog twin of q7 (demographic/promotion item averages)
+# ---------------------------------------------------------------------------
+
+
+def q26(dfs: Dict[str, "object"]):
+    cs = dfs["catalog_sales"].select(
+        "cs_sold_date_sk", "cs_item_sk", "cs_bill_cdemo_sk", "cs_promo_sk",
+        "cs_quantity", "cs_list_price", "cs_coupon_amt", "cs_sales_price")
+    dt = (dfs["date_dim"].filter(col("d_year") == lit(2000))
+          .select("d_date_sk"))
+    cd = (dfs["customer_demographics"]
+          .filter((col("cd_gender") == lit("M"))
+                  & (col("cd_marital_status") == lit("S"))
+                  & (col("cd_education_status") == lit("College")))
+          .select("cd_demo_sk"))
+    promo = (dfs["promotion"]
+             .filter((col("p_channel_email") == lit("N"))
+                     | (col("p_channel_event") == lit("N")))
+             .select("p_promo_sk"))
+    it = dfs["item"].select("i_item_sk", "i_item_id")
+    j = cs.join(dt, on=col("cs_sold_date_sk") == col("d_date_sk"))
+    j = j.join(cd, on=col("cs_bill_cdemo_sk") == col("cd_demo_sk"))
+    j = j.join(promo, on=col("cs_promo_sk") == col("p_promo_sk"))
+    j = j.join(it, on=col("cs_item_sk") == col("i_item_sk"))
+    return (j.group_by("i_item_id")
+            .agg(("avg", "cs_quantity", "agg1"),
+                 ("avg", "cs_list_price", "agg2"),
+                 ("avg", "cs_coupon_amt", "agg3"),
+                 ("avg", "cs_sales_price", "agg4"))
+            .sort("i_item_id").limit(100))
+
+
+def q26_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[d.d_year == 2000][["d_date_sk"]]
+    c = t["customer_demographics"]
+    cd = c[(c.cd_gender == "M") & (c.cd_marital_status == "S")
+           & (c.cd_education_status == "College")][["cd_demo_sk"]]
+    p = t["promotion"]
+    promo = p[(p.p_channel_email == "N")
+              | (p.p_channel_event == "N")][["p_promo_sk"]]
+    j = t["catalog_sales"].merge(dt, left_on="cs_sold_date_sk",
+                                 right_on="d_date_sk")
+    j = j.merge(cd, left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+    j = j.merge(promo, left_on="cs_promo_sk", right_on="p_promo_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_id"]],
+                left_on="cs_item_sk", right_on="i_item_sk")
+    g = j.groupby("i_item_id").agg(
+        agg1=("cs_quantity", "mean"), agg2=("cs_list_price", "mean"),
+        agg3=("cs_coupon_amt", "mean"),
+        agg4=("cs_sales_price", "mean")).reset_index()
+    return g.sort_values("i_item_id").head(100).reset_index(drop=True)
+
+
+# ---------------------------------------------------------------------------
+# q43 — weekly store pivot: SUM(CASE WHEN d_day_name = ... ) per weekday
+# ---------------------------------------------------------------------------
+
+_DAY_COLS = (("sun_sales", "Sunday"), ("mon_sales", "Monday"),
+             ("tue_sales", "Tuesday"), ("wed_sales", "Wednesday"),
+             ("thu_sales", "Thursday"), ("fri_sales", "Friday"),
+             ("sat_sales", "Saturday"))
+
+
+def q43(dfs: Dict[str, "object"]):
+    from hyperspace_tpu.plan.expr import when
+
+    ss = dfs["store_sales"].select("ss_sold_date_sk", "ss_store_sk",
+                                   "ss_sales_price")
+    dt = (dfs["date_dim"].filter(col("d_year") == lit(2000))
+          .select("d_date_sk", "d_day_name"))
+    st = (dfs["store"].filter(col("s_gmt_offset") == lit(-5.0))
+          .select("s_store_sk", "s_store_id", "s_store_name"))
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    aggs = [("sum", when(col("d_day_name") == lit(day),
+                         col("ss_sales_price")), alias)
+            for alias, day in _DAY_COLS]
+    return (j.group_by("s_store_name", "s_store_id")
+            .agg(*aggs)
+            .sort("s_store_name", "s_store_id",
+                  *[alias for alias, _ in _DAY_COLS])
+            .limit(100))
+
+
+def q43_pandas(t: Dict[str, "object"]):
+    import numpy as np
+
+    d = t["date_dim"]
+    dt = d[d.d_year == 2000][["d_date_sk", "d_day_name"]]
+    s = t["store"]
+    st = s[s.s_gmt_offset == -5.0][["s_store_sk", "s_store_id",
+                                    "s_store_name"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+    for alias, day in _DAY_COLS:
+        j[alias] = np.where(j.d_day_name == day, j.ss_sales_price, np.nan)
+    # min_count=1: a (store, weekday) group with no matching rows is SQL
+    # NULL (the framework's no-ELSE CASE semantics), not 0.0.
+    g = j.groupby(["s_store_name", "s_store_id"]).agg(
+        **{alias: (alias, lambda s: s.sum(min_count=1))
+           for alias, _ in _DAY_COLS}).reset_index()
+    return (g.sort_values(["s_store_name", "s_store_id"]
+                          + [alias for alias, _ in _DAY_COLS])
+            .head(100).reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# q50 — return-lag buckets: SUM(CASE WHEN returned - sold <= N ...) pivot
+# over the ss JOIN sr ticket identity (the q17/q25 index pair serves it)
+# ---------------------------------------------------------------------------
+
+_Q50_STORE_COLS = ("s_store_name", "s_company_id", "s_street_number",
+                   "s_street_name", "s_street_type", "s_suite_number",
+                   "s_city", "s_county", "s_state", "s_zip")
+
+
+def q50(dfs: Dict[str, "object"]):
+    from hyperspace_tpu.plan.expr import when
+
+    ss = dfs["store_sales"].select(
+        "ss_sold_date_sk", "ss_store_sk", "ss_ticket_number", "ss_item_sk",
+        "ss_customer_sk")
+    sr = dfs["store_returns"].select(
+        "sr_returned_date_sk", "sr_ticket_number", "sr_item_sk",
+        "sr_customer_sk")
+    j = ss.join(sr, on=((col("ss_ticket_number") == col("sr_ticket_number"))
+                        & (col("ss_item_sk") == col("sr_item_sk"))
+                        & (col("ss_customer_sk") == col("sr_customer_sk"))))
+    d2 = (dfs["date_dim"]
+          .filter((col("d_year") == lit(2001)) & (col("d_moy") == lit(8)))
+          .select("d_date_sk"))
+    j = j.join(d2, on=col("sr_returned_date_sk") == col("d_date_sk"))
+    d1 = dfs["date_dim"].select("d_date_sk")
+    # Drop d2's key before the second date join or the names collide.
+    j = j.select("ss_sold_date_sk", "ss_store_sk", "sr_returned_date_sk")
+    j = j.join(d1, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    st = dfs["store"].select("s_store_sk", *_Q50_STORE_COLS)
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    lag = col("sr_returned_date_sk") - col("ss_sold_date_sk")
+    buckets = [
+        ("days_30", when(lag <= lit(30), lit(1)).otherwise(lit(0))),
+        ("days_31_60", when((lag > lit(30)) & (lag <= lit(60)),
+                            lit(1)).otherwise(lit(0))),
+        ("days_61_90", when((lag > lit(60)) & (lag <= lit(90)),
+                            lit(1)).otherwise(lit(0))),
+        ("days_91_120", when((lag > lit(90)) & (lag <= lit(120)),
+                             lit(1)).otherwise(lit(0))),
+        ("days_over_120", when(lag > lit(120), lit(1)).otherwise(lit(0))),
+    ]
+    return (j.group_by(*_Q50_STORE_COLS)
+            .agg(*[("sum", e, alias) for alias, e in buckets])
+            .sort(*_Q50_STORE_COLS).limit(100))
+
+
+def q50_pandas(t: Dict[str, "object"]):
+    import numpy as np
+
+    j = t["store_sales"][["ss_sold_date_sk", "ss_store_sk",
+                          "ss_ticket_number", "ss_item_sk",
+                          "ss_customer_sk"]].merge(
+        t["store_returns"][["sr_returned_date_sk", "sr_ticket_number",
+                            "sr_item_sk", "sr_customer_sk"]],
+        left_on=["ss_ticket_number", "ss_item_sk", "ss_customer_sk"],
+        right_on=["sr_ticket_number", "sr_item_sk", "sr_customer_sk"])
+    d = t["date_dim"]
+    d2 = d[(d.d_year == 2001) & (d.d_moy == 8)][["d_date_sk"]]
+    j = j.merge(d2, left_on="sr_returned_date_sk", right_on="d_date_sk")
+    j = j[["ss_sold_date_sk", "ss_store_sk", "sr_returned_date_sk"]]
+    j = j.merge(d[["d_date_sk"]], left_on="ss_sold_date_sk",
+                right_on="d_date_sk")
+    j = j.merge(t["store"][["s_store_sk", *_Q50_STORE_COLS]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    lag = j.sr_returned_date_sk - j.ss_sold_date_sk
+    j = j.assign(
+        days_30=np.where(lag <= 30, 1, 0),
+        days_31_60=np.where((lag > 30) & (lag <= 60), 1, 0),
+        days_61_90=np.where((lag > 60) & (lag <= 90), 1, 0),
+        days_91_120=np.where((lag > 90) & (lag <= 120), 1, 0),
+        days_over_120=np.where(lag > 120, 1, 0))
+    g = j.groupby(list(_Q50_STORE_COLS)).agg(
+        days_30=("days_30", "sum"), days_31_60=("days_31_60", "sum"),
+        days_61_90=("days_61_90", "sum"),
+        days_91_120=("days_91_120", "sum"),
+        days_over_120=("days_over_120", "sum")).reset_index()
+    return (g.sort_values(list(_Q50_STORE_COLS))
+            .head(100).reset_index(drop=True))
+
+
 QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q3": (q3, q3_pandas),
     "q7": (q7, q7_pandas),
+    "q13": (q13, q13_pandas),
+    "q15": (q15, q15_pandas),
     "q17": (q17, q17_pandas),
     "q19": (q19, q19_pandas),
     "q25": (q25, q25_pandas),
+    "q26": (q26, q26_pandas),
     "q42": (q42, q42_pandas),
+    "q43": (q43, q43_pandas),
+    "q48": (q48, q48_pandas),
+    "q50": (q50, q50_pandas),
     "q52": (q52, q52_pandas),
     "q55": (q55, q55_pandas),
     "q64": (q64, q64_pandas),

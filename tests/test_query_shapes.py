@@ -228,3 +228,46 @@ def test_bare_count_star(sess, tables):
     df = sess.read_parquet(lp)
     out = df.group_by().agg(("count", "*", "cnt")).collect().to_pandas()
     assert out["cnt"].tolist() == [300]
+
+
+def test_case_when_projection_matches_pandas(sess, tables):
+    from hyperspace_tpu.plan.expr import when
+
+    lpdf, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    e = (when(col("k") < lit(5), col("x") * lit(10.0))
+         .when(col("k") < lit(15), lit(1.5))
+         .otherwise(col("x") - lit(1.0)))
+    got = df.select("k", e.alias("v")).collect().to_pandas()
+    exp = lpdf.assign(v=np.where(lpdf.k < 5, lpdf.x * 10.0,
+                                 np.where(lpdf.k < 15, 1.5,
+                                          lpdf.x - 1.0)))[["k", "v"]]
+    pd.testing.assert_frame_equal(norm(got), norm(exp), check_dtype=False)
+
+
+def test_case_when_no_else_aggregation_skips_nulls(sess, tables):
+    """sum/avg/count over `CASE WHEN ... THEN x END` skip unmatched rows
+    (SQL NULL semantics) — the TPC-DS conditional-aggregation idiom."""
+    from hyperspace_tpu.plan.expr import when
+
+    lpdf, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    e = when(col("q") == lit(3), col("x"))
+    got = df.group_by("k").agg(("sum", e, "s3"),
+                               ("count", e, "c3")).collect().to_pandas()
+    m = lpdf.assign(v=np.where(lpdf.q == 3, lpdf.x, np.nan))
+    exp = (m.groupby("k")
+           .agg(s3=("v", lambda s: s.sum(min_count=1)), c3=("v", "count"))
+           .reset_index())
+    pd.testing.assert_frame_equal(norm(got), norm(exp), check_dtype=False)
+
+
+def test_case_when_in_filter(sess, tables):
+    from hyperspace_tpu.plan.expr import when
+
+    lpdf, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    band = when(col("k") < lit(10), lit(1)).otherwise(lit(2))
+    got = df.filter(band == lit(1)).select("k").collect().to_pandas()
+    exp = lpdf[lpdf.k < 10][["k"]]
+    assert len(got) == len(exp)

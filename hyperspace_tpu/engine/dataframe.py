@@ -65,11 +65,18 @@ class DataFrame:
         return DataFrame(Project(entries, self.plan), self.session)
 
     def join(self, other: "DataFrame",
-             on: Union[E.Expression, str, Sequence[str]],
+             on: Union[E.Expression, str, Sequence[str], None] = None,
              how: str = "inner") -> "DataFrame":
         how = {"semi": "left_semi", "anti": "left_anti",
                "left": "left_outer", "right": "right_outer",
                "full": "full_outer", "outer": "full_outer"}.get(how, how)
+        if how == "cross" or on is None:
+            if on is not None or how != "cross":
+                raise HyperspaceException(
+                    "join needs `on` keys unless how='cross'; cross joins "
+                    "take none.")
+            return DataFrame(Join(self.plan, other.plan, None, "cross"),
+                             self.session)
         if isinstance(on, str):
             on = [on]
         if isinstance(on, (list, tuple)):
@@ -96,6 +103,15 @@ class DataFrame:
 
     def group_by(self, *columns: str) -> "GroupedData":
         return GroupedData(self, list(columns))
+
+    def distinct(self) -> "DataFrame":
+        """SELECT DISTINCT: deduplicate rows (an aggregation over all
+        columns with no aggregate outputs)."""
+        from hyperspace_tpu.plan.nodes import Aggregate
+        return DataFrame(Aggregate(self.columns, [], self.plan),
+                         self.session)
+
+    drop_duplicates = distinct
 
     def agg(self, *specs, **named) -> "DataFrame":
         """Global aggregation (no grouping); see GroupedData.agg."""

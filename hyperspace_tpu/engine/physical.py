@@ -454,7 +454,12 @@ class AggregateExec(PhysicalNode):
         batch = self.child.execute(bucket)
         batch, specs = self._materialize_inputs(batch)
         mesh = None
-        if self.group_columns and batch.num_rows > 0:
+        if (self.group_columns and batch.num_rows > 0 and specs
+                # count_distinct is not decomposable into mergeable
+                # per-shard partials (a value present on two shards must
+                # not count twice); it — and pure DISTINCT (no aggregate
+                # lanes) — stay on the single-device lane.
+                and not any(s.func == "count_distinct" for s in specs)):
             mesh = should_distribute(self.conf, batch.num_rows,
                                      host_batch=batch.is_host)
         if mesh is not None:
@@ -490,6 +495,54 @@ class LimitExec(PhysicalNode):
             return batch.take(np.arange(self.n, dtype=np.int32))
         import jax.numpy as jnp
         return batch.take(jnp.arange(self.n, dtype=jnp.int32))
+
+
+class CrossJoinExec(PhysicalNode):
+    """Cartesian product (CROSS JOIN). Exists for the scalar-subquery
+    assembly idiom — TPC-DS q28/q61/q88 cross their independent one-row
+    aggregates into a single result row — so it is guarded against
+    accidental blow-ups rather than optimized for scale. Output naming
+    matches the equi-join: right-side duplicates get a `_r` suffix."""
+
+    name = "CrossJoin"
+    MAX_ROWS = 50_000_000
+
+    def __init__(self, left: PhysicalNode, right: PhysicalNode):
+        self.left = left
+        self.right = right
+
+    @property
+    def children(self):
+        return [self.left, self.right]
+
+    def simple_string(self) -> str:
+        return "CrossJoin"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        import numpy as np
+
+        from hyperspace_tpu.plan.schema import Field
+
+        lbatch = self.left.execute(bucket)
+        rbatch = self.right.execute(bucket)
+        n = lbatch.num_rows * rbatch.num_rows
+        if n > self.MAX_ROWS:
+            raise HyperspaceException(
+                f"Cross join would produce {n} rows "
+                f"({lbatch.num_rows} x {rbatch.num_rows}); refusing.")
+        lt = lbatch.take(np.repeat(
+            np.arange(lbatch.num_rows, dtype=np.int32), rbatch.num_rows))
+        rt = rbatch.take(np.tile(
+            np.arange(rbatch.num_rows, dtype=np.int32), lbatch.num_rows))
+        fields = list(lt.schema.fields)
+        columns = dict(lt.columns)
+        left_names = {f.name.lower() for f in fields}
+        for f in rt.schema.fields:
+            name = (f.name if f.name.lower() not in left_names
+                    else f.name + "_r")
+            fields.append(Field(name, f.dtype, f.nullable))
+            columns[name] = rt.columns[f.name]
+        return columnar.ColumnBatch(Schema(fields), columns)
 
 
 class UnionExec(PhysicalNode):
@@ -909,6 +962,28 @@ def _bucketize_union_children(node: PhysicalNode, keys: List[str],
     node._children = wrapped
 
 
+def _split_join_required(required: Set[str], left_schema: Schema,
+                         right_schema: Schema, left_keys=(), right_keys=()):
+    """Split a join's required OUTPUT names into per-side input column
+    sets. A required `<name>_r` maps back to the right-side source AND
+    keeps the left-side copy alive — the executor renames the right
+    column only when the left batch still carries the collision, so
+    pruning the left copy would silently un-suffix the output. ONE home
+    for this rule (equi and cross branches both had a hand copy; the
+    cross copy had already drifted and dropped the left side)."""
+    left_req = ({n for n in required if left_schema.contains(n)}
+                | set(left_keys))
+    right_req = ({n for n in required if right_schema.contains(n)}
+                 | set(right_keys))
+    for n in required:
+        base = n[:-2] if n.lower().endswith("_r") else None
+        if (base and right_schema.contains(base)
+                and left_schema.contains(base)):
+            right_req.add(base)
+            left_req.add(base)
+    return left_req, right_req
+
+
 def _join_keys(condition: E.Expression, left_schema: Schema,
                right_schema: Schema) -> Tuple[List[str], List[str]]:
     """Extract equi-join key pairs from an AND-of-equalities condition
@@ -1105,6 +1180,20 @@ def _plan_physical_node(plan: LogicalPlan,
             for c in plan.children])
 
     if isinstance(plan, Join):
+        if plan.join_type == "cross":
+            left_req, right_req = _split_join_required(
+                set(required), plan.left.schema, plan.right.schema)
+            # A side no output column resolves to must still read ONE
+            # column: a zero-column batch reports num_rows == 0 and would
+            # collapse the whole product (same floor the Aggregate
+            # planner applies for bare count(*)).
+            if not left_req:
+                left_req = {plan.left.schema.names[0]}
+            if not right_req:
+                right_req = {plan.right.schema.names[0]}
+            return CrossJoinExec(
+                _plan_physical(plan.left, left_req, conf, ctx),
+                _plan_physical(plan.right, right_req, conf, ctx))
         # Join-over-union distribution: (A UNION B) JOIN R executes as
         # (A JOIN R) UNION (B JOIN R) when the join type distributes over
         # that side. The hybrid-scan Union then keeps its index part on
@@ -1147,17 +1236,9 @@ def _plan_physical_node(plan: LogicalPlan,
                 left_keys, right_keys, bucketed=False,
                 how=plan.join_type, conf=conf)
         out_columns = {n.lower() for n in required}
-        left_required = ({n for n in required if plan.left.schema.contains(n)}
-                         | set(left_keys))
-        right_required = ({n for n in required if plan.right.schema.contains(n)}
-                          | set(right_keys))
-        # A duplicate right column surfaces as `<name>_r` in the join
-        # output; map such required names back to the right-side source.
-        for n in required:
-            base = n[:-2] if n.lower().endswith("_r") else None
-            if (base and plan.right.schema.contains(base)
-                    and plan.left.schema.contains(base)):
-                right_required.add(base)
+        left_required, right_required = _split_join_required(
+            set(required), plan.left.schema, plan.right.schema,
+            left_keys, right_keys)
         left_phys = _plan_physical(plan.left, left_required, conf, ctx)
         right_phys = _plan_physical(plan.right, right_required, conf, ctx)
 

@@ -41,6 +41,22 @@ def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
               "string": jnp.int32}
 
     if n == 0:
+        if not group_columns:
+            # SQL: a GLOBAL aggregate over zero rows is ONE row —
+            # count/count_distinct 0, everything else NULL. (The
+            # cross-join scalar-assembly queries rely on this: an empty
+            # bucket must not collapse the whole product to zero rows.)
+            columns = {}
+            for spec in aggregates:
+                f = out_schema.field(spec.alias)
+                if spec.func in ("count", "count_distinct"):
+                    columns[f.name] = DeviceColumn(
+                        jnp.zeros(1, dtype=jnp.int64), "int64")
+                else:
+                    columns[f.name] = DeviceColumn(
+                        jnp.zeros(1, dtype=_NP_OF[f.dtype]), f.dtype,
+                        validity=jnp.zeros(1, dtype=bool))
+            return ColumnBatch(out_schema, columns)
         columns = {}
         for f in out_schema.fields:
             src = (batch.column(f.name)
@@ -98,7 +114,7 @@ def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
             columns[out_field.name] = DeviceColumn(data, "int64")
             continue
         src = sorted_batch.column(spec.column)
-        if src.is_string and spec.func != "count":
+        if src.is_string and spec.func not in ("count", "count_distinct"):
             raise HyperspaceException(
                 f"Aggregate {spec.func} over string column {spec.column} "
                 "is not supported.")
@@ -108,6 +124,28 @@ def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
                                      num_segments=num_groups)
         if spec.func == "count":
             columns[out_field.name] = DeviceColumn(counts, "int64")
+            continue
+        if spec.func == "count_distinct":
+            # Distinct non-null values per group: ONE more device sort
+            # keyed (segment, invalid-last, *value lanes), then count run
+            # starts at valid rows. Strings count by dictionary code
+            # (dictionaries are sorted+unique, so code identity is value
+            # identity); nulls sort after the valid block so a shared
+            # masked value can never swallow a valid run start.
+            lanes = column_sort_lanes(src)
+            invalid = (~valid).astype(jnp.int32)
+            res = jax.lax.sort([segment_ids, invalid, *lanes],
+                               num_keys=2 + len(lanes))
+            seg_s, inv_s, lanes_s = res[0], res[1], res[2:]
+            differs = seg_s[1:] != seg_s[:-1]
+            for lane in lanes_s:
+                differs = differs | (lane[1:] != lane[:-1])
+            run_start = jnp.concatenate(
+                [jnp.ones(1, dtype=bool), differs])
+            data = jax.ops.segment_sum(
+                (run_start & (inv_s == 0)).astype(jnp.int64), seg_s,
+                num_segments=num_groups)
+            columns[out_field.name] = DeviceColumn(data, "int64")
             continue
         values = src.data
         validity_out = counts > 0
@@ -218,7 +256,7 @@ def _host_group_aggregate(batch: ColumnBatch,
             columns[out_field.name] = DeviceColumn(data, "int64")
             continue
         src = sorted_batch.column(spec.column)
-        if src.is_string and spec.func != "count":
+        if src.is_string and spec.func not in ("count", "count_distinct"):
             raise HyperspaceException(
                 f"Aggregate {spec.func} over string column {spec.column} "
                 "is not supported.")
@@ -228,6 +266,25 @@ def _host_group_aggregate(batch: ColumnBatch,
                              minlength=num_groups).astype(np.int64)
         if spec.func == "count":
             columns[out_field.name] = DeviceColumn(counts, "int64")
+            continue
+        if spec.func == "count_distinct":
+            # Mirror of the device lane: lexsort (segment, invalid-last,
+            # *value lanes), count run starts at valid rows.
+            lanes = [np.asarray(lane)
+                     for lane in host_column_sort_lanes(src)]
+            inv = (~valid).astype(np.int8)
+            order = np.lexsort(tuple(reversed(
+                [segment_ids, inv] + lanes)))
+            seg_s = segment_ids[order]
+            differs = seg_s[1:] != seg_s[:-1]
+            for lane in lanes:
+                lane_s = lane[order]
+                differs = differs | (lane_s[1:] != lane_s[:-1])
+            run_start = np.concatenate([[True], differs])
+            data = np.bincount(
+                seg_s, weights=(run_start & valid[order]),
+                minlength=num_groups).astype(np.int64)
+            columns[out_field.name] = DeviceColumn(data, "int64")
             continue
         values = np.asarray(src.data)
         validity_out = counts > 0

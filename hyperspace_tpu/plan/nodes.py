@@ -282,7 +282,8 @@ class Project(LogicalPlan):
         return f"Project [{', '.join(parts)}]"
 
 
-_AGG_FUNCS = ("sum", "count", "min", "max", "avg", "stddev")
+_AGG_FUNCS = ("sum", "count", "min", "max", "avg", "stddev",
+              "count_distinct")
 
 
 @dataclass(frozen=True)
@@ -337,9 +338,11 @@ class Aggregate(LogicalPlan):
                  aggregates: Sequence[AggSpec], child: LogicalPlan):
         self.group_columns = list(group_columns)
         self.aggregates = list(aggregates)
-        if not self.aggregates:
-            raise HyperspaceException("Aggregate requires at least one "
-                                      "aggregation expression.")
+        if not self.aggregates and not self.group_columns:
+            raise HyperspaceException(
+                "Aggregate requires group columns or at least one "
+                "aggregation expression.")
+        # Group columns with no aggregates = DISTINCT over those columns.
         self.child = child
 
     @property
@@ -351,7 +354,7 @@ class Aggregate(LogicalPlan):
         from hyperspace_tpu.plan.schema import Field
         fields = [self.child.schema.field(c) for c in self.group_columns]
         for spec in self.aggregates:
-            if spec.func == "count":
+            if spec.func in ("count", "count_distinct"):
                 dtype = "int64"
             elif spec.func in ("avg", "stddev"):
                 dtype = "float64"
@@ -486,14 +489,18 @@ class Union(LogicalPlan):
 
 
 _JOIN_TYPES = ("inner", "left_outer", "right_outer", "full_outer",
-               "left_semi", "left_anti")
+               "left_semi", "left_anti", "cross")
 
 
 class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
-                 condition: Expression, join_type: str = "inner"):
+                 condition: Optional[Expression], join_type: str = "inner"):
         if join_type not in _JOIN_TYPES:
             raise HyperspaceException(f"Unsupported join type: {join_type}")
+        if (condition is None) != (join_type == "cross"):
+            raise HyperspaceException(
+                "cross joins take no condition; every other join type "
+                "requires one.")
         self.left = left
         self.right = right
         self.condition = condition
@@ -531,8 +538,11 @@ class Join(LogicalPlan):
 
     def to_dict(self) -> dict:
         return {"node": "join", "type": self.join_type,
-                "condition": self.condition.to_dict(),
+                "condition": (self.condition.to_dict()
+                              if self.condition is not None else None),
                 "left": self.left.to_dict(), "right": self.right.to_dict()}
 
     def simple_string(self) -> str:
+        if self.condition is None:
+            return f"Join {self.join_type}"
         return f"Join {self.join_type} ({self.condition!r})"

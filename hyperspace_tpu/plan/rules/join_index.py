@@ -50,6 +50,8 @@ class JoinIndexRule(Rule):
         if not isinstance(node, Join):
             return node
         join = node
+        if join.condition is None:
+            return node  # cross join: nothing to bucket on
         mapping = self._column_mapping(join)
         if mapping is None:
             return node

@@ -54,8 +54,9 @@ def plan_from_dict(d: dict) -> LogicalPlan:
     if node == "limit":
         return Limit(d["n"], plan_from_dict(d["child"]))
     if node == "join":
+        cond = d["condition"]
         return Join(plan_from_dict(d["left"]), plan_from_dict(d["right"]),
-                    Expression.from_dict(d["condition"]),
+                    Expression.from_dict(cond) if cond is not None else None,
                     d.get("type", "inner"))
     raise HyperspaceException(f"Unknown plan node kind: {node}")
 

@@ -149,6 +149,13 @@ def generate(out_dir: str, scale: float = 1.0,
                                      for i in range(n_promo)]),
         "p_channel_event": np.array([["N", "N", "Y"][i % 3]
                                      for i in range(n_promo)]),
+        # Staggered so (dmail OR email OR tv) is DISCRIMINATING: promos
+        # with i % 4 == 2 match no channel, keeping q61's promotions sum
+        # strictly below its total.
+        "p_channel_dmail": np.array([["Y", "N", "N", "N"][i % 4]
+                                     for i in range(n_promo)]),
+        "p_channel_tv": np.array([["N", "N", "N", "Y"][i % 4]
+                                  for i in range(n_promo)]),
     }
 
     # Demographic / address / time dimensions (fixed-size, like TPC-DS).
@@ -183,6 +190,7 @@ def generate(out_dir: str, scale: float = 1.0,
         "ca_state": np.array([_STATES[i % len(_STATES)]
                               for i in range(n_addr)]),
         "ca_country": np.array(["United States"] * n_addr),
+        "ca_gmt_offset": np.full(n_addr, -5.0),
     }
     # Seconds 08:00:00 .. 20:59:59 (the selling day q96 probes).
     t_sk = np.arange(8 * 3600, 21 * 3600, dtype=np.int64)

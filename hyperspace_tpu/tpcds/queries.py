@@ -1,6 +1,6 @@
-"""Eighteen TPC-DS queries on the framework DataFrame API, with pandas
-oracles: q3, q7, q13, q15, q17, q19, q25, q26, q42, q43, q48, q50, q52,
-q55, q64, q68, q79, q96.
+"""Twenty-one TPC-DS queries on the framework DataFrame API, with pandas
+oracles: q3, q7, q13, q15, q17, q19, q25, q26, q28, q42, q43, q48, q50,
+q52, q55, q61, q64, q68, q79, q88, q96.
 
 Each query is expressed as a join tree the rewrite rules can accelerate:
 the innermost join is a linear scan pair (JoinIndexRule's applicability,
@@ -369,11 +369,11 @@ _INDEX_DEFS = (
        "ss_quantity", "ss_list_price", "ss_sales_price", "ss_coupon_amt",
        "ss_ext_sales_price", "ss_ext_list_price", "ss_ext_tax",
        "ss_ext_wholesale_cost", "ss_net_profit"]),
-     _STAR_FAMILY),
+     _STAR_FAMILY + ("q61",)),
     ("idx_dd_datesk", "date_dim",
      (["d_date_sk"],
       ["d_year", "d_moy", "d_dom", "d_dow", "d_qoy", "d_day_name"]),
-     _STAR_FAMILY + ("q15", "q26")),
+     _STAR_FAMILY + ("q15", "q26", "q61")),
     # q15 / q26 join catalog_sales to a filtered date_dim innermost.
     ("idx_cs_date", "catalog_sales",
      (["cs_sold_date_sk"],
@@ -381,11 +381,15 @@ _INDEX_DEFS = (
        "cs_promo_sk", "cs_quantity", "cs_list_price", "cs_sales_price",
        "cs_coupon_amt"]),
      ("q15", "q26")),
-    # q96 joins store_sales to household_demographics innermost.
+    # q96 / q88 join store_sales to household_demographics innermost.
     ("idx_ss_hdemo", "store_sales",
-     (["ss_hdemo_sk"], ["ss_sold_time_sk", "ss_store_sk"]), ("q96",)),
+     (["ss_hdemo_sk"], ["ss_sold_time_sk", "ss_store_sk"]), ("q96", "q88")),
     ("idx_hd_demo", "household_demographics",
-     (["hd_demo_sk"], ["hd_dep_count", "hd_vehicle_count"]), ("q96",)),
+     (["hd_demo_sk"], ["hd_dep_count", "hd_vehicle_count"]), ("q96", "q88")),
+    # q28's six band filters all probe ss_quantity first.
+    ("idx_ss_qty", "store_sales",
+     (["ss_quantity"],
+      ["ss_list_price", "ss_coupon_amt", "ss_wholesale_cost"]), ("q28",)),
 )
 
 
@@ -1227,6 +1231,191 @@ def q50_pandas(t: Dict[str, "object"]):
             .head(100).reset_index(drop=True))
 
 
+# ---------------------------------------------------------------------------
+# q28 / q88 / q61 — the scalar-subquery assembly family: independent one-row
+# aggregates crossed into a single result row (CROSS JOIN in the official
+# text's FROM-list-of-subqueries form)
+# ---------------------------------------------------------------------------
+
+# (bucket tag, qty_lo, qty_hi, lp_lo, coupon_lo, whole_lo) — official q28
+# band parameters: list_price +10, coupon +1000, wholesale +20.
+_Q28_BUCKETS = (("b1", 0, 5, 8, 459, 57), ("b2", 6, 10, 90, 2323, 31),
+                ("b3", 11, 15, 142, 12214, 79),
+                ("b4", 16, 20, 135, 6071, 38),
+                ("b5", 21, 25, 122, 836, 17), ("b6", 26, 30, 154, 7326, 7))
+
+
+def q28(dfs: Dict[str, "object"]):
+    out = None
+    for tag, qlo, qhi, lp, cp, wc in _Q28_BUCKETS:
+        b = (dfs["store_sales"]
+             .select("ss_quantity", "ss_list_price", "ss_coupon_amt",
+                     "ss_wholesale_cost")
+             .filter(col("ss_quantity").between(lit(qlo), lit(qhi))
+                     & (col("ss_list_price").between(lit(float(lp)),
+                                                     lit(float(lp + 10)))
+                        | col("ss_coupon_amt").between(lit(float(cp)),
+                                                       lit(float(cp + 1000)))
+                        | col("ss_wholesale_cost").between(
+                            lit(float(wc)), lit(float(wc + 20)))))
+             .agg(("avg", "ss_list_price", f"{tag}_lp"),
+                  ("count", "ss_list_price", f"{tag}_cnt"),
+                  ("count_distinct", "ss_list_price", f"{tag}_cntd")))
+        out = b if out is None else out.join(b, how="cross")
+    return out.limit(100)
+
+
+def q28_pandas(t: Dict[str, "object"]):
+    import pandas as pd
+
+    ss = t["store_sales"]
+    row = {}
+    for tag, qlo, qhi, lp, cp, wc in _Q28_BUCKETS:
+        b = ss[ss.ss_quantity.between(qlo, qhi)
+               & (ss.ss_list_price.between(lp, lp + 10)
+                  | ss.ss_coupon_amt.between(cp, cp + 1000)
+                  | ss.ss_wholesale_cost.between(wc, wc + 20))]
+        row[f"{tag}_lp"] = b.ss_list_price.mean()
+        row[f"{tag}_cnt"] = b.ss_list_price.count()
+        row[f"{tag}_cntd"] = b.ss_list_price.nunique()
+    return pd.DataFrame([row])
+
+
+# Official q88 half-hour windows 8:30 .. 12:30 (t_hour, minute-half).
+_Q88_BANDS = (("h8_30", 8, ">="), ("h9", 9, "<"), ("h9_30", 9, ">="),
+              ("h10", 10, "<"), ("h10_30", 10, ">="), ("h11", 11, "<"),
+              ("h11_30", 11, ">="), ("h12", 12, "<"))
+
+
+def q88(dfs: Dict[str, "object"]):
+    hd = (dfs["household_demographics"]
+          .filter(((col("hd_dep_count") == lit(4))
+                   & (col("hd_vehicle_count") <= lit(6)))
+                  | ((col("hd_dep_count") == lit(2))
+                     & (col("hd_vehicle_count") <= lit(4)))
+                  | ((col("hd_dep_count") == lit(0))
+                     & (col("hd_vehicle_count") <= lit(2))))
+          .select("hd_demo_sk"))
+    st = (dfs["store"].filter(col("s_store_name") == lit("ese"))
+          .select("s_store_sk"))
+    out = None
+    for tag, hour, half in _Q88_BANDS:
+        minute = (col("t_minute") >= lit(30) if half == ">="
+                  else col("t_minute") < lit(30))
+        td = (dfs["time_dim"]
+              .filter((col("t_hour") == lit(hour)) & minute)
+              .select("t_time_sk"))
+        ss = dfs["store_sales"].select("ss_sold_time_sk", "ss_hdemo_sk",
+                                       "ss_store_sk")
+        j = ss.join(hd, on=col("ss_hdemo_sk") == col("hd_demo_sk"))
+        j = j.join(td, on=col("ss_sold_time_sk") == col("t_time_sk"))
+        j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+        b = j.agg(("count", "*", tag))
+        out = b if out is None else out.join(b, how="cross")
+    return out
+
+
+def q88_pandas(t: Dict[str, "object"]):
+    import pandas as pd
+
+    h = t["household_demographics"]
+    hd = h[((h.hd_dep_count == 4) & (h.hd_vehicle_count <= 6))
+           | ((h.hd_dep_count == 2) & (h.hd_vehicle_count <= 4))
+           | ((h.hd_dep_count == 0) & (h.hd_vehicle_count <= 2))][
+               ["hd_demo_sk"]]
+    s = t["store"]
+    st = s[s.s_store_name == "ese"][["s_store_sk"]]
+    row = {}
+    for tag, hour, half in _Q88_BANDS:
+        td = t["time_dim"]
+        td = td[(td.t_hour == hour)
+                & (td.t_minute >= 30 if half == ">="
+                   else td.t_minute < 30)][["t_time_sk"]]
+        j = t["store_sales"].merge(hd, left_on="ss_hdemo_sk",
+                                   right_on="hd_demo_sk")
+        j = j.merge(td, left_on="ss_sold_time_sk", right_on="t_time_sk")
+        j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        row[tag] = len(j)
+    return pd.DataFrame([row])
+
+
+def q61(dfs: Dict[str, "object"]):
+    """Promotional-channel revenue share. Probes 2000-11 instead of the
+    official 1998-11 (the generator concentrates sales in 1999-2001 —
+    same adjustment q19 makes)."""
+
+    def channel_sales(with_promo: bool):
+        ss = dfs["store_sales"].select(
+            "ss_sold_date_sk", "ss_store_sk", "ss_promo_sk",
+            "ss_customer_sk", "ss_item_sk", "ss_ext_sales_price")
+        dt = (dfs["date_dim"]
+              .filter((col("d_year") == lit(2000))
+                      & (col("d_moy") == lit(11)))
+              .select("d_date_sk"))
+        st = (dfs["store"].filter(col("s_gmt_offset") == lit(-5.0))
+              .select("s_store_sk"))
+        it = (dfs["item"].filter(col("i_category") == lit("Jewelry"))
+              .select("i_item_sk"))
+        cu = dfs["customer"].select("c_customer_sk", "c_current_addr_sk")
+        ca = (dfs["customer_address"]
+              .filter(col("ca_gmt_offset") == lit(-5.0))
+              .select("ca_address_sk"))
+        j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+        j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+        if with_promo:
+            promo = (dfs["promotion"]
+                     .filter((col("p_channel_dmail") == lit("Y"))
+                             | (col("p_channel_email") == lit("Y"))
+                             | (col("p_channel_tv") == lit("Y")))
+                     .select("p_promo_sk"))
+            j = j.join(promo, on=col("ss_promo_sk") == col("p_promo_sk"))
+        j = j.join(cu, on=col("ss_customer_sk") == col("c_customer_sk"))
+        j = j.join(ca, on=col("c_current_addr_sk") == col("ca_address_sk"))
+        j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+        alias = "promotions" if with_promo else "total"
+        return j.agg(("sum", "ss_ext_sales_price", alias))
+
+    p = channel_sales(True)
+    tot = channel_sales(False)
+    return (p.join(tot, how="cross")
+            .select("promotions", "total",
+                    ((col("promotions") / col("total"))
+                     * lit(100.0)).alias("share")))
+
+
+def q61_pandas(t: Dict[str, "object"]):
+    import pandas as pd
+
+    def channel_sales(with_promo: bool):
+        d = t["date_dim"]
+        dt = d[(d.d_year == 2000) & (d.d_moy == 11)][["d_date_sk"]]
+        s = t["store"]
+        st = s[s.s_gmt_offset == -5.0][["s_store_sk"]]
+        i = t["item"]
+        it = i[i.i_category == "Jewelry"][["i_item_sk"]]
+        ca = t["customer_address"]
+        ca = ca[ca.ca_gmt_offset == -5.0][["ca_address_sk"]]
+        j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                                   right_on="d_date_sk")
+        j = j.merge(st, left_on="ss_store_sk", right_on="s_store_sk")
+        if with_promo:
+            p = t["promotion"]
+            promo = p[(p.p_channel_dmail == "Y") | (p.p_channel_email == "Y")
+                      | (p.p_channel_tv == "Y")][["p_promo_sk"]]
+            j = j.merge(promo, left_on="ss_promo_sk", right_on="p_promo_sk")
+        j = j.merge(t["customer"][["c_customer_sk", "c_current_addr_sk"]],
+                    left_on="ss_customer_sk", right_on="c_customer_sk")
+        j = j.merge(ca, left_on="c_current_addr_sk",
+                    right_on="ca_address_sk")
+        j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        return j.ss_ext_sales_price.sum()
+
+    promotions = channel_sales(True)
+    total = channel_sales(False)
+    return pd.DataFrame([{"promotions": promotions, "total": total,
+                          "share": promotions / total * 100.0}])
+
+
 QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q3": (q3, q3_pandas),
     "q7": (q7, q7_pandas),
@@ -1236,14 +1425,17 @@ QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q19": (q19, q19_pandas),
     "q25": (q25, q25_pandas),
     "q26": (q26, q26_pandas),
+    "q28": (q28, q28_pandas),
     "q42": (q42, q42_pandas),
     "q43": (q43, q43_pandas),
     "q48": (q48, q48_pandas),
     "q50": (q50, q50_pandas),
     "q52": (q52, q52_pandas),
     "q55": (q55, q55_pandas),
+    "q61": (q61, q61_pandas),
     "q64": (q64, q64_pandas),
     "q68": (q68, q68_pandas),
     "q79": (q79, q79_pandas),
+    "q88": (q88, q88_pandas),
     "q96": (q96, q96_pandas),
 }

@@ -271,3 +271,33 @@ def test_case_when_in_filter(sess, tables):
     got = df.filter(band == lit(1)).select("k").collect().to_pandas()
     exp = lpdf[lpdf.k < 10][["k"]]
     assert len(got) == len(exp)
+
+
+def test_cross_join_suffixed_select(sess, tables):
+    """Selecting only the right side's `_r` copy (or only right columns)
+    through a cross join must keep the collision rename working."""
+    _, _, lp, rp = tables
+    l = sess.read_parquet(lp).select("k", "x").limit(5)
+    r = sess.read_parquet(rp).select("k", "y").limit(3)
+    got = l.join(r, how="cross").select("k_r").collect().to_pandas()
+    assert len(got) == 15 and list(got.columns) == ["k_r"]
+    only_right = l.join(r, how="cross").select("y").collect().to_pandas()
+    assert len(only_right) == 15
+
+
+def test_global_aggregate_over_zero_rows_is_one_row(sess, tables):
+    """SQL: global aggregates over an empty input yield ONE row (count 0,
+    sum/avg NULL) — and an empty bucket must not collapse a cross-join
+    scalar assembly."""
+    _, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    empty = df.filter(col("k") == lit(-999))
+    got = empty.agg(("count", "*", "c"), ("sum", "q", "s"),
+                    ("count_distinct", "q", "d")).to_pandas()
+    assert len(got) == 1
+    assert got["c"][0] == 0 and got["d"][0] == 0
+    assert pd.isna(got["s"][0])
+    total = df.agg(("count", "*", "n"))
+    crossed = empty.agg(("sum", "q", "s")).join(total, how="cross") \
+        .to_pandas()
+    assert len(crossed) == 1 and crossed["n"][0] == 300

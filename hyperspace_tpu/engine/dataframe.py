@@ -107,7 +107,6 @@ class DataFrame:
     def distinct(self) -> "DataFrame":
         """SELECT DISTINCT: deduplicate rows (an aggregation over all
         columns with no aggregate outputs)."""
-        from hyperspace_tpu.plan.nodes import Aggregate
         return DataFrame(Aggregate(self.columns, [], self.plan),
                          self.session)
 

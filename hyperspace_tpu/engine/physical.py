@@ -125,8 +125,8 @@ class ScanExec(PhysicalNode):
             batch = parquet.read_host_batch(files, self.columns,
                                             self.out_schema)
         else:
-            table = parquet.read_table(files, columns=self.columns)
-            batch = columnar.from_arrow(table, self.out_schema, device=True)
+            batch = parquet.read_device_batch(files, self.columns,
+                                              self.out_schema)
         if bucket is not None and len(files) > 1:
             # Multiple sorted runs in one bucket (incremental deltas): the
             # concat is not globally sorted — restore order on device.
@@ -171,9 +171,8 @@ class ScanExec(PhysicalNode):
         if int(lengths.sum()) < min_dev:
             return parquet.read_host_batch(files, self.columns,
                                            self.out_schema), lengths
-        table = parquet.read_table(files, columns=self.columns)
-        return columnar.from_arrow(table, self.out_schema,
-                                   device=True), lengths
+        return parquet.read_device_batch(files, self.columns,
+                                         self.out_schema), lengths
 
 
 class FilterExec(PhysicalNode):

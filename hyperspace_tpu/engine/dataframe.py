@@ -104,6 +104,20 @@ class DataFrame:
     def group_by(self, *columns: str) -> "GroupedData":
         return GroupedData(self, list(columns))
 
+    def window(self, partition_by: Sequence[str],
+               order_by: Optional[Sequence[str]] = None,
+               **specs) -> "DataFrame":
+        """Append window columns over partitions:
+        `df.window(["k"], order_by=["-total"], rk=("rank", "*"),
+        part_avg=("avg", "total"))`. Functions: rank, dense_rank,
+        row_number (ORDER BY required; column "*"), and partition-wide
+        sum/avg/min/max/count."""
+        from hyperspace_tpu.plan.nodes import Window
+        parsed = [AggSpec(func, column, alias)
+                  for alias, (func, column) in specs.items()]
+        return DataFrame(Window(list(partition_by), list(order_by or []),
+                                parsed, self.plan), self.session)
+
     def distinct(self) -> "DataFrame":
         """SELECT DISTINCT: deduplicate rows (an aggregation over all
         columns with no aggregate outputs)."""

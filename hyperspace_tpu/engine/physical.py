@@ -19,7 +19,7 @@ from hyperspace_tpu.io import columnar, parquet
 from hyperspace_tpu.plan import expr as E
 from hyperspace_tpu.plan.nodes import (Aggregate, BucketSpec, Filter, Join,
                                        Limit, LogicalPlan, Project, Scan,
-                                       Sort, Union)
+                                       Sort, Union, Window)
 from hyperspace_tpu.plan.schema import Schema
 
 
@@ -380,6 +380,33 @@ class ExchangeExec(PhysicalNode):
         return self.execute_partitioned()
 
 
+class WindowExec(PhysicalNode):
+    name = "Window"
+
+    def __init__(self, partition_by, order_by, specs, out_schema: Schema,
+                 child: PhysicalNode):
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.specs = list(specs)
+        self.out_schema = out_schema
+        self.child = child
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def simple_string(self) -> str:
+        parts = [f"{s.func}({s.column}) AS {s.alias}" for s in self.specs]
+        return (f"Window [{', '.join(parts)}] PARTITION BY "
+                f"[{', '.join(self.partition_by)}]")
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        from hyperspace_tpu.ops.window import window_compute
+        batch = self.child.execute(bucket)
+        return window_compute(batch, self.partition_by, self.order_by,
+                              self.specs, self.out_schema)
+
+
 class SortExec(PhysicalNode):
     name = "Sort"
 
@@ -713,8 +740,9 @@ class SortMergeJoinExec(PhysicalNode):
                                  self.num_buckets)
                 lbatch, l_lengths = lf.result()
                 rbatch, r_lengths = rf.result()
-            # The mesh path shares the padded [B, L] layout; under hot-key
-            # skew route single-chip so the global-join fallback applies.
+            # The mesh path uses the padded [B, L] layout, so hot-key
+            # skew routes single-chip where the counting join's memory is
+            # bounded by true row count (skew-immune by construction).
             # Host-lane sides skip the mesh in "auto" mode for the same
             # reason FilterExec does: distribution would pay the device
             # transfers the lane exists to avoid.
@@ -794,17 +822,13 @@ class SortMergeJoinExec(PhysicalNode):
                                             self.left_keys, self.right_keys,
                                             how=self.how,
                                             columns=self.out_columns)
-        presort = (lkeys is not None and rkeys is not None
-                   and not lbatch.is_host and not rbatch.is_host)
-        if presort:
-            from hyperspace_tpu.ops.sort import sort_batch
-            if lbatch.num_rows:
-                lbatch = sort_batch(lbatch, lkeys)
-            if rbatch.num_rows:
-                rbatch = sort_batch(rbatch, rkeys)
+        # No pre-sort: the counting join (`ops/join.py`) matches in
+        # ORIGINAL row space over unsorted ids, so the Sort wrappers'
+        # work is genuinely elided here — sorting the payload batches
+        # first would buy nothing and cost two full device sorts.
         return sort_merge_join(lbatch, rbatch, self.left_keys,
-                               self.right_keys, presorted=presort,
-                               how=self.how, columns=self.out_columns)
+                               self.right_keys, how=self.how,
+                               columns=self.out_columns)
 
     def _join_mesh(self, total_rows: int, host_batch: bool = False):
         """Mesh for the distributed co-bucketed join, or None. Requires an
@@ -1155,6 +1179,29 @@ def _plan_physical_node(plan: LogicalPlan,
                              _plan_physical(plan.child, child_required,
                                             conf, ctx),
                              conf=conf)
+
+    if isinstance(plan, Window):
+        from hyperspace_tpu.plan.nodes import sort_direction
+        aliases = {s.alias.lower() for s in plan.specs}
+        child_required = ({n for n in required if n.lower() not in aliases
+                           and plan.child.schema.contains(n)}
+                          | set(plan.partition_by)
+                          | {sort_direction(c)[0] for c in plan.order_by})
+        for s in plan.specs:
+            child_required |= s.references()
+        if not child_required:
+            child_required = {plan.child.schema.names[0]}
+        # Output schema restricted to what survives pruning: child columns
+        # actually read + every window column.
+        child_phys = _plan_physical(plan.child, child_required, conf, ctx)
+        from hyperspace_tpu.plan.schema import Schema as _Schema
+        kept = {n.lower() for n in child_required}
+        fields = [f for f in plan.child.schema.fields
+                  if f.name.lower() in kept]
+        out_schema = _Schema(fields + [plan.schema.field(s.alias)
+                                       for s in plan.specs])
+        return WindowExec(plan.partition_by, plan.order_by, plan.specs,
+                          out_schema, child_phys)
 
     if isinstance(plan, Sort):
         from hyperspace_tpu.plan.nodes import sort_direction

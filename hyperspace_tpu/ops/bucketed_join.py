@@ -1,23 +1,26 @@
-"""Bucketed sort-merge join as ONE batched XLA program.
+"""Bucketed sort-merge join, batched across all buckets.
 
-The naive per-bucket Python loop dispatches a separately-compiled join per
-bucket — on a TPU each unique bucket shape is a fresh XLA compile. Here all
-buckets are joined in a single compiled program:
+The naive per-bucket Python loop dispatches a separately-compiled join
+per bucket — on a TPU each unique bucket shape is a fresh XLA compile.
+Here the whole join is TWO compiled programs and one host sync:
 
 1. key tuples of both sides are globally group-encoded to order-preserving
    int32 ids (one joint `lax.sort` over 32-bit key lanes, `ops/keys.py`);
-2. each side is laid out as a padded [B, L] matrix (L = next power of two of
-   the largest bucket, so repeated queries reuse compiles), padding slots
-   carry id INT32_MAX;
-3. one batched `lax.sort` per side orders every bucket's ids (robust to
-   multi-run buckets from incremental refresh — no reliance on file order);
-4. a vmapped double `searchsorted` finds per-row match ranges; counts are
-   clamped to each bucket's valid length;
-5. after ONE host sync for the total match count, a second jitted program
-   expands (bucket, row, offset) -> original row index pairs.
+2. the GLOBAL counting join (`ops/join.counting_join_indices`) matches
+   the id arrays — legal precisely because both sides hash-bucket by the
+   same keys, so equal tuples always co-bucket and the global match set
+   equals the per-bucket one. One more flat sort + cumulative counting;
+   no `searchsorted` (log-n serialized gather sweeps dominate on TPU at
+   TPC-DS scale), no padded [B, L] layout, skew-immune by construction.
 
-SQL null semantics ride the same sentinels as `ops/join.py`: left-null id
--1, right-null id -2, padding +INT32_MAX — none ever equal.
+SQL null semantics ride shared sentinels: left-null id -1, right-null id
+-2 — never equal across sides.
+
+The host lane keeps the per-bucket merge over the already-sorted index
+layout (`ops/join.host_bucketed_join_indices` / the native C++ kernel);
+the padded-layout helpers below remain for the mesh-sharded distributed
+join (`parallel/join.py`) and compaction (`ops/merge.py`), which shard
+the bucket axis.
 """
 
 from __future__ import annotations
@@ -58,32 +61,6 @@ def padded_skew(l_lengths, r_lengths, n_rows: int, m_rows: int) -> bool:
     cells = B * (Ll + Lr)
     return (cells > SKEW_MIN_CELLS
             and cells > SKEW_BLOWUP_FACTOR * max(n_rows + m_rows, 1))
-
-
-def _global_join_indices(left: ColumnBatch, right: ColumnBatch,
-                         left_keys: Sequence[str],
-                         right_keys: Sequence[str], how: str) -> Tuple:
-    """Skew fallback. Both sides are bucketed by the same hash of the same
-    keys, so equal key tuples always share a bucket: a global id-sort +
-    merge join over all rows returns exactly the per-bucket match set
-    (row order differs; join output order is unspecified), with memory
-    bounded by the true row count."""
-    import jax.numpy as jnp
-
-    from hyperspace_tpu.ops.join import merge_join_indices
-
-    l_ids, r_ids = encode_group_ids(left, right, left_keys, right_keys)
-    l_perm = jnp.argsort(l_ids, stable=True)
-    r_perm = jnp.argsort(r_ids, stable=True)
-    li_s, ri_s = merge_join_indices(jnp.take(l_ids, l_perm),
-                                    jnp.take(r_ids, r_perm), how=how)
-    if li_s.shape[0] == 0:
-        return li_s, ri_s
-    li = jnp.take(l_perm, li_s).astype(jnp.int32)
-    ri = jnp.where(ri_s >= 0,
-                   jnp.take(r_perm, jnp.clip(ri_s, 0, None)),
-                   jnp.int32(-1)).astype(jnp.int32)
-    return li, ri
 
 
 def encode_group_ids(left: ColumnBatch, right: ColumnBatch,
@@ -157,70 +134,6 @@ def _padded_layout(lengths: np.ndarray, width: int):
     return idx.astype(np.int32), valid
 
 
-@partial(__import__("jax").jit, static_argnames=())
-def _match_core(l_ids, r_ids, l_idx, l_valid, r_idx, r_valid):
-    """Batched per-bucket match-range computation.
-
-    l_idx/l_valid: [B, Ll] gather matrix + mask; likewise right. Returns
-    (counts [B*Ll], starts [B*Ll], lo [B, Ll], l_pos [B, Ll], r_pos [B, Lr])
-    where l_pos/r_pos give, per bucket, the original padded-slot position of
-    each id-sorted element.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    B, Ll = l_idx.shape
-    Lr = r_idx.shape[1]
-    lid = jnp.where(l_valid, jnp.take(l_ids, l_idx), _I32_MAX)
-    rid = jnp.where(r_valid, jnp.take(r_ids, r_idx), _I32_MAX)
-
-    pos_l = jnp.broadcast_to(jnp.arange(Ll, dtype=jnp.int32), (B, Ll))
-    pos_r = jnp.broadcast_to(jnp.arange(Lr, dtype=jnp.int32), (B, Lr))
-    lid_s, l_pos = jax.lax.sort([lid, pos_l], num_keys=1, is_stable=True,
-                                dimension=1)
-    rid_s, r_pos = jax.lax.sort([rid, pos_r], num_keys=1, is_stable=True,
-                                dimension=1)
-
-    lo = jax.vmap(lambda r, l: jnp.searchsorted(r, l, side="left"))(rid_s, lid_s)
-    hi = jax.vmap(lambda r, l: jnp.searchsorted(r, l, side="right"))(rid_s, lid_s)
-    r_len = jnp.sum(r_valid, axis=1).astype(lo.dtype)  # valid (incl. null-id) rows sort before pads
-    lo_c = jnp.minimum(lo, r_len[:, None])
-    hi_c = jnp.minimum(hi, r_len[:, None])
-    counts = jnp.maximum(hi_c - lo_c, 0)
-    real = (lid_s != _I32_MAX).reshape(-1)  # non-padding left slots
-    counts = jnp.where(lid_s == _I32_MAX, 0, counts)  # padding left rows
-    flat = counts.reshape(-1)
-    starts = jnp.cumsum(flat) - flat
-    return flat, starts, lo_c, l_pos, r_pos, real
-
-
-@partial(__import__("jax").jit, static_argnames=("total", "Ll"))
-def _expand_core(starts, match_counts, lo_c, l_pos, r_pos, l_idx, r_idx,
-                 total: int, Ll: int):
-    """Expand (bucket,row,offset) -> original row index pairs.
-
-    `starts` is the cumulative layout of EFFECTIVE counts (outer joins
-    reserve one output slot for unmatched real left rows); `match_counts`
-    is the TRUE per-slot match count from `_match_core`, pre-outer-fill —
-    a slot whose true count is zero emits right index -1. Deriving
-    `matched` from the effective counts would make every reserved outer
-    slot look matched and gather an arbitrary right row."""
-    import jax.numpy as jnp
-
-    slots = jnp.arange(total, dtype=starts.dtype)
-    row = jnp.searchsorted(starts, slots, side="right") - 1
-    b = (row // Ll).astype(jnp.int32)
-    i = (row % Ll).astype(jnp.int32)
-    offset = (slots - jnp.take(starts, row)).astype(jnp.int32)
-    l_slot = l_pos[b, i]
-    matched = jnp.take(match_counts, row) > 0
-    Lr = r_pos.shape[1]
-    r_lookup = jnp.clip(lo_c[b, i] + offset, 0, Lr - 1)
-    r_slot = r_pos[b, r_lookup]
-    ri = jnp.where(matched, r_idx[b, r_slot], jnp.int32(-1))
-    return l_idx[b, l_slot], ri
-
-
 def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
                           l_lengths: np.ndarray, r_lengths: np.ndarray,
                           left_keys: Sequence[str],
@@ -228,7 +141,17 @@ def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
                           how: str = "inner") -> Tuple:
     """Join row-index pairs for two sides stored concat-in-bucket-order with
     the given per-bucket lengths. One host sync total. For how='left_outer'
-    unmatched left rows appear once with right index -1."""
+    unmatched left rows appear once with right index -1.
+
+    Device lane: the global counting join over the shared group encode
+    (`ops/join.counting_join_indices`) — both sides hash-bucket by the
+    same keys, so equal tuples always co-bucket and the GLOBAL match set
+    IS the per-bucket match set. The earlier padded [B, L] per-bucket
+    formulation is gone: its batched dim-1 sorts and vmapped
+    `searchsorted` were 4-7x slower than one flat sort + cumulative
+    counting at every device-lane size (3.4s vs ~0.5s at 4M rows, 22s vs
+    ~5s at 39M on a v5e), and the counting join is skew-immune — memory
+    is bounded by true row count, so no skew fallback either."""
     import jax.numpy as jnp
 
     left_outer = how == "left_outer"
@@ -248,29 +171,10 @@ def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
         return host_bucketed_join_indices(
             left, right, np.asarray(l_lengths), np.asarray(r_lengths),
             left_keys, right_keys, how="left_outer" if left_outer else how)
-    if padded_skew(l_lengths, r_lengths, left.num_rows, right.num_rows):
-        return _global_join_indices(left, right, left_keys, right_keys,
-                                    "left_outer" if left_outer else how)
+    from hyperspace_tpu.ops.join import counting_join_indices
     l_ids, r_ids = encode_group_ids(left, right, left_keys, right_keys)
-    Ll = next_pow2(max(1, int(l_lengths.max(initial=0))))
-    Lr = next_pow2(max(1, int(r_lengths.max(initial=0))))
-    l_idx, l_valid = _padded_layout(np.asarray(l_lengths), Ll)
-    r_idx, r_valid = _padded_layout(np.asarray(r_lengths), Lr)
-    l_idx, l_valid = jnp.asarray(l_idx), jnp.asarray(l_valid)
-    r_idx, r_valid = jnp.asarray(r_idx), jnp.asarray(r_valid)
-
-    match_counts, starts, lo_c, l_pos, r_pos, real = _match_core(
-        l_ids, r_ids, l_idx, l_valid, r_idx, r_valid)
-    counts = match_counts
-    if left_outer:
-        # One output row per unmatched REAL left row (incl. null keys).
-        counts = jnp.maximum(match_counts, real.astype(match_counts.dtype))
-        starts = jnp.cumsum(counts) - counts
-    total = int(jnp.sum(counts))  # the one host sync
-    if total == 0:
-        return empty, empty
-    return _expand_core(starts, match_counts, lo_c, l_pos, r_pos, l_idx,
-                        r_idx, total, int(l_pos.shape[1]))
+    return counting_join_indices(l_ids, r_ids,
+                                 how="left_outer" if left_outer else how)
 
 
 def _gather_side(batch: ColumnBatch, idx, names, may_unmatch: bool = True):

@@ -46,6 +46,98 @@ def encode_join_keys(left: ColumnBatch, right: ColumnBatch,
     return encode_group_ids(left, right, left_keys, right_keys)
 
 
+def counting_join_indices(l_ids, r_ids, how: str = "inner") -> Tuple:
+    """Join row-index pairs over UNSORTED id arrays (original row space),
+    via ONE joint sort + cumulative counting — no `searchsorted`.
+
+    On TPU, `searchsorted` over tens of millions of rows lowers to
+    log(n) serialized gather sweeps and dominated the join at TPC-DS
+    scale (measured ~17-20s of a 22s 39M-row join); a flat 1-D
+    `lax.sort` of the same rows runs in ~1s. So: sort (id, side,
+    original index) once, derive per-id-run right-row counts and bracket
+    starts from cumulative sums over the SORTED sequence, and expand
+    matches with `jnp.repeat`. 4-5x faster end-to-end at 39M rows, and
+    callers no longer pre-sort their payload batches — indices come back
+    in original row space.
+
+    Supports how='inner' and 'left_outer' (unmatched left rows appear
+    once with right index -1); callers express right/full outer by
+    swapping / appending as usual. Null sentinels (-1 left, -2 right)
+    form single-side runs, so they match nothing.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    left_outer = how == "left_outer"
+    n, m = int(l_ids.shape[0]), int(r_ids.shape[0])
+    empty = jnp.zeros(0, dtype=jnp.int32)
+    if n == 0 or (m == 0 and not left_outer):
+        return empty, empty
+    if m == 0:
+        return (jnp.arange(n, dtype=jnp.int32),
+                jnp.full(n, -1, dtype=jnp.int32))
+    counts, starts, rights, rstart, orig_s = _counting_match(
+        l_ids, r_ids, left_outer)
+    total = int(jnp.sum(counts))  # the one host sync
+    if total == 0:
+        return empty, empty
+    return _counting_expand(counts, starts, rights, rstart, orig_s,
+                            total, left_outer)
+
+
+@__import__("functools").partial(__import__("jax").jit,
+                                 static_argnames=("left_outer",))
+def _counting_match(l_ids, r_ids, left_outer: bool):
+    import jax
+    import jax.numpy as jnp
+
+    n, m = l_ids.shape[0], r_ids.shape[0]
+    T = n + m
+    ids2 = jnp.concatenate([l_ids, r_ids])
+    side = jnp.concatenate([jnp.zeros(n, jnp.int32),
+                            jnp.ones(m, jnp.int32)])
+    orig = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                            jnp.arange(m, dtype=jnp.int32)])
+    ids_s, side_s, orig_s = jax.lax.sort([ids2, side, orig], num_keys=2,
+                                         is_stable=True)
+    pos = jnp.arange(T, dtype=jnp.int32)
+    run_start = jnp.concatenate([jnp.ones(1, bool),
+                                 ids_s[1:] != ids_s[:-1]])
+    run_first = jax.lax.cummax(jnp.where(run_start, pos, 0))
+    # Exclusive run end: position of the NEXT run start (reverse cummin).
+    nxt = jnp.flip(jax.lax.cummin(jnp.flip(
+        jnp.where(run_start, pos, jnp.int32(T)))))
+    run_last = jnp.concatenate([nxt[1:], jnp.full(1, T, jnp.int32)]) - 1
+    R = jnp.cumsum(side_s)  # inclusive right-element count
+    rights = (jnp.take(R, run_last) - jnp.take(R, run_first)
+              + jnp.take(side_s, run_first))
+    rstart = run_last - rights + 1  # first right element of the run
+    counts = jnp.where(side_s == 0, rights, 0).astype(jnp.int32)
+    if left_outer:
+        counts = jnp.where(side_s == 0, jnp.maximum(counts, 1), 0)
+    starts = jnp.cumsum(counts) - counts
+    return counts, starts, rights, rstart, orig_s
+
+
+@__import__("functools").partial(
+    __import__("jax").jit, static_argnames=("total", "left_outer"))
+def _counting_expand(counts, starts, rights, rstart, orig_s, total: int,
+                     left_outer: bool):
+    import jax.numpy as jnp
+
+    rows = jnp.repeat(jnp.arange(counts.shape[0], dtype=jnp.int32),
+                      counts, total_repeat_length=total)
+    slots = jnp.arange(total, dtype=starts.dtype)
+    offset = (slots - jnp.take(starts, rows)).astype(jnp.int32)
+    li = jnp.take(orig_s, rows)
+    r_sorted_pos = jnp.clip(jnp.take(rstart, rows) + offset, 0,
+                            orig_s.shape[0] - 1)
+    ri = jnp.take(orig_s, r_sorted_pos)
+    if left_outer:
+        ri = jnp.where(jnp.take(rights, rows) > 0, ri, jnp.int32(-1))
+    return li, ri
+
+
 def merge_join_indices(left_ids, right_ids, how: str = "inner") -> Tuple:
     """Join row index pairs of two *sorted* id arrays.
 
@@ -147,14 +239,11 @@ def semi_anti_indices(left: ColumnBatch, right: ColumnBatch,
 
 def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                     left_keys: Sequence[str], right_keys: Sequence[str],
-                    presorted: bool = False, how: str = "inner",
-                    columns=None):
+                    how: str = "inner", columns=None):
     """Join of two batches on equi-keys (inner / left_outer / right_outer
-    / full_outer).
-
-    If `presorted` is False, both sides are sorted by their group ids first
-    (the plain path); bucketed index scans pass presorted=True and skip the
-    sort — the observable saving the rewrite rules buy.
+    / full_outer). Neither side needs to be pre-sorted: the device lane
+    matches unsorted group ids in original row space
+    (`counting_join_indices`), the host lane sorts ids internally.
 
     full_outer = the left_outer expansion plus one appended row per
     unmatched right row (the index-pair machinery both outer sides share).
@@ -185,18 +274,14 @@ def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
         return assemble_join_output(left, right, li, ri, how=how,
                                     columns=columns)
 
+    # Device lane: the counting join works in ORIGINAL row space over
+    # unsorted ids — no argsort, no pre-gather of payload batches, no
+    # searchsorted.
     l_ids, r_ids = encode_join_keys(left, right, left_keys, right_keys)
-    if not presorted:
-        l_perm = jnp.argsort(l_ids, stable=True)
-        r_perm = jnp.argsort(r_ids, stable=True)
-        left = left.take(l_perm)
-        right = right.take(r_perm)
-        l_ids = jnp.take(l_ids, l_perm)
-        r_ids = jnp.take(r_ids, r_perm)
     if how == "right_outer":
-        ri, li = merge_join_indices(r_ids, l_ids, how="left_outer")
+        ri, li = counting_join_indices(r_ids, l_ids, how="left_outer")
     else:
-        li, ri = merge_join_indices(
+        li, ri = counting_join_indices(
             l_ids, r_ids, how="left_outer" if how == "full_outer" else how)
         if how == "full_outer":
             extra = unmatched_right_from_indices(ri, right.num_rows)

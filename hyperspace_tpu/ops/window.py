@@ -1,0 +1,212 @@
+"""Window functions over partitions: rank / dense_rank / row_number and
+partition-wide aggregates (sum/avg/min/max/count), appended as columns
+with the input row order preserved.
+
+The reference delegates windows to Spark SQL; here they compile to the
+same sorted-segment machinery aggregation uses: ONE stable sort keyed
+(partition lanes, order lanes), segment ids from partition-lane change
+flags, rank family via cumulative max/sum over tie-run flags, partition
+aggregates as segment reductions broadcast back through the segment ids,
+and an inverse permutation restoring input order. Host batches run the
+numpy mirror; device batches stay XLA end to end.
+
+SQL semantics: NULL is its own partition/peer value (validity rides the
+sort lanes); aggregates skip NULL inputs; a partition with zero non-null
+inputs yields NULL for sum/avg/min/max and 0 for count.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import ColumnBatch, DeviceColumn
+from hyperspace_tpu.plan.schema import Schema
+
+RANK_FUNCS = ("rank", "dense_rank", "row_number")
+AGG_FUNCS = ("sum", "avg", "min", "max", "count")
+
+
+def window_compute(batch: ColumnBatch, partition_by: Sequence[str],
+                   order_by: Sequence[str], specs,
+                   out_schema: Schema) -> ColumnBatch:
+    """`specs` are AggSpec-shaped (func, column, alias). Returns `batch`
+    with one appended column per spec, rows in the INPUT order."""
+    from hyperspace_tpu.ops.sort import sort_permutation
+
+    n = batch.num_rows
+    host = batch.is_host
+    if host:
+        xp = np
+        cummax = np.maximum.accumulate
+        from hyperspace_tpu.ops.keys import (
+            host_column_sort_lanes as lanes_of)
+    else:
+        import jax
+        import jax.numpy as jnp
+        xp = jnp
+        cummax = jax.lax.cummax
+        from hyperspace_tpu.ops.keys import column_sort_lanes as lanes_of
+
+    from hyperspace_tpu.io.columnar import HOST_NP_DTYPES
+
+    if n == 0:
+        columns = dict(batch.columns)
+        for spec in specs:
+            f = out_schema.field(spec.alias)
+            dt = HOST_NP_DTYPES.get(f.dtype, np.int64)
+            columns[f.name] = DeviceColumn(
+                np.zeros(0, dtype=dt) if host
+                else xp.zeros(0, dtype=dt), f.dtype)
+        return ColumnBatch(out_schema, columns)
+
+    by = list(partition_by) + list(order_by)
+    perm = sort_permutation(batch, by) if by else xp.arange(n, dtype=np.int32)
+    sorted_batch = batch.take(perm)
+
+    def change_flags(names):
+        """True where any of `names`'s sort lanes differ from the previous
+        sorted row (column names may carry a '-' descending prefix —
+        direction doesn't matter for equality)."""
+        from hyperspace_tpu.plan.nodes import sort_direction
+        changed = xp.zeros(n - 1, dtype=bool) if n > 1 else xp.zeros(
+            0, dtype=bool)
+        for spec_name in names:
+            name, _ = sort_direction(spec_name)
+            for lane in lanes_of(sorted_batch.column(name)):
+                lane = xp.asarray(lane)
+                changed = changed | (lane[1:] != lane[:-1])
+        return changed
+
+    first = xp.ones(1, dtype=bool)
+    seg_flag = xp.concatenate([first, change_flags(partition_by)])
+    seg_ids = (xp.cumsum(seg_flag.astype(np.int32)) - 1).astype(np.int32)
+    num_segs_arr = seg_ids[-1] + 1
+    iota = xp.arange(n, dtype=np.int64)
+    # First row index of each row's segment, broadcast per row.
+    seg_first = cummax(xp.where(seg_flag, iota, xp.zeros_like(iota)))
+
+    rank_needed = any(s.func in RANK_FUNCS and s.func != "row_number"
+                      for s in specs)
+    if rank_needed:
+        peer_flag = xp.concatenate([first, change_flags(by)])
+        run_first = cummax(xp.where(peer_flag, iota, xp.zeros_like(iota)))
+        dense = xp.cumsum(peer_flag.astype(np.int64))
+
+    agg_needed = [s for s in specs if s.func in AGG_FUNCS]
+    if agg_needed:
+        num_segs = int(num_segs_arr)  # one host sync, shared by all specs
+
+    out_sorted = {}
+    for spec in specs:
+        if spec.func == "row_number":
+            out_sorted[spec.alias] = DeviceColumn(
+                (iota - seg_first + 1).astype(np.int64), "int64")
+            continue
+        if spec.func == "rank":
+            out_sorted[spec.alias] = DeviceColumn(
+                (run_first - seg_first + 1).astype(np.int64), "int64")
+            continue
+        if spec.func == "dense_rank":
+            # Peer-run ordinal within the segment: dense index at the row
+            # minus the dense index at the segment's first row, + 1.
+            seg_dense = (dense[seg_first] if host
+                         else xp.take(dense, seg_first))
+            out_sorted[spec.alias] = DeviceColumn(
+                (dense - seg_dense + 1).astype(np.int64), "int64")
+            continue
+        # Partition-wide aggregate: segment-reduce, broadcast back.
+        f = out_schema.field(spec.alias)
+        src = sorted_batch.column(spec.column) if spec.column != "*" else None
+        if spec.func == "count" and spec.column == "*":
+            ones = xp.ones(n, dtype=np.int64)
+            per_seg = _seg_sum(ones, seg_ids, num_segs, host)
+            out_sorted[spec.alias] = DeviceColumn(
+                _bcast(per_seg, seg_ids, host, xp), "int64")
+            continue
+        if src.is_string and spec.func != "count":
+            raise HyperspaceException(
+                f"Window {spec.func} over string column {spec.column} "
+                "is not supported.")
+        valid = (xp.asarray(src.validity) if src.validity is not None
+                 else xp.ones(n, dtype=bool))
+        counts = _seg_sum(valid.astype(np.int64), seg_ids, num_segs, host)
+        if spec.func == "count":
+            out_sorted[spec.alias] = DeviceColumn(
+                _bcast(counts, seg_ids, host, xp), "int64")
+            continue
+        values = xp.asarray(src.data)
+        if spec.func in ("sum", "avg"):
+            acc = np.float64 if f.dtype == "float64" else np.int64
+            total = _seg_sum(xp.where(valid, values, 0).astype(acc),
+                             seg_ids, num_segs, host)
+            per_seg = (total if spec.func == "sum"
+                       else total.astype(np.float64)
+                       / xp.maximum(counts, 1))
+        elif spec.func == "min":
+            big = (np.inf if values.dtype.kind == "f"
+                   else np.iinfo(values.dtype).max)
+            per_seg = _seg_min(xp.where(valid, values, big), seg_ids,
+                               num_segs, host)
+        else:  # max
+            small = (-np.inf if values.dtype.kind == "f"
+                     else np.iinfo(values.dtype).min)
+            per_seg = _seg_max(xp.where(valid, values, small), seg_ids,
+                               num_segs, host)
+        data = _bcast(per_seg, seg_ids, host, xp)
+        validity = _bcast(counts > 0, seg_ids, host, xp)
+        out_sorted[spec.alias] = DeviceColumn(
+            data.astype(HOST_NP_DTYPES.get(f.dtype, np.int64)), f.dtype,
+            validity=validity)
+
+    # Inverse permutation: out[perm[i]] = sorted_val[i].
+    if host:
+        inv = np.empty(n, dtype=np.int32)
+        inv[np.asarray(perm)] = np.arange(n, dtype=np.int32)
+    else:
+        import jax.numpy as jnp
+        inv = jnp.zeros(n, dtype=jnp.int32).at[perm].set(
+            jnp.arange(n, dtype=jnp.int32))
+    columns = dict(batch.columns)
+    for spec in specs:
+        col = out_sorted[spec.alias]
+        f = out_schema.field(spec.alias)
+        columns[f.name] = DeviceColumn(
+            col.data[inv] if host else xp.take(col.data, inv),
+            col.dtype,
+            validity=(None if col.validity is None else
+                      (col.validity[inv] if host
+                       else xp.take(col.validity, inv))))
+    return ColumnBatch(out_schema, columns)
+
+
+def _seg_sum(x, seg_ids, num_segs, host):
+    if host:
+        # seg_ids are sorted-contiguous here, so reduceat applies — and
+        # keeps int64 sums exact (bincount's float64 weights would not).
+        starts = np.searchsorted(seg_ids, np.arange(num_segs), "left")
+        return np.add.reduceat(x, starts)
+    import jax
+    return jax.ops.segment_sum(x, seg_ids, num_segments=num_segs)
+
+
+def _seg_min(x, seg_ids, num_segs, host):
+    if host:
+        return np.minimum.reduceat(
+            x, np.searchsorted(seg_ids, np.arange(num_segs), "left"))
+    import jax
+    return jax.ops.segment_min(x, seg_ids, num_segments=num_segs)
+
+
+def _seg_max(x, seg_ids, num_segs, host):
+    if host:
+        return np.maximum.reduceat(
+            x, np.searchsorted(seg_ids, np.arange(num_segs), "left"))
+    import jax
+    return jax.ops.segment_max(x, seg_ids, num_segments=num_segs)
+
+
+def _bcast(per_seg, seg_ids, host, xp):
+    return per_seg[seg_ids] if host else xp.take(per_seg, seg_ids)

@@ -296,7 +296,10 @@ class AggSpec:
     alias: str
 
     def __post_init__(self):
-        if self.func not in _AGG_FUNCS:
+        # Window reuses this spec shape with its own function set;
+        # Aggregate and Window each validate against theirs.
+        if self.func not in _AGG_FUNCS + ("rank", "dense_rank",
+                                          "row_number"):
             raise HyperspaceException(f"Unsupported aggregate: {self.func}")
 
     @property
@@ -342,6 +345,10 @@ class Aggregate(LogicalPlan):
             raise HyperspaceException(
                 "Aggregate requires group columns or at least one "
                 "aggregation expression.")
+        for spec in self.aggregates:
+            if spec.func not in _AGG_FUNCS:
+                raise HyperspaceException(
+                    f"Unsupported aggregate: {spec.func}")
         # Group columns with no aggregates = DISTINCT over those columns.
         self.child = child
 
@@ -380,6 +387,87 @@ class Aggregate(LogicalPlan):
         aggs = ", ".join(f"{a.func}({a.column}) AS {a.alias}"
                          for a in self.aggregates)
         return f"Aggregate [{', '.join(self.group_columns)}] [{aggs}]"
+
+
+_WINDOW_FUNCS = ("rank", "dense_rank", "row_number", "sum", "avg", "min",
+                 "max", "count")
+
+
+class Window(LogicalPlan):
+    """Window functions: appends one column per spec to the child's rows
+    (input row order preserved). `partition_by` are plain column names;
+    `order_by` uses Sort's spec syntax ("name" asc / "-name" desc) and is
+    required by the rank family. The reference delegates windows to Spark
+    SQL; this engine executes them as sorted-segment computations
+    (`ops/window.py`)."""
+
+    def __init__(self, partition_by: Sequence[str], order_by: Sequence[str],
+                 specs: Sequence[AggSpec], child: LogicalPlan):
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.specs = list(specs)
+        self.child = child
+        if not self.specs:
+            raise HyperspaceException("Window requires at least one spec.")
+        for spec in self.specs:
+            if spec.func not in _WINDOW_FUNCS:
+                raise HyperspaceException(
+                    f"Unsupported window function: {spec.func}")
+            if spec.func in ("rank", "dense_rank") and not self.order_by:
+                raise HyperspaceException(
+                    f"{spec.func} requires an ORDER BY.")
+            if spec.is_expression:
+                raise HyperspaceException(
+                    "Window inputs must be plain columns; project the "
+                    "expression first.")
+            if (spec.column == "*"
+                    and spec.func not in ("rank", "dense_rank",
+                                          "row_number", "count")):
+                raise HyperspaceException(
+                    f"Window {spec.func} requires a column input.")
+            if child.schema.contains(spec.alias):
+                raise HyperspaceException(
+                    f"Window output name collides with an input column: "
+                    f"{spec.alias}")
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.child]
+
+    @cached_property
+    def schema(self) -> Schema:
+        from hyperspace_tpu.plan.schema import Field
+        fields = list(self.child.schema.fields)
+        for spec in self.specs:
+            if spec.func in ("rank", "dense_rank", "row_number", "count"):
+                dtype = "int64"
+            elif spec.func == "avg":
+                dtype = "float64"
+            elif spec.func == "sum":
+                src = spec.input_dtype(self.child.schema)
+                dtype = ("float64" if src in ("float32", "float64")
+                         else "int64")
+            else:  # min/max keep the input type
+                dtype = spec.input_dtype(self.child.schema)
+            fields.append(Field(spec.alias, dtype, True))
+        return Schema(fields)
+
+    def with_children(self, children):
+        (child,) = children
+        return Window(self.partition_by, self.order_by, self.specs, child)
+
+    def to_dict(self) -> dict:
+        return {"node": "window", "partitionBy": list(self.partition_by),
+                "orderBy": list(self.order_by),
+                "specs": [s.to_dict() for s in self.specs],
+                "child": self.child.to_dict()}
+
+    def simple_string(self) -> str:
+        parts = [f"{s.func}({s.column}) AS {s.alias}" for s in self.specs]
+        order = f" ORDER BY {', '.join(self.order_by)}" if self.order_by \
+            else ""
+        return (f"Window [{', '.join(parts)}] PARTITION BY "
+                f"[{', '.join(self.partition_by)}]{order}")
 
 
 def sort_direction(column: str):

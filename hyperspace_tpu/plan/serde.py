@@ -18,7 +18,7 @@ from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.plan.expr import Expression
 from hyperspace_tpu.plan.nodes import (Aggregate, AggSpec, BucketSpec, Filter,
                                        Join, Limit, LogicalPlan, Project,
-                                       Scan, Sort, Union)
+                                       Scan, Sort, Union, Window)
 from hyperspace_tpu.plan.schema import Field, Schema
 
 
@@ -49,6 +49,10 @@ def plan_from_dict(d: dict) -> LogicalPlan:
         return Aggregate(d["groupBy"],
                          [AggSpec.from_dict(a) for a in d["aggregates"]],
                          plan_from_dict(d["child"]))
+    if node == "window":
+        return Window(d["partitionBy"], d["orderBy"],
+                      [AggSpec.from_dict(s) for s in d["specs"]],
+                      plan_from_dict(d["child"]))
     if node == "sort":
         return Sort(d["columns"], plan_from_dict(d["child"]))
     if node == "limit":

@@ -107,6 +107,7 @@ def generate(out_dir: str, scale: float = 1.0,
         "s_county": np.array([["Williamson County", "Ziebach County"][i % 2]
                               for i in range(n_store)]),
         "s_gmt_offset": np.full(n_store, -5.0),
+        "s_company_name": np.array(["Unknown"] * n_store),
     }
 
     _CATEGORIES = ["Books", "Home", "Electronics", "Jewelry", "Sports",
@@ -118,11 +119,16 @@ def generate(out_dir: str, scale: float = 1.0,
         "i_item_desc": np.array(["desc_%d" % (i % 997) for i in range(n_item)]),
         "i_product_name": np.array(["prod_%d" % i for i in range(n_item)]),
         "i_current_price": np.round(rng.uniform(0.5, 100.0, n_item), 2),
+        "i_wholesale_cost": np.round(rng.uniform(0.3, 80.0, n_item), 2),
         "i_brand_id": (1001001 + (np.arange(n_item) % 60) * 1000
                        ).astype(np.int64),
         "i_brand": np.array(["brand_%02d" % (i % 60) for i in range(n_item)]),
         "i_category_id": (1 + np.arange(n_item) % 10).astype(np.int64),
         "i_category": np.array([_CATEGORIES[i % 10] for i in range(n_item)]),
+        "i_class": np.array([["personal", "portable", "reference",
+                              "self-help", "accessories", "classical",
+                              "fragrances", "pants"][i % 8]
+                             for i in range(n_item)]),
         "i_manufact_id": (1 + np.arange(n_item) % 200).astype(np.int64),
         "i_manufact": np.array(["manufact_%03d" % (i % 200)
                                 for i in range(n_item)]),

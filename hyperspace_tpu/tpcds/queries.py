@@ -1,6 +1,6 @@
-"""Twenty-one TPC-DS queries on the framework DataFrame API, with pandas
+"""Twenty-six TPC-DS queries on the framework DataFrame API, with pandas
 oracles: q3, q7, q13, q15, q17, q19, q25, q26, q28, q42, q43, q48, q50,
-q52, q55, q61, q64, q68, q79, q88, q96.
+q52, q53, q55, q61, q63, q64, q65, q68, q79, q88, q89, q96, q98.
 
 Each query is expressed as a join tree the rewrite rules can accelerate:
 the innermost join is a linear scan pair (JoinIndexRule's applicability,
@@ -333,7 +333,7 @@ def q64_pandas(t: Dict[str, "object"]):
 
 
 _STAR_FAMILY = ("q3", "q7", "q13", "q19", "q42", "q43", "q48", "q52",
-                "q55", "q68", "q79")
+                "q53", "q55", "q63", "q65", "q68", "q79", "q89", "q98")
 
 # index name -> (table, IndexConfig args, queries that can use it)
 _INDEX_DEFS = (
@@ -921,7 +921,8 @@ def q13_pandas(t: Dict[str, "object"]):
         "avg_qty": [j.ss_quantity.mean()],
         "avg_esp": [j.ss_ext_sales_price.mean()],
         "avg_ewc": [j.ss_ext_wholesale_cost.mean()],
-        "sum_ewc": [j.ss_ext_wholesale_cost.sum()]})
+        # min_count=1: SUM over zero rows is SQL NULL, not 0.0.
+        "sum_ewc": [j.ss_ext_wholesale_cost.sum(min_count=1)]})
 
 
 def q48(dfs: Dict[str, "object"]):
@@ -989,7 +990,8 @@ def q48_pandas(t: Dict[str, "object"]):
             | (j.ca_state.isin(["VA", "CA", "MS"])
                & j.ss_net_profit.between(50, 25000)))
     j = j[demo & addr]
-    return pd.DataFrame({"sum_qty": [j.ss_quantity.sum()]})
+    # min_count=1: SUM over zero rows is SQL NULL, not 0.
+    return pd.DataFrame({"sum_qty": [j.ss_quantity.sum(min_count=1)]})
 
 
 # ---------------------------------------------------------------------------
@@ -1416,6 +1418,267 @@ def q61_pandas(t: Dict[str, "object"]):
                           "share": promotions / total * 100.0}])
 
 
+# ---------------------------------------------------------------------------
+# q53 / q63 / q89 / q98 — the window family: grouped sums compared against
+# their AVG/SUM OVER (PARTITION BY ...), deviation filters, share ratios.
+# Date predicates use d_year/d_moy (the generator has no d_month_seq /
+# d_date); item brand literals use the generator's brand_NN domain.
+# ---------------------------------------------------------------------------
+
+_Q53_DISJUNCT_ARGS = (
+    (("Books", "Children", "Electronics"),
+     ("personal", "portable", "reference", "self-help"),
+     ("brand_01", "brand_03", "brand_05", "brand_07")),
+    (("Women", "Music", "Men"),
+     ("accessories", "classical", "fragrances", "pants"),
+     ("brand_02", "brand_04", "brand_06", "brand_08")),
+)
+
+
+def _item_disjunct_expr():
+    (c1, k1, b1), (c2, k2, b2) = _Q53_DISJUNCT_ARGS
+    return ((col("i_category").isin(*c1) & col("i_class").isin(*k1)
+             & col("i_brand").isin(*b1))
+            | (col("i_category").isin(*c2) & col("i_class").isin(*k2)
+               & col("i_brand").isin(*b2)))
+
+
+def _item_disjunct_mask(i):
+    (c1, k1, b1), (c2, k2, b2) = _Q53_DISJUNCT_ARGS
+    return ((i.i_category.isin(c1) & i.i_class.isin(k1)
+             & i.i_brand.isin(b1))
+            | (i.i_category.isin(c2) & i.i_class.isin(k2)
+               & i.i_brand.isin(b2)))
+
+
+def _abs(e):
+    from hyperspace_tpu.plan.expr import when
+    return when(e < lit(0.0), lit(0.0) - e).otherwise(e)
+
+
+def _q53_shape(dfs, key_col: str, period_col: str, avg_alias: str):
+    """Shared q53/q63 body: quarterly/monthly sums per item key vs the
+    key's average over periods, rows deviating >10% from it."""
+    ss = dfs["store_sales"].select("ss_item_sk", "ss_sold_date_sk",
+                                   "ss_store_sk", "ss_sales_price")
+    it = (dfs["item"]
+          .filter(_item_disjunct_expr())
+          .select("i_item_sk", key_col))
+    dt = (dfs["date_dim"].filter(col("d_year") == lit(2000))
+          .select("d_date_sk", period_col))
+    st = dfs["store"].select("s_store_sk")
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    g = (j.group_by(key_col, period_col)
+         .agg(("sum", "ss_sales_price", "sum_sales")))
+    w = g.window([key_col], **{avg_alias: ("avg", "sum_sales")})
+    dev = _abs(col("sum_sales") - col(avg_alias)) / col(avg_alias)
+    return (w.filter((col(avg_alias) > lit(0.0)) & (dev > lit(0.1)))
+            .select(key_col, "sum_sales", avg_alias)
+            .sort(avg_alias, "sum_sales", key_col).limit(100))
+
+
+def _q53_shape_pandas(t, key_col: str, left_key: str, period_col: str,
+                      avg_alias: str):
+    i = t["item"]
+    it = i[_item_disjunct_mask(i)][["i_item_sk", key_col]]
+    d = t["date_dim"]
+    dt = d[d.d_year == 2000][["d_date_sk", period_col]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(t["store"][["s_store_sk"]], left_on="ss_store_sk",
+                right_on="s_store_sk")
+    g = (j.groupby([key_col, period_col])
+         .agg(sum_sales=("ss_sales_price", "sum")).reset_index())
+    g[avg_alias] = g.groupby(key_col)["sum_sales"].transform("mean")
+    g = g[(g[avg_alias] > 0)
+          & ((g.sum_sales - g[avg_alias]).abs() / g[avg_alias] > 0.1)]
+    return (g[[key_col, "sum_sales", avg_alias]]
+            .sort_values([avg_alias, "sum_sales", key_col])
+            .head(100).reset_index(drop=True))
+
+
+def q53(dfs: Dict[str, "object"]):
+    return _q53_shape(dfs, "i_manufact_id", "d_qoy", "avg_quarterly_sales")
+
+
+def q53_pandas(t: Dict[str, "object"]):
+    return _q53_shape_pandas(t, "i_manufact_id", "ss_item_sk", "d_qoy",
+                             "avg_quarterly_sales")
+
+
+def q63(dfs: Dict[str, "object"]):
+    return _q53_shape(dfs, "i_manager_id", "d_moy", "avg_monthly_sales")
+
+
+def q63_pandas(t: Dict[str, "object"]):
+    return _q53_shape_pandas(t, "i_manager_id", "ss_item_sk", "d_moy",
+                             "avg_monthly_sales")
+
+
+_Q89_KEYS = ["i_category", "i_class", "i_brand", "s_store_name",
+             "s_company_name"]
+
+
+def q89(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select("ss_item_sk", "ss_sold_date_sk",
+                                   "ss_store_sk", "ss_sales_price")
+    it = (dfs["item"]
+          .filter(_item_disjunct_expr())
+          .select("i_item_sk", "i_category", "i_class", "i_brand"))
+    dt = (dfs["date_dim"].filter(col("d_year") == lit(2000))
+          .select("d_date_sk", "d_moy"))
+    st = dfs["store"].select("s_store_sk", "s_store_name",
+                             "s_company_name")
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    g = (j.group_by(*(_Q89_KEYS + ["d_moy"]))
+         .agg(("sum", "ss_sales_price", "sum_sales")))
+    w = g.window(["i_category", "i_brand", "s_store_name",
+                  "s_company_name"],
+                 avg_monthly_sales=("avg", "sum_sales"))
+    dev = (_abs(col("sum_sales") - col("avg_monthly_sales"))
+           / col("avg_monthly_sales"))
+    return (w.filter((col("avg_monthly_sales") > lit(0.0))
+                     & (dev > lit(0.1)))
+            .select(*(_Q89_KEYS + ["d_moy", "sum_sales",
+                                   "avg_monthly_sales"]),
+                    (col("sum_sales")
+                     - col("avg_monthly_sales")).alias("delta"))
+            .sort("delta", "s_store_name", *_Q89_KEYS, "d_moy")
+            .limit(100).select(*(_Q89_KEYS + ["d_moy", "sum_sales",
+                                              "avg_monthly_sales"])))
+
+
+def q89_pandas(t: Dict[str, "object"]):
+    i = t["item"]
+    it = i[_item_disjunct_mask(i)][["i_item_sk", "i_category", "i_class",
+                                    "i_brand"]]
+    d = t["date_dim"]
+    dt = d[d.d_year == 2000][["d_date_sk", "d_moy"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_store_name",
+                            "s_company_name"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    g = (j.groupby(_Q89_KEYS + ["d_moy"])
+         .agg(sum_sales=("ss_sales_price", "sum")).reset_index())
+    g["avg_monthly_sales"] = g.groupby(
+        ["i_category", "i_brand", "s_store_name",
+         "s_company_name"])["sum_sales"].transform("mean")
+    g = g[(g.avg_monthly_sales > 0)
+          & ((g.sum_sales - g.avg_monthly_sales).abs()
+             / g.avg_monthly_sales > 0.1)]
+    g = g.assign(delta=g.sum_sales - g.avg_monthly_sales)
+    g = (g.sort_values(["delta", "s_store_name"] + _Q89_KEYS + ["d_moy"])
+         .head(100).reset_index(drop=True))
+    return g[_Q89_KEYS + ["d_moy", "sum_sales", "avg_monthly_sales"]]
+
+
+_Q98_KEYS = ["i_item_id", "i_item_desc", "i_category", "i_class",
+             "i_current_price"]
+
+
+def q98(dfs: Dict[str, "object"]):
+    """Item revenue share of its class. Probes d_year=2000, d_moy=5 (a
+    ~31-day window like the official 30-day d_date range, which the
+    generator's date_dim does not carry)."""
+    ss = dfs["store_sales"].select("ss_item_sk", "ss_sold_date_sk",
+                                   "ss_ext_sales_price")
+    it = (dfs["item"]
+          .filter(col("i_category").isin("Sports", "Books", "Home"))
+          .select("i_item_sk", *_Q98_KEYS))
+    dt = (dfs["date_dim"]
+          .filter((col("d_year") == lit(2000)) & (col("d_moy") == lit(5)))
+          .select("d_date_sk"))
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    g = (j.group_by(*_Q98_KEYS)
+         .agg(("sum", "ss_ext_sales_price", "itemrevenue")))
+    w = g.window(["i_class"], class_revenue=("sum", "itemrevenue"))
+    return (w.select(*_Q98_KEYS, "itemrevenue",
+                     ((col("itemrevenue") * lit(100.0))
+                      / col("class_revenue")).alias("revenueratio"))
+            .sort("i_category", "i_class", "i_item_id", "i_item_desc",
+                  "revenueratio", "itemrevenue"))
+
+
+def q98_pandas(t: Dict[str, "object"]):
+    i = t["item"]
+    it = i[i.i_category.isin(["Sports", "Books", "Home"])][
+        ["i_item_sk"] + _Q98_KEYS]
+    d = t["date_dim"]
+    dt = d[(d.d_year == 2000) & (d.d_moy == 5)][["d_date_sk"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+    g = (j.groupby(_Q98_KEYS)
+         .agg(itemrevenue=("ss_ext_sales_price", "sum")).reset_index())
+    g["revenueratio"] = (g.itemrevenue * 100.0
+                         / g.groupby("i_class")["itemrevenue"]
+                         .transform("sum"))
+    return (g[_Q98_KEYS + ["itemrevenue", "revenueratio"]]
+            .sort_values(["i_category", "i_class", "i_item_id",
+                          "i_item_desc", "revenueratio", "itemrevenue"])
+            .reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# q65 — stores' under-performing items: per-(store, item) revenue joined
+# against the store's average item revenue (aggregated-subquery join; the
+# shared inner aggregate executes ONCE via the engine's subtree reuse).
+# Probes d_year=2000 for the official d_month_seq window (not generated).
+# ---------------------------------------------------------------------------
+
+
+def q65(dfs: Dict[str, "object"]):
+    ss = dfs["store_sales"].select("ss_sold_date_sk", "ss_store_sk",
+                                   "ss_item_sk", "ss_sales_price")
+    dt = (dfs["date_dim"].filter(col("d_year") == lit(2000))
+          .select("d_date_sk"))
+    inner = (ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+             .group_by("ss_store_sk", "ss_item_sk")
+             .agg(("sum", "ss_sales_price", "revenue")))
+    sb = (inner.group_by("ss_store_sk")
+          .agg(("avg", "revenue", "ave")))
+    j = inner.join(sb, on=col("ss_store_sk") == col("ss_store_sk"))
+    j = j.filter(col("revenue") <= col("ave") * lit(0.1))
+    st = dfs["store"].select("s_store_sk", "s_store_name")
+    it = dfs["item"].select("i_item_sk", "i_item_desc", "i_current_price",
+                            "i_wholesale_cost", "i_brand")
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    return (j.select("s_store_name", "i_item_desc", "revenue",
+                     "i_current_price", "i_wholesale_cost", "i_brand")
+            .sort("s_store_name", "i_item_desc", "revenue").limit(100))
+
+
+def q65_pandas(t: Dict[str, "object"]):
+    d = t["date_dim"]
+    dt = d[d.d_year == 2000][["d_date_sk"]]
+    inner = (t["store_sales"]
+             .merge(dt, left_on="ss_sold_date_sk", right_on="d_date_sk")
+             .groupby(["ss_store_sk", "ss_item_sk"])
+             .agg(revenue=("ss_sales_price", "sum")).reset_index())
+    sb = (inner.groupby("ss_store_sk")
+          .agg(ave=("revenue", "mean")).reset_index())
+    j = inner.merge(sb, on="ss_store_sk")
+    j = j[j.revenue <= 0.1 * j.ave]
+    j = j.merge(t["store"][["s_store_sk", "s_store_name"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_item_desc", "i_current_price",
+                           "i_wholesale_cost", "i_brand"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    return (j[["s_store_name", "i_item_desc", "revenue",
+               "i_current_price", "i_wholesale_cost", "i_brand"]]
+            .sort_values(["s_store_name", "i_item_desc", "revenue"])
+            .head(100).reset_index(drop=True))
+
+
 QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q3": (q3, q3_pandas),
     "q7": (q7, q7_pandas),
@@ -1431,11 +1694,16 @@ QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q48": (q48, q48_pandas),
     "q50": (q50, q50_pandas),
     "q52": (q52, q52_pandas),
+    "q53": (q53, q53_pandas),
     "q55": (q55, q55_pandas),
     "q61": (q61, q61_pandas),
+    "q63": (q63, q63_pandas),
     "q64": (q64, q64_pandas),
+    "q65": (q65, q65_pandas),
     "q68": (q68, q68_pandas),
     "q79": (q79, q79_pandas),
     "q88": (q88, q88_pandas),
+    "q89": (q89, q89_pandas),
     "q96": (q96, q96_pandas),
+    "q98": (q98, q98_pandas),
 }

@@ -1,0 +1,96 @@
+"""Operator-level wall-clock profile of a TPC-DS query at scale.
+
+Wraps every PhysicalNode.execute/execute_bucketed with timers (inclusive
+time per operator instance) and prints the per-node breakdown of ONE
+warm run against a persistent generated dataset + warehouse, so engine
+hot spots at scale are measured instead of guessed.
+
+    python scripts/profile_tpcds.py --query q25 --data /root/tpcds100 \
+        --scale 100 [--rules-off]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--query", default="q25")
+    ap.add_argument("--data", default="/root/tpcds100")
+    ap.add_argument("--scale", type=float, default=100.0)
+    ap.add_argument("--rules-off", action="store_true")
+    ap.add_argument("--runs", type=int, default=2)
+    args = ap.parse_args()
+
+    from hyperspace_tpu import Hyperspace, HyperspaceConf, HyperspaceSession
+    from hyperspace_tpu.engine import physical
+    from hyperspace_tpu.tpcds import QUERIES, generate
+    from hyperspace_tpu.tpcds.queries import create_indexes
+
+    paths = generate(os.path.join(args.data, "data"), scale=args.scale)
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": os.path.join(args.data, "wh"),
+        "spark.hyperspace.index.num.buckets": "32"}))
+    hs = Hyperspace(sess)
+    dfs = {n: sess.read_parquet(p) for n, p in paths.items()}
+    existing = set()
+    try:
+        cat = hs.indexes()
+        if len(cat):
+            existing = set(cat["name"])
+    except Exception:
+        pass
+    if not existing:
+        t0 = time.perf_counter()
+        create_indexes(hs, dfs, queries=[args.query])
+        print(f"index build: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+
+    build, _oracle = QUERIES[args.query]
+    if args.rules_off:
+        sess.disable_hyperspace()
+    else:
+        sess.enable_hyperspace()
+
+    # -- instrument ------------------------------------------------------
+    records = []
+
+    def wrap(cls, method):
+        orig = getattr(cls, method)
+
+        def timed(self, *a, **kw):
+            t0 = time.perf_counter()
+            out = orig(self, *a, **kw)
+            records.append((time.perf_counter() - t0,
+                            self.simple_string()[:110]))
+            return out
+
+        setattr(cls, method, timed)
+
+    for name in dir(physical):
+        cls = getattr(physical, name)
+        if (isinstance(cls, type) and name.endswith("Exec")
+                and hasattr(cls, "execute")):
+            wrap(cls, "execute")
+            if "execute_bucketed" in cls.__dict__:
+                wrap(cls, "execute_bucketed")
+
+    for i in range(args.runs):
+        records.clear()
+        t0 = time.perf_counter()
+        out = build(dfs).collect()
+        total = time.perf_counter() - t0
+        print(f"run {i}: {total:.2f}s total, {out.num_rows} rows",
+              file=sys.stderr)
+    # Last run's breakdown, slowest first (times are INCLUSIVE of
+    # children — read top-down).
+    for dt, label in sorted(records, reverse=True)[:25]:
+        print(f"{dt:9.3f}s  {label}")
+
+
+if __name__ == "__main__":
+    main()

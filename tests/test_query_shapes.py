@@ -13,6 +13,7 @@ import pyarrow.parquet as pq
 import pytest
 
 from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.engine.session import HyperspaceSession
 from hyperspace_tpu.facade import Hyperspace
 from hyperspace_tpu.index.index_config import IndexConfig
@@ -301,3 +302,65 @@ def test_global_aggregate_over_zero_rows_is_one_row(sess, tables):
     crossed = empty.agg(("sum", "q", "s")).join(total, how="cross") \
         .to_pandas()
     assert len(crossed) == 1 and crossed["n"][0] == 300
+
+
+def test_window_rank_and_partition_aggregates(sess, tables):
+    lpdf, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    got = df.window(["k"], order_by=["-q"],
+                    rk=("rank", "*"), drk=("dense_rank", "*"),
+                    rn=("row_number", "*"), pavg=("avg", "x"),
+                    pcnt=("count", "*")).to_pandas()
+    gb = lpdf.groupby("k")
+    exp = lpdf.assign(
+        rk=gb["q"].rank(method="min", ascending=False).astype("int64"),
+        drk=gb["q"].rank(method="dense", ascending=False).astype("int64"),
+        pavg=gb["x"].transform("mean"),
+        pcnt=gb["x"].transform("size").astype("int64"))
+    key = ["k", "q", "x", "s"]
+    g = got.sort_values(key + ["rn"]).reset_index(drop=True)
+    e = exp.sort_values(key).reset_index(drop=True)
+    for c in ("rk", "drk", "pavg", "pcnt"):
+        assert np.allclose(g[c], e[c]), c
+    for _, grp in got.groupby("k"):
+        assert sorted(grp.rn) == list(range(1, len(grp) + 1))
+
+
+def test_window_serde_roundtrip(sess, tables):
+    from hyperspace_tpu.plan.serde import plan_from_json, plan_to_json
+
+    _, _, lp, _ = tables
+    df = sess.read_parquet(lp).window(["k"], order_by=["q"],
+                                      rk=("rank", "*"),
+                                      tot=("sum", "q"))
+    back = plan_from_json(plan_to_json(df.plan))
+    assert back.to_dict() == df.plan.to_dict()
+
+
+def test_window_validation(sess, tables):
+    _, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    with pytest.raises(HyperspaceException, match="ORDER BY"):
+        df.window(["k"], rk=("rank", "*"))
+    with pytest.raises(HyperspaceException, match="collides"):
+        df.window(["k"], order_by=["q"], x=("rank", "*"))
+
+
+def test_window_min_max_keep_float_dtype(sess, tables):
+    """min/max window results keep the input dtype — float values must
+    not truncate through the int64 default (review regression)."""
+    _, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    got = df.window(["k"], pmin=("min", "x"),
+                    pmax=("max", "x")).to_pandas()
+    lpdf = pd.read_parquet(lp)
+    gb = lpdf.groupby("k")
+    exp = lpdf.assign(pmin=gb["x"].transform("min"),
+                      pmax=gb["x"].transform("max"))
+    key = ["k", "q", "x", "s"]
+    g = got.sort_values(key).reset_index(drop=True)
+    e = exp.sort_values(key).reset_index(drop=True)
+    assert np.allclose(g["pmin"], e["pmin"]) and np.allclose(
+        g["pmax"], e["pmax"])
+    with pytest.raises(HyperspaceException, match="requires a column"):
+        df.window(["k"], a=("avg", "*"))

@@ -1291,10 +1291,27 @@ def _plan_physical_node(plan: LogicalPlan,
         lspec = _underlying_bucket_spec(plan.left)
         rspec = _underlying_bucket_spec(plan.right)
 
-        def _covers(spec: Optional[BucketSpec], keys: List[str]) -> bool:
-            return (spec is not None
-                    and [c.lower() for c in spec.bucket_columns]
-                    == [k.lower() for k in keys])
+        def _align_to_spec(spec: Optional[BucketSpec]):
+            """Reorder the (left, right) key PAIRS so the left list matches
+            `spec.bucket_columns`. The CONDITION's conjunct order is
+            irrelevant to bucketing — each side hashes in its own
+            indexed-column order — so a join written `b = b AND a = a`
+            over an (a, b) layout must still take the bucketed path
+            (q50's ticket-identity join was silently demoted to
+            Exchange+Sort by the old exact-order check). None when the
+            key set is not exactly the bucket column set."""
+            if spec is None or len(spec.bucket_columns) != len(left_keys):
+                return None
+            lk_lower = [k.lower() for k in left_keys]
+            order = []
+            for bc in spec.bucket_columns:
+                if bc.lower() not in lk_lower:
+                    return None
+                order.append(lk_lower.index(bc.lower()))
+            if len(set(order)) != len(order):
+                return None
+            return ([left_keys[i] for i in order],
+                    [right_keys[i] for i in order])
 
         def _key_dtypes_match() -> bool:
             # Co-partitioning assumes both layouts hashed with the SAME
@@ -1306,8 +1323,17 @@ def _plan_physical_node(plan: LogicalPlan,
                        == plan.right.schema.field(rk).dtype
                        for lk, rk in zip(left_keys, right_keys))
 
-        if (_covers(lspec, left_keys) and _covers(rspec, right_keys)
-                and _key_dtypes_match()):
+        aligned = _align_to_spec(lspec)
+        # The right layout must hash the MAPPED columns in the same
+        # positions (the rule's order-compat requirement; checked here
+        # too for hand-built bucketed joins).
+        if (aligned is None or rspec is None
+                or [c.lower() for c in rspec.bucket_columns]
+                != [k.lower() for k in aligned[1]]):
+            aligned = None
+
+        if aligned is not None and _key_dtypes_match():
+            left_keys, right_keys = aligned
             # Bucketed SMJ — the indexed fast path. With mismatched bucket
             # counts (the ranker's fallback, reference
             # `JoinIndexRanker.scala:40-55`) ONLY the coarser side is

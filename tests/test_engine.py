@@ -542,3 +542,48 @@ def test_descending_sort_both_lanes(session, tmp_path):
             assert got3.a.tolist()[0] is None or np.isnan(got3.a[0])  # nulls first on asc
         finally:
             session.conf.unset("spark.hyperspace.execution.min.device.rows")
+
+
+def test_bucketed_join_key_order_insensitive(tmp_path):
+    """A join condition written in a different conjunct order than the
+    index's bucket columns must still take the shuffle-free bucketed
+    path (no Exchange in the physical plan)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import (Hyperspace, HyperspaceConf,
+                                HyperspaceSession, IndexConfig)
+    from hyperspace_tpu.plan.expr import col
+
+    rng = np.random.default_rng(9)
+    n = 2000
+    lt = pa.table({"a": rng.integers(0, 50, n), "b": rng.integers(0, 7, n),
+                   "x": rng.random(n)})
+    rt = pa.table({"a": rng.integers(0, 50, 400),
+                   "b": rng.integers(0, 7, 400),
+                   "y": rng.random(400)})
+    lp, rp = str(tmp_path / "lt"), str(tmp_path / "rt")
+    for p, t in ((lp, lt), (rp, rt)):
+        import os
+        os.makedirs(p)
+        pq.write_table(t, p + "/p.parquet")
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": 4}))
+    hs = Hyperspace(sess)
+    hs.create_index(sess.read_parquet(lp), IndexConfig("l", ["a", "b"], ["x"]))
+    hs.create_index(sess.read_parquet(rp), IndexConfig("r", ["a", "b"], ["y"]))
+    sess.enable_hyperspace()
+    l, r = sess.read_parquet(lp), sess.read_parquet(rp)
+    # condition deliberately ordered (b, a) against the (a, b) layout
+    q = l.join(r, on=(col("b") == col("b")) & (col("a") == col("a")))
+    import pandas as pd
+    _, _, physical = q.explain_plans()
+    ops = [n.name for n in physical.collect()]
+    assert "Exchange" not in ops, ops
+    got = q.to_pandas().sort_values(["a", "b", "x", "y"]).reset_index(drop=True)
+    lpd, rpd = lt.to_pandas(), rt.to_pandas()
+    exp = (lpd.merge(rpd, on=["a", "b"])
+           .sort_values(["a", "b", "x", "y"]).reset_index(drop=True))
+    pd.testing.assert_frame_equal(got[exp.columns], exp, check_dtype=False)

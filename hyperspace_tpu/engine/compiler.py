@@ -83,6 +83,12 @@ class ExpressionCompiler:
             return col.data, validity
         if isinstance(e, E.Literal):
             return e.value, None
+        if isinstance(e, E.NullLiteral):
+            n = self.batch.num_rows
+            from hyperspace_tpu.io.columnar import HOST_NP_DTYPES
+            zeros = self.xp.zeros(n, dtype=HOST_NP_DTYPES.get(e.dtype,
+                                                              np.int64))
+            return zeros, self.xp.zeros(n, dtype=bool)
         if isinstance(e, (E.Add, E.Sub, E.Mul, E.Div)):
             lv, lval = self.value(e.left)
             rv, rval = self.value(e.right)
@@ -161,18 +167,13 @@ class ExpressionCompiler:
         if isinstance(e, E.Column):
             col = self.batch.column(e.name)
             return col if col.is_string else None
+        if isinstance(e, E.NullLiteral) and e.dtype == "string":
+            # All-NULL string column (ROLLUP's coarser granularities).
+            return self._const_string_column("", valid=False)
         if isinstance(e, E.Literal) and isinstance(e.value, str):
             # Constant string column (q5/q33/q56-style channel tags): a
             # one-entry dictionary with all codes 0.
-            from hyperspace_tpu.io.columnar import (_split_hashes,
-                                                    _string_hash64)
-            d = np.array([e.value])
-            n = self.batch.num_rows
-            host = self.xp is np
-            codes = self.xp.zeros(n, dtype=np.int32)
-            return DeviceColumn(codes, "string", None, d,
-                                _split_hashes(_string_hash64(d),
-                                              device=not host))
+            return self._const_string_column(e.value, valid=True)
         if isinstance(e, E.Substr):
             child = self.string_column(e.child)
             if child is None:
@@ -180,6 +181,19 @@ class ExpressionCompiler:
                     f"SUBSTR over non-string expression: {e.child!r}")
             return self._substr(child, e.start, e.length)
         return None
+
+    def _const_string_column(self, value: str, valid: bool) -> DeviceColumn:
+        """One-entry-dictionary string column: every row carries `value`
+        (valid=True) or NULL (valid=False)."""
+        from hyperspace_tpu.io.columnar import _split_hashes, _string_hash64
+
+        d = np.array([value])
+        n = self.batch.num_rows
+        host = self.xp is np
+        return DeviceColumn(
+            self.xp.zeros(n, dtype=np.int32), "string",
+            None if valid else self.xp.zeros(n, dtype=bool), d,
+            _split_hashes(_string_hash64(d), device=not host))
 
     def _substr(self, col: DeviceColumn, start: int,
                 length: int) -> DeviceColumn:

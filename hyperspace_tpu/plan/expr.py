@@ -149,6 +149,34 @@ class Literal(Expression):
         return f"lit({self.value!r})"
 
 
+class NullLiteral(Expression):
+    """A typed SQL NULL (`lit(None)` needs a dtype to carry through the
+    engine's static schemas). Exists for the grouping-set/ROLLUP idiom —
+    coarser granularities union in with NULL-filled grouping columns —
+    and anywhere else a query projects an explicit NULL."""
+
+    op = "null"
+
+    def __init__(self, dtype: str):
+        from hyperspace_tpu.plan.schema import Field
+        Field("_", dtype)  # validates the dtype name
+        self.dtype = dtype
+
+    def to_dict(self) -> dict:
+        return {"op": "null", "dtype": self.dtype}
+
+    @staticmethod
+    def _from_dict(d: dict) -> "NullLiteral":
+        return NullLiteral(d["dtype"])
+
+    def __repr__(self):
+        return f"NULL::{self.dtype}"
+
+
+def null(dtype: str) -> NullLiteral:
+    return NullLiteral(dtype)
+
+
 class _Binary(Expression):
     op: str = ""
     symbol: str = ""
@@ -409,6 +437,7 @@ _REGISTRY: Dict[str, Any] = {
     "add": Add, "sub": Sub, "mul": Mul, "div": Div,
     "is_null": IsNull, "is_not_null": IsNotNull, "in": In,
     "alias": Alias, "substr": Substr, "case": CaseWhen,
+    "null": NullLiteral,
 }
 
 
@@ -425,6 +454,8 @@ def infer_dtype(expr: Expression, schema) -> str:
         return infer_dtype(expr.child, schema)
     if isinstance(expr, Column):
         return schema.field(expr.name).dtype
+    if isinstance(expr, NullLiteral):
+        return expr.dtype
     if isinstance(expr, Literal):
         v = expr.value
         if isinstance(v, bool):

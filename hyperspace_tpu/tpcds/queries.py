@@ -1,6 +1,7 @@
-"""Twenty-six TPC-DS queries on the framework DataFrame API, with pandas
+"""Twenty-seven TPC-DS queries on the framework DataFrame API, with pandas
 oracles: q3, q7, q13, q15, q17, q19, q25, q26, q28, q42, q43, q48, q50,
-q52, q53, q55, q61, q63, q64, q65, q68, q79, q88, q89, q96, q98.
+q52, q53, q55, q61, q63, q64, q65, q67, q68, q79, q88, q89, q96,
+q98.
 
 Each query is expressed as a join tree the rewrite rules can accelerate:
 the innermost join is a linear scan pair (JoinIndexRule's applicability,
@@ -333,7 +334,8 @@ def q64_pandas(t: Dict[str, "object"]):
 
 
 _STAR_FAMILY = ("q3", "q7", "q13", "q19", "q42", "q43", "q48", "q52",
-                "q53", "q55", "q63", "q65", "q68", "q79", "q89", "q98")
+                "q53", "q55", "q63", "q65", "q67", "q68", "q79", "q89",
+                "q98")
 
 # index name -> (table, IndexConfig args, queries that can use it)
 _INDEX_DEFS = (
@@ -393,15 +395,18 @@ _INDEX_DEFS = (
 )
 
 
-def create_indexes(hs, dfs, queries=None) -> None:
+def create_indexes(hs, dfs, queries=None, skip=()) -> None:
     """Build the covering indexes the given queries (default: all) can
     use — each query family's innermost-join pair plus the dimension
-    filter indexes for FilterIndexRule + bucket pruning."""
+    filter indexes for FilterIndexRule + bucket pruning. `skip` names
+    indexes that already exist (persistent-warehouse callers)."""
     from hyperspace_tpu import IndexConfig
 
     wanted = None if queries is None else set(queries)
     for name, table, (indexed, included), used_by in _INDEX_DEFS:
         if wanted is not None and not (wanted & set(used_by)):
+            continue
+        if name in skip:
             continue
         hs.create_index(dfs[table], IndexConfig(name, indexed, included))
 
@@ -1679,6 +1684,92 @@ def q65_pandas(t: Dict[str, "object"]):
             .head(100).reset_index(drop=True))
 
 
+# ---------------------------------------------------------------------------
+# q67 — ROLLUP over 8 item/date/store columns + rank per category.
+# ROLLUP(c1..c8) is expressed as its definition: the UNION of 9 grouping
+# granularities, coarser branches projecting typed NULLs for the dropped
+# columns; the 9 branches share ONE joined subtree (engine subtree reuse).
+# Probes d_year=2000 for the official d_month_seq window (not generated).
+# ---------------------------------------------------------------------------
+
+_Q67_ROLLUP = (("i_category", "string"), ("i_class", "string"),
+               ("i_brand", "string"), ("i_product_name", "string"),
+               ("d_year", "int64"), ("d_qoy", "int64"), ("d_moy", "int64"),
+               ("s_store_id", "string"))
+
+
+def q67(dfs: Dict[str, "object"]):
+    from hyperspace_tpu.engine.dataframe import DataFrame
+    from hyperspace_tpu.plan.expr import null
+    from hyperspace_tpu.plan.nodes import Union
+
+    ss = dfs["store_sales"].select("ss_sold_date_sk", "ss_item_sk",
+                                   "ss_store_sk", "ss_quantity",
+                                   "ss_sales_price")
+    dt = (dfs["date_dim"].filter(col("d_year") == lit(2000))
+          .select("d_date_sk", "d_year", "d_qoy", "d_moy"))
+    st = dfs["store"].select("s_store_sk", "s_store_id")
+    it = dfs["item"].select("i_item_sk", "i_category", "i_class",
+                            "i_brand", "i_product_name")
+    j = ss.join(dt, on=col("ss_sold_date_sk") == col("d_date_sk"))
+    j = j.join(st, on=col("ss_store_sk") == col("s_store_sk"))
+    j = j.join(it, on=col("ss_item_sk") == col("i_item_sk"))
+    sales = (col("ss_sales_price") * col("ss_quantity")).alias("_sales")
+    j = j.select(*[name for name, _ in _Q67_ROLLUP], sales)
+
+    names = [name for name, _ in _Q67_ROLLUP]
+    branches = []
+    for depth in range(len(_Q67_ROLLUP), -1, -1):
+        keep = names[:depth]
+        if keep:
+            g = j.group_by(*keep).agg(("sum", "_sales", "sumsales"))
+        else:
+            g = j.agg(("sum", "_sales", "sumsales"))
+        entries = list(keep) + [null(dtype).alias(name)
+                                for name, dtype in _Q67_ROLLUP[depth:]]
+        branches.append(g.select(*entries, "sumsales").plan)
+    u = DataFrame(Union(branches), j.session)
+    w = u.window(["i_category"], order_by=["-sumsales"],
+                 rk=("rank", "*"))
+    return (w.filter(col("rk") <= lit(100))
+            .sort(*names, "sumsales", "rk").limit(100))
+
+
+def q67_pandas(t: Dict[str, "object"]):
+    import numpy as np
+    import pandas as pd
+
+    d = t["date_dim"]
+    dt = d[d.d_year == 2000][["d_date_sk", "d_year", "d_qoy", "d_moy"]]
+    j = t["store_sales"].merge(dt, left_on="ss_sold_date_sk",
+                               right_on="d_date_sk")
+    j = j.merge(t["store"][["s_store_sk", "s_store_id"]],
+                left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["item"][["i_item_sk", "i_category", "i_class", "i_brand",
+                           "i_product_name"]],
+                left_on="ss_item_sk", right_on="i_item_sk")
+    j = j.assign(_sales=j.ss_sales_price * j.ss_quantity)
+    names = [name for name, _ in _Q67_ROLLUP]
+    parts = []
+    for depth in range(len(names), -1, -1):
+        keep = names[:depth]
+        if keep:
+            g = (j.groupby(keep).agg(sumsales=("_sales", "sum"))
+                 .reset_index())
+        else:
+            g = pd.DataFrame({"sumsales": [j._sales.sum()]})
+        for name in names[depth:]:
+            g[name] = np.nan
+        parts.append(g[names + ["sumsales"]])
+    u = pd.concat(parts, ignore_index=True)
+    u["rk"] = (u.groupby("i_category", dropna=False)["sumsales"]
+               .rank(method="min", ascending=False).astype("int64"))
+    u = u[u.rk <= 100]
+    # Engine Sort is ascending nulls-FIRST; mirror it for the limit.
+    u = u.sort_values(names + ["sumsales", "rk"], na_position="first")
+    return u.head(100).reset_index(drop=True)
+
+
 QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q3": (q3, q3_pandas),
     "q7": (q7, q7_pandas),
@@ -1700,6 +1791,7 @@ QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q63": (q63, q63_pandas),
     "q64": (q64, q64_pandas),
     "q65": (q65, q65_pandas),
+    "q67": (q67, q67_pandas),
     "q68": (q68, q68_pandas),
     "q79": (q79, q79_pandas),
     "q88": (q88, q88_pandas),

@@ -44,9 +44,9 @@ def main():
             existing = set(cat["name"])
     except Exception:
         pass
-    if not existing:
-        t0 = time.perf_counter()
-        create_indexes(hs, dfs, queries=[args.query])
+    t0 = time.perf_counter()
+    create_indexes(hs, dfs, queries=[args.query], skip=existing)
+    if time.perf_counter() - t0 > 1:
         print(f"index build: {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
 

@@ -364,3 +364,37 @@ def test_window_min_max_keep_float_dtype(sess, tables):
         g["pmax"], e["pmax"])
     with pytest.raises(HyperspaceException, match="requires a column"):
         df.window(["k"], a=("avg", "*"))
+
+
+def test_null_literal_projection_and_union(sess, tables):
+    """Typed NULL projections (the ROLLUP idiom: coarser granularities
+    union in with NULL-filled grouping columns)."""
+    from hyperspace_tpu.engine.dataframe import DataFrame
+    from hyperspace_tpu.plan.expr import null
+    from hyperspace_tpu.plan.nodes import Union
+
+    lpdf, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    out = df.select("k", null("string").alias("ns"),
+                    null("int64").alias("ni"),
+                    null("float64").alias("nf")).to_pandas()
+    assert out["ns"].isna().all() and out["ni"].isna().all() \
+        and out["nf"].isna().all()
+
+    fine = df.group_by("k", "s").agg(("sum", "q", "sq")).select(
+        "k", "s", "sq")
+    coarse = df.group_by("k").agg(("sum", "q", "sq")).select(
+        "k", null("string").alias("s"), "sq")
+    u = DataFrame(Union([fine.plan, coarse.plan]), sess).to_pandas()
+    exp_f = lpdf.groupby(["k", "s"]).q.sum().reset_index(name="sq")
+    exp_c = lpdf.groupby("k").q.sum().reset_index(name="sq")
+    exp_c["s"] = np.nan
+    exp = pd.concat([exp_f, exp_c[["k", "s", "sq"]]], ignore_index=True)
+
+    def nrm(d):
+        d = d.copy()
+        d["s"] = d["s"].astype(object).where(d["s"].notna(), np.nan)
+        return d.sort_values(["k", "s", "sq"],
+                             na_position="last").reset_index(drop=True)
+
+    pd.testing.assert_frame_equal(nrm(u), nrm(exp), check_dtype=False)

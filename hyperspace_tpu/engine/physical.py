@@ -715,7 +715,7 @@ class SortMergeJoinExec(PhysicalNode):
         from hyperspace_tpu.ops.join import sort_merge_join
         if self.how in ("left_semi", "left_anti"):
             # Membership joins: no expansion, no output from the right —
-            # one encode + searchsorted bracket per left row, then a
+            # one encode + counting-match membership flags, then a
             # single left-side gather. (No Exchange/Sort wrappers: the
             # planner builds semi/anti sides bare.)
             from hyperspace_tpu.ops.join import semi_anti_indices

@@ -226,10 +226,17 @@ def semi_anti_indices(left: ColumnBatch, right: ColumnBatch,
             return jnp.arange(left.num_rows, dtype=jnp.int32)
         return jnp.zeros(0, dtype=jnp.int32)
     l_ids, r_ids = encode_join_keys(left, right, left_keys, right_keys)
-    rs = jnp.sort(r_ids)
-    matched = (jnp.searchsorted(rs, l_ids, side="left")
-               < jnp.searchsorted(rs, l_ids, side="right"))
-    mask = ~matched if anti else matched
+    # Membership via the counting match (same joint-sort core as the
+    # join; `searchsorted` is the slow primitive on TPU): with
+    # left_outer counting, counts > 0 marks exactly the LEFT elements in
+    # sorted space, and `rights` holds each element's run match count.
+    # Scatter-max back to original row order (right elements carry False
+    # so they never touch a left slot).
+    counts, _starts, rights, _rstart, orig_s = _counting_match(
+        l_ids, r_ids, True)
+    is_left = counts > 0
+    hit = is_left & ((rights == 0) if anti else (rights > 0))
+    mask = jnp.zeros(left.num_rows, dtype=bool).at[orig_s].max(hit)
     count = int(jnp.sum(mask))  # host sync
     if count == 0:
         return jnp.zeros(0, dtype=jnp.int32)

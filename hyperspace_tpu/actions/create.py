@@ -75,6 +75,20 @@ class CreateActionBase(Action):
             return None
         return {f: i for i, f in enumerate(files)}
 
+    _LINEAGE_UNSET = object()
+
+    def lineage_id_map(self, df) -> Optional[dict]:
+        """THE build's {source file: lineage id} assignment, computed once
+        per action over the full current source file list. The data write
+        and the log entry's FileInfos must agree row-for-row, so both read
+        this one memoized map — two independent `_lineage_ids` calls would
+        only agree while every source is a single sorted Scan."""
+        cached = getattr(self, "_lineage_map", self._LINEAGE_UNSET)
+        if cached is not self._LINEAGE_UNSET:
+            return cached
+        self._lineage_map = self._lineage_ids(self.source_files(df))
+        return self._lineage_map
+
     def get_index_log_entry(self, df, index_config: IndexConfig,
                             path: str) -> IndexLogEntry:
         """Build the full metadata record (reference `CreateActionBase.scala:38-87`):
@@ -90,7 +104,7 @@ class CreateActionBase(Action):
         columns = index_config.indexed_columns + index_config.included_columns
         schema = df.schema.select(columns)
         source_file_list = self.source_files(df)
-        lineage_ids = self._lineage_ids(source_file_list)
+        lineage_ids = self.lineage_id_map(df)
         file_infos = None
         if lineage_ids is not None:
             from hyperspace_tpu.index.log_entry import FileInfo
@@ -136,7 +150,7 @@ class CreateActionBase(Action):
         write_index(df, list(index_config.indexed_columns),
                     list(index_config.included_columns),
                     self.num_buckets(), path, conf=self.conf,
-                    lineage_ids=self._lineage_ids(self.source_files(df)))
+                    lineage_ids=self.lineage_id_map(df))
 
 
 class CreateAction(CreateActionBase):

@@ -200,7 +200,11 @@ class RefreshIncrementalAction(RefreshAction):
         columns = cfg.indexed_columns + cfg.included_columns
         names = [source_scan.schema.field(c).name for c in columns]
         table = parquet.read_table(appended, columns=names)
-        lineage_ids = self._lineage_ids(appended)
+        # One shared {file: id} map per action (memoized over the FULL
+        # current listing) — the same map the log entry's FileInfos are
+        # built from, so appended rows can never be written under an id
+        # that disagrees with the logged metadata.
+        lineage_ids = self.lineage_id_map(self.df)
         if lineage_ids is not None:
             from hyperspace_tpu.io.builder import append_lineage_column
             table = append_lineage_column(table, appended, lineage_ids)

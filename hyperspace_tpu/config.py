@@ -96,6 +96,20 @@ class HyperspaceConf:
                             constants.DISTRIBUTION_MIN_ROWS_DEFAULT)
 
     @property
+    def read_cache_bytes(self):
+        """Host decoded-batch cache budget; None = env/process default."""
+        value = self.get(constants.READ_CACHE_BYTES_KEY)
+        return int(value) if value is not None else None
+
+    @property
+    def device_cache_bytes(self):
+        """HBM-resident batch cache budget; None = env/process default.
+        Competes with join/sort working sets for device memory — lower it
+        (or 0) when large queries OOM."""
+        value = self.get(constants.DEVICE_CACHE_BYTES_KEY)
+        return int(value) if value is not None else None
+
+    @property
     def cache_expiry_seconds(self) -> int:
         return self.get_int(
             constants.INDEX_CACHE_EXPIRY_DURATION_SECONDS,

@@ -22,6 +22,15 @@ INDEX_CACHE_EXPIRY_DURATION_SECONDS = (
     "spark.hyperspace.index.cache.expiryDurationInSeconds")
 INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
 
+# Decoded-batch cache budgets (no reference analog — Spark's block manager
+# owns executor memory there). Session-conf keys; when unset, the
+# HYPERSPACE_READ_CACHE_BYTES / HYPERSPACE_DEVICE_CACHE_BYTES env vars
+# (read at `io/parquet.py` import) provide the process-wide defaults.
+# The device budget shares HBM with join/sort working sets — size it
+# against the largest query, not the chip.
+READ_CACHE_BYTES_KEY = "spark.hyperspace.cache.read.bytes"
+DEVICE_CACHE_BYTES_KEY = "spark.hyperspace.cache.device.bytes"
+
 HYBRID_SCAN_ENABLED = "spark.hyperspace.index.hybridscan.enabled"
 
 # Per-row lineage (extension; the reference's v0.2 direction): when enabled

@@ -82,6 +82,14 @@ class ScanExec(PhysicalNode):
         # `index/rules/FilterIndexRule.scala:112-120`).
         self.allowed_buckets = allowed_buckets
 
+    def _budget(self, device: bool):
+        """Session-conf cache budget for this scan's lane (None = the
+        process-wide env default)."""
+        if self.conf is None:
+            return None
+        return (self.conf.device_cache_bytes if device
+                else self.conf.read_cache_bytes)
+
     def simple_string(self) -> str:
         bucket = (f", buckets={self.scan.bucket_spec.num_buckets}"
                   if self.scan.bucket_spec else "")
@@ -123,10 +131,12 @@ class ScanExec(PhysicalNode):
                 and sum(parquet.file_row_counts(files)) < min_dev)
         if host:
             batch = parquet.read_host_batch(files, self.columns,
-                                            self.out_schema)
+                                            self.out_schema,
+                                            budget=self._budget(device=False))
         else:
             batch = parquet.read_device_batch(files, self.columns,
-                                              self.out_schema)
+                                              self.out_schema,
+                                              budget=self._budget(device=True))
         if bucket is not None and len(files) > 1:
             # Multiple sorted runs in one bucket (incremental deltas): the
             # concat is not globally sorted — restore order on device.
@@ -169,10 +179,12 @@ class ScanExec(PhysicalNode):
         min_dev = (self.conf.min_device_rows if self.conf is not None
                    else MIN_DEVICE_ROWS_DEFAULT)
         if int(lengths.sum()) < min_dev:
-            return parquet.read_host_batch(files, self.columns,
-                                           self.out_schema), lengths
-        return parquet.read_device_batch(files, self.columns,
-                                         self.out_schema), lengths
+            return parquet.read_host_batch(
+                files, self.columns, self.out_schema,
+                budget=self._budget(device=False)), lengths
+        return parquet.read_device_batch(
+            files, self.columns, self.out_schema,
+            budget=self._budget(device=True)), lengths
 
 
 class FilterExec(PhysicalNode):

@@ -217,6 +217,19 @@ def _stamped_batch_read(paths: Sequence[str],
 
     key = (tuple(paths), tuple(columns) if columns is not None else None,
            schema.to_json() if schema is not None else None)
+    # Enforce the effective budget on ENTRY, not only on insert: a budget
+    # lowered mid-session (the documented OOM remedy — conf
+    # `cache.device.bytes`) must actually release already-resident
+    # batches, and budget 0 must empty the cache, or the memory being
+    # tuned away stays pinned.
+    with lock:
+        if budget <= 0:
+            cache.clear()
+        else:
+            total = sum(b for _, _, b in cache.values())
+            while total > budget and cache:
+                _, (_, _, evicted) = cache.popitem(last=False)
+                total -= evicted
     stamps = _stamps(paths)
     if stamps is not None and budget > 0:
         with lock:
@@ -243,11 +256,14 @@ def _stamped_batch_read(paths: Sequence[str],
 
 
 def read_host_batch(paths: Sequence[str],
-                    columns: Optional[Sequence[str]], schema):
+                    columns: Optional[Sequence[str]], schema,
+                    budget: Optional[int] = None):
     """Read parquet files into a HOST-lane ColumnBatch through the stamped
-    decoded-batch cache."""
+    decoded-batch cache. `budget` (session conf) overrides the env-default
+    cache bound."""
     return _stamped_batch_read(paths, columns, schema, _batch_cache,
-                               _batch_cache_lock, READ_CACHE_BYTES,
+                               _batch_cache_lock,
+                               READ_CACHE_BYTES if budget is None else budget,
                                device=False)
 
 
@@ -258,8 +274,11 @@ def read_host_batch(paths: Sequence[str],
 # immutable (`v__=N` versioning), batches are immutable downstream, and
 # accelerator HBM is exactly where hot index columns should live, so
 # repeat scans of unchanged files reuse the HBM-resident batch. Same
-# stamp validation as the host caches; budget via
-# HYPERSPACE_DEVICE_CACHE_BYTES (0 disables).
+# stamp validation as the host caches; budget via the session conf
+# `spark.hyperspace.cache.device.bytes` (preferred — it must be sized
+# against the join/sort working set sharing HBM) with the
+# HYPERSPACE_DEVICE_CACHE_BYTES env var as the process-wide default
+# (0 disables).
 DEVICE_CACHE_BYTES = int(os.environ.get(
     "HYPERSPACE_DEVICE_CACHE_BYTES", 4 * 1024 ** 3))
 _device_cache: "_OrderedDict" = _OrderedDict()
@@ -272,12 +291,15 @@ def clear_device_cache() -> None:
 
 
 def read_device_batch(paths: Sequence[str],
-                      columns: Optional[Sequence[str]], schema):
+                      columns: Optional[Sequence[str]], schema,
+                      budget: Optional[int] = None):
     """Read parquet files into a DEVICE-resident ColumnBatch through the
     stamped device cache — a warm hit skips the parquet decode AND the
-    host->device copy."""
+    host->device copy. `budget` (session conf) overrides the env-default
+    cache bound."""
     return _stamped_batch_read(paths, columns, schema, _device_cache,
-                               _device_cache_lock, DEVICE_CACHE_BYTES,
+                               _device_cache_lock,
+                               DEVICE_CACHE_BYTES if budget is None else budget,
                                device=True)
 
 

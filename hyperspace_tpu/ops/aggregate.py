@@ -49,6 +49,15 @@ def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
             columns = {}
             for spec in aggregates:
                 f = out_schema.field(spec.alias)
+                if (spec.column != "*"
+                        and batch.column(spec.column).is_string
+                        and spec.func not in ("count", "count_distinct")):
+                    # Same contract as the non-empty path: surface the
+                    # unsupported case here, not as a downstream crash on a
+                    # dictionary-less string column.
+                    raise HyperspaceException(
+                        f"Aggregate {spec.func} over string column "
+                        f"{spec.column} is not supported.")
                 if spec.func in ("count", "count_distinct"):
                     columns[f.name] = DeviceColumn(
                         jnp.zeros(1, dtype=jnp.int64), "int64")

@@ -236,7 +236,11 @@ def semi_anti_indices(left: ColumnBatch, right: ColumnBatch,
         l_ids, r_ids, True)
     is_left = counts > 0
     hit = is_left & ((rights == 0) if anti else (rights > 0))
-    mask = jnp.zeros(left.num_rows, dtype=bool).at[orig_s].max(hit)
+    # Right-side orig values (0..m-1) can exceed left.num_rows; they carry
+    # hit=False, but drop them explicitly rather than relying on JAX's
+    # default out-of-bounds scatter behavior.
+    mask = jnp.zeros(left.num_rows, dtype=bool).at[orig_s].max(
+        hit, mode="drop")
     count = int(jnp.sum(mask))  # host sync
     if count == 0:
         return jnp.zeros(0, dtype=jnp.int32)

@@ -1,6 +1,6 @@
 """Window functions over partitions: rank / dense_rank / row_number and
-partition-wide aggregates (sum/avg/min/max/count), appended as columns
-with the input row order preserved.
+aggregates (sum/avg/min/max/count), appended as columns with the input
+row order preserved.
 
 The reference delegates windows to Spark SQL; here they compile to the
 same sorted-segment machinery aggregation uses: ONE stable sort keyed
@@ -10,8 +10,16 @@ aggregates as segment reductions broadcast back through the segment ids,
 and an inverse permutation restoring input order. Host batches run the
 numpy mirror; device batches stay XLA end to end.
 
+Frames follow SQL/Spark defaults: an aggregate WITHOUT order_by is
+whole-partition; WITH order_by it is the running frame
+`RANGE BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW` — cumulative over
+the partition, peers (order-key ties) included. Running sum/avg/count
+ride a segment-rebased cumsum; running min/max a segmented prefix scan
+(`associative_scan` on device, log-step numpy on host); the peer-run
+last index maps the row frame onto the RANGE frame.
+
 SQL semantics: NULL is its own partition/peer value (validity rides the
-sort lanes); aggregates skip NULL inputs; a partition with zero non-null
+sort lanes); aggregates skip NULL inputs; a frame with zero non-null
 inputs yields NULL for sum/avg/min/max and 0 for count.
 """
 
@@ -88,15 +96,32 @@ def window_compute(batch: ColumnBatch, partition_by: Sequence[str],
     # First row index of each row's segment, broadcast per row.
     seg_first = cummax(xp.where(seg_flag, iota, xp.zeros_like(iota)))
 
+    agg_needed = [s for s in specs if s.func in AGG_FUNCS]
+    # SQL default frames: aggregates with order_by are RUNNING (RANGE
+    # UNBOUNDED PRECEDING..CURRENT ROW, peers included); without order_by
+    # they are whole-partition.
+    running = bool(order_by) and bool(agg_needed)
     rank_needed = any(s.func in RANK_FUNCS and s.func != "row_number"
                       for s in specs)
-    if rank_needed:
+    if rank_needed or running:
         peer_flag = xp.concatenate([first, change_flags(by)])
         run_first = cummax(xp.where(peer_flag, iota, xp.zeros_like(iota)))
+    if rank_needed:
         dense = xp.cumsum(peer_flag.astype(np.int64))
+    if running:
+        # Last sorted index of each row's peer run: the next peer-run
+        # start (suffix-min over start positions, shifted) minus one.
+        # RANGE-frame values are the row-frame running values read there.
+        starts = xp.where(peer_flag, iota, n)
+        if host:
+            suffmin = np.minimum.accumulate(starts[::-1])[::-1]
+        else:
+            import jax
+            suffmin = jax.lax.cummin(starts, reverse=True)
+        run_last = xp.concatenate(
+            [suffmin[1:], xp.full(1, n, dtype=starts.dtype)]) - 1
 
-    agg_needed = [s for s in specs if s.func in AGG_FUNCS]
-    if agg_needed:
+    if agg_needed and not running:
         num_segs = int(num_segs_arr)  # one host sync, shared by all specs
 
     out_sorted = {}
@@ -117,19 +142,65 @@ def window_compute(batch: ColumnBatch, partition_by: Sequence[str],
             out_sorted[spec.alias] = DeviceColumn(
                 (dense - seg_dense + 1).astype(np.int64), "int64")
             continue
-        # Partition-wide aggregate: segment-reduce, broadcast back.
+        # Aggregate: running (order_by given) or whole-partition.
         f = out_schema.field(spec.alias)
         src = sorted_batch.column(spec.column) if spec.column != "*" else None
+        if src is not None and src.is_string and spec.func != "count":
+            raise HyperspaceException(
+                f"Window {spec.func} over string column {spec.column} "
+                "is not supported.")
+        if running:
+            if spec.func == "count" and spec.column == "*":
+                out_sorted[spec.alias] = DeviceColumn(
+                    (run_last - seg_first + 1).astype(np.int64), "int64")
+                continue
+            valid = (xp.asarray(src.validity) if src.validity is not None
+                     else xp.ones(n, dtype=bool))
+            rcounts = _take(_running_sum(valid.astype(np.int64), seg_first,
+                                         host, xp), run_last, host, xp)
+            if spec.func == "count":
+                out_sorted[spec.alias] = DeviceColumn(rcounts, "int64")
+                continue
+            values = xp.asarray(src.data)
+            if spec.func in ("sum", "avg"):
+                acc = np.float64 if (f.dtype == "float64"
+                                     or spec.func == "avg") else np.int64
+                masked = xp.where(valid, values, 0).astype(acc)
+                # Integer sums: exact global-cumsum rebase. Float sums:
+                # segmented scan — rebasing subtracts the WHOLE preceding
+                # prefix, which catastrophically cancels when an earlier
+                # partition's magnitude dwarfs this one's values.
+                if acc is np.int64:
+                    row_sum = _running_sum(masked, seg_first, host, xp)
+                else:
+                    row_sum = _running_scan(masked, seg_flag, seg_ids,
+                                            "add", host)
+                rtotal = _take(row_sum, run_last, host, xp)
+                r = (rtotal if spec.func == "sum"
+                     else rtotal.astype(np.float64)
+                     / xp.maximum(rcounts, 1))
+            else:
+                if spec.func == "min":
+                    fill = (np.inf if values.dtype.kind == "f"
+                            else np.iinfo(values.dtype).max)
+                else:
+                    fill = (-np.inf if values.dtype.kind == "f"
+                            else np.iinfo(values.dtype).min)
+                r = _take(
+                    _running_scan(xp.where(valid, values, fill), seg_flag,
+                                  seg_ids, spec.func, host), run_last,
+                    host, xp)
+            out_sorted[spec.alias] = DeviceColumn(
+                r.astype(HOST_NP_DTYPES.get(f.dtype, np.int64)), f.dtype,
+                validity=rcounts > 0)
+            continue
+        # Whole-partition: segment-reduce, broadcast back.
         if spec.func == "count" and spec.column == "*":
             ones = xp.ones(n, dtype=np.int64)
             per_seg = _seg_sum(ones, seg_ids, num_segs, host)
             out_sorted[spec.alias] = DeviceColumn(
                 _bcast(per_seg, seg_ids, host, xp), "int64")
             continue
-        if src.is_string and spec.func != "count":
-            raise HyperspaceException(
-                f"Window {spec.func} over string column {spec.column} "
-                "is not supported.")
         valid = (xp.asarray(src.validity) if src.validity is not None
                  else xp.ones(n, dtype=bool))
         counts = _seg_sum(valid.astype(np.int64), seg_ids, num_segs, host)
@@ -210,3 +281,44 @@ def _seg_max(x, seg_ids, num_segs, host):
 
 def _bcast(per_seg, seg_ids, host, xp):
     return per_seg[seg_ids] if host else xp.take(per_seg, seg_ids)
+
+
+def _take(arr, idx, host, xp):
+    return arr[idx] if host else xp.take(arr, idx)
+
+
+def _running_sum(x, seg_first, host, xp):
+    """Segment-rebased INCLUSIVE cumsum: at sorted row i, the sum of x
+    over [segment start, i]. Exact for integer accumulators (one global
+    cumsum minus the value just before each segment's start)."""
+    g = xp.cumsum(x)
+    head = _take(x, seg_first, host, xp)
+    base = _take(g, seg_first, host, xp) - head
+    return g - base
+
+
+def _running_scan(x, seg_flag, seg_ids, func, host):
+    """Segmented inclusive prefix min/max/sum. Device: one fused
+    `associative_scan` with a start-flag reset combiner. Host: log-step
+    Hillis-Steele passes masked to same-segment positions."""
+    n = x.shape[0]
+    if not host:
+        import jax
+        import jax.numpy as jnp
+        op = {"min": jnp.minimum, "max": jnp.maximum, "add": jnp.add}[func]
+        def combine(a, b):
+            va, fa = a
+            vb, fb = b
+            return jnp.where(fb, vb, op(va, vb)), fa | fb
+        v, _ = jax.lax.associative_scan(combine, (x, seg_flag))
+        return v
+    op = {"min": np.minimum, "max": np.maximum, "add": np.add}[func]
+    out = np.asarray(x).copy()
+    ids = np.asarray(seg_ids)
+    k = 1
+    while k < n:
+        same = np.concatenate([np.zeros(k, dtype=bool), ids[k:] == ids[:-k]])
+        prev = np.concatenate([out[:k], out[:-k]])
+        out = np.where(same, op(out, prev), out)
+        k *= 2
+    return out

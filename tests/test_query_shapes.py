@@ -312,11 +312,20 @@ def test_window_rank_and_partition_aggregates(sess, tables):
                     rn=("row_number", "*"), pavg=("avg", "x"),
                     pcnt=("count", "*")).to_pandas()
     gb = lpdf.groupby("k")
+    # order_by present => aggregates use the SQL default RUNNING frame
+    # (RANGE UNBOUNDED PRECEDING..CURRENT ROW, peers included): count(*)
+    # at a row ordered by -q is the count of peers with q >= q_i — the
+    # max-method rank — and avg is the expanding mean read at the last
+    # row of each peer run.
     exp = lpdf.assign(
         rk=gb["q"].rank(method="min", ascending=False).astype("int64"),
         drk=gb["q"].rank(method="dense", ascending=False).astype("int64"),
-        pavg=gb["x"].transform("mean"),
-        pcnt=gb["x"].transform("size").astype("int64"))
+        pcnt=gb["q"].rank(method="max", ascending=False).astype("int64"))
+    s = lpdf.sort_values(["k", "q"], ascending=[True, False], kind="stable")
+    ravg = (s.groupby("k", sort=False)["x"].expanding().mean()
+            .reset_index(level=0, drop=True))
+    ravg = ravg.groupby([s["k"], s["q"]], sort=False).transform("last")
+    exp = exp.assign(pavg=ravg.reindex(lpdf.index))
     key = ["k", "q", "x", "s"]
     g = got.sort_values(key + ["rn"]).reset_index(drop=True)
     e = exp.sort_values(key).reset_index(drop=True)
@@ -324,6 +333,71 @@ def test_window_rank_and_partition_aggregates(sess, tables):
         assert np.allclose(g[c], e[c]), c
     for _, grp in got.groupby("k"):
         assert sorted(grp.rn) == list(range(1, len(grp) + 1))
+
+
+def test_window_whole_partition_aggregates(sess, tables):
+    """No order_by => whole-partition values (SQL default frame without
+    ORDER BY is the entire partition)."""
+    lpdf, _, lp, _ = tables
+    df = sess.read_parquet(lp)
+    got = df.window(["k"], pavg=("avg", "x"),
+                    pcnt=("count", "*")).to_pandas()
+    gb = lpdf.groupby("k")
+    exp = lpdf.assign(pavg=gb["x"].transform("mean"),
+                      pcnt=gb["x"].transform("size").astype("int64"))
+    key = ["k", "q", "x", "s"]
+    g = got.sort_values(key).reset_index(drop=True)
+    e = exp.sort_values(key).reset_index(drop=True)
+    assert np.allclose(g["pavg"], e["pavg"])
+    assert np.allclose(g["pcnt"], e["pcnt"])
+
+
+def test_window_running_frames(sess):
+    """Cumulative sum/min/max/count with order_by (TPC-DS q51-style),
+    including NULL inputs (skipped by aggregates) and order-key ties
+    (peers share the frame value)."""
+    pdf = pd.DataFrame({
+        "k": [1, 1, 1, 1, 1, 2, 2, 2],
+        "o": [10, 20, 20, 30, 40, 5, 5, 7],
+        "v": pd.array([3.0, None, 1.0, 7.0, 2.0, 4.0, 6.0, None],
+                      dtype="float64"),
+    })
+    df = sess.create_dataframe(pdf)
+    got = df.window(["k"], order_by=["o"], rsum=("sum", "v"),
+                    rmin=("min", "v"), rmax=("max", "v"),
+                    rcnt=("count", "v")).to_pandas()
+    got = got.sort_values(["k", "o", "v"], na_position="first") \
+        .reset_index(drop=True)
+    # Hand-computed RANGE frames: k=1 rows ordered by o=10,20,20,30,40 —
+    # the two o=20 peers (v NULL and 1.0) both see sum 3+1=4, count 2.
+    exp = pd.DataFrame({
+        "k": [1, 1, 1, 1, 1, 2, 2, 2],
+        "o": [10, 20, 20, 30, 40, 5, 5, 7],
+        "rsum": [3.0, 4.0, 4.0, 11.0, 13.0, 10.0, 10.0, 10.0],
+        "rmin": [3.0, 1.0, 1.0, 1.0, 1.0, 4.0, 4.0, 4.0],
+        "rmax": [3.0, 3.0, 3.0, 7.0, 7.0, 6.0, 6.0, 6.0],
+        "rcnt": [1, 2, 2, 3, 4, 2, 2, 2],
+    }).sort_values(["k", "o"]).reset_index(drop=True)
+    # Align the two o=20 peer rows by v (NULL first) before comparing.
+    for c in ("rsum", "rmin", "rmax", "rcnt"):
+        assert np.allclose(got[c].astype("float64"),
+                           exp[c].astype("float64")), c
+
+
+def test_window_running_sum_no_cross_partition_cancellation(sess):
+    """Float running sums use a segmented scan, not global-cumsum
+    rebasing: a huge-magnitude partition sorted before a small one must
+    not cancel the small partition's values away (review regression)."""
+    pdf = pd.DataFrame({
+        "k": [1, 1, 2, 2, 2, 2],
+        "o": [1, 2, 1, 2, 3, 4],
+        "v": [1e16, 1e16, 0.1, 0.2, 0.3, 0.4],
+    })
+    got = sess.create_dataframe(pdf) \
+        .window(["k"], order_by=["o"], rsum=("sum", "v")).to_pandas() \
+        .sort_values(["k", "o"]).reset_index(drop=True)
+    exp = [1e16, 2e16, 0.1, 0.3, 0.6, 1.0]
+    assert np.allclose(got["rsum"], exp, rtol=1e-12), list(got["rsum"])
 
 
 def test_window_serde_roundtrip(sess, tables):

@@ -96,8 +96,19 @@ class HyperspaceConf:
                             constants.DISTRIBUTION_MIN_ROWS_DEFAULT)
 
     @property
+    def broadcast_threshold(self) -> int:
+        """Join sides estimated under this many bytes broadcast as a
+        direct-address table instead of riding Exchange+Sort; <= 0
+        disables (Spark `autoBroadcastJoinThreshold` analog)."""
+        return self.get_int(constants.BROADCAST_THRESHOLD,
+                            constants.BROADCAST_THRESHOLD_DEFAULT)
+
+    @property
     def read_cache_bytes(self):
-        """Host decoded-batch cache budget; None = env/process default."""
+        """Host decoded-batch cache budget; None = env/process default.
+        The cache itself is PROCESS-wide — a session that sets this
+        governs the shared cache while its queries run, so sessions
+        sharing a process should agree on it."""
         value = self.get(constants.READ_CACHE_BYTES_KEY)
         return int(value) if value is not None else None
 
@@ -105,7 +116,8 @@ class HyperspaceConf:
     def device_cache_bytes(self):
         """HBM-resident batch cache budget; None = env/process default.
         Competes with join/sort working sets for device memory — lower it
-        (or 0) when large queries OOM."""
+        (or 0) when large queries OOM; 0 releases already-resident
+        batches. Process-wide cache, same caveat as read_cache_bytes."""
         value = self.get(constants.DEVICE_CACHE_BYTES_KEY)
         return int(value) if value is not None else None
 

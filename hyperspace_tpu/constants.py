@@ -31,6 +31,14 @@ INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
 READ_CACHE_BYTES_KEY = "spark.hyperspace.cache.read.bytes"
 DEVICE_CACHE_BYTES_KEY = "spark.hyperspace.cache.device.bytes"
 
+# Broadcast-join size threshold in estimated decoded bytes; <= 0 disables
+# (the analog of Spark's `spark.sql.autoBroadcastJoinThreshold`, which
+# the reference leans on for dimension joins and its E2E suite pins to
+# -1 to force the SMJ path, `E2EHyperspaceRulesTests.scala:42`). Default
+# matches Spark's 10 MB.
+BROADCAST_THRESHOLD = "spark.hyperspace.broadcast.threshold"
+BROADCAST_THRESHOLD_DEFAULT = 10 * 1024 * 1024
+
 HYBRID_SCAN_ENABLED = "spark.hyperspace.index.hybridscan.enabled"
 
 # Per-row lineage (extension; the reference's v0.2 direction): when enabled

@@ -858,6 +858,82 @@ class SortMergeJoinExec(PhysicalNode):
         return mesh
 
 
+class BroadcastHashJoinExec(PhysicalNode):
+    """Small-side join with NO Exchange/Sort on either side — the engine's
+    analog of Spark's BroadcastHashJoin, which the reference leans on for
+    every dimension join (`E2EHyperspaceRulesTests.scala:42` must disable
+    it to exercise the SMJ path). The planner routes a join here when one
+    side's estimated size is under `spark.hyperspace.broadcast.threshold`;
+    execution replicates that side as a direct-address lookup table and
+    matches probe rows with one gather (`ops/broadcast_join.py`). When the
+    keys are ineligible at run time (strings/floats/duplicates/wide
+    ranges), the counting join runs on the bare batches instead — still
+    zero Exchange, just without the no-sort shortcut."""
+
+    name = "BroadcastHashJoin"
+
+    def __init__(self, left: PhysicalNode, right: PhysicalNode,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 build_side: str, how: str = "inner", conf=None,
+                 out_columns: Optional[Set[str]] = None):
+        self.left = left
+        self.right = right
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.build_side = build_side  # "left" | "right"
+        self.how = how
+        self.conf = conf
+        self.out_columns = out_columns
+
+    @property
+    def children(self):
+        return [self.left, self.right]
+
+    def simple_string(self) -> str:
+        keys = ", ".join(f"{l}={r}"
+                         for l, r in zip(self.left_keys, self.right_keys))
+        return (f"BroadcastHashJoin {self.how} [{keys}] "
+                f"build={self.build_side}")
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        from hyperspace_tpu.ops.broadcast_join import (broadcast_join_indices,
+                                                       broadcast_membership)
+        from hyperspace_tpu.ops.bucketed_join import assemble_join_output
+        from hyperspace_tpu.ops.join import (semi_anti_indices,
+                                             sort_merge_join)
+
+        lbatch = self.left.execute(bucket)
+        rbatch = self.right.execute(bucket)
+        if self.how in ("left_semi", "left_anti"):
+            anti = self.how == "left_anti"
+            idx = broadcast_membership(lbatch, rbatch, self.left_keys,
+                                       self.right_keys, anti=anti)
+            if idx is None:
+                idx = semi_anti_indices(lbatch, rbatch, self.left_keys,
+                                        self.right_keys, anti=anti)
+            return lbatch.take(idx)
+        if self.build_side == "right":
+            pair = broadcast_join_indices(lbatch, rbatch, self.left_keys,
+                                          self.right_keys, self.how)
+            if pair is not None:
+                li, ri = pair
+                return assemble_join_output(lbatch, rbatch, li, ri,
+                                            how=self.how,
+                                            columns=self.out_columns)
+        else:
+            pair = broadcast_join_indices(
+                rbatch, lbatch, self.right_keys, self.left_keys,
+                "left_outer" if self.how == "right_outer" else "inner")
+            if pair is not None:
+                ri, li = pair
+                return assemble_join_output(lbatch, rbatch, li, ri,
+                                            how=self.how,
+                                            columns=self.out_columns)
+        return sort_merge_join(lbatch, rbatch, self.left_keys,
+                               self.right_keys, how=self.how,
+                               columns=self.out_columns)
+
+
 # ---------------------------------------------------------------------------
 # Planner
 # ---------------------------------------------------------------------------
@@ -1061,6 +1137,51 @@ def _underlying_bucket_spec(plan: LogicalPlan) -> Optional[BucketSpec]:
         if isinstance(node, Union):
             return _underlying_bucket_spec(node.children[0])
         return None
+
+
+# Approximate in-memory bytes per value; strings budget code + a share of
+# the dictionary. Only relative accuracy vs the broadcast threshold
+# matters (Spark's estimate — raw file size — is no finer).
+_DTYPE_WIDTH = {"bool": 1, "int8": 1, "int16": 2, "int32": 4, "date32": 4,
+                "float32": 4, "int64": 8, "float64": 8, "timestamp": 8,
+                "string": 16}
+
+
+def _estimated_plan_bytes(plan: LogicalPlan,
+                          required: Set[str]) -> Optional[int]:
+    """Upper-bound decoded bytes of `plan`'s output restricted to
+    `required`, from parquet footer row counts (cached; no data read).
+    None when the subtree's cardinality is not statically bounded by its
+    scans — aggregates/joins/windows can shrink OR grow, so they never
+    qualify a side for broadcast. Mirrors what Spark's
+    `autoBroadcastJoinThreshold` keys on (leaf statistics propagated
+    through Filter/Project)."""
+    if isinstance(plan, Scan):
+        files = plan.files()
+        if not files:
+            return 0
+        try:
+            rows = sum(parquet.file_row_counts(files))
+        except Exception:
+            return None
+        lowered = {r.lower() for r in required}
+        width = sum(_DTYPE_WIDTH.get(f.dtype, 8) for f in plan.schema.fields
+                    if f.name.lower() in lowered)
+        return rows * max(width, 1)
+    if isinstance(plan, (Filter, Project, Sort, Limit)):
+        # Row count bounded by the child's (Filter/Limit only shrink);
+        # keep the SAME required set — renamed/computed projections just
+        # fall out of the width sum, and rows dominate the estimate.
+        return _estimated_plan_bytes(plan.child, required)
+    if isinstance(plan, Union):
+        total = 0
+        for c in plan.children:
+            est = _estimated_plan_bytes(c, required)
+            if est is None:
+                return None
+            total += est
+        return total
+    return None
 
 
 def _required_for(plan: LogicalPlan, required: Set[str]) -> List[str]:
@@ -1288,9 +1409,20 @@ def _plan_physical_node(plan: LogicalPlan,
             left_required = ({n for n in required
                               if plan.left.schema.contains(n)}
                              | set(left_keys))
+            left_phys = _plan_physical(plan.left, left_required, conf, ctx)
+            right_phys = _plan_physical(plan.right, set(right_keys), conf,
+                                        ctx)
+            threshold = conf.broadcast_threshold if conf is not None else 0
+            if threshold > 0:
+                est = _estimated_plan_bytes(plan.right, set(right_keys))
+                if est is not None and est <= threshold:
+                    # Small membership side: direct-address probe instead
+                    # of the counting-match's joint sort of both sides.
+                    return BroadcastHashJoinExec(
+                        left_phys, right_phys, left_keys, right_keys,
+                        build_side="right", how=plan.join_type, conf=conf)
             return SortMergeJoinExec(
-                _plan_physical(plan.left, left_required, conf, ctx),
-                _plan_physical(plan.right, set(right_keys), conf, ctx),
+                left_phys, right_phys,
                 left_keys, right_keys, bucketed=False,
                 how=plan.join_type, conf=conf)
         out_columns = {n.lower() for n in required}
@@ -1369,6 +1501,31 @@ def _plan_physical_node(plan: LogicalPlan,
                                      num_buckets=target,
                                      how=plan.join_type, conf=conf,
                                      out_columns=out_columns)
+        # Broadcast path: one side estimated small (dimension tables) —
+        # no Exchange/Sort on EITHER side; the build side replicates as a
+        # direct-address table. The reference relies on Spark's
+        # BroadcastHashJoin for exactly these joins; disable with
+        # `spark.hyperspace.broadcast.threshold = -1` (the analog of the
+        # reference E2E suite pinning autoBroadcastJoinThreshold to -1,
+        # `E2EHyperspaceRulesTests.scala:42`). The probe side must keep
+        # ALL its rows, so outer joins only broadcast their inner side.
+        threshold = conf.broadcast_threshold if conf is not None else 0
+        if threshold > 0:
+            build = None
+            if plan.join_type in ("inner", "left_outer"):
+                est = _estimated_plan_bytes(plan.right, right_required)
+                if est is not None and est <= threshold:
+                    build = "right"
+            if build is None and plan.join_type in ("inner", "right_outer"):
+                est = _estimated_plan_bytes(plan.left, left_required)
+                if est is not None and est <= threshold:
+                    build = "left"
+            if build is not None:
+                return BroadcastHashJoinExec(left_phys, right_phys,
+                                             left_keys, right_keys,
+                                             build_side=build,
+                                             how=plan.join_type, conf=conf,
+                                             out_columns=out_columns)
         # General path: hash exchange + sort on each side.
         num_partitions = max(lspec.num_buckets if lspec else 0,
                              rspec.num_buckets if rspec else 0, 200)

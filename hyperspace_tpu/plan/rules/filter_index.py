@@ -6,7 +6,9 @@ Parity: reference `index/rules/FilterIndexRule.scala:41-229`.
   it: the filter must reference the index's FIRST indexed column, and
   project+filter columns must be a subset of indexed+included columns
   (reference `:203-215`).
-- Ranking is first-wins (reference's placeholder, `:222-228`).
+- Ranking is cost-based — smallest on-disk index (fallback: fewest
+  columns), more buckets on ties — exceeding the reference's first-wins
+  placeholder (`:222-228`).
 - Replacement keeps Project+Filter but swaps the relation for a scan over
   the index data root with NO bucket spec — a plain scan keeps full read
   parallelism (reference `:109-131`).
@@ -29,7 +31,12 @@ class FilterIndexRule(Rule):
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
         self._sig_cache = {}
         try:
-            return plan.transform_up(self._rewrite)
+            # TOP-DOWN, mirroring the reference's `transform` (pre-order,
+            # `FilterIndexRule.scala:42-56`): a Project(Filter(Scan)) must
+            # match BEFORE its inner bare Filter(Scan) — coverage judged
+            # on the projected columns admits narrower (cheaper) indexes
+            # than the bare match's full-schema requirement.
+            return plan.transform_down(self._rewrite)
         except Exception as exc:
             logger.warning("FilterIndexRule failed; skipping: %s", exc)
             return plan
@@ -144,8 +151,47 @@ class FilterIndexRule(Rule):
             if not self.signature_matches(entry, filt):
                 continue
             candidates.append(entry)
-        # First-wins ranking (reference placeholder `:222-228`).
-        return candidates[0] if candidates else None
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return candidates[0]
+        return self._rank(candidates)
+
+    @staticmethod
+    def _rank(candidates: List[IndexLogEntry]) -> IndexLogEntry:
+        """Cost-based selection — exceeds the reference's first-wins
+        placeholder (`FilterIndexRule.scala:222-228`): among covering
+        candidates, pick the one that reads the FEWEST BYTES (on-disk
+        size of the index data root, the exact cost of the swapped-in
+        scan); when any candidate's storage is unstatable, fall back to
+        total column count (fewer columns ~ narrower rows ~ fewer
+        bytes). Ties break toward MORE buckets (finer point-filter
+        bucket pruning: each point value reads 1/num_buckets of the
+        files), then name for determinism."""
+        from hyperspace_tpu.utils.file_utils import get_directory_size
+
+        sizes = []
+        for entry in candidates:
+            try:
+                size = get_directory_size(entry.content.root)
+            except OSError:
+                size = 0
+            # 0 bytes means missing/unreadable as much as legitimately
+            # empty (`get_directory_size` reports both as 0). An index
+            # whose data root was deleted out-of-band must never WIN the
+            # ranking by looking free: candidates with real bytes beat
+            # 0-byte ones outright (covering siblings index the same
+            # source, so a lone 0 is damage, not data); with no sized
+            # candidate at all, fall back to the column-count proxy.
+            sizes.append(size if size > 0 else None)
+        sized = [(s, e) for s, e in zip(sizes, candidates) if s is not None]
+        if sized:
+            return min(sized,
+                       key=lambda p: (p[0], -p[1].num_buckets, p[1].name))[1]
+        counts = [len(e.indexed_columns) + len(e.included_columns)
+                  for e in candidates]
+        return min(zip(counts, candidates),
+                   key=lambda p: (p[0], -p[1].num_buckets, p[1].name))[1]
 
     @staticmethod
     def _covers(entry: IndexLogEntry, project_columns: Sequence[str],

@@ -1,0 +1,193 @@
+"""Broadcast-style dimension joins: plan shape (no Exchange/Sort on
+either side), result parity with the general join across join types,
+run-time fallback for ineligible keys, and the disable conf — the
+engine's analog of Spark's BroadcastHashJoin, which the reference's E2E
+suite must disable to exercise SMJ (`E2EHyperspaceRulesTests.scala:42`).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.engine.physical import BroadcastHashJoinExec
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.plan.expr import col, lit
+
+
+def norm(d):
+    out = d.sort_values(list(d.columns)).reset_index(drop=True)
+    return out.astype({c: "float64" for c in out.columns
+                       if out[c].dtype.kind in "fi"})
+
+
+@pytest.fixture(params=["host", "device"])
+def sess(request, tmp_path):
+    conf = {"hyperspace.warehouse.dir": str(tmp_path / "wh")}
+    if request.param == "device":
+        conf["spark.hyperspace.execution.min.device.rows"] = "0"
+    return HyperspaceSession(HyperspaceConf(conf))
+
+
+@pytest.fixture
+def fact_dim(sess):
+    rng = np.random.default_rng(11)
+    n = 4000
+    fact = pd.DataFrame({
+        "sk": rng.integers(100, 160, n).astype(np.int64),  # some miss dim
+        "qty": rng.integers(1, 9, n).astype(np.int64),
+        "amt": rng.random(n),
+    })
+    dim = pd.DataFrame({
+        "d_sk": np.arange(100, 150, dtype=np.int64),
+        "d_year": (1998 + (np.arange(50) % 4)).astype(np.int64),
+        "d_name": pd.array([f"day{i:02d}" for i in range(50)]),
+    })
+    return (sess.create_dataframe(fact), sess.create_dataframe(dim),
+            fact, dim)
+
+
+def _physical_names(q):
+    _, _, physical = q.explain_plans()
+    return [type(n).__name__ for n in physical.collect()]
+
+
+def test_broadcast_plan_has_no_exchange_or_sort(fact_dim):
+    f, d, _, _ = fact_dim
+    q = f.join(d, on=col("sk") == col("d_sk"))
+    names = _physical_names(q)
+    assert names.count("BroadcastHashJoinExec") == 1
+    assert names.count("ExchangeExec") == 0
+    assert names.count("SortExec") == 0
+
+
+def test_broadcast_disabled_by_threshold(fact_dim):
+    f, d, _, _ = fact_dim
+    f.session.conf.set("hyperspace.broadcast.threshold", -1)
+    names = _physical_names(f.join(d, on=col("sk") == col("d_sk")))
+    assert names.count("BroadcastHashJoinExec") == 0
+    assert names.count("ExchangeExec") == 2
+
+
+@pytest.mark.parametrize("how", ["inner", "left_outer", "right_outer"])
+def test_broadcast_join_matches_pandas(fact_dim, how):
+    f, d, fact, dim = fact_dim
+    q = f.join(d, on=col("sk") == col("d_sk"), how=how)
+    assert _physical_names(q).count("BroadcastHashJoinExec") == 1
+    got = q.to_pandas()
+    exp = fact.merge(dim, left_on="sk", right_on="d_sk",
+                     how={"inner": "inner", "left_outer": "left",
+                          "right_outer": "right"}[how])
+    pd.testing.assert_frame_equal(norm(got), norm(exp), check_dtype=False,
+                                  atol=1e-9)
+
+
+def test_broadcast_left_build_side(fact_dim):
+    """dim JOIN fact right_outer keeps every fact row, so the broadcast
+    build side must be the (small) LEFT dim."""
+    f, d, fact, dim = fact_dim
+    q = d.join(f, on=col("d_sk") == col("sk"), how="right_outer")
+    _, _, physical = q.explain_plans()
+    nodes = [n for n in physical.collect()
+             if isinstance(n, BroadcastHashJoinExec)]
+    assert len(nodes) == 1 and nodes[0].build_side == "left"
+    got = q.to_pandas()
+    exp = dim.merge(fact, left_on="d_sk", right_on="sk", how="right")
+    pd.testing.assert_frame_equal(norm(got), norm(exp), check_dtype=False,
+                                  atol=1e-9)
+
+
+def test_broadcast_semi_anti(fact_dim):
+    f, d, fact, dim = fact_dim
+    for how, expect in (("left_semi", fact[fact.sk.isin(dim.d_sk)]),
+                        ("left_anti", fact[~fact.sk.isin(dim.d_sk)])):
+        q = f.join(d, on=col("sk") == col("d_sk"), how=how)
+        assert _physical_names(q).count("BroadcastHashJoinExec") == 1
+        got = q.to_pandas()
+        pd.testing.assert_frame_equal(norm(got), norm(expect),
+                                      check_dtype=False, atol=1e-9)
+
+
+def test_broadcast_null_keys_match_nothing(sess):
+    fact = pd.DataFrame({"k": pd.array([1, 2, None, 4], dtype="Int64"),
+                         "v": [10, 20, 30, 40]})
+    dim = pd.DataFrame({"k2": pd.array([1, None, 4], dtype="Int64"),
+                        "w": [100, 200, 300]})
+    q = sess.create_dataframe(fact).join(
+        sess.create_dataframe(dim), on=col("k") == col("k2"),
+        how="left_outer")
+    assert _physical_names(q).count("BroadcastHashJoinExec") == 1
+    got = q.to_pandas().sort_values("v").reset_index(drop=True)
+    assert list(got["w"].fillna(-1)) == [100, -1, -1, 300]
+
+
+def test_broadcast_duplicate_build_keys_fall_back_correctly(sess):
+    """Duplicate build keys are ineligible for the unique-table path —
+    execution must fall back to the counting join with identical
+    results (including the expansion)."""
+    fact = pd.DataFrame({"k": np.arange(2000, dtype=np.int64) % 7,
+                         "v": np.arange(2000, dtype=np.int64)})
+    dim = pd.DataFrame({"k2": np.asarray([0, 1, 1, 3], dtype=np.int64),
+                        "w": np.asarray([9, 8, 7, 6], dtype=np.int64)})
+    q = sess.create_dataframe(fact).join(
+        sess.create_dataframe(dim), on=col("k") == col("k2"))
+    assert _physical_names(q).count("BroadcastHashJoinExec") == 1
+    got = q.to_pandas()
+    exp = fact.merge(dim, left_on="k", right_on="k2")
+    pd.testing.assert_frame_equal(norm(got), norm(exp), check_dtype=False)
+
+
+def test_broadcast_multi_key(sess):
+    fact = pd.DataFrame({"a": np.arange(3000, dtype=np.int64) % 5,
+                         "b": np.arange(3000, dtype=np.int64) % 11,
+                         "v": np.arange(3000, dtype=np.float64)})
+    dim = pd.DataFrame({"a2": np.asarray([0, 1, 2, 3], dtype=np.int64),
+                        "b2": np.asarray([3, 4, 5, 6], dtype=np.int64),
+                        "w": np.asarray([1, 2, 3, 4], dtype=np.int64)})
+    q = sess.create_dataframe(fact).join(
+        sess.create_dataframe(dim),
+        on=(col("a") == col("a2")) & (col("b") == col("b2")))
+    assert _physical_names(q).count("BroadcastHashJoinExec") == 1
+    got = q.to_pandas()
+    exp = fact.merge(dim, left_on=["a", "b"], right_on=["a2", "b2"])
+    pd.testing.assert_frame_equal(norm(got), norm(exp), check_dtype=False)
+
+
+def test_broadcast_string_keys_fall_back_correctly(sess):
+    """String keys are ineligible for the direct-address table; the node
+    still answers correctly through the counting-join fallback."""
+    fact = pd.DataFrame({"s": pd.array([f"u{i % 6}" for i in range(500)]),
+                         "v": np.arange(500, dtype=np.int64)})
+    dim = pd.DataFrame({"s2": pd.array(["u0", "u2", "u4"]),
+                        "w": np.asarray([7, 8, 9], dtype=np.int64)})
+    q = sess.create_dataframe(fact).join(
+        sess.create_dataframe(dim), on=col("s") == col("s2"))
+    got = q.to_pandas()
+    exp = fact.merge(dim, left_on="s", right_on="s2")
+    pd.testing.assert_frame_equal(norm(got[["v", "w"]]),
+                                  norm(exp[["v", "w"]]), check_dtype=False)
+
+
+def test_broadcast_estimator_excludes_aggregates(sess, tmp_path):
+    """An aggregate side has no static bound -> never broadcast."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import os
+    d = tmp_path / "t"
+    os.makedirs(d)
+    pq.write_table(pa.table({"k": np.arange(100, dtype=np.int64),
+                             "v": np.arange(100, dtype=np.int64)}),
+                   str(d / "p.parquet"))
+    df = sess.read_parquet(str(d))
+    agg = df.group_by("k").agg(("sum", "v", "sv"))
+    # Aggregate on the right, tiny scan on the left: the unbounded
+    # aggregate must not qualify, so the planner builds LEFT instead.
+    from hyperspace_tpu.engine.physical import BroadcastHashJoinExec
+    _, _, physical = df.join(agg, on="k").explain_plans()
+    nodes = [n for n in physical.collect()
+             if isinstance(n, BroadcastHashJoinExec)]
+    assert len(nodes) == 1 and nodes[0].build_side == "left"
+    # Aggregates on BOTH sides: no static bound anywhere -> no broadcast.
+    agg2 = df.group_by("k").agg(("count", "*", "c"))
+    q = agg.join(agg2, on="k")
+    assert _physical_names(q).count("BroadcastHashJoinExec") == 0

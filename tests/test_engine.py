@@ -127,6 +127,10 @@ def test_select_and_projection_pushdown(df):
 
 
 def test_unbucketed_join_plans_exchange_and_sort(session, sample_parquet):
+    # Disable broadcast to exercise the Exchange+Sort machinery on these
+    # tiny fixtures — the reference E2E suite pins
+    # autoBroadcastJoinThreshold=-1 for the same reason.
+    session.conf.set("hyperspace.broadcast.threshold", -1)
     df = session.read_parquet(sample_parquet)
     q = df.select("id", "clicks").join(df.select("id", "score"), on="id")
     _, _, physical = q.explain_plans()
@@ -437,7 +441,11 @@ def test_cross_dtype_indexed_join_takes_general_path(tmp_path):
     for lbuckets, rbuckets in ((8, 8), (16, 4)):
         conf = HyperspaceConf({
             "hyperspace.warehouse.dir": str(tmp_path / f"wh{lbuckets}"
-                                            / str(rbuckets))})
+                                            / str(rbuckets)),
+            # The small right side would broadcast; this test exercises
+            # the promoting GENERAL path (reference analog: E2E pins
+            # autoBroadcastJoinThreshold=-1).
+            "hyperspace.broadcast.threshold": -1})
         sess = HyperspaceSession(conf)
         hs = Hyperspace(sess)
         rng = np.random.default_rng(7)

@@ -67,6 +67,9 @@ def test_explain_filter_query(env):
 
 def test_explain_join_shows_exchange_elision(env):
     session, hs, src = env
+    # Pin broadcast off so the rules-off plan shows the Exchange+Sort
+    # the index elides (reference: `E2EHyperspaceRulesTests.scala:42`).
+    session.conf.set("hyperspace.broadcast.threshold", -1)
     df = session.read_parquet(src)
     hs.create_index(df, IndexConfig("el", ["imprs"], ["id"]))
     hs.create_index(df, IndexConfig("er", ["imprs"], ["score"]))

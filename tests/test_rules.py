@@ -95,6 +95,55 @@ def test_filter_rule_bare_filter(session, tmp_path):
     assert "fidx" in out.collect_leaves()[0].root_paths[0]
 
 
+def test_filter_rule_cost_based_ranking(session, tmp_path):
+    """With several covering indexes, the CHEAPEST one (smallest on-disk
+    data) is chosen — exceeding the reference's first-wins placeholder
+    (`FilterIndexRule.scala:222-228`)."""
+    scan = base_scan(tmp_path)
+    wide = fabricate_index(session, "aWide", ["c1"], ["c2", "c3", "c4"],
+                           scan)
+    narrow = fabricate_index(session, "zNarrow", ["c1"], ["c2"], scan)
+    for entry, nbytes in ((wide, 4096), (narrow, 64)):
+        os.makedirs(entry.content.root, exist_ok=True)
+        with open(os.path.join(entry.content.root, "part-0.parquet"),
+                  "wb") as f:
+            f.write(b"x" * nbytes)
+    plan = Project(["c2"], Filter(col("c1") == 10, scan))
+    out = FilterIndexRule(session).apply(plan)
+    # First-wins would pick aWide (listed first); cost picks zNarrow.
+    assert "zNarrow" in out.collect_leaves()[0].root_paths[0]
+
+
+def test_filter_rule_ranking_prefers_populated_over_missing(session,
+                                                            tmp_path):
+    """An index whose data root vanished out-of-band (0 bytes) must not
+    win the ranking by looking free — a populated covering index beats
+    it even when wider (review regression)."""
+    scan = base_scan(tmp_path)
+    wide = fabricate_index(session, "aWide", ["c1"], ["c2", "c3", "c4"],
+                           scan)
+    fabricate_index(session, "zGone", ["c1"], ["c2"], scan)  # no data dir
+    os.makedirs(wide.content.root, exist_ok=True)
+    with open(os.path.join(wide.content.root, "part-0.parquet"), "wb") as f:
+        f.write(b"x" * 512)
+    plan = Project(["c2"], Filter(col("c1") == 10, scan))
+    out = FilterIndexRule(session).apply(plan)
+    assert "aWide" in out.collect_leaves()[0].root_paths[0]
+
+
+def test_filter_rule_ranking_bucket_tiebreak(session, tmp_path):
+    """Equal cost (no data dirs on disk -> column-count fallback ties):
+    MORE buckets wins — finer point-filter bucket pruning."""
+    scan = base_scan(tmp_path)
+    fabricate_index(session, "coarse", ["c1"], ["c2"], scan, num_buckets=4)
+    fabricate_index(session, "fine", ["c1"], ["c2"], scan, num_buckets=32)
+    plan = Project(["c2"], Filter(col("c1") == 10, scan))
+    out = FilterIndexRule(session).apply(plan)
+    leaf = out.collect_leaves()[0]
+    assert "fine" in leaf.root_paths[0]
+    assert leaf.bucket_spec.num_buckets == 32
+
+
 def test_filter_rule_requires_first_indexed_column(session, tmp_path):
     scan = base_scan(tmp_path)
     fabricate_index(session, "fidx", ["c1", "c2"], ["c3"], scan)

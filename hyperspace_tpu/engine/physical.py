@@ -743,7 +743,7 @@ class SortMergeJoinExec(PhysicalNode):
             # per-bucket compile explosion. Buckets are independent ->
             # mesh-parallel in `parallel/join.py`.
             from hyperspace_tpu.ops.bucketed_join import (
-                bucketed_sort_merge_join, padded_skew)
+                bucketed_sort_merge_join)
             # The two sides' reads are independent IO — overlap them.
             from concurrent.futures import ThreadPoolExecutor
             with ThreadPoolExecutor(max_workers=2) as pool:
@@ -752,18 +752,19 @@ class SortMergeJoinExec(PhysicalNode):
                                  self.num_buckets)
                 lbatch, l_lengths = lf.result()
                 rbatch, r_lengths = rf.result()
-            # The mesh path uses the padded [B, L] layout, so hot-key
-            # skew routes single-chip where the counting join's memory is
-            # bounded by true row count (skew-immune by construction).
             # Host-lane sides skip the mesh in "auto" mode for the same
             # reason FilterExec does: distribution would pay the device
-            # transfers the lane exists to avoid.
-            skewed = padded_skew(l_lengths, r_lengths, lbatch.num_rows,
-                                 rbatch.num_rows)
-            mesh = (None if skewed
-                    else self._join_mesh(
-                        lbatch.num_rows + rbatch.num_rows,
-                        host_batch=lbatch.is_host and rbatch.is_host))
+            # transfers the lane exists to avoid. Hot-bucket skew that
+            # would blow up the [S, C] shard layout routes single-chip,
+            # where the counting join's memory is bounded by true rows.
+            mesh = self._join_mesh(
+                lbatch.num_rows + rbatch.num_rows,
+                host_batch=lbatch.is_host and rbatch.is_host)
+            if mesh is not None:
+                from hyperspace_tpu.parallel.context import mesh_size
+                from hyperspace_tpu.parallel.join import shard_skew
+                if shard_skew(l_lengths, r_lengths, mesh_size(mesh)):
+                    mesh = None
             if mesh is not None:
                 from hyperspace_tpu.ops.bucketed_join import (
                     assemble_join_output)
@@ -843,13 +844,17 @@ class SortMergeJoinExec(PhysicalNode):
                                columns=self.out_columns)
 
     def _join_mesh(self, total_rows: int, host_batch: bool = False):
-        """Mesh for the distributed co-bucketed join, or None. Requires an
-        inner/one-sided-outer join (full_outer's appended-right pass is
-        single-chip only) and the bucket<->shard map (num_buckets
-        divisible by mesh size)."""
+        """Mesh for the distributed co-bucketed join, or None. Covers
+        inner and all outer types; semi/anti return from execute() via
+        the membership branch before bucketed execution, so their
+        distributed variant (`parallel/join.distributed_semi_anti_indices`)
+        is not routed from here yet — the planner builds semi/anti sides
+        without the bucketed layout. Requires the bucket<->shard map
+        (num_buckets divisible by mesh size)."""
         from hyperspace_tpu.parallel.context import (mesh_size,
                                                      should_distribute)
-        if self.how not in ("inner", "left_outer", "right_outer"):
+        if self.how not in ("inner", "left_outer", "right_outer",
+                            "full_outer"):
             return None
         mesh = should_distribute(self.conf, total_rows,
                                  host_batch=host_batch)

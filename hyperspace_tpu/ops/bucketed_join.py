@@ -38,29 +38,8 @@ from hyperspace_tpu.ops import keys as keymod
 
 _I32_MAX = np.int32(np.iinfo(np.int32).max)
 
-# Skew guard: the padded [B, L] layout costs B * next_pow2(max bucket len)
-# cells per side, so ONE hot key inflates every bucket's row to L and the
-# batched join degrades to O(B*L) memory/compute. Past this blowup the
-# layout loses to a global id-sort + merge join, whose cost is
-# O((n+m) log(n+m)) regardless of how keys distribute — the analog of
-# Spark's ragged partitions, where no bucket pays for a neighbour's skew.
-SKEW_BLOWUP_FACTOR = 8
-SKEW_MIN_CELLS = 1 << 22
-
-
 def next_pow2(n: int) -> int:
     return 1 << max(4, (int(n) - 1).bit_length())
-
-
-def padded_skew(l_lengths, r_lengths, n_rows: int, m_rows: int) -> bool:
-    """True when the padded bucket layout would materially out-size the
-    actual row count (hot-key skew) and the global join should be used."""
-    B = max(len(l_lengths), 1)
-    Ll = next_pow2(max(1, int(np.asarray(l_lengths).max(initial=0))))
-    Lr = next_pow2(max(1, int(np.asarray(r_lengths).max(initial=0))))
-    cells = B * (Ll + Lr)
-    return (cells > SKEW_MIN_CELLS
-            and cells > SKEW_BLOWUP_FACTOR * max(n_rows + m_rows, 1))
 
 
 def encode_group_ids(left: ColumnBatch, right: ColumnBatch,

@@ -1,21 +1,35 @@
-"""Mesh-sharded co-bucketed join.
+"""Mesh-sharded co-bucketed join — the counting join over shard-local rows.
 
-The single-chip batched bucket join (`ops/bucketed_join.py`) is already
-expressed over a leading bucket axis [B, L]; distributing it is a matter of
-SHARDING THAT AXIS over the mesh and letting XLA's SPMD partitioner place
-the per-bucket work chip-locally — the jax-native "annotate shardings, let
-XLA insert collectives" recipe. Because bucket b of both sides lives on the
-same shard (bucket % n_shards), the match phase runs with ZERO inter-chip
-traffic — the claim the JoinIndexRanker's equal-bucket preference encodes
-(reference `index/rankers/JoinIndexRanker.scala:40-55`).
+Bucket b of both sides lives on shard `b % n_shards` (`parallel/mesh.py`),
+so once each shard holds its buckets' rows of BOTH sides the entire match
+phase runs with ZERO inter-chip traffic — the claim the JoinIndexRanker's
+equal-bucket preference encodes (reference
+`index/rankers/JoinIndexRanker.scala:40-55`).
 
-Group encoding is SHARD-LOCAL: matching only ever happens within a bucket,
-so key tuples need consistent ids only within each bucket. Both sides'
-rows of one bucket are gathered into a combined padded [B, Ll+Lr] matrix
-and sorted per bucket (one batched `lax.sort` along the row axis, sharded
-over buckets); adjacent-difference ids within each bucket row replace the
-round-2 design's REPLICATED global sort over all rows — the scaling
-bottleneck the round-2 review called out.
+Layout: a host-side [S, C] gather plan maps (shard, slot) -> original row
+(C = largest shard's row count; padding is masked). Each shard's slice —
+key lanes + validity only, never payload — is then device_put with a
+sharded `NamedSharding`, so per-chip live bytes are ~total/S. This
+replaces the round-3 design's two structural flaws (the round-3 review's
+item 3): key lanes replicated to every device (per-chip O(total rows)),
+and the padded [B, next_pow2(max_bucket)] layout where one hot bucket
+padded every bucket. Here a hot bucket inflates only its owner shard's
+capacity, and the match core is the same sort+cumulative-counting design
+the single-chip join uses (`ops/join.py` — skew-immune by construction).
+
+Per shard (all batched over the sharded axis, no collectives until the
+host sync that sizes the output):
+1. ONE stable dim-1 sort by (pad, null, *key lanes, side, slot);
+2. group runs from adjacent lane differences (null/pad break every run);
+3. right-run brackets via cumulative max/min counting — no searchsorted;
+4. counts -> global exclusive cumsum -> expansion to (li, ri) pairs.
+
+Coverage: inner / left_outer (callers swap for right_outer) and
+full_outer (left_outer expansion + unmatched-right append from the same
+match) are wired into `SortMergeJoinExec`; semi/anti membership is
+available here (`distributed_semi_anti_indices`) but the engine's
+semi/anti branch runs before bucketed execution, so it is exercised by
+tests and the driver dryrun, not yet routed from the planner.
 
 When bucket counts differ (the ranker's fallback), `rebucket` routes the
 smaller side through the build pipeline's all_to_all to the larger side's
@@ -33,16 +47,21 @@ import hyperspace_tpu._jax_config  # noqa: F401
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io.columnar import ColumnBatch, unify_string_columns
 from hyperspace_tpu.ops import keys as keymod
-from hyperspace_tpu.ops.bucketed_join import _padded_layout, next_pow2
-from hyperspace_tpu.parallel.mesh import SHARD_AXIS, replicated, shard_rows
+from hyperspace_tpu.parallel.mesh import SHARD_AXIS, shard_rows
 
-_I32_MAX = np.int32(np.iinfo(np.int32).max)
+# Mesh-path skew guard: if the [S, C] layout would materially out-size the
+# true row count (one shard owns a dominant hot bucket), stay single-chip
+# where the flat counting join's memory is bounded by the actual rows.
+SKEW_MIN_CELLS = 1 << 20
+SKEW_BLOWUP_FACTOR = 4
 
 
 def _side_lanes(left: ColumnBatch, right: ColumnBatch,
                 left_keys: Sequence[str], right_keys: Sequence[str]):
     """Per-key 32-bit lane pairs plus per-row key validity for both sides
-    (the shared decomposition, `ops/keys.py` — no cross-side encode)."""
+    (the shared decomposition, `ops/keys.py` — no cross-side encode).
+    Returned as HOST numpy arrays: the shard layout is gathered on the
+    host so each device receives only its slice."""
     import jax.numpy as jnp
 
     if len(left_keys) != len(right_keys) or not left_keys:
@@ -50,8 +69,8 @@ def _side_lanes(left: ColumnBatch, right: ColumnBatch,
     n, m = left.num_rows, right.num_rows
     l_lanes: List = []
     r_lanes: List = []
-    l_ok = jnp.ones(n, dtype=bool)
-    r_ok = jnp.ones(m, dtype=bool)
+    l_ok = np.ones(n, dtype=bool)
+    r_ok = np.ones(m, dtype=bool)
     for lk, rk in zip(left_keys, right_keys):
         lcol, rcol = left.column(lk), right.column(rk)
         if lcol.is_string != rcol.is_string:
@@ -59,55 +78,117 @@ def _side_lanes(left: ColumnBatch, right: ColumnBatch,
         if lcol.is_string:
             lcol, rcol = unify_string_columns(lcol, rcol)
         if lcol.validity is not None:
-            l_ok = l_ok & lcol.validity
+            l_ok &= np.asarray(lcol.validity)
         if rcol.validity is not None:
-            r_ok = r_ok & rcol.validity
+            r_ok &= np.asarray(rcol.validity)
         ldata, rdata = lcol.data, rcol.data
         if ldata.dtype != rdata.dtype:
             common = jnp.promote_types(ldata.dtype, rdata.dtype)
             ldata = ldata.astype(common)
             rdata = rdata.astype(common)
         for ll, rl in zip(keymod.key_lanes(ldata), keymod.key_lanes(rdata)):
-            l_lanes.append(ll)
-            r_lanes.append(rl)
-    return tuple(l_lanes), tuple(r_lanes), l_ok, r_ok
+            l_lanes.append(np.asarray(ll))
+            r_lanes.append(np.asarray(rl))
+    return l_lanes, r_lanes, l_ok, r_ok
 
 
-@partial(__import__("jax").jit, static_argnames=("left_outer",))
-def _dist_match_core(l_lanes, r_lanes, l_ok, r_ok, l_idx, l_valid, r_idx,
-                     r_valid, left_outer: bool = False):
-    """Shard-local per-bucket match over the combined [B, Ll+Lr] layout.
+def shard_layout(lengths, n_shards: int):
+    """Host-side [S, C] gather plan into a concat-in-bucket-order array:
+    shard s's slots are the rows of its buckets (b % S == s) in bucket
+    order; C = the largest shard's row count. Padding slots point at row
+    0 and are masked invalid."""
+    lengths = np.asarray(lengths, dtype=np.int64)
+    B = len(lengths)
+    starts = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+    shard_rows_list = []
+    for s in range(n_shards):
+        owned = np.arange(s, B, n_shards)
+        if len(owned) == 0 or lengths[owned].sum() == 0:
+            shard_rows_list.append(np.zeros(0, dtype=np.int64))
+            continue
+        shard_rows_list.append(np.concatenate(
+            [np.arange(starts[b], starts[b] + lengths[b]) for b in owned
+             if lengths[b] > 0]))
+    C = max(1, max(len(r) for r in shard_rows_list))
+    idx = np.zeros((n_shards, C), dtype=np.int32)
+    valid = np.zeros((n_shards, C), dtype=bool)
+    for s, rows in enumerate(shard_rows_list):
+        idx[s, :len(rows)] = rows
+        valid[s, :len(rows)] = True
+    return idx, valid, C
 
-    Per bucket: gather both sides' key lanes, ONE stable sort by
-    (pad, null, *lanes, side, slot), adjacent-difference group ids (null
-    keys force their own group, so they never match), then per-element
-    right-run brackets via a composite (id, side) searchsorted. Every op
-    after the gathers is batched over the bucket axis — sharded over the
-    mesh with zero collectives.
 
-    Returns (counts [B*T], starts [B*T], rlo [B, T], rcnt [B, T],
-    pos_sorted [B, T]) for `_dist_expand_core`.
+def shard_skew(l_lengths, r_lengths, n_shards: int) -> bool:
+    """True when hot-bucket skew would blow the [S, C] layout up far past
+    the true row count — route single-chip instead."""
+    l_lengths = np.asarray(l_lengths, dtype=np.int64)
+    r_lengths = np.asarray(r_lengths, dtype=np.int64)
+    B = len(l_lengths)
+    owned = [np.arange(s, B, n_shards) for s in range(n_shards)]
+    cl = max(1, max(int(l_lengths[o].sum()) for o in owned))
+    cr = max(1, max(int(r_lengths[o].sum()) for o in owned))
+    cells = n_shards * (cl + cr)
+    rows = int(l_lengths.sum() + r_lengths.sum())
+    return (cells > SKEW_MIN_CELLS
+            and cells > SKEW_BLOWUP_FACTOR * max(rows, 1))
+
+
+def _sharded_inputs(left, right, l_lengths, r_lengths, left_keys,
+                    right_keys, mesh):
+    """Build the sharded [S, T] match inputs (T = Cl + Cr): combined key
+    lanes, pad mask, null mask, plus the [S, Cl]/[S, Cr] row-index plans.
+    Everything is gathered host-side from the 1-D lanes and device_put
+    with the sharded spec — per-device bytes ~ T, not total rows."""
+    import jax
+
+    n_shards = mesh.shape[SHARD_AXIS]
+    l_lanes, r_lanes, l_ok, r_ok = _side_lanes(left, right, left_keys,
+                                               right_keys)
+    l_idx, l_valid, Cl = shard_layout(l_lengths, n_shards)
+    r_idx, r_valid, Cr = shard_layout(r_lengths, n_shards)
+
+    lanes2d = tuple(np.concatenate([ll[l_idx], rl[r_idx]], axis=1)
+                    for ll, rl in zip(l_lanes, r_lanes))
+    pad = np.concatenate([~l_valid, ~r_valid], axis=1)
+    null = np.concatenate([l_valid & ~l_ok[l_idx],
+                           r_valid & ~r_ok[r_idx]], axis=1)
+
+    # device_put STRAIGHT from numpy: jnp.asarray would materialize the
+    # full array on the default device first, defeating the per-device
+    # memory bound; device_put(host_array, sharding) transfers each
+    # device only its slice.
+    sharding = shard_rows(mesh)
+    put = partial(jax.device_put, device=sharding)
+    return (tuple(put(x) for x in lanes2d), put(pad), put(null),
+            put(l_idx), put(r_idx), Cl, Cr)
+
+
+@partial(__import__("jax").jit, static_argnames=("Cl", "left_outer",
+                                                 "need_right"))
+def _shard_match_core(lanes2d, pad, null, Cl: int, left_outer: bool,
+                      need_right: bool):
+    """Shard-local counting match over the combined [S, T] layout.
+
+    Per shard row: ONE stable sort by (pad, null, *lanes, side, slot),
+    group runs from adjacent differences (null/pad break every run), and
+    per-element right-run brackets from cumulative sums — the counting
+    design, no searchsorted. Every op is elementwise or axis-1 over the
+    sharded [S, T] arrays, so XLA keeps it chip-local.
+
+    Returns (flat counts [S*T], starts [S*T], rights [S, T], rstart
+    [S, T], pos_s [S, T], right_unmatched [S, T] or None).
     """
     import jax
     import jax.numpy as jnp
 
-    B, Ll = l_idx.shape
-    Lr = r_idx.shape[1]
-    T = Ll + Lr
-
-    pad = jnp.concatenate([~l_valid, ~r_valid], axis=1).astype(jnp.int32)
-    null = jnp.concatenate(
-        [jnp.where(l_valid, ~jnp.take(l_ok, l_idx), False),
-         jnp.where(r_valid, ~jnp.take(r_ok, r_idx), False)],
-        axis=1).astype(jnp.int32)
+    S, T = pad.shape
     side = jnp.broadcast_to(
-        jnp.concatenate([jnp.zeros(Ll, jnp.int32),
-                         jnp.ones(Lr, jnp.int32)]), (B, T))
-    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
-    lanes2d = [jnp.concatenate([jnp.take(ll, l_idx), jnp.take(rl, r_idx)],
-                               axis=1)
-               for ll, rl in zip(l_lanes, r_lanes)]
-    results = jax.lax.sort([pad, null, *lanes2d, side, pos],
+        jnp.concatenate([jnp.zeros(Cl, jnp.int32),
+                         jnp.ones(T - Cl, jnp.int32)]), (S, T))
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (S, T))
+    pad_i = pad.astype(jnp.int32)
+    null_i = null.astype(jnp.int32)
+    results = jax.lax.sort([pad_i, null_i, *lanes2d, side, pos],
                            num_keys=3 + len(lanes2d), is_stable=True,
                            dimension=1)
     pad_s, null_s = results[0], results[1]
@@ -115,55 +196,73 @@ def _dist_match_core(l_lanes, r_lanes, l_ok, r_ok, l_idx, l_valid, r_idx,
     side_s = results[-2]
     pos_s = results[-1]
 
-    differs = jnp.ones((B, 1), dtype=jnp.int32)
-    rest = jnp.zeros((B, T - 1), dtype=jnp.int32)
+    first = jnp.ones((S, 1), dtype=bool)
+    rest = jnp.zeros((S, T - 1), dtype=bool)
     for k in lanes_s:
-        rest = rest | (k[:, 1:] != k[:, :-1]).astype(jnp.int32)
-    # Null-key elements never share a group with anything.
-    rest = rest | null_s[:, 1:] | null_s[:, :-1]
-    rest = rest | pad_s[:, 1:] | pad_s[:, :-1]
-    ids = jnp.cumsum(jnp.concatenate([differs, rest], axis=1),
-                     axis=1, dtype=jnp.int32)
+        rest = rest | (k[:, 1:] != k[:, :-1])
+    # Null-key and pad elements never share a run with anything.
+    rest = rest | (null_s[:, 1:] | null_s[:, :-1]
+                   | pad_s[:, 1:] | pad_s[:, :-1]).astype(bool)
+    run_start = jnp.concatenate([first, rest], axis=1)
 
-    # Right-run bracket per element: composite (id, side) is sorted within
-    # each bucket row because side is a trailing sort key.
-    composite = ids * 2 + side_s
-    want = ids * 2 + 1
-    rlo = jax.vmap(lambda c, w: jnp.searchsorted(c, w, side="left"))(
-        composite, want)
-    rhi = jax.vmap(lambda c, w: jnp.searchsorted(c, w, side="right"))(
-        composite, want)
-    rcnt = rhi - rlo
+    posT = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (S, T))
+    run_first = jax.lax.cummax(jnp.where(run_start, posT, 0), axis=1)
+    nxt = jnp.flip(jax.lax.cummin(jnp.flip(
+        jnp.where(run_start, posT, jnp.int32(T)), axis=1), axis=1), axis=1)
+    run_last = jnp.concatenate(
+        [nxt[:, 1:], jnp.full((S, 1), T, jnp.int32)], axis=1) - 1
+
+    R = jnp.cumsum(side_s, axis=1)  # inclusive right-element count
+    take = jnp.take_along_axis
+    rights = (take(R, run_last, axis=1) - take(R, run_first, axis=1)
+              + take(side_s, run_first, axis=1))
+    rstart = run_last - rights + 1  # first right element of the run
 
     is_left = (side_s == 0) & (pad_s == 0)
     matchable = is_left & (null_s == 0)
-    counts = jnp.where(matchable, rcnt, 0)
+    counts = jnp.where(matchable, rights, 0)
     if left_outer:
-        # Every REAL left element (incl. null keys) emits at least one row.
+        # Every REAL left element (incl. null keys) emits at least once.
         counts = jnp.maximum(counts, is_left.astype(counts.dtype))
     flat = counts.reshape(-1)
     starts = jnp.cumsum(flat) - flat
-    return flat, starts, rlo, jnp.where(matchable, rcnt, 0), pos_s
+
+    right_unmatched = None
+    if need_right:
+        run_len = run_last - run_first + 1
+        lefts = run_len - rights
+        right_unmatched = ((side_s == 1) & (pad_s == 0)
+                           & ((null_s == 1) | (lefts == 0)))
+    return flat, starts, jnp.where(matchable, rights, 0), rstart, pos_s, \
+        right_unmatched
 
 
-@partial(__import__("jax").jit, static_argnames=("total", "T", "Ll"))
-def _dist_expand_core(starts, rcnt, rlo, pos_s, l_idx, r_idx,
-                      total: int, T: int, Ll: int):
-    """Expand (bucket, sorted slot, offset) -> original row index pairs;
+@partial(__import__("jax").jit, static_argnames=("total", "T", "Cl"))
+def _shard_expand_core(starts, rights, rstart, pos_s, l_idx, r_idx,
+                       total: int, T: int, Cl: int):
+    """Expand (shard, sorted slot, offset) -> original row index pairs;
     slots with zero true matches (left_outer reservations) emit right -1."""
     import jax.numpy as jnp
 
+    S = pos_s.shape[0]
+    pos_f = pos_s.reshape(-1)
+    rights_f = rights.reshape(-1)
+    rstart_f = rstart.reshape(-1)
+    l_idx_f = l_idx.reshape(-1)
+    r_idx_f = r_idx.reshape(-1)
+    Cr = T - Cl
+
     slots = jnp.arange(total, dtype=starts.dtype)
     row = jnp.searchsorted(starts, slots, side="right") - 1
-    b = (row // T).astype(jnp.int32)
-    j = (row % T).astype(jnp.int32)
+    s = (row // T).astype(jnp.int32)
     offset = (slots - jnp.take(starts, row)).astype(jnp.int32)
-    l_slot = pos_s[b, j]
-    li = l_idx[b, l_slot]
-    matched = offset < rcnt[b, j]
-    r_sorted_idx = jnp.clip(rlo[b, j] + offset, 0, T - 1)
-    r_slot = pos_s[b, r_sorted_idx] - Ll
-    ri = jnp.where(matched, r_idx[b, jnp.clip(r_slot, 0, None)],
+    l_slot = jnp.take(pos_f, row)  # combined-slot position of the left el
+    li = jnp.take(l_idx_f, s * Cl + l_slot)
+    matched = offset < jnp.take(rights_f, row)
+    r_sorted = jnp.clip(jnp.take(rstart_f, row) + offset, 0, T - 1)
+    r_slot = jnp.take(pos_f, s * T + r_sorted) - Cl
+    ri = jnp.where(matched,
+                   jnp.take(r_idx_f, s * Cr + jnp.clip(r_slot, 0, None)),
                    jnp.int32(-1))
     return li, ri
 
@@ -173,54 +272,105 @@ def distributed_bucketed_join_indices(
         l_lengths: np.ndarray, r_lengths: np.ndarray,
         left_keys: Sequence[str], right_keys: Sequence[str], mesh,
         how: str = "inner") -> Tuple:
-    """As `ops.bucketed_join.bucketed_join_indices`, but with the padded
-    [B, T] forms sharded over the mesh's bucket axis and the group encode
-    computed per bucket (shard-local — no replicated global sort).
-    Requires num_buckets divisible by the mesh size (the bucket<->shard
-    map). `how` is inner or left_outer (callers swap sides for
-    right_outer)."""
-    import jax
+    """As `ops.bucketed_join.bucketed_join_indices`, over rows sharded by
+    bucket ownership: each shard matches ONLY its buckets' rows, with no
+    replicated key lanes. `how` is inner / left_outer / full_outer
+    (callers swap sides for right_outer). Requires num_buckets divisible
+    by the mesh size (the bucket<->shard map)."""
     import jax.numpy as jnp
 
-    if how not in ("inner", "left_outer"):
+    if how not in ("inner", "left_outer", "full_outer"):
         raise HyperspaceException(
-            f"Distributed bucketed join supports inner/left_outer; "
-            f"got {how}.")
+            f"Distributed bucketed join supports inner/left_outer/"
+            f"full_outer; got {how}.")
     num_buckets = len(l_lengths)
     n_shards = mesh.shape[SHARD_AXIS]
     if num_buckets % n_shards != 0:
         raise ValueError(
             f"num_buckets ({num_buckets}) must be divisible by mesh size "
             f"({n_shards}).")
-
-    l_lanes, r_lanes, l_ok, r_ok = _side_lanes(left, right, left_keys,
-                                               right_keys)
-    Ll = next_pow2(max(1, int(np.asarray(l_lengths).max(initial=0))))
-    Lr = next_pow2(max(1, int(np.asarray(r_lengths).max(initial=0))))
-    l_idx, l_valid = _padded_layout(np.asarray(l_lengths), Ll)
-    r_idx, r_valid = _padded_layout(np.asarray(r_lengths), Lr)
-
-    bucket_sharding = shard_rows(mesh)   # shard the bucket axis
-    repl = replicated(mesh)
-    put = jax.device_put
-    l_idx = put(jnp.asarray(l_idx), bucket_sharding)
-    l_valid = put(jnp.asarray(l_valid), bucket_sharding)
-    r_idx = put(jnp.asarray(r_idx), bucket_sharding)
-    r_valid = put(jnp.asarray(r_valid), bucket_sharding)
-    l_lanes = tuple(put(x, repl) for x in l_lanes)
-    r_lanes = tuple(put(x, repl) for x in r_lanes)
-    l_ok = put(l_ok, repl)
-    r_ok = put(r_ok, repl)
-
-    counts, starts, rlo, rcnt, pos_s = _dist_match_core(
-        l_lanes, r_lanes, l_ok, r_ok, l_idx, l_valid, r_idx, r_valid,
-        left_outer=(how == "left_outer"))
-    total = int(jnp.sum(counts))
-    if total == 0:
+    n, m = left.num_rows, right.num_rows
+    if n == 0 or m == 0:
+        # Degenerate sides never reach the mesh (the single-chip path
+        # guards these too, `ops/bucketed_join.py`): inner with any empty
+        # side is empty; outer expansions are pure index arithmetic.
         empty = jnp.zeros(0, dtype=jnp.int32)
-        return empty, empty
-    return _dist_expand_core(starts, rcnt, rlo, pos_s, l_idx, r_idx,
-                             total, Ll + Lr, Ll)
+        li = (jnp.arange(n, dtype=jnp.int32)
+              if how in ("left_outer", "full_outer") else empty)
+        ri = jnp.full(li.shape[0], -1, dtype=jnp.int32)
+        if how == "full_outer" and m > 0:
+            li = jnp.concatenate([li, jnp.full(m, -1, dtype=jnp.int32)])
+            ri = jnp.concatenate([ri, jnp.arange(m, dtype=jnp.int32)])
+        return li, ri
+
+    lanes2d, pad, null, l_idx, r_idx, Cl, Cr = _sharded_inputs(
+        left, right, l_lengths, r_lengths, left_keys, right_keys, mesh)
+    full_outer = how == "full_outer"
+    counts, starts, rights, rstart, pos_s, right_unmatched = \
+        _shard_match_core(lanes2d, pad, null, Cl,
+                          left_outer=how in ("left_outer", "full_outer"),
+                          need_right=full_outer)
+    total = int(jnp.sum(counts))  # the one host sync sizing the output
+    empty = jnp.zeros(0, dtype=jnp.int32)
+    if total == 0:
+        li, ri = empty, empty
+    else:
+        li, ri = _shard_expand_core(starts, rights, rstart, pos_s, l_idx,
+                                    r_idx, total, Cl + Cr, Cl)
+    if full_outer:
+        extra = int(jnp.sum(right_unmatched))  # second host sync
+        if extra:
+            (rows,) = jnp.nonzero(right_unmatched.reshape(-1), size=extra,
+                                  fill_value=0)
+            T = Cl + Cr
+            s = (rows // T).astype(jnp.int32)
+            r_slot = jnp.take(pos_s.reshape(-1), rows) - Cl
+            r_orig = jnp.take(r_idx.reshape(-1),
+                              s * Cr + jnp.clip(r_slot, 0, None))
+            li = jnp.concatenate(
+                [li, jnp.full(extra, -1, dtype=jnp.int32)])
+            ri = jnp.concatenate([ri, r_orig.astype(jnp.int32)])
+    return li, ri
+
+
+def distributed_semi_anti_indices(
+        left: ColumnBatch, right: ColumnBatch,
+        l_lengths: np.ndarray, r_lengths: np.ndarray,
+        left_keys: Sequence[str], right_keys: Sequence[str], mesh,
+        anti: bool = False):
+    """Left-row indices for LEFT SEMI / LEFT ANTI over co-bucketed sides,
+    sharded by bucket ownership (anti emits null-key left rows — NOT
+    EXISTS semantics, mirroring `ops/join.semi_anti_indices`)."""
+    import jax.numpy as jnp
+
+    num_buckets = len(l_lengths)
+    n_shards = mesh.shape[SHARD_AXIS]
+    if num_buckets % n_shards != 0:
+        raise ValueError(
+            f"num_buckets ({num_buckets}) must be divisible by mesh size "
+            f"({n_shards}).")
+    if left.num_rows == 0:
+        return jnp.zeros(0, dtype=jnp.int32)
+    if right.num_rows == 0:
+        return (jnp.arange(left.num_rows, dtype=jnp.int32) if anti
+                else jnp.zeros(0, dtype=jnp.int32))
+    lanes2d, pad, null, l_idx, r_idx, Cl, Cr = _sharded_inputs(
+        left, right, l_lengths, r_lengths, left_keys, right_keys, mesh)
+    counts, _starts, rights, _rstart, pos_s, _ = _shard_match_core(
+        lanes2d, pad, null, Cl, left_outer=True, need_right=False)
+    counts2d = counts.reshape(pos_s.shape)
+    is_left = counts2d > 0  # left_outer counting marks exactly left slots
+    hit = is_left & ((rights == 0) if anti else (rights > 0))
+    want = hit.reshape(-1)
+    total = int(jnp.sum(want))  # host sync
+    if total == 0:
+        return jnp.zeros(0, dtype=jnp.int32)
+    (rows,) = jnp.nonzero(want, size=total, fill_value=0)
+    T = Cl + Cr
+    s = (rows // T).astype(jnp.int32)
+    l_slot = jnp.take(pos_s.reshape(-1), rows)
+    li = jnp.take(l_idx.reshape(-1), s * Cl + l_slot)
+    return li.astype(jnp.int32)
 
 
 def rebucket(batch: ColumnBatch, key_columns: Sequence[str],

@@ -39,6 +39,13 @@ DEVICE_CACHE_BYTES_KEY = "spark.hyperspace.cache.device.bytes"
 BROADCAST_THRESHOLD = "spark.hyperspace.broadcast.threshold"
 BROADCAST_THRESHOLD_DEFAULT = 10 * 1024 * 1024
 
+# Object-store OCC: backends with no create precondition (neither GCS
+# generation match nor S3 conditional put nor atomic exclusive create)
+# make write_log RAISE, because check-then-create corrupts the op log
+# under concurrency — unless this conf explicitly accepts single-writer
+# semantics.
+SINGLE_WRITER = "spark.hyperspace.single.writer"
+
 HYBRID_SCAN_ENABLED = "spark.hyperspace.index.hybridscan.enabled"
 
 # Per-row lineage (extension; the reference's v0.2 direction): when enabled

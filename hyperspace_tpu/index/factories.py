@@ -11,8 +11,8 @@ from hyperspace_tpu.index.log_manager import IndexLogManager, IndexLogManagerImp
 
 
 class IndexLogManagerFactory:
-    def create(self, index_path: str) -> IndexLogManager:
-        return IndexLogManagerImpl(index_path)
+    def create(self, index_path: str, conf=None) -> IndexLogManager:
+        return IndexLogManagerImpl(index_path, conf=conf)
 
 
 class IndexDataManagerFactory:

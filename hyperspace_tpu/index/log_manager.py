@@ -49,11 +49,22 @@ class IndexLogManager(ABC):
 
 
 class IndexLogManagerImpl(IndexLogManager):
-    """Filesystem-backed impl (reference `index/IndexLogManager.scala:56-157`)."""
+    """Filesystem-backed impl (reference `index/IndexLogManager.scala:56-157`).
 
-    def __init__(self, index_path: str):
+    `conf` (optional) carries `spark.hyperspace.single.writer`: on object
+    stores with no create precondition, write_log RAISES unless that conf
+    explicitly accepts check-then-create semantics."""
+
+    def __init__(self, index_path: str, conf=None):
         self.index_path = index_path
         self.log_dir = os.path.join(index_path, constants.HYPERSPACE_LOG)
+        self.conf = conf
+
+    def _single_writer(self) -> bool:
+        if self.conf is None:
+            return False
+        return (self.conf.get(constants.SINGLE_WRITER, "false")
+                or "false").lower() == "true"
 
     def _path_for(self, log_id: int) -> str:
         return os.path.join(self.log_dir, str(log_id))
@@ -136,5 +147,6 @@ class IndexLogManagerImpl(IndexLogManager):
         if file_utils.exists(self._path_for(log_id)):
             return False
         entry.id = log_id
-        return file_utils.atomic_write_if_absent(self._path_for(log_id),
-                                                 entry.to_json(indent=2))
+        return file_utils.atomic_write_if_absent(
+            self._path_for(log_id), entry.to_json(indent=2),
+            single_writer=self._single_writer())

@@ -37,7 +37,9 @@ logger = logging.getLogger(__name__)
 
 @dataclass
 class IndexSummary:
-    """Catalog row (reference `IndexCollectionManager.scala:151-173`)."""
+    """Catalog row (reference `IndexCollectionManager.scala:151-173`),
+    including the source plan's pretty string (`queryPlan` — the field
+    round 3 omitted)."""
 
     name: str
     indexed_columns: List[str]
@@ -45,6 +47,7 @@ class IndexSummary:
     num_buckets: int
     schema_json: str
     index_location: str
+    query_plan: str
     state: str
 
     def to_dict(self) -> dict:
@@ -55,8 +58,21 @@ class IndexSummary:
             "numBuckets": self.num_buckets,
             "schema": self.schema_json,
             "indexLocation": self.index_location,
+            "queryPlan": self.query_plan,
             "state": self.state,
         }
+
+
+def _pretty_plan(entry: IndexLogEntry) -> str:
+    """Pretty string of the LOGGED source plan (reference stores
+    `df.queryExecution.optimizedPlan.toString`,
+    `IndexCollectionManager.scala:151-173`). The log keeps the serialized
+    logical IR; a corrupt/unparseable record degrades to empty rather
+    than failing the whole catalog listing."""
+    try:
+        return entry.plan().tree_string()
+    except Exception:
+        return ""
 
 
 class IndexManager(ABC):
@@ -102,7 +118,7 @@ class IndexCollectionManager(IndexManager):
 
     def _managers(self, index_name: str):
         path = self.path_resolver.get_index_path(index_name)
-        return (self.log_manager_factory.create(path),
+        return (self.log_manager_factory.create(path, conf=self.conf),
                 self.data_manager_factory.create(path))
 
     def create(self, df, index_config: IndexConfig) -> None:
@@ -156,6 +172,7 @@ class IndexCollectionManager(IndexManager):
                 num_buckets=entry.num_buckets,
                 schema_json=entry.schema_json,
                 index_location=entry.content.root,
+                query_plan=_pretty_plan(entry),
                 state=entry.state))
         return out
 
@@ -176,7 +193,8 @@ class IndexCollectionManager(IndexManager):
             index_path = storage.join(root, name)
             if not file_utils.is_dir(index_path):
                 continue
-            log_manager = self.log_manager_factory.create(index_path)
+            log_manager = self.log_manager_factory.create(index_path,
+                                                          conf=self.conf)
             try:
                 entry = log_manager.get_latest_log()
             except HyperspaceException as exc:

@@ -18,9 +18,9 @@ SQL null semantics ride shared sentinels: left-null id -1, right-null id
 
 The host lane keeps the per-bucket merge over the already-sorted index
 layout (`ops/join.host_bucketed_join_indices` / the native C++ kernel);
-the padded-layout helpers below remain for the mesh-sharded distributed
-join (`parallel/join.py`) and compaction (`ops/merge.py`), which shard
-the bucket axis.
+the padded-layout helpers below (`next_pow2`, `_padded_layout`) serve
+merge compaction (`ops/merge.py`) — the mesh-sharded distributed join
+(`parallel/join.py`) builds its own [S, C] shard layout since round 4.
 """
 
 from __future__ import annotations

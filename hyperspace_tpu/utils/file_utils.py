@@ -124,7 +124,8 @@ def load_byte_array(path: str) -> bytes:
         return f.read()
 
 
-def atomic_write_if_absent(path: str, contents: str) -> bool:
+def atomic_write_if_absent(path: str, contents: str,
+                           single_writer: bool = False) -> bool:
     """Write `contents` to `path` only if `path` does not already exist.
 
     This is the op log's optimistic-concurrency primitive: the reference
@@ -133,19 +134,27 @@ def atomic_write_if_absent(path: str, contents: str) -> bool:
     POSIX rename overwrites, so the atomic publish here is `os.link` (hard
     link creation fails with EEXIST if the target exists) with an
     O_CREAT|O_EXCL fallback for filesystems without hard links. URL paths
-    use fsspec exclusive create (`storage.py` documents which backends
-    make that a true generation precondition).
+    go through `storage.exclusive_create`, which uses each backend's REAL
+    create precondition (GCS generation match, S3 conditional put) and
+    RAISES on backends that have none — unless `single_writer` (the
+    `spark.hyperspace.single.writer` conf) explicitly accepts
+    check-then-create semantics.
     Returns True iff this caller won the write.
     """
     if storage.is_url(path):
-        fs, real = storage.get_fs(path)
-        fs.makedirs(os.path.dirname(real), exist_ok=True)
+        from hyperspace_tpu.exceptions import HyperspaceException
         try:
-            with fs.open(real, "xb") as f:
+            return storage.exclusive_create(path, contents.encode("utf-8"))
+        except storage.PreconditionUnsupported as exc:
+            if not single_writer:
+                raise HyperspaceException(str(exc)) from exc
+            fs, real = storage.get_fs(path)
+            fs.makedirs(os.path.dirname(real), exist_ok=True)
+            if fs.exists(real):
+                return False
+            with fs.open(real, "wb") as f:
                 f.write(contents.encode("utf-8"))
             return True
-        except FileExistsError:
-            return False
     create_directory(os.path.dirname(path))
     tmp = path + ".temp" + uuid.uuid4().hex
     with open(tmp, "w", encoding="utf-8") as f:

@@ -8,17 +8,23 @@ implementations and anything with a `scheme://` routes through fsspec
 imports fsspec.
 
 OCC without rename (SURVEY hard part #5): the op log's write-if-absent
-maps to fsspec exclusive create (mode "xb"). Local and memory filesystems
-enforce it atomically; object-store backends are atomic exactly when the
-backend implements a create precondition (GCS `ifGenerationMatch`,
-S3 `If-None-Match`) — backends without one degrade to check-then-create,
-which is safe for single-writer deployments only.
+routes through `exclusive_create`, which dispatches per backend to a REAL
+create precondition — GCS `if_generation_match=0`, S3 conditional put
+(`If-None-Match: *`), exclusive-create mode for local/memory filesystems
+(atomic there). Backends with no enforceable precondition RAISE
+`PreconditionUnsupported` instead of silently degrading; callers may
+degrade to check-then-create only under an explicit
+`spark.hyperspace.single.writer=true` conf (`file_utils.py`).
 """
 
 from __future__ import annotations
 
 import posixpath
 from typing import List, Tuple
+
+
+class PreconditionUnsupported(Exception):
+    """The backend cannot enforce an atomic create-if-absent."""
 
 
 def is_url(path: str) -> bool:
@@ -52,6 +58,94 @@ def canonical(path: str) -> str:
     if is_url(path):
         return path
     return os.path.abspath(path)
+
+
+# Protocols whose fsspec "x" (exclusive-create) mode is genuinely atomic:
+# local files use O_CREAT|O_EXCL; the in-process memory fs is serialized
+# by the interpreter. Object stores are NOT in this set — their "x" mode
+# is check-then-create (two racy calls), so they need a server-side
+# precondition instead.
+_ATOMIC_X_PROTOCOLS = {"file", "local", "memory"}
+
+
+def _protocols(fs) -> set:
+    proto = getattr(fs, "protocol", ())
+    return {proto} if isinstance(proto, str) else set(proto)
+
+
+def _is_precondition_failure(exc: Exception) -> bool:
+    """Lost-the-race signatures across backends: GCS/S3 surface HTTP 412
+    (PreconditionFailed); some wrappers raise FileExistsError directly.
+    Typed status attributes are checked before any text matching so an
+    unrelated error whose message merely CONTAINS such a string (request
+    ids, byte counts) is re-raised, not misread as a lost race."""
+    if isinstance(exc, FileExistsError):
+        return True
+    for attr in ("code", "status", "status_code"):
+        if getattr(exc, attr, None) == 412:
+            return True
+    response = getattr(exc, "response", None)  # botocore ClientError shape
+    if isinstance(response, dict):
+        meta = response.get("ResponseMetadata") or {}
+        error = response.get("Error") or {}
+        if (meta.get("HTTPStatusCode") == 412
+                or error.get("Code") in ("PreconditionFailed", "412")):
+            return True
+    compact = f"{type(exc).__name__}{exc}".replace(" ", "").lower()
+    return "preconditionfailed" in compact
+
+
+def exclusive_create(path: str, data: bytes) -> bool:
+    """Create `path` with `data` only if it does not exist, using a true
+    backend precondition. Returns True iff this caller created it; False
+    when a concurrent (or earlier) writer won. Raises
+    `PreconditionUnsupported` when the backend offers no atomic create —
+    silent check-then-create here would corrupt the op log's OCC
+    (reference `IndexLogManager.scala:139-156`)."""
+    import os
+
+    fs, real = get_fs(path)
+    fs.makedirs(posixpath.dirname(real) or os.path.dirname(real),
+                exist_ok=True)
+    protos = _protocols(fs)
+    if protos & {"gs", "gcs"}:
+        # GCS: generation 0 precondition = object must not exist.
+        try:
+            fs.pipe_file(real, data, if_generation_match=0)
+            return True
+        except TypeError as exc:
+            raise PreconditionUnsupported(
+                f"gcsfs on this system does not accept "
+                f"if_generation_match: {exc}")
+        except Exception as exc:
+            if _is_precondition_failure(exc):
+                return False
+            raise
+    if protos & {"s3", "s3a"}:
+        # S3 conditional put (If-None-Match: *), supported by AWS S3
+        # since 2024 and by MinIO.
+        try:
+            fs.pipe_file(real, data, IfNoneMatch="*")
+            return True
+        except TypeError as exc:
+            raise PreconditionUnsupported(
+                f"s3fs on this system does not accept IfNoneMatch: {exc}")
+        except Exception as exc:
+            if _is_precondition_failure(exc):
+                return False
+            raise
+    if protos & _ATOMIC_X_PROTOCOLS:
+        try:
+            with fs.open(real, "xb") as f:
+                f.write(data)
+            return True
+        except FileExistsError:
+            return False
+    raise PreconditionUnsupported(
+        f"Backend {sorted(protos)} has no atomic create-if-absent; "
+        "concurrent index operations could corrupt the operation log. "
+        "Set spark.hyperspace.single.writer=true to accept "
+        "check-then-create semantics for single-writer deployments.")
 
 
 def listdir_names(path: str) -> List[str]:

@@ -124,6 +124,10 @@ def test_index_lifecycle_and_catalog(env):
     hs.create_index(df, IndexConfig("lc", ["clicks"], ["id"]))
     cat = hs.indexes()
     assert list(cat["name"]) == ["lc"] and list(cat["state"]) == ["ACTIVE"]
+    # queryPlan carries the logged source plan's pretty string (reference
+    # `IndexCollectionManager.scala:151-173` — the round-3 gap).
+    assert "queryPlan" in cat.columns
+    assert "Scan" in cat["queryPlan"][0] and src in cat["queryPlan"][0]
 
     hs.delete_index("lc")
     assert list(hs.indexes()["state"]) == ["DELETED"]

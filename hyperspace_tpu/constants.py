@@ -66,6 +66,13 @@ DISTRIBUTION_ENABLED_DEFAULT = "auto"
 # Minimum row count before the sharded filter scan pays for itself.
 DISTRIBUTION_MIN_ROWS = "spark.hyperspace.distribution.min.rows"
 DISTRIBUTION_MIN_ROWS_DEFAULT = 4096
+# Multi-host topology: number of slices (DCN rows) in the mesh. 1 (the
+# default) = a flat single-axis ICI mesh; >1 builds a 2-axis
+# (dcn, shard) mesh whose build exchange routes hierarchically — the
+# heavy re-bucket all_to_all confined to the inner ICI axis, one
+# cross-slice hop over DCN (SURVEY §2.12 "DCN only across slices").
+DISTRIBUTION_DCN_SIZE = "spark.hyperspace.distribution.dcn.size"
+DISTRIBUTION_DCN_SIZE_DEFAULT = 1
 
 # XLA profiler integration: when set to a directory, every executed
 # query is captured as a profiler trace under it (one subdirectory per

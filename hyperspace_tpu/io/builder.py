@@ -199,6 +199,50 @@ def write_bucketed_table(table, indexed_columns: Sequence[str],
     return _write_sorted_runs(table, chunks, starts, ends, path, file_suffix)
 
 
+def write_bucketed_from_files(files: Sequence[str],
+                              column_names: Sequence[str],
+                              key_names: Sequence[str], num_buckets: int,
+                              path: str, lineage_ids=None,
+                              file_suffix: Optional[str] = None
+                              ) -> List[str]:
+    """PIPELINED build straight from parquet files (the plain-scan create
+    path): decode only the KEY columns, dispatch the device permutation
+    (async — jax returns before the sort finishes), then decode the
+    payload columns WHILE the device sorts. On the decode-bound 1-core
+    host the sort and the key H2D ride along nearly free; the round-3
+    sequential pipeline (full decode -> sort -> write) paid them end to
+    end. Below the device-amortization row count this degrades to the
+    single-read host path."""
+    import pyarrow as pa
+
+    from hyperspace_tpu.ops.build import permutation_from_tree
+
+    key_table = parquet.read_table(files, columns=list(key_names))
+    n = key_table.num_rows
+    if n < BUILD_MIN_DEVICE_ROWS:
+        table = parquet.read_table(files, columns=list(column_names))
+        if lineage_ids is not None:
+            table = append_lineage_column(table, files, lineage_ids)
+        return write_bucketed_table(table, list(key_names), num_buckets,
+                                    path, file_suffix=file_suffix)
+    tree = _stage_key_tree(key_table, key_names)
+    chunks, starts, ends = permutation_from_tree(tree, key_names, n,
+                                                 num_buckets)
+    payload_names = [c for c in column_names if c not in key_names]
+    if payload_names:
+        # Decoded while the device sorts.
+        ptable = parquet.read_table(files, columns=payload_names)
+        table = pa.table({c: (key_table.column(c) if c in key_names
+                              else ptable.column(c))
+                          for c in column_names})
+    else:
+        table = key_table.select(list(column_names))
+    if lineage_ids is not None:
+        table = append_lineage_column(table, files, lineage_ids)
+    return _write_sorted_runs(table, chunks, starts, ends, path,
+                              file_suffix)
+
+
 def write_bucketed_batch(batch: columnar.ColumnBatch,
                          indexed_columns: Sequence[str],
                          num_buckets: int, path: str,
@@ -321,18 +365,24 @@ def write_index(df, indexed_columns: Sequence[str],
     if source is not None:
         files, scan_schema = source
         names = [scan_schema.field(c).name for c in columns]
-        table = parquet.read_table(files, columns=names)
+        key_names = [scan_schema.field(c).name for c in indexed_columns]
         schema = scan_schema.select(columns)
         if lineage_ids is not None:
-            table = append_lineage_column(table, files, lineage_ids)
             schema = lineage_schema(schema)
-        mesh = should_distribute(conf, table.num_rows)
+        rows = sum(parquet.file_row_counts(files))  # footers only
+        mesh = should_distribute(conf, rows)
         if mesh is not None:
+            table = parquet.read_table(files, columns=names)
+            if lineage_ids is not None:
+                table = append_lineage_column(table, files, lineage_ids)
             written = build_distributed(mesh, columnar.from_arrow(table,
                                                                   schema))
         else:
-            written = write_bucketed_table(table, indexed_columns,
-                                           num_buckets, path)
+            # Pipelined: key decode -> async device sort -> payload
+            # decode overlapping the sort -> streamed bucket writes.
+            written = write_bucketed_from_files(
+                files, names, key_names, num_buckets, path,
+                lineage_ids=lineage_ids)
     else:
         batch = execute_plan(df.plan, projection=columns, conf=conf)
         schema = batch.schema

@@ -25,7 +25,7 @@ import numpy as np
 import hyperspace_tpu._jax_config  # noqa: F401
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io.columnar import ColumnBatch, DeviceColumn
-from hyperspace_tpu.parallel.mesh import SHARD_AXIS
+from hyperspace_tpu.parallel.mesh import total_shards
 from hyperspace_tpu.parallel.scan import shard_batch
 from hyperspace_tpu.plan.nodes import AggSpec
 from hyperspace_tpu.plan.schema import Schema
@@ -116,13 +116,16 @@ def make_partial_step(mesh, num_lanes: int, specs_meta, capacity: int):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    from hyperspace_tpu.parallel.mesh import row_spec
+    rows_spec = row_spec(mesh)
+
     def step(tree):
         body = partial(_shard_partials, num_lanes=num_lanes,
                        specs_meta=specs_meta, capacity=capacity)
         return shard_map(
             body, mesh=mesh,
-            in_specs=(jax.tree_util.tree_map(lambda _: P(SHARD_AXIS), tree),),
-            out_specs=P(SHARD_AXIS), check_vma=False)(tree)
+            in_specs=(jax.tree_util.tree_map(lambda _: rows_spec, tree),),
+            out_specs=rows_spec, check_vma=False)(tree)
 
     return jax.jit(step)
 
@@ -140,7 +143,7 @@ def distributed_group_aggregate(batch: ColumnBatch,
     if not group_columns:
         raise HyperspaceException(
             "distributed aggregation requires group columns")
-    n_shards = mesh.shape[SHARD_AXIS]
+    n_shards = total_shards(mesh)
     sharded, row_valid = shard_batch(batch, mesh)
 
     tree = {"valid": row_valid}

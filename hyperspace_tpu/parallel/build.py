@@ -38,20 +38,15 @@ from hyperspace_tpu.ops.build import _entry_sort_lanes, _tree_hash_lanes
 from hyperspace_tpu.parallel.mesh import SHARD_AXIS
 
 
-def _shard_step(tree, key_names: Tuple[str, ...], num_buckets: int,
-                n_shards: int, capacity: int):
-    """The per-shard body (runs under shard_map; local shapes)."""
+def _route_stage(tree, row_valid, bucket, dest, axis: str, n_peers: int,
+                 capacity: int):
+    """One routing exchange: sort local rows by `dest` peer, scatter into
+    a [n_peers, capacity] send buffer, all_to_all over the named mesh
+    `axis` (the collective is CONFINED to that axis's device groups).
+    Returns (routed tree, routed valid, routed bucket, overflow count) —
+    overflow rows are counted exactly, never silently dropped."""
     import jax
     import jax.numpy as jnp
-    from hyperspace_tpu.ops.hash_partition import flat_hash32
-
-    row_valid = tree["__valid__"]
-    lanes = []
-    for name in key_names:
-        lanes.extend(_tree_hash_lanes(tree[name]))
-    h = flat_hash32(lanes)  # the one shared hash identity
-    bucket = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
-    dest = jnp.where(row_valid, bucket % n_shards, jnp.int32(n_shards))
 
     n_local = dest.shape[0]
     iota = jnp.arange(n_local, dtype=jnp.int32)
@@ -59,29 +54,29 @@ def _shard_step(tree, key_names: Tuple[str, ...], num_buckets: int,
 
     # Slot within the destination segment.
     seg_start = jnp.searchsorted(
-        dest_sorted, jnp.arange(n_shards + 1, dtype=jnp.int32), side="left")
+        dest_sorted, jnp.arange(n_peers + 1, dtype=jnp.int32), side="left")
     offset = jnp.arange(n_local, dtype=jnp.int32) - jnp.take(
-        seg_start, jnp.clip(dest_sorted, 0, n_shards))
-    keep = (offset < capacity) & (dest_sorted < n_shards)
-    overflow = jnp.sum((offset >= capacity) & (dest_sorted < n_shards))
-    slot = jnp.where(keep, dest_sorted * capacity + offset, n_shards * capacity)
+        seg_start, jnp.clip(dest_sorted, 0, n_peers))
+    keep = (offset < capacity) & (dest_sorted < n_peers)
+    overflow = jnp.sum((offset >= capacity) & (dest_sorted < n_peers))
+    slot = jnp.where(keep, dest_sorted * capacity + offset,
+                     n_peers * capacity)
 
     def route(arr):
         src = jnp.take(arr, perm, axis=0)
-        buf_shape = (n_shards * capacity + 1,) + src.shape[1:]
+        buf_shape = (n_peers * capacity + 1,) + src.shape[1:]
         buf = jnp.zeros(buf_shape, dtype=src.dtype)
         buf = buf.at[slot].set(src, mode="drop")
-        send = buf[:n_shards * capacity].reshape(
-            (n_shards, capacity) + src.shape[1:])
-        return jax.lax.all_to_all(send, SHARD_AXIS, split_axis=0,
+        send = buf[:n_peers * capacity].reshape(
+            (n_peers, capacity) + src.shape[1:])
+        return jax.lax.all_to_all(send, axis, split_axis=0,
                                   concat_axis=0, tiled=False)
 
     routed = {}
     for name, entry in tree.items():
-        if name == "__valid__":
-            continue
         out = dict(entry)
-        out["data"] = route(entry["data"]).reshape(-1, *entry["data"].shape[1:])
+        out["data"] = route(entry["data"]).reshape(
+            -1, *entry["data"].shape[1:])
         if "validity" in entry:
             out["validity"] = route(entry["validity"]).reshape(-1)
         routed[name] = out
@@ -90,49 +85,107 @@ def _shard_step(tree, key_names: Tuple[str, ...], num_buckets: int,
     # applies the dest-sort permutation internally).
     recv_valid = route(row_valid).reshape(-1)
     recv_bucket = route(bucket).reshape(-1)
-    recv_bucket = jnp.where(recv_valid, recv_bucket, num_buckets)
+    return routed, recv_valid, recv_bucket, overflow
+
+
+def _stage_capacity(local_rows: int, n_peers: int,
+                    capacity_factor: float) -> int:
+    return max(16, int(local_rows / n_peers * capacity_factor))
+
+
+def _shard_step(tree, key_names: Tuple[str, ...], num_buckets: int,
+                n_ici: int, n_dcn: int, capacity_factor: float):
+    """The per-shard body (runs under shard_map; local shapes).
+
+    1-axis mesh (n_dcn == 1): one all_to_all routes each row to its
+    bucket's owner shard. 2-axis mesh: HIERARCHICAL routing — stage 1
+    moves rows to the owner's ICI position within the source slice
+    (all_to_all over the inner `shard` axis: rides ICI), stage 2 moves
+    them to the owner's slice (all_to_all over the outer `dcn` axis);
+    each stage changes exactly one mesh coordinate, so the flat owner
+    `bucket % (n_dcn * n_ici) = d * n_ici + i` is reached in two
+    axis-confined hops instead of one flat exchange."""
+    import jax.numpy as jnp
+    from hyperspace_tpu.ops.hash_partition import flat_hash32
+
+    row_valid = tree["__valid__"]
+    data_tree = {k: v for k, v in tree.items() if k != "__valid__"}
+    lanes = []
+    for name in key_names:
+        lanes.extend(_tree_hash_lanes(tree[name]))
+    h = flat_hash32(lanes)  # the one shared hash identity
+    bucket = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+    n_total = n_ici * n_dcn
+    owner = bucket % n_total
+    overflow = jnp.zeros((), dtype=jnp.int32)
+
+    # Stage 1 (ICI): to the owner's position within THIS slice.
+    dest1 = jnp.where(row_valid, owner % n_ici, jnp.int32(n_ici))
+    cap1 = _stage_capacity(dest1.shape[0], n_ici, capacity_factor)
+    data_tree, row_valid, bucket, ov = _route_stage(
+        data_tree, row_valid, bucket, dest1, SHARD_AXIS, n_ici, cap1)
+    overflow = overflow + ov
+
+    if n_dcn > 1:
+        # Stage 2 (DCN): to the owner slice, ICI position already final.
+        from hyperspace_tpu.parallel.mesh import DCN_AXIS
+        owner2 = (bucket % n_total) // n_ici
+        dest2 = jnp.where(row_valid, owner2, jnp.int32(n_dcn))
+        cap2 = _stage_capacity(dest2.shape[0], n_dcn, capacity_factor)
+        data_tree, row_valid, bucket, ov2 = _route_stage(
+            data_tree, row_valid, bucket, dest2, DCN_AXIS, n_dcn, cap2)
+        overflow = overflow + ov2
+
+    recv_bucket = jnp.where(row_valid, bucket, num_buckets)
 
     # Local order: (bucket, keys); invalid rows (bucket=num_buckets) last.
     operands = [recv_bucket]
     for name in key_names:
-        operands.extend(_entry_sort_lanes(routed[name]))
+        operands.extend(_entry_sort_lanes(data_tree[name]))
     m = recv_bucket.shape[0]
     iota2 = jnp.arange(m, dtype=jnp.int32)
+    import jax
     results = jax.lax.sort([*operands, iota2], num_keys=len(operands),
                            is_stable=True)
     perm2 = results[-1]
     sorted_bucket = results[0]
     out_tree = {}
-    for name, entry in routed.items():
+    for name, entry in data_tree.items():
         out = dict(entry)
         out["data"] = jnp.take(entry["data"], perm2, axis=0)
         if "validity" in entry:
             out["validity"] = jnp.take(entry["validity"], perm2, axis=0)
         out_tree[name] = out
-    out_tree["__valid__"] = {"data": jnp.take(recv_valid, perm2)}
+    out_tree["__valid__"] = {"data": jnp.take(row_valid, perm2)}
     out_tree["__bucket__"] = {"data": sorted_bucket}
     out_tree["__overflow__"] = {"data": overflow.reshape(1)}
     return out_tree
 
 
 def make_distributed_build_step(mesh, key_names: Tuple[str, ...],
-                                num_buckets: int, capacity: int):
-    """Compile the full mesh-sharded build step (jit of shard_map)."""
+                                num_buckets: int, capacity_factor: float):
+    """Compile the full mesh-sharded build step (jit of shard_map). On a
+    2-axis (dcn, shard) mesh the row axis shards over BOTH axes and the
+    body runs the hierarchical two-stage exchange."""
     import jax
-    from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
-    n_shards = mesh.shape[SHARD_AXIS]
+    from hyperspace_tpu.parallel.mesh import dcn_size, row_spec
+
+    n_ici = mesh.shape[SHARD_AXIS]
+    n_dcn = dcn_size(mesh)
+    rows_spec = row_spec(mesh)
 
     def spec_like(tree):
-        return jax.tree_util.tree_map(lambda _: P(SHARD_AXIS), tree)
+        return jax.tree_util.tree_map(lambda _: rows_spec, tree)
 
     def step(tree):
         body = partial(_shard_step, key_names=key_names,
-                       num_buckets=num_buckets, n_shards=n_shards,
-                       capacity=capacity)
+                       num_buckets=num_buckets, n_ici=n_ici, n_dcn=n_dcn,
+                       capacity_factor=capacity_factor)
         sharded = shard_map(body, mesh=mesh, in_specs=(spec_like(tree),),
-                            out_specs=P(SHARD_AXIS),
+                            out_specs=rows_spec,
                             check_vma=False)
         return sharded(tree)
 
@@ -152,7 +205,9 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
     import jax
     import jax.numpy as jnp
 
-    n_shards = mesh.shape[SHARD_AXIS]
+    from hyperspace_tpu.parallel.mesh import total_shards
+
+    n_shards = total_shards(mesh)
     key_names = tuple(batch.schema.field(c).name for c in key_columns)
     n = batch.num_rows
     local = -(-n // n_shards)  # ceil
@@ -180,15 +235,15 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
     in_tree["__valid__"] = jnp.concatenate(
         [jnp.ones(n, dtype=bool), jnp.zeros(padded - n, dtype=bool)])
 
-    capacity = max(16, int(local / n_shards * capacity_factor))
+    factor = capacity_factor
     while True:
         step = make_distributed_build_step(mesh, key_names, num_buckets,
-                                           capacity)
+                                           factor)
         out = step(in_tree)
         overflow = int(jnp.sum(out["__overflow__"]["data"]))
         if overflow == 0:
             break
-        capacity *= 2  # exact recovery: nothing was lost, rerun wider
+        factor *= 2  # exact recovery: nothing was lost, rerun wider
 
     result_tree = {}
     for name, entry in out.items():

@@ -32,13 +32,25 @@ def distribution_mesh(conf=None):
         return None
     from hyperspace_tpu.parallel.mesh import make_mesh
 
-    return make_mesh(len(devices))
+    dcn = (conf.get_int(constants.DISTRIBUTION_DCN_SIZE,
+                        constants.DISTRIBUTION_DCN_SIZE_DEFAULT)
+           if conf is not None
+           else constants.DISTRIBUTION_DCN_SIZE_DEFAULT)
+    if dcn > 1 and len(devices) % dcn != 0:
+        import logging
+        logging.getLogger(__name__).warning(
+            "distribution.dcn.size=%d does not divide the %d visible "
+            "devices; falling back to a FLAT mesh — build re-bucket "
+            "collectives will span DCN.", dcn, len(devices))
+        dcn = 1
+    return make_mesh(len(devices), dcn_size=dcn if dcn > 1 else None)
 
 
 def mesh_size(mesh) -> int:
-    from hyperspace_tpu.parallel.mesh import SHARD_AXIS
+    """TOTAL device count of the mesh (both axes of a (dcn, shard) mesh)."""
+    from hyperspace_tpu.parallel.mesh import total_shards
 
-    return mesh.shape[SHARD_AXIS]
+    return total_shards(mesh)
 
 
 def should_distribute(conf, num_rows: Optional[int] = None,

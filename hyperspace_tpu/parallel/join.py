@@ -47,7 +47,8 @@ import hyperspace_tpu._jax_config  # noqa: F401
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io.columnar import ColumnBatch, unify_string_columns
 from hyperspace_tpu.ops import keys as keymod
-from hyperspace_tpu.parallel.mesh import SHARD_AXIS, shard_rows
+from hyperspace_tpu.parallel.mesh import (SHARD_AXIS, shard_rows,
+                                          total_shards)
 
 # Mesh-path skew guard: if the [S, C] layout would materially out-size the
 # true row count (one shard owns a dominant hot bucket), stay single-chip
@@ -141,7 +142,7 @@ def _sharded_inputs(left, right, l_lengths, r_lengths, left_keys,
     with the sharded spec — per-device bytes ~ T, not total rows."""
     import jax
 
-    n_shards = mesh.shape[SHARD_AXIS]
+    n_shards = total_shards(mesh)
     l_lanes, r_lanes, l_ok, r_ok = _side_lanes(left, right, left_keys,
                                                right_keys)
     l_idx, l_valid, Cl = shard_layout(l_lengths, n_shards)
@@ -284,7 +285,7 @@ def distributed_bucketed_join_indices(
             f"Distributed bucketed join supports inner/left_outer/"
             f"full_outer; got {how}.")
     num_buckets = len(l_lengths)
-    n_shards = mesh.shape[SHARD_AXIS]
+    n_shards = total_shards(mesh)
     if num_buckets % n_shards != 0:
         raise ValueError(
             f"num_buckets ({num_buckets}) must be divisible by mesh size "
@@ -344,7 +345,7 @@ def distributed_semi_anti_indices(
     import jax.numpy as jnp
 
     num_buckets = len(l_lengths)
-    n_shards = mesh.shape[SHARD_AXIS]
+    n_shards = total_shards(mesh)
     if num_buckets % n_shards != 0:
         raise ValueError(
             f"num_buckets ({num_buckets}) must be divisible by mesh size "

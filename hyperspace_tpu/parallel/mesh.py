@@ -5,23 +5,38 @@ split, SURVEY §2.12); here the cluster is a `jax.sharding.Mesh` over TPU
 chips — ICI within a slice, DCN across slices — and data movement is XLA
 collectives, not a block-shuffle service.
 
-Bucket <-> shard ownership: shard `s` of an `n`-shard mesh owns every bucket
-`b` with `b % n == s`. Both the build (all_to_all routing) and the
-co-sharded join rely on this one mapping, which is also why equal bucket
-counts join with ZERO inter-chip traffic (the ranker's preference,
-reference `index/rankers/JoinIndexRanker.scala:40-55`).
+Mesh shapes: single-slice deployments use a 1-axis `(shard,)` mesh.
+Multi-host deployments use a 2-axis `(dcn, shard)` mesh — `shard` is the
+INNER axis (devices within a slice, connected by ICI), `dcn` the outer
+axis (one row per slice, connected by datacenter network). Collectives
+issued over one named axis are confined to its device groups, so the
+build's heavy within-slice re-bucket rides ICI and only the cross-slice
+stage touches DCN (SURVEY §2.12: "DCN only across slices").
+
+Bucket <-> shard ownership: flat shard `s` of an `n`-total-shard mesh owns
+every bucket `b` with `b % n == s`; on a 2-axis mesh flat order is
+row-major (dcn, shard), i.e. `s = d * n_ici + i`. Both the build
+(all_to_all routing) and the co-sharded join rely on this one mapping,
+which is also why equal bucket counts join with ZERO inter-chip traffic
+(the ranker's preference, reference
+`index/rankers/JoinIndexRanker.scala:40-55`).
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import hyperspace_tpu._jax_config  # noqa: F401
 
 SHARD_AXIS = "shard"
+DCN_AXIS = "dcn"
 
 
-def make_mesh(num_devices: Optional[int] = None):
+def make_mesh(num_devices: Optional[int] = None,
+              dcn_size: Optional[int] = None):
+    """1-axis `(shard,)` mesh, or — with `dcn_size` > 1 — a 2-axis
+    `(dcn, shard)` mesh of dcn_size slices."""
     import jax
     from jax.sharding import Mesh
 
@@ -32,13 +47,42 @@ def make_mesh(num_devices: Optional[int] = None):
                 f"Requested {num_devices} devices, have {len(devices)}.")
         devices = devices[:num_devices]
     import numpy as np
+    if dcn_size is not None and dcn_size > 1:
+        if len(devices) % dcn_size != 0:
+            raise ValueError(
+                f"dcn size {dcn_size} must divide device count "
+                f"{len(devices)}.")
+        grid = np.array(devices).reshape(dcn_size, -1)
+        return Mesh(grid, (DCN_AXIS, SHARD_AXIS))
     return Mesh(np.array(devices), (SHARD_AXIS,))
 
 
+def row_axes(mesh):
+    """The mesh axis names the ROW dimension shards over — every axis,
+    outer (dcn) first, so flat shard order is row-major (dcn, shard)."""
+    return tuple(mesh.axis_names)
+
+
+def total_shards(mesh) -> int:
+    return math.prod(mesh.shape.values())
+
+
+def dcn_size(mesh) -> int:
+    """Number of slices (1 on a flat single-axis mesh)."""
+    return mesh.shape.get(DCN_AXIS, 1)
+
+
+def row_spec(mesh):
+    """PartitionSpec splitting axis 0 across ALL mesh axes — THE row
+    sharding used by every parallel operator (build/join/aggregate/scan)."""
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(row_axes(mesh))
+
+
 def shard_rows(mesh):
-    """Sharding spec: rows (axis 0) split across the mesh."""
-    from jax.sharding import NamedSharding, PartitionSpec
-    return NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
+    """Sharding spec: rows (axis 0) split across ALL mesh devices."""
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, row_spec(mesh))
 
 
 def replicated(mesh):

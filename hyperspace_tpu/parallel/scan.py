@@ -13,7 +13,7 @@ from __future__ import annotations
 from typing import Dict
 
 from hyperspace_tpu.io.columnar import ColumnBatch, DeviceColumn
-from hyperspace_tpu.parallel.mesh import SHARD_AXIS, shard_rows
+from hyperspace_tpu.parallel.mesh import shard_rows, total_shards
 
 
 def shard_batch(batch: ColumnBatch, mesh):
@@ -24,7 +24,7 @@ def shard_batch(batch: ColumnBatch, mesh):
     import jax.numpy as jnp
 
     n = batch.num_rows
-    n_shards = mesh.shape[SHARD_AXIS]
+    n_shards = total_shards(mesh)
     padded = -(-n // n_shards) * n_shards
     pad = padded - n
     sharding = shard_rows(mesh)

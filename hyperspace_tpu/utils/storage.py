@@ -95,6 +95,21 @@ def _is_precondition_failure(exc: Exception) -> bool:
     return "preconditionfailed" in compact
 
 
+def _is_conflict(exc: Exception) -> bool:
+    """S3 409 ConflictError from a concurrent conditional put."""
+    for attr in ("code", "status", "status_code"):
+        if getattr(exc, attr, None) == 409:
+            return True
+    response = getattr(exc, "response", None)
+    if isinstance(response, dict):
+        meta = response.get("ResponseMetadata") or {}
+        error = response.get("Error") or {}
+        if (meta.get("HTTPStatusCode") == 409
+                or error.get("Code") in ("ConflictError", "409")):
+            return True
+    return "conflicterror" in f"{type(exc).__name__}{exc}".lower()
+
+
 def exclusive_create(path: str, data: bytes) -> bool:
     """Create `path` with `data` only if it does not exist, using a true
     backend precondition. Returns True iff this caller created it; False
@@ -123,17 +138,29 @@ def exclusive_create(path: str, data: bytes) -> bool:
             raise
     if protos & {"s3", "s3a"}:
         # S3 conditional put (If-None-Match: *), supported by AWS S3
-        # since 2024 and by MinIO.
-        try:
-            fs.pipe_file(real, data, IfNoneMatch="*")
-            return True
-        except TypeError as exc:
-            raise PreconditionUnsupported(
-                f"s3fs on this system does not accept IfNoneMatch: {exc}")
-        except Exception as exc:
-            if _is_precondition_failure(exc):
-                return False
-            raise
+        # since 2024 and by MinIO. Concurrent conditional puts against
+        # the same key may return 409 ConflictError while another upload
+        # is in flight (AWS documents retry); retry briefly, then treat
+        # a persistent conflict as the other writer winning.
+        import time
+        for attempt in range(5):
+            try:
+                fs.pipe_file(real, data, IfNoneMatch="*")
+                return True
+            except TypeError as exc:
+                raise PreconditionUnsupported(
+                    f"s3fs on this system does not accept IfNoneMatch: "
+                    f"{exc}")
+            except Exception as exc:
+                if _is_precondition_failure(exc):
+                    return False
+                if _is_conflict(exc) and attempt < 4:
+                    time.sleep(0.05 * (attempt + 1))
+                    continue
+                if _is_conflict(exc):
+                    return False  # persistent conflict: other writer won
+                raise
+        return False
     if protos & _ATOMIC_X_PROTOCOLS:
         try:
             with fs.open(real, "xb") as f:

@@ -368,3 +368,68 @@ def test_distributed_left_outer_join_with_nulls(mesh):
     unmatched_left = set(range(len(lkey))) - set(matched.li)
     for row in unmatched_left:
         assert got_left_counts[row] == 1
+
+
+# -- two-axis (dcn x shard) mesh: multi-host topology ---------------------
+
+
+@pytest.fixture(scope="module")
+def mesh24():
+    from hyperspace_tpu.parallel.mesh import make_mesh
+    return make_mesh(8, dcn_size=2)
+
+
+def test_two_axis_build_matches_single_chip(mesh24):
+    from hyperspace_tpu.ops.build import build_sorted
+
+    batch = make_batch(900, seed=21, with_strings=True)
+    built, lengths = distributed_build(batch, ["k"], 16, mesh24)
+    single, starts, ends = build_sorted(batch, ["k"], 16)
+    sl = np.asarray(ends) - np.asarray(starts)
+    assert (lengths == sl).all()
+    cols = ["k", "v", "s"]
+    a = columnar.to_arrow(built).to_pandas()[cols].sort_values(cols) \
+        .reset_index(drop=True)
+    b = columnar.to_arrow(single).to_pandas()[cols].sort_values(cols) \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(a, b)
+
+
+def test_two_axis_join_matches_pandas(mesh24):
+    left = make_batch(700, seed=22, with_strings=False)
+    right = make_batch(350, seed=23, with_strings=False)
+    lb, ll = distributed_build(left, ["k"], 16, mesh24)
+    rb, rl = distributed_build(right, ["k"], 16, mesh24)
+    li, ri = distributed_bucketed_join_indices(lb, rb, ll, rl, ["k"], ["k"],
+                                               mesh24)
+    lk = np.asarray(lb.column("k").data)
+    rk = np.asarray(rb.column("k").data)
+    assert (lk[np.asarray(li)] == rk[np.asarray(ri)]).all()
+    exp = pd.DataFrame({"k": lk}).merge(pd.DataFrame({"k": rk}), on="k")
+    assert len(exp) == len(np.asarray(li))
+
+
+def test_two_axis_collectives_confined_to_axes(mesh24):
+    """SURVEY §2.12 "DCN only across slices": the build's heavy re-bucket
+    all_to_all must be CONFINED to the inner (ICI) axis — replica groups
+    {0..3},{4..7} — with only the slim cross-slice stage over DCN pairs
+    {0,4},{1,5},... . Asserted on the COMPILED HLO's replica groups."""
+    import re
+
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.io.columnar import batch_to_tree
+    from hyperspace_tpu.parallel.build import make_distributed_build_step
+
+    batch = make_batch(1024, seed=24, with_strings=False)
+    tree, _ = batch_to_tree(batch)
+    in_tree = {name: dict(e, data=jnp.asarray(e["data"]))
+               for name, e in tree.items()}
+    in_tree["__valid__"] = jnp.ones(1024, dtype=bool)
+    step = make_distributed_build_step(mesh24, ("k",), 16, 2.0)
+    hlo = step.lower(in_tree).compile().as_text()
+    groups = set(re.findall(r"replica_groups=(\{\{[0-9,{}]*\}\})", hlo))
+    assert "{{0,1,2,3},{4,5,6,7}}" in groups, groups  # ICI stage
+    assert "{{0,4},{1,5},{2,6},{3,7}}" in groups, groups  # DCN stage
+    flat = "{{0,1,2,3,4,5,6,7}}"
+    assert flat not in groups, "a collective spans the full mesh"

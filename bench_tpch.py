@@ -1,0 +1,124 @@
+"""TPC-H benchmark — the 22-query suite, three ways, warm best-of-N:
+  - rules ON   (index-accelerated framework execution)
+  - rules OFF  (framework execution without indexes)
+  - pandas     (vectorized CPU oracle — the commodity baseline)
+Result equality across all three is asserted before timing is reported
+(the reference's E2E guarantee, `E2EHyperspaceRulesTests.scala:330-346`;
+its serde layer pins the full TPC-H set, `serde/package.scala:46-49`).
+
+Prints exactly ONE JSON line:
+  {"metric": "tpch_22q_wall_s", "value": <rules-on total>,
+   "vs_baseline": <pandas total / rules-on total>, "queries": {...}}
+
+BENCH_TPCH_SCALE scales the tables (1.0 ~ 60k lineitem rows).
+BENCH_TPCH_QUERIES selects a comma-separated subset.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+SCALE = float(os.environ.get("BENCH_TPCH_SCALE", 1.0))
+WARM_RUNS = int(os.environ.get("BENCH_WARM_RUNS", 3))
+QUERY_FILTER = [q for q in os.environ.get(
+    "BENCH_TPCH_QUERIES", "").split(",") if q]
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def best_of(fn, runs=WARM_RUNS, label=""):
+    best, out = float("inf"), None
+    for i in range(runs):
+        t0 = time.perf_counter()
+        out = fn()
+        elapsed = time.perf_counter() - t0
+        log(f"  {label} run {i}: {elapsed:.3f}s")
+        best = min(best, elapsed)
+    return best, out
+
+
+def norm(df):
+    from hyperspace_tpu.tpch.queries import normalize_result
+    return normalize_result(df)
+
+
+def main():
+    import pandas as pd
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu import Hyperspace, HyperspaceConf, HyperspaceSession
+    from hyperspace_tpu.tpch import QUERIES, generate
+    from hyperspace_tpu.tpch.queries import create_indexes
+
+    work = tempfile.mkdtemp(prefix="hs_tpch_")
+    try:
+        t0 = time.perf_counter()
+        paths = generate(os.path.join(work, "data"), scale=SCALE)
+        log(f"generate (scale={SCALE}): {time.perf_counter() - t0:.1f}s")
+
+        sess = HyperspaceSession(HyperspaceConf({
+            "hyperspace.warehouse.dir": os.path.join(work, "wh"),
+            "spark.hyperspace.index.num.buckets": "32"}))
+        hs = Hyperspace(sess)
+        dfs = {n: sess.read_parquet(p) for n, p in paths.items()}
+        selected = {n: q for n, q in QUERIES.items()
+                    if not QUERY_FILTER or n in QUERY_FILTER}
+        t0 = time.perf_counter()
+        create_indexes(hs, dfs, queries=list(selected))
+        index_build_s = time.perf_counter() - t0
+        log(f"index build: {index_build_s:.1f}s")
+
+        pdfs = {n: pq.read_table(os.path.join(p, "part-0.parquet"))
+                .to_pandas() for n, p in paths.items()}
+
+        queries = {}
+        tot_on = tot_off = tot_cpu = 0.0
+        for name, (build, oracle) in selected.items():
+            cpu_s, expected = best_of(lambda: oracle(pdfs),
+                                      label=f"{name} pandas")
+            sess.enable_hyperspace()
+            build(dfs).collect()  # warm (compiles, file listings)
+            on_s, got_on = best_of(lambda: build(dfs).collect().to_pandas(),
+                                   label=f"{name} rules-on")
+            sess.disable_hyperspace()
+            off_s, got_off = best_of(lambda: build(dfs).collect().to_pandas(),
+                                     label=f"{name} rules-off")
+            for got, tag in ((got_on, "rules-on"), (got_off, "rules-off")):
+                pd.testing.assert_frame_equal(
+                    norm(got), norm(expected), check_dtype=False,
+                    check_exact=False, rtol=1e-6, atol=1e-9)
+            log(f"{name}: on {on_s:.3f}s off {off_s:.3f}s cpu {cpu_s:.3f}s "
+                f"(vs cpu x{cpu_s / on_s:.2f}, "
+                f"vs no-index x{off_s / on_s:.2f})")
+            queries[name] = {"rules_on_s": round(on_s, 4),
+                             "rules_off_s": round(off_s, 4),
+                             "pandas_s": round(cpu_s, 4),
+                             "vs_baseline": round(cpu_s / on_s, 3),
+                             "vs_no_index": round(off_s / on_s, 3),
+                             "rows": int(len(expected))}
+            tot_on += on_s
+            tot_off += off_s
+            tot_cpu += cpu_s
+
+        print(json.dumps({
+            "metric": (f"tpch_{len(selected)}q_wall_s"),
+            "value": round(tot_on, 3),
+            "unit": "s",
+            "vs_baseline": round(tot_cpu / tot_on, 3),
+            "scale": SCALE,
+            "index_build_s": round(index_build_s, 2),
+            "queries": queries,
+        }))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -324,6 +324,24 @@ class ExpressionCompiler:
             if folded is None:
                 return xp.zeros(n, bool), None
             return folded
+        if isinstance(e, E.Like):
+            # LIKE in DICTIONARY space: run the anchored pattern over the
+            # distinct values (host, O(dictionary)), then one vectorized
+            # code-membership test per row.
+            import re as _re
+            s = self.string_column(e.child)
+            if s is None:
+                raise HyperspaceException(
+                    f"LIKE requires a string operand: {e!r}")
+            rx = _re.compile(e.regex(), _re.DOTALL)
+            d = np.asarray(s.dictionary)
+            codes = np.nonzero([rx.fullmatch(str(v)) is not None
+                                for v in d])[0]
+            member = xp.isin(xp.asarray(s.data),
+                             xp.asarray(codes.astype(np.int32)))
+            if s.validity is None:
+                return member, None
+            return member & s.validity, s.validity
         if isinstance(e, (E.EqualTo, E.NotEqualTo, E.LessThan,
                           E.LessThanOrEqual, E.GreaterThan,
                           E.GreaterThanOrEqual)):

@@ -47,8 +47,7 @@ import hyperspace_tpu._jax_config  # noqa: F401
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io.columnar import ColumnBatch, unify_string_columns
 from hyperspace_tpu.ops import keys as keymod
-from hyperspace_tpu.parallel.mesh import (SHARD_AXIS, shard_rows,
-                                          total_shards)
+from hyperspace_tpu.parallel.mesh import shard_rows, total_shards
 
 # Mesh-path skew guard: if the [S, C] layout would materially out-size the
 # true row count (one shard owns a dominant hot bucket), stay single-chip

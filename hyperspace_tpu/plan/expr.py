@@ -102,6 +102,10 @@ class Expression:
         expressions."""
         return Substr(self, start, length)
 
+    def like(self, pattern: str) -> "Like":
+        """SQL LIKE: `%` any run, `_` any single char, anchored."""
+        return Like(self, pattern)
+
     def between(self, low, high) -> "Expression":
         """SQL BETWEEN: low <= self <= high (inclusive)."""
         return And(GreaterThanOrEqual(self, _wrap(low)),
@@ -340,6 +344,57 @@ class Substr(Expression):
         return f"substr({self.child!r}, {self.start}, {self.length})"
 
 
+class Like(Expression):
+    """SQL LIKE over a string expression: `%` matches any run, `_` any
+    single character, anchored at both ends. Compiled in DICTIONARY space
+    (the pattern runs over the distinct values, O(dictionary) on the
+    host; rows pay one code-membership test), so the predicate stays
+    XLA-friendly at any row count."""
+
+    op = "like"
+
+    def __init__(self, child: Expression, pattern: str):
+        self.child = child
+        self.pattern = str(pattern)
+
+    @property
+    def children(self) -> List[Expression]:
+        return [self.child]
+
+    def regex(self) -> str:
+        """Anchored regex equivalent of the SQL pattern. Backslash is the
+        escape character (Spark's LIKE default): `\\%` / `\\_` match the
+        literal wildcard, `\\\\` a literal backslash."""
+        import re
+        out = []
+        chars = iter(self.pattern)
+        for ch in chars:
+            if ch == "\\":
+                nxt = next(chars, None)
+                if nxt is None:
+                    out.append(re.escape("\\"))
+                else:
+                    out.append(re.escape(nxt))
+            elif ch == "%":
+                out.append(".*")
+            elif ch == "_":
+                out.append(".")
+            else:
+                out.append(re.escape(ch))
+        return "".join(out)
+
+    def to_dict(self) -> dict:
+        return {"op": "like", "pattern": self.pattern,
+                "child": self.child.to_dict()}
+
+    @staticmethod
+    def _from_dict(d: dict) -> "Like":
+        return Like(Expression.from_dict(d["child"]), d["pattern"])
+
+    def __repr__(self):
+        return f"{self.child!r} LIKE {self.pattern!r}"
+
+
 class In(Expression):
     def __init__(self, child: Expression, values: Sequence[Expression]):
         self.child = child
@@ -437,12 +492,12 @@ _REGISTRY: Dict[str, Any] = {
     "add": Add, "sub": Sub, "mul": Mul, "div": Div,
     "is_null": IsNull, "is_not_null": IsNotNull, "in": In,
     "alias": Alias, "substr": Substr, "case": CaseWhen,
-    "null": NullLiteral,
+    "null": NullLiteral, "like": Like,
 }
 
 
 _BOOL_OPS = (EqualTo, NotEqualTo, LessThan, LessThanOrEqual, GreaterThan,
-             GreaterThanOrEqual, And, Or, Not, IsNull, IsNotNull, In)
+             GreaterThanOrEqual, And, Or, Not, IsNull, IsNotNull, In, Like)
 
 
 def infer_dtype(expr: Expression, schema) -> str:

@@ -1,0 +1,11 @@
+"""TPC-H on the framework DataFrame API.
+
+The reference pins "all TPC-H and TPC-DS queries serializable" through its
+plan layer (`index/serde/package.scala:46-49`); here the 22 TPC-H queries
+run end to end — built, optimized (index rules), executed — with pandas
+oracles asserting 3-way result equality, the same contract the TPC-DS
+suite carries.
+"""
+
+from hyperspace_tpu.tpch.generator import generate  # noqa: F401
+from hyperspace_tpu.tpch.queries import QUERIES  # noqa: F401

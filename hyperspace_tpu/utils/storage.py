@@ -143,6 +143,7 @@ def exclusive_create(path: str, data: bytes) -> bool:
         # is in flight (AWS documents retry); retry briefly, then treat
         # a persistent conflict as the other writer winning.
         import time
+        last_conflict = None
         for attempt in range(5):
             try:
                 fs.pipe_file(real, data, IfNoneMatch="*")
@@ -154,13 +155,18 @@ def exclusive_create(path: str, data: bytes) -> bool:
             except Exception as exc:
                 if _is_precondition_failure(exc):
                     return False
-                if _is_conflict(exc) and attempt < 4:
+                if _is_conflict(exc):
+                    last_conflict = exc
                     time.sleep(0.05 * (attempt + 1))
                     continue
-                if _is_conflict(exc):
-                    return False  # persistent conflict: other writer won
                 raise
-        return False
+        # Persistent 409: "another writer won" is only true if their
+        # object actually landed — a crashed/aborted upload also 409s,
+        # and silently reporting a loss then would corrupt the OCC log
+        # (the caller would trust a log entry that never exists).
+        if fs.exists(real):
+            return False
+        raise last_conflict
     if protos & _ATOMIC_X_PROTOCOLS:
         try:
             with fs.open(real, "xb") as f:

@@ -31,7 +31,7 @@ _BASE = {
 TABLES = ("store_sales", "store_returns", "catalog_sales",
           "catalog_returns", "date_dim", "store", "item", "customer",
           "promotion", "customer_demographics", "household_demographics",
-          "customer_address", "time_dim")
+          "customer_address", "time_dim", "reason", "income_band")
 
 _QUARTERS = ["%dQ%d" % (y, q) for y in range(1998, 2004)
              for q in range(1, 5)]
@@ -136,6 +136,12 @@ def generate(out_dir: str, scale: float = 1.0,
         "i_color": np.array([["red", "blue", "green", "plum", "puff",
                               "misty", "navy", "orange"][i % 8]
                              for i in range(n_item)]),
+        "i_units": np.array([["Oz", "Bunch", "Ton", "N/A", "Dozen", "Box",
+                              "Pound", "Pallet"][i % 8]
+                             for i in range(n_item)]),
+        "i_size": np.array([["medium", "extra large", "N/A", "small",
+                             "petite", "large"][i % 6]
+                            for i in range(n_item)]),
     }
 
     n_addr = 1000  # ss_addr_sk / c_current_addr_sk domain
@@ -144,6 +150,14 @@ def generate(out_dir: str, scale: float = 1.0,
         "c_customer_id": np.array(["C%010d" % i for i in range(n_cust)]),
         "c_current_addr_sk": rng.integers(1, n_addr + 1,
                                           n_cust).astype(np.int64),
+        "c_current_cdemo_sk": rng.integers(1, 1001,
+                                           n_cust).astype(np.int64),
+        "c_current_hdemo_sk": rng.integers(1, 1001,
+                                           n_cust).astype(np.int64),
+        "c_first_sales_date_sk": rng.integers(
+            1, _BASE["date_dim"] // 20 + 1, n_cust).astype(np.int64),
+        "c_first_shipto_date_sk": rng.integers(
+            1, _BASE["date_dim"] // 20 + 1, n_cust).astype(np.int64),
         "c_first_name": np.array(["fn_%d" % (i % 400) for i in range(n_cust)]),
         "c_last_name": np.array(["ln_%d" % (i % 700) for i in range(n_cust)]),
     }
@@ -182,6 +196,23 @@ def generate(out_dir: str, scale: float = 1.0,
         "hd_demo_sk": np.arange(1, n_demo + 1, dtype=np.int64),
         "hd_dep_count": (np.arange(n_demo) % 10).astype(np.int64),
         "hd_vehicle_count": (np.arange(n_demo) % 6 - 1).astype(np.int64),
+        # (i // 6) decouples from hd_vehicle_count's i % 6 cycle — the
+        # q34/q73 filter ANDs buy_potential with vehicle_count > 0.
+        "hd_income_band_sk": (1 + np.arange(n_demo) % 20).astype(np.int64),
+        "hd_buy_potential": np.array([
+            [">10000", "unknown", "1001-5000", "5001-10000", "501-1000",
+             "0-500"][(i // 6) % 6] for i in range(n_demo)]),
+    }
+    tables["income_band"] = {
+        "ib_income_band_sk": np.arange(1, 21, dtype=np.int64),
+        "ib_lower_bound": (np.arange(20) * 10000).astype(np.int64),
+        "ib_upper_bound": ((np.arange(20) + 1) * 10000 - 1).astype(np.int64),
+    }
+    _REASONS = ["reason 1", "reason 28", "Did not like the warranty",
+                "Not the product that was ordred", "reason 55"]
+    tables["reason"] = {
+        "r_reason_sk": np.arange(1, len(_REASONS) + 1, dtype=np.int64),
+        "r_reason_desc": np.array(_REASONS),
     }
     _CITIES = ["%s_%02d" % (base, i) for base in
                ("Springfield", "Greenville", "Franklin", "Clinton")
@@ -189,6 +220,11 @@ def generate(out_dir: str, scale: float = 1.0,
     _STATES = ["TX", "OH", "KY", "GA", "NM", "VA", "MO", "ND", "IN", "SC"]
     tables["customer_address"] = {
         "ca_address_sk": np.arange(1, n_addr + 1, dtype=np.int64),
+        "ca_street_number": np.array(["%d" % (100 + 3 * i)
+                                      for i in range(n_addr)]),
+        "ca_street_name": np.array([["Main", "Oak", "Park", "First",
+                                     "Elm", "Lake"][i % 6]
+                                    for i in range(n_addr)]),
         "ca_city": np.array([_CITIES[i % len(_CITIES)]
                              for i in range(n_addr)]),
         "ca_zip": np.array(["%05d" % (10000 + 37 * i % 90000)
@@ -211,11 +247,37 @@ def generate(out_dir: str, scale: float = 1.0,
     # queries (q17 2000Q1, q25 Apr-Oct 2000, q64 2000 vs 2001) see dense
     # data at every scale; date_dim itself still spans the full range.
     lo_day, hi_day = 366, min(1460, n_dates)
-    ss_sold_date = rng.integers(lo_day, hi_day + 1, n_ss).astype(np.int64)
-    ss_item = rng.integers(1, n_item + 1, n_ss).astype(np.int64)
-    ss_cust = rng.integers(1, n_cust + 1, n_ss).astype(np.int64)
-    ss_store = rng.integers(1, n_store + 1, n_ss).astype(np.int64)
-    ss_ticket = np.arange(1, n_ss + 1, dtype=np.int64)
+    # Rows group into multi-line TICKETS (one store visit: ticket-level
+    # date/customer/store/demo/address shared by its rows, ~12 lines
+    # Poisson-distributed) — the official layout the ticket-size band
+    # queries (q34 counts 15-20, q73 counts 1-5) and per-ticket grouping
+    # queries (q46/q68/q79) measure.
+    n_ticket = max(n_ss // 12, 1)
+    # Bimodal basket sizes: ~30% quick visits (1-5 lines), the rest full
+    # carts (8-23) — both ticket-size bands (q73's 1-5, q34's 15-20)
+    # carry mass at every scale. n_ss becomes the realized row total.
+    sizes = np.where(rng.random(n_ticket) < 0.3,
+                     rng.integers(1, 6, n_ticket),
+                     rng.integers(8, 24, n_ticket))
+    tick = np.repeat(np.arange(n_ticket, dtype=np.int64), sizes)
+    n_ss = len(tick)
+    t_date = rng.integers(lo_day, hi_day + 1, n_ticket).astype(np.int64)
+    t_cust = rng.integers(1, n_cust + 1, n_ticket).astype(np.int64)
+    t_store = rng.integers(1, n_store + 1, n_ticket).astype(np.int64)
+    t_cdemo = rng.integers(1, n_demo + 1, n_ticket).astype(np.int64)
+    t_hdemo = rng.integers(1, n_demo + 1, n_ticket).astype(np.int64)
+    t_addr = rng.integers(1, n_addr + 1, n_ticket).astype(np.int64)
+    ss_sold_date = t_date[tick]
+    # Items WITHOUT replacement within a ticket ((item, ticket) is the
+    # official PK the ss-sr identity joins key on): random per-ticket
+    # base + within-ticket position, distinct for any basket <= n_item.
+    starts_of = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    pos = np.arange(n_ss, dtype=np.int64) - np.repeat(starts_of, sizes)
+    t_base = rng.integers(0, n_item, n_ticket).astype(np.int64)
+    ss_item = 1 + (t_base[tick] + pos) % n_item
+    ss_cust = t_cust[tick]
+    ss_store = t_store[tick]
+    ss_ticket = tick + 1
     ss_qty = rng.integers(1, 100, n_ss).astype(np.int64)
     ss_price = np.round(rng.uniform(1.0, 300.0, n_ss), 2)
     tables["store_sales"] = {
@@ -224,9 +286,9 @@ def generate(out_dir: str, scale: float = 1.0,
                                         n_ss).astype(np.int64),
         "ss_item_sk": ss_item,
         "ss_customer_sk": ss_cust,
-        "ss_cdemo_sk": rng.integers(1, n_demo + 1, n_ss).astype(np.int64),
-        "ss_hdemo_sk": rng.integers(1, n_demo + 1, n_ss).astype(np.int64),
-        "ss_addr_sk": rng.integers(1, n_addr + 1, n_ss).astype(np.int64),
+        "ss_cdemo_sk": t_cdemo[tick],
+        "ss_hdemo_sk": t_hdemo[tick],
+        "ss_addr_sk": t_addr[tick],
         "ss_store_sk": ss_store,
         "ss_promo_sk": rng.integers(1, n_promo + 1, n_ss).astype(np.int64),
         "ss_ticket_number": ss_ticket,
@@ -249,14 +311,18 @@ def generate(out_dir: str, scale: float = 1.0,
     n_sr = n_ss * 3 // 10
     ret_pick = rng.choice(n_ss, n_sr, replace=False)
     ret_lag = rng.integers(1, 90, n_sr)
+    sr_ret_qty = np.maximum(
+        ss_qty[ret_pick] - rng.integers(0, 50, n_sr), 1).astype(np.int64)
     tables["store_returns"] = {
         "sr_returned_date_sk": np.minimum(ss_sold_date[ret_pick] + ret_lag,
                                           n_dates).astype(np.int64),
         "sr_item_sk": ss_item[ret_pick],
         "sr_customer_sk": ss_cust[ret_pick],
+        "sr_store_sk": ss_store[ret_pick],
+        "sr_reason_sk": (1 + rng.integers(0, 5, n_sr)).astype(np.int64),
         "sr_ticket_number": ss_ticket[ret_pick],
-        "sr_return_quantity": np.maximum(
-            ss_qty[ret_pick] - rng.integers(0, 50, n_sr), 1).astype(np.int64),
+        "sr_return_quantity": sr_ret_qty,
+        "sr_return_amt": np.round(ss_price[ret_pick] * sr_ret_qty, 2),
         "sr_net_loss": np.round(rng.uniform(1.0, 200.0, n_sr), 2),
     }
 
@@ -287,6 +353,10 @@ def generate(out_dir: str, scale: float = 1.0,
         "cs_quantity": cs_qty,
         "cs_list_price": np.round(cs_price * 1.2, 2),
         "cs_sales_price": cs_price,
+        "cs_ext_sales_price": np.round(cs_price * cs_qty, 2),
+        "cs_ext_discount_amt": np.round(
+            np.where(rng.random(n_cs) < 0.4,
+                     rng.uniform(0.0, 60.0, n_cs), 5.0), 2),
         "cs_coupon_amt": np.round(
             np.where(rng.random(n_cs) < 0.3,
                      rng.uniform(0.0, 20.0, n_cs), 0.0), 2),
@@ -300,6 +370,11 @@ def generate(out_dir: str, scale: float = 1.0,
     tables["catalog_returns"] = {
         "cr_item_sk": cs_item[cr_pick],
         "cr_order_number": cs_order[cr_pick],
+        "cr_returning_customer_sk": cs_cust[cr_pick],
+        "cr_returned_date_sk": np.minimum(
+            cs_date[cr_pick] + rng.integers(1, 90, n_cr),
+            n_dates).astype(np.int64),
+        "cr_return_amt_inc_tax": np.round(rng.uniform(1.0, 300.0, n_cr), 2),
         "cr_refunded_cash": np.round(rng.uniform(1.0, 150.0, n_cr), 2),
         "cr_reversed_charge": np.round(rng.uniform(0.0, 40.0, n_cr), 2),
         "cr_store_credit": np.round(rng.uniform(0.0, 40.0, n_cr), 2),

@@ -1,7 +1,8 @@
-"""Twenty-seven TPC-DS queries on the framework DataFrame API, with pandas
-oracles: q3, q7, q13, q15, q17, q19, q25, q26, q28, q42, q43, q48, q50,
-q52, q53, q55, q61, q63, q64, q65, q67, q68, q79, q88, q89, q96,
-q98.
+"""Forty-two TPC-DS queries on the framework DataFrame API, with pandas
+oracles: q1, q3, q6, q7, q13, q15, q17, q19, q20, q25, q26, q27, q28,
+q29, q32, q34, q36, q41, q42, q43, q46, q48, q50, q52, q53, q55, q61,
+q63, q64, q65, q67, q68, q70, q73, q79, q81, q88, q89, q93, q96, q97,
+q98 (the round-4 additions live in `queries_ext.py`).
 
 Each query is expressed as a join tree the rewrite rules can accelerate:
 the innermost join is a linear scan pair (JoinIndexRule's applicability,
@@ -27,9 +28,9 @@ extracting it is standard planner normalization), SUBSTR-IN zip probes
 (q15), the catalog twin of q7 (q26), and SUM(CASE WHEN ...) pivots
 (q43 weekday columns, q50 return-lag buckets over the ss-sr ticket
 identity join).
-q64 remains structurally faithful at reduced width (cs_ui HAVING
-subquery, cross_sales aggregation, year-over-year self-join all
-present); q19 probes 1999 instead of the official 1998 because the
+q64 runs at FULL official width since round 4 (the 13-way cross_sales
+join with both customer addresses, demographics/income-band pairs, and
+all three year columns); q19 probes 1999 instead of the official 1998 because the
 deterministic generator concentrates sales in 1999-2001; q79 appends
 ss_ticket_number as a final sort key on both lanes because the official
 ORDER BY does not totally order rows and the 3-way equality check needs
@@ -235,46 +236,114 @@ def _q64_cs_ui(dfs):
 
 
 def _q64_cross_sales(dfs, year: int):
+    """FULL-WIDTH cross_sales: the official 13-way join — ss x sr x cs_ui
+    x d1/d2/d3 x store x customer x cd1/cd2 x promotion x hd1/hd2 (with
+    income bands) x ad1/ad2 x item — grouped by the official column list
+    (product/item/store plus both street addresses and all three years).
+    """
     ss = dfs["store_sales"].select(
         "ss_sold_date_sk", "ss_item_sk", "ss_customer_sk", "ss_store_sk",
-        "ss_ticket_number", "ss_wholesale_cost", "ss_list_price")
+        "ss_cdemo_sk", "ss_hdemo_sk", "ss_addr_sk", "ss_promo_sk",
+        "ss_ticket_number", "ss_wholesale_cost", "ss_list_price",
+        "ss_coupon_amt")
     sr = dfs["store_returns"].select("sr_item_sk", "sr_ticket_number")
     dy = (dfs["date_dim"].filter(col("d_year") == lit(year))
-          .select("d_date_sk"))
+          .select("d_date_sk", col("d_year").alias("syear")))
     store = dfs["store"].select("s_store_sk", "s_store_name", "s_zip")
     item = (dfs["item"]
             .filter(col("i_color").isin(*_Q64_COLORS)
-                    & (col("i_current_price") >= lit(20.0))
-                    & (col("i_current_price") <= lit(85.0)))
+                    & (col("i_current_price") >= lit(25.0))
+                    & (col("i_current_price") <= lit(60.0)))
             .select("i_item_sk", "i_product_name"))
-    customer = dfs["customer"].select("c_customer_sk")
+    customer = dfs["customer"].select(
+        "c_customer_sk", "c_current_cdemo_sk", "c_current_hdemo_sk",
+        "c_current_addr_sk", "c_first_sales_date_sk",
+        "c_first_shipto_date_sk")
+    cd = dfs["customer_demographics"].select("cd_demo_sk",
+                                             "cd_marital_status")
+    hd = dfs["household_demographics"].select("hd_demo_sk",
+                                              "hd_income_band_sk")
+    ib = dfs["income_band"].select("ib_income_band_sk")
+    ad = dfs["customer_address"].select(
+        "ca_address_sk", "ca_street_number", "ca_street_name", "ca_city",
+        "ca_zip")
+    promo = dfs["promotion"].select("p_promo_sk")
 
     j = ss.join(sr, on=(col("ss_item_sk") == col("sr_item_sk"))
                 & (col("ss_ticket_number") == col("sr_ticket_number")))
     j = j.join(_q64_cs_ui(dfs), on=col("ss_item_sk") == col("cs_item_sk"))
     j = j.join(dy, on=col("ss_sold_date_sk") == col("d_date_sk")).select(
-        "ss_item_sk", "ss_customer_sk", "ss_store_sk", "ss_wholesale_cost",
-        "ss_list_price")
+        "ss_item_sk", "ss_customer_sk", "ss_store_sk", "ss_cdemo_sk",
+        "ss_hdemo_sk", "ss_addr_sk", "ss_promo_sk", "ss_wholesale_cost",
+        "ss_list_price", "ss_coupon_amt", "syear")
     j = j.join(store, on=col("ss_store_sk") == col("s_store_sk"))
-    j = j.join(item, on=col("ss_item_sk") == col("i_item_sk"))
     j = j.join(customer, on=col("ss_customer_sk") == col("c_customer_sk"))
-    return j.group_by("i_product_name", "s_store_name", "s_zip").agg(
+    # cd1 (sale-time) and cd2 (current) with differing marital status.
+    j = j.join(cd, on=col("ss_cdemo_sk") == col("cd_demo_sk"))
+    j = j.join(cd, on=col("c_current_cdemo_sk") == col("cd_demo_sk"))
+    j = j.filter(col("cd_marital_status") != col("cd_marital_status_r"))
+    j = j.join(promo, on=col("ss_promo_sk") == col("p_promo_sk"))
+    j = j.join(hd, on=col("ss_hdemo_sk") == col("hd_demo_sk"))
+    j = j.join(ib, on=col("hd_income_band_sk") == col("ib_income_band_sk"))
+    j = j.join(hd, on=col("c_current_hdemo_sk") == col("hd_demo_sk"))
+    j = j.join(ib, on=col("hd_income_band_sk_r")
+               == col("ib_income_band_sk"))
+    # first-sales / first-shipto years (d2 / d3).
+    d2 = dfs["date_dim"].select("d_date_sk",
+                                col("d_year").alias("fsyear"))
+    d3 = dfs["date_dim"].select("d_date_sk",
+                                col("d_year").alias("s2year"))
+    j = j.join(d2, on=col("c_first_sales_date_sk") == col("d_date_sk"))
+    j = j.join(d3, on=col("c_first_shipto_date_sk") == col("d_date_sk"))
+    # bought-at (ad1 -> b_*) and current (ad2 -> c_*) addresses.
+    j = j.join(ad, on=col("ss_addr_sk") == col("ca_address_sk"))
+    j = j.join(ad, on=col("c_current_addr_sk") == col("ca_address_sk"))
+    j = j.join(item, on=col("ss_item_sk") == col("i_item_sk"))
+    j = j.select(
+        "i_product_name", col("ss_item_sk").alias("item_sk"),
+        "s_store_name", "s_zip",
+        col("ca_street_number").alias("b_street_number"),
+        col("ca_street_name").alias("b_street_name"),
+        col("ca_city").alias("b_city"), col("ca_zip").alias("b_zip"),
+        col("ca_street_number_r").alias("c_street_number"),
+        col("ca_street_name_r").alias("c_street_name"),
+        col("ca_city_r").alias("c_city"), col("ca_zip_r").alias("c_zip"),
+        "syear", "fsyear", "s2year", "ss_wholesale_cost", "ss_list_price",
+        "ss_coupon_amt")
+    keys = ["i_product_name", "item_sk", "s_store_name", "s_zip",
+            "b_street_number", "b_street_name", "b_city", "b_zip",
+            "c_street_number", "c_street_name", "c_city", "c_zip",
+            "syear", "fsyear", "s2year"]
+    return j.group_by(*keys).agg(
         ("count", "*", "cnt"),
         ("sum", "ss_wholesale_cost", "s1"),
-        ("sum", "ss_list_price", "s2"))
+        ("sum", "ss_list_price", "s2"),
+        ("sum", "ss_coupon_amt", "s3"))
 
 
 def q64(dfs: Dict[str, "object"]):
     cs1 = _q64_cross_sales(dfs, 2000)
-    cs2 = _q64_cross_sales(dfs, 2001)
-    j = cs1.join(cs2, on=(col("i_product_name") == col("i_product_name"))
-                 & (col("s_store_name") == col("s_store_name"))
-                 & (col("s_zip") == col("s_zip")))
-    # Self-join duplicates take the _r suffix on the cs2 side.
-    j = j.filter(col("cnt_r") <= col("cnt"))
-    return (j.select("i_product_name", "s_store_name", "s_zip",
-                     "cnt", "s1", "s2", "cnt_r", "s1_r", "s2_r")
-            .sort("i_product_name", "s_store_name", "s_zip").limit(100))
+    cs2 = _q64_cross_sales(dfs, 2001).select(
+        col("item_sk").alias("item_sk2"),
+        col("s_store_name").alias("store_name2"),
+        col("s_zip").alias("store_zip2"), col("syear").alias("syear2"),
+        col("cnt").alias("cnt2"), col("s1").alias("s1_2"),
+        col("s2").alias("s2_2"), col("s3").alias("s3_2"))
+    j = cs1.join(cs2, on=(col("item_sk") == col("item_sk2"))
+                 & (col("s_store_name") == col("store_name2"))
+                 & (col("s_zip") == col("store_zip2")))
+    j = j.filter(col("cnt2") <= col("cnt"))
+    return (j.select(
+        "i_product_name", "item_sk", "s_store_name", "s_zip",
+        "b_street_number", "b_street_name", "b_city", "b_zip",
+        "c_street_number", "c_street_name", "c_city", "c_zip",
+        "syear", "cnt", "s1", "s2", "s3",
+        "syear2", "cnt2", "s1_2", "s2_2", "s3_2")
+        .sort("i_product_name", "s_store_name", "cnt2", "item_sk",
+              "s_zip", "b_street_number", "b_street_name", "b_city",
+              "b_zip", "c_street_number", "c_street_name", "c_city",
+              "c_zip", "s1", "s2", "s3", "s1_2", "s2_2",
+              "s3_2").limit(100))
 
 
 def _q64_cs_ui_pandas(t):
@@ -293,10 +362,11 @@ def _q64_cs_ui_pandas(t):
 
 def _q64_cross_sales_pandas(t, year: int):
     d = t["date_dim"]
-    dy = d[d.d_year == year][["d_date_sk"]]
+    dy = d[d.d_year == year][["d_date_sk", "d_year"]].rename(
+        columns={"d_year": "syear"})
     it = t["item"]
     it = it[it.i_color.isin(list(_Q64_COLORS))
-            & (it.i_current_price >= 20.0) & (it.i_current_price <= 85.0)]
+            & (it.i_current_price >= 25.0) & (it.i_current_price <= 60.0)]
     j = t["store_sales"].merge(
         t["store_returns"][["sr_item_sk", "sr_ticket_number"]],
         left_on=["ss_item_sk", "ss_ticket_number"],
@@ -306,25 +376,76 @@ def _q64_cross_sales_pandas(t, year: int):
     j = j.merge(dy, left_on="ss_sold_date_sk", right_on="d_date_sk")
     j = j.merge(t["store"][["s_store_sk", "s_store_name", "s_zip"]],
                 left_on="ss_store_sk", right_on="s_store_sk")
+    j = j.merge(t["customer"], left_on="ss_customer_sk",
+                right_on="c_customer_sk")
+    cd = t["customer_demographics"][["cd_demo_sk", "cd_marital_status"]]
+    j = j.merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+    j = j.merge(cd, left_on="c_current_cdemo_sk", right_on="cd_demo_sk",
+                suffixes=("", "_r"))
+    j = j[j.cd_marital_status != j.cd_marital_status_r]
+    j = j.merge(t["promotion"][["p_promo_sk"]], left_on="ss_promo_sk",
+                right_on="p_promo_sk")
+    hd = t["household_demographics"][["hd_demo_sk", "hd_income_band_sk"]]
+    ib = t["income_band"][["ib_income_band_sk"]]
+    j = j.merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+    j = j.merge(ib, left_on="hd_income_band_sk",
+                right_on="ib_income_band_sk")
+    j = j.merge(hd, left_on="c_current_hdemo_sk", right_on="hd_demo_sk",
+                suffixes=("", "_r"))
+    j = j.merge(ib, left_on="hd_income_band_sk_r",
+                right_on="ib_income_band_sk", suffixes=("", "_r"))
+    dd = t["date_dim"][["d_date_sk", "d_year"]]
+    j = j.merge(dd.rename(columns={"d_year": "fsyear"}),
+                left_on="c_first_sales_date_sk", right_on="d_date_sk")
+    j = j.merge(dd.rename(columns={"d_year": "s2year"}),
+                left_on="c_first_shipto_date_sk", right_on="d_date_sk")
+    ad = t["customer_address"][["ca_address_sk", "ca_street_number",
+                                "ca_street_name", "ca_city", "ca_zip"]]
+    j = j.merge(ad, left_on="ss_addr_sk", right_on="ca_address_sk")
+    j = j.merge(ad, left_on="c_current_addr_sk", right_on="ca_address_sk",
+                suffixes=("", "_r"))
     j = j.merge(it[["i_item_sk", "i_product_name"]],
                 left_on="ss_item_sk", right_on="i_item_sk")
-    j = j.merge(t["customer"][["c_customer_sk"]],
-                left_on="ss_customer_sk", right_on="c_customer_sk")
-    return j.groupby(["i_product_name", "s_store_name", "s_zip"]).agg(
-        cnt=("ss_item_sk", "size"),
+    j = j.rename(columns={
+        "ss_item_sk": "item_sk",
+        "ca_street_number": "b_street_number",
+        "ca_street_name": "b_street_name", "ca_city": "b_city",
+        "ca_zip": "b_zip", "ca_street_number_r": "c_street_number",
+        "ca_street_name_r": "c_street_name", "ca_city_r": "c_city",
+        "ca_zip_r": "c_zip"})
+    keys = ["i_product_name", "item_sk", "s_store_name", "s_zip",
+            "b_street_number", "b_street_name", "b_city", "b_zip",
+            "c_street_number", "c_street_name", "c_city", "c_zip",
+            "syear", "fsyear", "s2year"]
+    return j.groupby(keys, as_index=False).agg(
+        cnt=("item_sk", "size"),
         s1=("ss_wholesale_cost", "sum"),
-        s2=("ss_list_price", "sum")).reset_index()
+        s2=("ss_list_price", "sum"),
+        s3=("ss_coupon_amt", "sum"))
 
 
 def q64_pandas(t: Dict[str, "object"]):
     cs1 = _q64_cross_sales_pandas(t, 2000)
     cs2 = _q64_cross_sales_pandas(t, 2001)
-    j = cs1.merge(cs2, on=["i_product_name", "s_store_name", "s_zip"],
-                  suffixes=("", "_r"))
-    j = j[j.cnt_r <= j.cnt]
-    out = j[["i_product_name", "s_store_name", "s_zip",
-             "cnt", "s1", "s2", "cnt_r", "s1_r", "s2_r"]]
-    return (out.sort_values(["i_product_name", "s_store_name", "s_zip"])
+    cs2 = cs2[["item_sk", "s_store_name", "s_zip", "syear", "cnt", "s1",
+               "s2", "s3"]].rename(columns={
+        "item_sk": "item_sk2", "s_store_name": "store_name2",
+        "s_zip": "store_zip2", "syear": "syear2", "cnt": "cnt2",
+        "s1": "s1_2", "s2": "s2_2", "s3": "s3_2"})
+    j = cs1.merge(cs2, left_on=["item_sk", "s_store_name", "s_zip"],
+                  right_on=["item_sk2", "store_name2", "store_zip2"])
+    j = j[j.cnt2 <= j.cnt]
+    out = j[["i_product_name", "item_sk", "s_store_name", "s_zip",
+             "b_street_number", "b_street_name", "b_city", "b_zip",
+             "c_street_number", "c_street_name", "c_city", "c_zip",
+             "syear", "cnt", "s1", "s2", "s3",
+             "syear2", "cnt2", "s1_2", "s2_2", "s3_2"]]
+    return (out.sort_values(["i_product_name", "s_store_name", "cnt2",
+                             "item_sk", "s_zip", "b_street_number",
+                             "b_street_name", "b_city", "b_zip",
+                             "c_street_number", "c_street_name", "c_city",
+                             "c_zip", "s1", "s2", "s3", "s1_2", "s2_2",
+                             "s3_2"])
             .head(100).reset_index(drop=True))
 
 
@@ -342,11 +463,11 @@ _INDEX_DEFS = (
     ("idx_ss_ret", "store_sales",
      (["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
       ["ss_sold_date_sk", "ss_store_sk", "ss_quantity", "ss_net_profit"]),
-     ("q17", "q25", "q50")),
+     ("q17", "q25", "q29", "q50")),
     ("idx_sr_ret", "store_returns",
      (["sr_customer_sk", "sr_item_sk", "sr_ticket_number"],
       ["sr_returned_date_sk", "sr_return_quantity", "sr_net_loss"]),
-     ("q17", "q25", "q50")),
+     ("q17", "q25", "q29", "q50")),
     ("idx_ss_ticket", "store_sales",
      (["ss_item_sk", "ss_ticket_number"],
       ["ss_sold_date_sk", "ss_customer_sk", "ss_store_sk",
@@ -371,18 +492,18 @@ _INDEX_DEFS = (
        "ss_quantity", "ss_list_price", "ss_sales_price", "ss_coupon_amt",
        "ss_ext_sales_price", "ss_ext_list_price", "ss_ext_tax",
        "ss_ext_wholesale_cost", "ss_net_profit"]),
-     _STAR_FAMILY + ("q61",)),
+     _STAR_FAMILY + ("q61", "q6", "q27", "q34", "q36", "q46", "q70", "q73")),
     ("idx_dd_datesk", "date_dim",
      (["d_date_sk"],
       ["d_year", "d_moy", "d_dom", "d_dow", "d_qoy", "d_day_name"]),
-     _STAR_FAMILY + ("q15", "q26", "q61")),
+     _STAR_FAMILY + ("q15", "q26", "q61", "q1", "q6", "q20", "q27", "q29", "q32", "q34", "q36", "q46", "q70", "q73", "q81", "q97")),
     # q15 / q26 join catalog_sales to a filtered date_dim innermost.
     ("idx_cs_date", "catalog_sales",
      (["cs_sold_date_sk"],
       ["cs_bill_customer_sk", "cs_bill_cdemo_sk", "cs_item_sk",
        "cs_promo_sk", "cs_quantity", "cs_list_price", "cs_sales_price",
-       "cs_coupon_amt"]),
-     ("q15", "q26")),
+       "cs_coupon_amt", "cs_ext_sales_price", "cs_ext_discount_amt"]),
+     ("q15", "q26", "q20", "q32", "q97")),
     # q96 / q88 join store_sales to household_demographics innermost.
     ("idx_ss_hdemo", "store_sales",
      (["ss_hdemo_sk"], ["ss_sold_time_sk", "ss_store_sk"]), ("q96", "q88")),
@@ -1770,6 +1891,8 @@ def q67_pandas(t: Dict[str, "object"]):
     return u.head(100).reset_index(drop=True)
 
 
+from hyperspace_tpu.tpcds.queries_ext import QUERIES_EXT  # noqa: E402
+
 QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q3": (q3, q3_pandas),
     "q7": (q7, q7_pandas),
@@ -1799,3 +1922,4 @@ QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q96": (q96, q96_pandas),
     "q98": (q98, q98_pandas),
 }
+QUERIES.update(QUERIES_EXT)

@@ -163,7 +163,13 @@ def exclusive_create(path: str, data: bytes) -> bool:
         # Persistent 409: "another writer won" is only true if their
         # object actually landed — a crashed/aborted upload also 409s,
         # and silently reporting a loss then would corrupt the OCC log
-        # (the caller would trust a log entry that never exists).
+        # (the caller would trust a log entry that never exists). Drop
+        # any cached listing first: s3fs serves exists() from its
+        # dircache, which predates the race.
+        try:
+            fs.invalidate_cache(posixpath.dirname(real))
+        except Exception:
+            pass
         if fs.exists(real):
             return False
         raise last_conflict

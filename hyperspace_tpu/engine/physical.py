@@ -728,14 +728,26 @@ class SortMergeJoinExec(PhysicalNode):
         if self.how in ("left_semi", "left_anti"):
             # Membership joins: no expansion, no output from the right —
             # one encode + counting-match membership flags, then a
-            # single left-side gather. (No Exchange/Sort wrappers: the
-            # planner builds semi/anti sides bare.)
+            # single left-side gather. Over co-bucketed index layouts the
+            # match runs shard-local on the mesh (each shard owns both
+            # sides' rows of its buckets).
             from hyperspace_tpu.ops.join import semi_anti_indices
-            lbatch = self.left.execute(bucket)
-            rbatch = self.right.execute(bucket)
+            anti = self.how == "left_anti"
+            if self.bucketed:
+                lbatch, rbatch, l_lengths, r_lengths, mesh = \
+                    self._bucketed_inputs()
+                if mesh is not None:
+                    from hyperspace_tpu.parallel.join import (
+                        distributed_semi_anti_indices)
+                    idx = distributed_semi_anti_indices(
+                        lbatch, rbatch, l_lengths, r_lengths,
+                        self.left_keys, self.right_keys, mesh, anti=anti)
+                    return lbatch.take(idx)
+            else:
+                lbatch = self.left.execute(bucket)
+                rbatch = self.right.execute(bucket)
             idx = semi_anti_indices(lbatch, rbatch, self.left_keys,
-                                    self.right_keys,
-                                    anti=self.how == "left_anti")
+                                    self.right_keys, anti=anti)
             return lbatch.take(idx)
         if self.bucketed:
             # Co-partitioned bucket joins, batched into ONE compiled program
@@ -744,27 +756,8 @@ class SortMergeJoinExec(PhysicalNode):
             # mesh-parallel in `parallel/join.py`.
             from hyperspace_tpu.ops.bucketed_join import (
                 bucketed_sort_merge_join)
-            # The two sides' reads are independent IO — overlap them.
-            from concurrent.futures import ThreadPoolExecutor
-            with ThreadPoolExecutor(max_workers=2) as pool:
-                lf = pool.submit(self.left.execute_bucketed, self.num_buckets)
-                rf = pool.submit(self.right.execute_bucketed,
-                                 self.num_buckets)
-                lbatch, l_lengths = lf.result()
-                rbatch, r_lengths = rf.result()
-            # Host-lane sides skip the mesh in "auto" mode for the same
-            # reason FilterExec does: distribution would pay the device
-            # transfers the lane exists to avoid. Hot-bucket skew that
-            # would blow up the [S, C] shard layout routes single-chip,
-            # where the counting join's memory is bounded by true rows.
-            mesh = self._join_mesh(
-                lbatch.num_rows + rbatch.num_rows,
-                host_batch=lbatch.is_host and rbatch.is_host)
-            if mesh is not None:
-                from hyperspace_tpu.parallel.context import mesh_size
-                from hyperspace_tpu.parallel.join import shard_skew
-                if shard_skew(l_lengths, r_lengths, mesh_size(mesh)):
-                    mesh = None
+            lbatch, rbatch, l_lengths, r_lengths, mesh = \
+                self._bucketed_inputs()
             if mesh is not None:
                 from hyperspace_tpu.ops.bucketed_join import (
                     assemble_join_output)
@@ -843,18 +836,37 @@ class SortMergeJoinExec(PhysicalNode):
                                self.right_keys, how=self.how,
                                columns=self.out_columns)
 
+    def _bucketed_inputs(self):
+        """Read both sides in bucket order (overlapped IO) and decide the
+        mesh: None when no mesh applies, the batches are host-lane in
+        "auto" mode (distribution would pay the device transfers the lane
+        exists to avoid), or hot-bucket skew would blow up the [S, C]
+        shard layout (single-chip counting memory is bounded by true
+        rows). Shared by the payload join and the membership branch."""
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            lf = pool.submit(self.left.execute_bucketed, self.num_buckets)
+            rf = pool.submit(self.right.execute_bucketed, self.num_buckets)
+            lbatch, l_lengths = lf.result()
+            rbatch, r_lengths = rf.result()
+        mesh = self._join_mesh(lbatch.num_rows + rbatch.num_rows,
+                               host_batch=lbatch.is_host and rbatch.is_host)
+        if mesh is not None:
+            from hyperspace_tpu.parallel.context import mesh_size
+            from hyperspace_tpu.parallel.join import shard_skew
+            if shard_skew(l_lengths, r_lengths, mesh_size(mesh)):
+                mesh = None
+        return lbatch, rbatch, l_lengths, r_lengths, mesh
+
     def _join_mesh(self, total_rows: int, host_batch: bool = False):
-        """Mesh for the distributed co-bucketed join, or None. Covers
-        inner and all outer types; semi/anti return from execute() via
-        the membership branch before bucketed execution, so their
-        distributed variant (`parallel/join.distributed_semi_anti_indices`)
-        is not routed from here yet — the planner builds semi/anti sides
-        without the bucketed layout. Requires the bucket<->shard map
-        (num_buckets divisible by mesh size)."""
+        """Mesh for the distributed co-bucketed join, or None — every
+        equi-join type the sharded counting match covers (inner, the
+        outers, and the semi/anti membership probes). Requires the
+        bucket<->shard map (num_buckets divisible by mesh size)."""
         from hyperspace_tpu.parallel.context import (mesh_size,
                                                      should_distribute)
         if self.how not in ("inner", "left_outer", "right_outer",
-                            "full_outer"):
+                            "full_outer", "left_semi", "left_anti"):
             return None
         mesh = should_distribute(self.conf, total_rows,
                                  host_batch=host_batch)
@@ -1407,33 +1419,19 @@ def _plan_physical_node(plan: LogicalPlan,
                        for c in right_h.children]), required, conf, ctx)
         left_keys, right_keys = _join_keys(plan.condition, plan.left.schema,
                                            plan.right.schema)
-        if plan.join_type in ("left_semi", "left_anti"):
-            # Membership join: the right side contributes only its keys,
-            # and no Exchange/Sort wrapping is needed (the executor's
-            # searchsorted membership probe sorts nothing but ids).
+        membership = plan.join_type in ("left_semi", "left_anti")
+        if membership:
+            # Membership join: the right side contributes only its keys.
+            out_columns = None
             left_required = ({n for n in required
                               if plan.left.schema.contains(n)}
                              | set(left_keys))
-            left_phys = _plan_physical(plan.left, left_required, conf, ctx)
-            right_phys = _plan_physical(plan.right, set(right_keys), conf,
-                                        ctx)
-            threshold = conf.broadcast_threshold if conf is not None else 0
-            if threshold > 0:
-                est = _estimated_plan_bytes(plan.right, set(right_keys))
-                if est is not None and est <= threshold:
-                    # Small membership side: direct-address probe instead
-                    # of the counting-match's joint sort of both sides.
-                    return BroadcastHashJoinExec(
-                        left_phys, right_phys, left_keys, right_keys,
-                        build_side="right", how=plan.join_type, conf=conf)
-            return SortMergeJoinExec(
-                left_phys, right_phys,
-                left_keys, right_keys, bucketed=False,
-                how=plan.join_type, conf=conf)
-        out_columns = {n.lower() for n in required}
-        left_required, right_required = _split_join_required(
-            set(required), plan.left.schema, plan.right.schema,
-            left_keys, right_keys)
+            right_required = set(right_keys)
+        else:
+            out_columns = {n.lower() for n in required}
+            left_required, right_required = _split_join_required(
+                set(required), plan.left.schema, plan.right.schema,
+                left_keys, right_keys)
         left_phys = _plan_physical(plan.left, left_required, conf, ctx)
         right_phys = _plan_physical(plan.right, right_required, conf, ctx)
 
@@ -1471,6 +1469,21 @@ def _plan_physical_node(plan: LogicalPlan,
             return all(plan.left.schema.field(lk).dtype
                        == plan.right.schema.field(rk).dtype
                        for lk, rk in zip(left_keys, right_keys))
+
+        threshold = conf.broadcast_threshold if conf is not None else 0
+        if membership and threshold > 0:
+            # For MEMBERSHIP joins a small right side beats even an
+            # aligned bucketed layout: the direct-address probe is one
+            # gather over the left, no joint counting match — so
+            # broadcast outranks the bucketed path here (unlike payload
+            # joins, where the index pair's zero-work layout wins).
+            est = _estimated_plan_bytes(plan.right, right_required)
+            if est is not None and est <= threshold:
+                return BroadcastHashJoinExec(left_phys, right_phys,
+                                             left_keys, right_keys,
+                                             build_side="right",
+                                             how=plan.join_type, conf=conf,
+                                             out_columns=out_columns)
 
         aligned = _align_to_spec(lspec)
         # The right layout must hash the MAPPED columns in the same
@@ -1514,7 +1527,6 @@ def _plan_physical_node(plan: LogicalPlan,
         # reference E2E suite pinning autoBroadcastJoinThreshold to -1,
         # `E2EHyperspaceRulesTests.scala:42`). The probe side must keep
         # ALL its rows, so outer joins only broadcast their inner side.
-        threshold = conf.broadcast_threshold if conf is not None else 0
         if threshold > 0:
             build = None
             if plan.join_type in ("inner", "left_outer"):
@@ -1531,6 +1543,12 @@ def _plan_physical_node(plan: LogicalPlan,
                                              build_side=build,
                                              how=plan.join_type, conf=conf,
                                              out_columns=out_columns)
+        if membership:
+            # Bare membership probe: Exchange/Sort wrappers would be pure
+            # overhead — the counting match sorts only ids.
+            return SortMergeJoinExec(left_phys, right_phys, left_keys,
+                                     right_keys, bucketed=False,
+                                     how=plan.join_type, conf=conf)
         # General path: hash exchange + sort on each side.
         num_partitions = max(lspec.num_buckets if lspec else 0,
                              rspec.num_buckets if rspec else 0, 200)

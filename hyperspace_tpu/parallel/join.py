@@ -24,12 +24,12 @@ host sync that sizes the output):
 3. right-run brackets via cumulative max/min counting — no searchsorted;
 4. counts -> global exclusive cumsum -> expansion to (li, ri) pairs.
 
-Coverage: inner / left_outer (callers swap for right_outer) and
-full_outer (left_outer expansion + unmatched-right append from the same
-match) are wired into `SortMergeJoinExec`; semi/anti membership is
-available here (`distributed_semi_anti_indices`) but the engine's
-semi/anti branch runs before bucketed execution, so it is exercised by
-tests and the driver dryrun, not yet routed from the planner.
+Coverage: inner / left_outer (callers swap for right_outer), full_outer
+(left_outer expansion + unmatched-right append from the same match), and
+semi/anti membership — all wired into `SortMergeJoinExec`, which routes
+co-bucketed sides here whenever a mesh is active (semi/anti over
+index-pair layouts included, since round 4's planner keeps their
+bucketed alignment instead of always probing bare).
 
 When bucket counts differ (the ranker's fallback), `rebucket` routes the
 smaller side through the build pipeline's all_to_all to the larger side's

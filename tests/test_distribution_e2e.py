@@ -107,6 +107,41 @@ def test_e2e_join_query_distributed(dist_env):
     pd.testing.assert_frame_equal(plain, indexed)
 
 
+def test_e2e_semi_anti_distributed_bucketed(dist_env):
+    """Semi/anti over an index pair ride the co-bucketed MESH membership
+    path (round 4): the planner keeps their bucketed alignment and the
+    executor routes `distributed_semi_anti_indices`. Results must equal
+    rules-off, and the plan must be a bucketed SMJ with no Exchange."""
+    from hyperspace_tpu.engine.physical import SortMergeJoinExec
+
+    session, hs, src = dist_env
+    # Broadcast would shortcut the small right side; pin it off to
+    # exercise the bucketed membership (reference-E2E style).
+    session.conf.set("hyperspace.broadcast.threshold", -1)
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("dsl", ["imprs"], ["id", "clicks"]))
+    hs.create_index(df, IndexConfig("dsr", ["imprs"], ["score", "id"]))
+    left = df.select("imprs", "id", "clicks")
+    # Selective membership side (only the imprs of three rows) so BOTH
+    # semi and anti keep rows.
+    right = df.select("imprs", "id", "score").filter(col("id") < 3) \
+        .select("imprs", "score")
+    for how in ("left_semi", "left_anti"):
+        query = left.join(right, on="imprs", how=how)
+        plain, indexed = run_with_and_without(
+            session, query, ["imprs", "id"])
+        assert len(plain) > 0
+        pd.testing.assert_frame_equal(plain, indexed)
+        session.enable_hyperspace()
+        _, _, physical = query.explain_plans()
+        session.disable_hyperspace()
+        smj = [n for n in physical.collect()
+               if isinstance(n, SortMergeJoinExec)]
+        names = [type(n).__name__ for n in physical.collect()]
+        assert smj and smj[0].bucketed and smj[0].how == how, names
+        assert names.count("ExchangeExec") == 0
+
+
 def test_distributed_filter_matches_single_chip(tmp_path):
     """Unit-level: `parallel.scan.distributed_filter` equals
     `engine.compiler.apply_filter` on nullable + string data."""

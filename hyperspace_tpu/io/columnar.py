@@ -40,6 +40,25 @@ def _jnp():
     return jnp
 
 
+_fused_take_jit = None
+
+
+def _fused_take(arrays, indices):
+    """All columns' row gather as ONE jitted executable (see
+    ColumnBatch.take)."""
+    global _fused_take_jit
+    if _fused_take_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def _take_all(arrs, idx):
+            return tuple(jnp.take(a, idx, axis=0) for a in arrs)
+
+        _fused_take_jit = _take_all
+    return _fused_take_jit(arrays, indices)
+
+
 def _string_hash64(values: np.ndarray) -> np.ndarray:
     """FNV-1a 64-bit over utf-8 bytes of each value (host side, once per
     dictionary entry — O(dictionary), not O(rows)). Uses the native C++
@@ -145,19 +164,38 @@ class ColumnBatch:
 
     def take(self, indices) -> "ColumnBatch":
         """Row gather by index array. Host-lane batches gather with numpy
-        (no device round-trip) when the indices are host-side too."""
+        (no device round-trip) when the indices are host-side too. Device
+        batches gather every column (+validity) through ONE jitted
+        executable — per-column eager takes would each pay a compile
+        round-trip on a tunneled backend (~25s apiece at novel shapes)."""
         host = (isinstance(indices, np.ndarray)
                 and all(c.is_host for c in self.columns.values()))
-        xp = np if host else _jnp()
+        if host:
+            out = {}
+            for name, col in self.columns.items():
+                out[name] = DeviceColumn(
+                    data=np.take(col.data, indices, axis=0),
+                    dtype=col.dtype,
+                    validity=(np.take(col.validity, indices, axis=0)
+                              if col.validity is not None else None),
+                    dictionary=col.dictionary,
+                    dict_hashes=col.dict_hashes)
+            return ColumnBatch(self.schema, out)
+        jnp = _jnp()
+        arrays = []
+        for col in self.columns.values():
+            arrays.append(jnp.asarray(col.data))
+            if col.validity is not None:
+                arrays.append(jnp.asarray(col.validity))
+        gathered = list(_fused_take(tuple(arrays), jnp.asarray(indices)))
         out = {}
         for name, col in self.columns.items():
-            out[name] = DeviceColumn(
-                data=xp.take(col.data, indices, axis=0),
-                dtype=col.dtype,
-                validity=(xp.take(col.validity, indices, axis=0)
-                          if col.validity is not None else None),
-                dictionary=col.dictionary,
-                dict_hashes=col.dict_hashes)
+            data = gathered.pop(0)
+            validity = gathered.pop(0) if col.validity is not None else None
+            out[name] = DeviceColumn(data=data, dtype=col.dtype,
+                                     validity=validity,
+                                     dictionary=col.dictionary,
+                                     dict_hashes=col.dict_hashes)
         return ColumnBatch(self.schema, out)
 
 

@@ -23,6 +23,28 @@ from hyperspace_tpu.plan.nodes import AggSpec
 from hyperspace_tpu.plan.schema import Schema
 
 
+@__import__("jax").jit
+def _group_phase_a(operands):
+    """(sort permutation, sorted-space segment ids) of the group-key
+    lanes, fused into one executable (staged sort + adjacent-difference
+    segmenting; the narrow path's sort yields the sorted lanes for
+    free — no re-gather)."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.keys import _staged_sort
+
+    ops = list(operands)
+    n = ops[0].shape[0]
+    perm, sorted_ops = _staged_sort(ops)
+    differs = jnp.zeros(n, dtype=jnp.int32)
+    for k in sorted_ops:
+        differs = differs | jnp.concatenate(
+            [jnp.zeros(1, dtype=jnp.int32),
+             (k[1:] != k[:-1]).astype(jnp.int32)])
+    segment_ids = jnp.cumsum(differs, dtype=jnp.int32)
+    return perm, segment_ids
+
+
 def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
                     aggregates: Sequence[AggSpec],
                     out_schema: Schema) -> ColumnBatch:
@@ -81,17 +103,12 @@ def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
         operands: List = []
         for name in group_columns:
             operands.extend(column_sort_lanes(batch.column(name)))
-        iota = jnp.arange(n, dtype=jnp.int32)
-        results = jax.lax.sort([*operands, iota], num_keys=len(operands),
-                               is_stable=True)
-        perm = results[-1]
-        keys_sorted = results[:-1]
-        differs = jnp.zeros(n, dtype=jnp.int32)
-        for k in keys_sorted:
-            differs = differs | jnp.concatenate(
-                [jnp.zeros(1, dtype=jnp.int32),
-                 (k[1:] != k[:-1]).astype(jnp.int32)])
-        segment_ids = jnp.cumsum(differs, dtype=jnp.int32)
+        # ONE fused executable: staged narrow-pass sort (wide groupings —
+        # q64's 15 columns — explode XLA's variadic comparator compile
+        # time) + segment-id derivation. Separate eager ops would each
+        # pay a compile round-trip over the tunneled backend.
+        perm, segment_ids = _group_phase_a(
+            tuple(jnp.asarray(op) for op in operands))
         num_groups = int(segment_ids[-1]) + 1  # the one host sync
         sorted_batch = batch.take(perm)
         # Representative row (first of each segment) carries the group keys.
@@ -143,6 +160,8 @@ def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
             # masked value can never swallow a valid run start.
             lanes = column_sort_lanes(src)
             invalid = (~valid).astype(jnp.int32)
+            # Bounded width (one column: <= 5 operands) — the single
+            # fused sort also returns the sorted lanes.
             res = jax.lax.sort([segment_ids, invalid, *lanes],
                                num_keys=2 + len(lanes))
             seg_s, inv_s, lanes_s = res[0], res[1], res[2:]

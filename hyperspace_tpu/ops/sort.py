@@ -81,14 +81,10 @@ def sort_permutation(batch: ColumnBatch, by: Sequence[str],
             operands.extend(lanes)
         # np.lexsort's primary key is the LAST operand.
         return np.lexsort(tuple(reversed(operands))).astype(np.int32)
-    import jax
-    import jax.numpy as jnp
+    from hyperspace_tpu.ops.keys import staged_sort_permutation
 
     operands = list(leading_keys or []) + _key_operands(batch, by)
-    iota = jnp.arange(batch.num_rows, dtype=jnp.int32)
-    results = jax.lax.sort([*operands, iota], num_keys=len(operands),
-                           is_stable=True)
-    return results[-1]
+    return staged_sort_permutation(operands)
 
 
 def sort_batch(batch: ColumnBatch, by: Sequence[str],

@@ -531,3 +531,31 @@ def test_float_group_by_negative_zero_one_group():
                           out_schema)
     assert out.num_rows == 1
     assert int(np.asarray(out.column("sv").data)[0]) == 6
+
+
+def test_staged_sort_permutation_matches_wide_sort():
+    """Wide key sets (> MAX_SORT_OPERANDS) sort via staged LSD passes;
+    the permutation must equal the single wide lexicographic sort (XLA's
+    wide variadic comparator is the q64 compile-time explosion the
+    staging exists to avoid)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.keys import (MAX_SORT_OPERANDS,
+                                         staged_sort_permutation)
+
+    rng = np.random.default_rng(5)
+    n = 5000
+    k = MAX_SORT_OPERANDS * 2 + 3  # forces three chunked passes
+    operands = [jnp.asarray(rng.integers(0, 4, n).astype(np.int32))
+                for _ in range(k)]
+    got = staged_sort_permutation(operands)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    want = jax.lax.sort([*operands, iota], num_keys=k,
+                        is_stable=True)[-1]
+    assert (np.asarray(got) == np.asarray(want)).all()
+    # narrow path identity too
+    got2 = staged_sort_permutation(operands[:3])
+    want2 = jax.lax.sort([*operands[:3], iota], num_keys=3,
+                         is_stable=True)[-1]
+    assert (np.asarray(got2) == np.asarray(want2)).all()

@@ -1,0 +1,82 @@
+"""Shared bench utilities: link-health probe + timing helpers.
+
+The tunneled device link this rig benches over is SHARED and wobbles ~2x
+by time of day (round-4 committed artifact hit a degraded window; its
+own pandas lane swung 40-60% same-day). Every artifact therefore
+carries a `link_probe` — raw device_put bandwidth + scalar-fetch sync
+latency, median of N — so a regression in a committed number can be
+attributed to code vs link after the fact, and per-phase timings report
+median alongside best.
+"""
+
+import statistics
+import sys
+import time
+
+import numpy as np
+
+PROBE_RUNS = 5
+PROBE_BYTES = 32 * 1024 * 1024
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def link_probe(runs: int = PROBE_RUNS) -> dict:
+    """Median raw-link health over `runs` trials: host->device bandwidth
+    (one `device_put` of 32 MB float32, synced) and sync round-trip
+    latency (fetch of an already-computed device scalar). Runs against
+    whatever backend jax resolves (the real chip under the driver; CPU
+    locally) — the artifact records which."""
+    import jax
+
+    dev = jax.devices()[0]
+    # DISTINCT payloads per trial: a repeated put of the same host array
+    # can hit client-side caching and under-report.
+    rng = np.random.default_rng(0)
+    payloads = [rng.random(PROBE_BYTES // 4).astype(np.float32)
+                for _ in range(runs)]
+    jax.device_put(payloads[0], dev).block_until_ready()  # warm the path
+
+    bump = jax.jit(lambda x: x + 1.0)
+    small = jax.device_put(np.float32(1.0), dev)
+    float(bump(small))  # warm compile
+    h2d_s, sync_s = [], []
+    for i in range(runs):
+        t0 = time.perf_counter()
+        jax.device_put(payloads[i], dev).block_until_ready()
+        h2d_s.append(time.perf_counter() - t0)
+        # One jitted dispatch + device->host scalar fetch: the cost every
+        # output-sizing sync in query execution pays.
+        t0 = time.perf_counter()
+        small = bump(small)
+        float(small)
+        sync_s.append(time.perf_counter() - t0)
+    probe = {
+        "platform": dev.platform,
+        "h2d_mb_s": round(PROBE_BYTES / (1 << 20) / statistics.median(h2d_s),
+                          1),
+        "sync_latency_s": round(statistics.median(sync_s), 4),
+        "h2d_s_all": [round(x, 4) for x in h2d_s],
+        "sync_s_all": [round(x, 4) for x in sync_s],
+    }
+    log(f"link probe: {probe['h2d_mb_s']} MB/s h2d, "
+        f"{probe['sync_latency_s'] * 1e3:.1f} ms sync "
+        f"({dev.platform})")
+    return probe
+
+
+def timed_runs(fn, runs: int, label: str = ""):
+    """Run `fn` `runs` times; returns (best_s, median_s, last_output).
+    Medians ride next to best in every artifact so a lucky single run
+    can't carry a headline."""
+    times = []
+    out = None
+    for i in range(runs):
+        t0 = time.perf_counter()
+        out = fn()
+        elapsed = time.perf_counter() - t0
+        log(f"  {label} run {i}: {elapsed:.3f}s")
+        times.append(elapsed)
+    return min(times), statistics.median(times), out

@@ -45,19 +45,14 @@ QUERY_FILTER = [q for q in os.environ.get(
     "BENCH_TPCDS_QUERIES", "").split(",") if q]
 
 
-def log(msg):
-    print(msg, file=sys.stderr, flush=True)
+from bench_common import link_probe, log, timed_runs  # noqa: E402
 
 
 def best_of(fn, runs=WARM_RUNS, label=""):
-    best, out = float("inf"), None
-    for i in range(runs):
-        t0 = time.perf_counter()
-        out = fn()
-        elapsed = time.perf_counter() - t0
-        log(f"  {label} run {i}: {elapsed:.3f}s")
-        best = min(best, elapsed)
-    return best, out
+    """(best_s, median_s, out) over warm runs (medians ride along in the
+    artifact so a lucky run can't carry a headline — round-4 review)."""
+    best, median, out = timed_runs(fn, runs, label)
+    return best, median, out
 
 
 def norm(df):
@@ -98,18 +93,21 @@ def main():
         pdfs = {n: pq.read_table(os.path.join(p, "part-0.parquet"))
                 .to_pandas() for n, p in paths.items()}
 
+        probe = link_probe()
         queries = {}
         tot_on = tot_off = tot_cpu = 0.0
         for name, (build, oracle) in selected.items():
-            cpu_s, expected = best_of(lambda: oracle(pdfs),
-                                      label=f"{name} pandas")
+            cpu_s, cpu_med, expected = best_of(lambda: oracle(pdfs),
+                                               label=f"{name} pandas")
             sess.enable_hyperspace()
             build(dfs).collect()  # warm (compiles, file listings)
-            on_s, got_on = best_of(lambda: build(dfs).collect().to_pandas(),
-                                   label=f"{name} rules-on")
+            on_s, on_med, got_on = best_of(
+                lambda: build(dfs).collect().to_pandas(),
+                label=f"{name} rules-on")
             sess.disable_hyperspace()
-            off_s, got_off = best_of(lambda: build(dfs).collect().to_pandas(),
-                                     label=f"{name} rules-off")
+            off_s, off_med, got_off = best_of(
+                lambda: build(dfs).collect().to_pandas(),
+                label=f"{name} rules-off")
             for got, tag in ((got_on, "rules-on"), (got_off, "rules-off")):
                 pd.testing.assert_frame_equal(
                     norm(got), norm(expected), check_dtype=False,
@@ -119,6 +117,9 @@ def main():
             queries[name] = {"rules_on_s": round(on_s, 4),
                              "rules_off_s": round(off_s, 4),
                              "pandas_s": round(cpu_s, 4),
+                             "rules_on_median_s": round(on_med, 4),
+                             "rules_off_median_s": round(off_med, 4),
+                             "pandas_median_s": round(cpu_med, 4),
                              "vs_baseline": round(cpu_s / on_s, 3),
                              "vs_no_index": round(off_s / on_s, 3),
                              "rows": int(len(expected))}
@@ -135,6 +136,7 @@ def main():
             "vs_baseline": round(tot_cpu / tot_on, 3),
             "scale": SCALE,
             "index_build_s": round(index_build_s, 2),
+            "link_probe": probe,
             "queries": queries,
         }))
     finally:

@@ -30,6 +30,20 @@ from hyperspace_tpu.plan.nodes import Scan
 from hyperspace_tpu.plan.serde import plan_to_json
 
 
+def index_data_stats(root: str) -> dict:
+    """On-disk stats of an index data root: total bytes + row count (from
+    parquet footers — no data read). Computed at build time and stored in
+    the log entry so no query-time code needs a filesystem walk."""
+    from hyperspace_tpu.io import parquet
+    from hyperspace_tpu.utils.file_utils import get_directory_size
+
+    size = int(get_directory_size(root))
+    files = [f for per_bucket in parquet.bucket_files(root).values()
+             for f in per_bucket]
+    rows = int(sum(parquet.file_row_counts(files))) if files else 0
+    return {"dataSizeBytes": size, "rowCount": rows}
+
+
 class CreateActionBase(Action):
     """Shared machinery for Create/Refresh (reference `CreateActionBase.scala`)."""
 
@@ -152,6 +166,20 @@ class CreateActionBase(Action):
                     self.num_buckets(), path, conf=self.conf,
                     lineage_ids=self.lineage_id_map(df))
 
+    def stamp_stats(self) -> None:
+        """Persist the written index data's on-disk size and row count in
+        the entry (`extra.stats`), measured ONCE at build/refresh time from
+        the files just written. Query-time ranking
+        (`FilterIndexRule._rank`) reads these instead of walking the data
+        root per optimization pass — the reference keeps everything a rule
+        decision needs inside the log entry the same way
+        (`index/IndexLogEntry.scala:80-125`). Called at the end of every
+        data-writing `op()`, before `end()` serializes the entry."""
+        if self._entry is None:
+            return
+        self._entry.extra["stats"] = index_data_stats(
+            self._entry.content.root)
+
 
 class CreateAction(CreateActionBase):
     """transient CREATING -> final ACTIVE (reference `CreateAction.scala:27-75`)."""
@@ -197,3 +225,4 @@ class CreateAction(CreateActionBase):
 
     def op(self) -> None:
         self.write(self.df, self.index_config, self.index_data_path)
+        self.stamp_stats()

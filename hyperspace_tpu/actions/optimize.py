@@ -62,3 +62,4 @@ class OptimizeAction(CreateActionBase):
         from hyperspace_tpu.io.builder import compact_index
         compact_index(self.previous_entry, self.data_manager,
                       self.index_data_path)
+        self.stamp_stats()

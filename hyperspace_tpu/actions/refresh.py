@@ -86,3 +86,4 @@ class RefreshAction(CreateActionBase):
         """Reference `RefreshAction.scala:72-77` — rebuild into the next
         version dir; the old dir is retained for in-flight readers."""
         self.write(self.df, self.index_config, self.index_data_path)
+        self.stamp_stats()

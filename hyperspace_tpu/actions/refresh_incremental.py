@@ -194,6 +194,7 @@ class RefreshIncrementalAction(RefreshAction):
                           os.path.join(out_dir, parquet.BUCKET_SPEC_FILE))
 
         if not appended:
+            self.stamp_stats()
             return  # metadata-only refresh (signature/file set catches up)
         cfg = self.index_config
         source_scan = self._source_scans()[-1]
@@ -211,3 +212,4 @@ class RefreshIncrementalAction(RefreshAction):
         delta_version = os.path.basename(out_dir).split("=")[-1]
         write_bucketed_table(table, cfg.indexed_columns, self.num_buckets(),
                              out_dir, file_suffix=f"delta{delta_version}")
+        self.stamp_stats()

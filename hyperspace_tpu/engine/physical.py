@@ -1185,11 +1185,33 @@ def _estimated_plan_bytes(plan: LogicalPlan,
         width = sum(_DTYPE_WIDTH.get(f.dtype, 8) for f in plan.schema.fields
                     if f.name.lower() in lowered)
         return rows * max(width, 1)
-    if isinstance(plan, (Filter, Project, Sort, Limit)):
-        # Row count bounded by the child's (Filter/Limit only shrink);
-        # keep the SAME required set — renamed/computed projections just
-        # fall out of the width sum, and rows dominate the estimate.
+    if isinstance(plan, (Filter, Sort, Limit)):
+        # Row count bounded by the child's (Filter/Limit only shrink).
         return _estimated_plan_bytes(plan.child, required)
+    if isinstance(plan, Project):
+        # Map required OUTPUT names back through the projection to child
+        # columns (Spark's statistics propagation does the same): a
+        # renamed/computed column must contribute its SOURCE columns'
+        # width, not silently zero — a side whose broadcast-relevant
+        # columns are all computed would otherwise be underestimated and
+        # admitted past the threshold. Unmappable entries fall back to
+        # the full child width.
+        lowered = {r.lower() for r in required}
+        child_req: Set[str] = set()
+        for c in plan.columns:
+            if isinstance(c, str):
+                if c.lower() in lowered:
+                    child_req.add(c)
+                continue
+            if c.name.lower() not in lowered:
+                continue
+            try:
+                refs = c.child.references()
+            except Exception:
+                return _estimated_plan_bytes(
+                    plan.child, set(plan.child.schema.names))
+            child_req |= refs
+        return _estimated_plan_bytes(plan.child, child_req)
     if isinstance(plan, Union):
         total = 0
         for c in plan.children:

@@ -134,8 +134,14 @@ def _probe_lookup(probe: ColumnBatch, probe_keys: Sequence[str], table,
     ok = xp.ones(n, dtype=bool) if valid is None else xp.asarray(valid)
     idx = xp.zeros(n, dtype=np.int64)
     for a, mn, r in zip(arrays, mins, ranges):
-        d = xp.asarray(a).astype(np.int64) - mn
-        ok = ok & (d >= 0) & (d < r)
+        av = xp.asarray(a).astype(np.int64)
+        # Range-check on the ORIGINAL values (comparisons cannot wrap);
+        # `av - mn` can wrap in int64 for adversarial probe keys near
+        # INT64_MIN against builds near INT64_MAX, and a wrapped digit
+        # must never slip into [0, r) as a false match. mn + (r - 1) is
+        # the build max, exact in Python ints.
+        ok = ok & (av >= mn) & (av <= mn + (r - 1))
+        d = av - mn
         idx = idx * r + xp.clip(d, 0, r - 1)
     hit = xp.where(ok, xp.take(table_x, xp.where(ok, idx, 0)),
                    np.int32(-1)).astype(np.int32)

@@ -224,7 +224,11 @@ def _shard_match_core(lanes2d, pad, null, Cl: int, left_outer: bool,
     if left_outer:
         # Every REAL left element (incl. null keys) emits at least once.
         counts = jnp.maximum(counts, is_left.astype(counts.dtype))
-    flat = counts.reshape(-1)
+    # int64 accumulation: a distributed join can produce more than 2^31
+    # output pairs; the int32 per-slot counts must not overflow silently
+    # in the running total (the expansion sync turns `starts[-1] + ...`
+    # into the output size).
+    flat = counts.reshape(-1).astype(jnp.int64)
     starts = jnp.cumsum(flat) - flat
 
     right_unmatched = None

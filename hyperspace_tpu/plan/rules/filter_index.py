@@ -27,6 +27,25 @@ from hyperspace_tpu.plan.rules.base import Rule
 logger = logging.getLogger(__name__)
 
 
+def _entry_size_bytes(entry: IndexLogEntry) -> int:
+    """On-disk size of the index data, from the stats the build stamped
+    into the log entry (`extra.stats.dataSizeBytes`, written by
+    `actions/create.stamp_stats`) — ZERO filesystem calls on this path.
+    Entries from builds predating the stamp fall back to one directory
+    walk (compatibility only; every data-writing action now stamps)."""
+    stats = entry.extra.get("stats") if isinstance(entry.extra, dict) else None
+    if isinstance(stats, dict):
+        try:
+            return int(stats.get("dataSizeBytes", 0))
+        except (TypeError, ValueError):
+            return 0
+    from hyperspace_tpu.utils.file_utils import get_directory_size
+    try:
+        return int(get_directory_size(entry.content.root))
+    except OSError:
+        return 0
+
+
 class FilterIndexRule(Rule):
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
         self._sig_cache = {}
@@ -168,21 +187,19 @@ class FilterIndexRule(Rule):
         bytes). Ties break toward MORE buckets (finer point-filter
         bucket pruning: each point value reads 1/num_buckets of the
         files), then name for determinism."""
-        from hyperspace_tpu.utils.file_utils import get_directory_size
-
         sizes = []
         for entry in candidates:
-            try:
-                size = get_directory_size(entry.content.root)
-            except OSError:
-                size = 0
+            size = _entry_size_bytes(entry)
             # 0 bytes means missing/unreadable as much as legitimately
-            # empty (`get_directory_size` reports both as 0). An index
-            # whose data root was deleted out-of-band must never WIN the
+            # empty. An index whose data root vanished must never WIN the
             # ranking by looking free: candidates with real bytes beat
-            # 0-byte ones outright (covering siblings index the same
-            # source, so a lone 0 is damage, not data); with no sized
-            # candidate at all, fall back to the column-count proxy.
+            # 0-byte ones outright; with no sized candidate at all, fall
+            # back to the column-count proxy. NOTE: stamped stats are
+            # trusted as-is (metadata-only ranking, zero FS calls) — a
+            # data root deleted out-of-band AFTER a stamped build is not
+            # re-detected here and fails loudly at scan time instead;
+            # the walk fallback preserves the 0-byte guard only for
+            # legacy stampless entries.
             sizes.append(size if size > 0 else None)
         sized = [(s, e) for s, e in zip(sizes, candidates) if s is not None]
         if sized:

@@ -74,11 +74,12 @@ def _protocols(fs) -> set:
 
 
 def _is_precondition_failure(exc: Exception) -> bool:
-    """Lost-the-race signatures across backends: GCS/S3 surface HTTP 412
-    (PreconditionFailed); some wrappers raise FileExistsError directly.
-    Typed status attributes are checked before any text matching so an
-    unrelated error whose message merely CONTAINS such a string (request
-    ids, byte counts) is re-raised, not misread as a lost race."""
+    """TYPED lost-the-race signatures across backends: GCS/S3 surface
+    HTTP 412 (PreconditionFailed); some wrappers raise FileExistsError
+    directly. Deliberately no message-text matching here — an unrelated
+    backend error whose text merely echoes the string must not silently
+    become "another writer won" (a dropped OCC commit); the text path is
+    `_lost_race`, which verifies the other writer's object exists."""
     if isinstance(exc, FileExistsError):
         return True
     for attr in ("code", "status", "status_code"):
@@ -91,8 +92,29 @@ def _is_precondition_failure(exc: Exception) -> bool:
         if (meta.get("HTTPStatusCode") == 412
                 or error.get("Code") in ("PreconditionFailed", "412")):
             return True
+    return False
+
+
+def _lost_race(fs, real: str, exc: Exception) -> bool:
+    """True iff `exc` means a concurrent writer beat this one. Typed 412
+    signatures are trusted as-is; a message that merely *reads* like a
+    precondition failure (wrapper exceptions that flatten the status into
+    text) is only believed after verifying the winner's object actually
+    exists — with the listing cache dropped first, since fsspec serves
+    exists() from a dircache that predates the race."""
+    if _is_precondition_failure(exc):
+        return True
     compact = f"{type(exc).__name__}{exc}".replace(" ", "").lower()
-    return "preconditionfailed" in compact
+    if "preconditionfailed" not in compact:
+        return False
+    try:
+        fs.invalidate_cache(posixpath.dirname(real))
+    except Exception:
+        pass
+    try:
+        return bool(fs.exists(real))
+    except Exception:
+        return False
 
 
 def _is_conflict(exc: Exception) -> bool:
@@ -133,7 +155,7 @@ def exclusive_create(path: str, data: bytes) -> bool:
                 f"gcsfs on this system does not accept "
                 f"if_generation_match: {exc}")
         except Exception as exc:
-            if _is_precondition_failure(exc):
+            if _lost_race(fs, real, exc):
                 return False
             raise
     if protos & {"s3", "s3a"}:
@@ -153,7 +175,7 @@ def exclusive_create(path: str, data: bytes) -> bool:
                     f"s3fs on this system does not accept IfNoneMatch: "
                     f"{exc}")
             except Exception as exc:
-                if _is_precondition_failure(exc):
+                if _lost_race(fs, real, exc):
                     return False
                 if _is_conflict(exc):
                     last_conflict = exc

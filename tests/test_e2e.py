@@ -144,6 +144,36 @@ def test_index_lifecycle_and_catalog(env):
     assert list(hs.indexes()["state"]) == ["ACTIVE"]
 
 
+def test_create_stamps_index_stats(env):
+    """Every data-writing action persists on-disk size + row count in the
+    log entry (`extra.stats`) at build time, so rule ranking never walks
+    the filesystem at query time (round-4 review item 6)."""
+    from hyperspace_tpu.utils.file_utils import get_directory_size
+
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("st", ["clicks"], ["id"]))
+    manager = Hyperspace.get_context(session).index_collection_manager
+
+    def entry_of(name):
+        (e,) = [x for x in manager.get_indexes() if x.name == name]
+        return e
+
+    entry = entry_of("st")
+    stats = entry.extra["stats"]
+    assert stats["rowCount"] == 1000
+    assert stats["dataSizeBytes"] == get_directory_size(entry.content.root)
+    assert stats["dataSizeBytes"] > 0
+
+    hs.refresh_index("st")
+    manager.clear_cache()
+    entry = entry_of("st")
+    stats = entry.extra["stats"]
+    assert stats["rowCount"] == 1000
+    assert "v__=1" in entry.content.root
+    assert stats["dataSizeBytes"] == get_directory_size(entry.content.root)
+
+
 def test_create_validations(env):
     session, hs, src = env
     df = session.read_parquet(src)

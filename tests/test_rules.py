@@ -114,6 +114,36 @@ def test_filter_rule_cost_based_ranking(session, tmp_path):
     assert "zNarrow" in out.collect_leaves()[0].root_paths[0]
 
 
+def test_filter_rule_ranking_uses_stamped_stats_no_fs(session, tmp_path,
+                                                      monkeypatch):
+    """Entries carrying build-time stats (`extra.stats`) are ranked from
+    metadata ONLY — zero filesystem calls on the rank path (round-4
+    review item 6). The directory walk is only a fallback for entries
+    predating the stamp."""
+    import hyperspace_tpu.plan.rules.filter_index as fi
+    from hyperspace_tpu.utils import file_utils
+
+    scan = base_scan(tmp_path)
+    wide = fabricate_index(session, "aWide", ["c1"], ["c2", "c3", "c4"],
+                           scan)
+    narrow = fabricate_index(session, "zNarrow", ["c1"], ["c2"], scan)
+    # Stamp stats the way the build does; sizes contradict what any disk
+    # walk would find (no data dirs exist at all).
+    for entry, nbytes in ((wide, 4096), (narrow, 64)):
+        entry.extra["stats"] = {"dataSizeBytes": nbytes, "rowCount": 10}
+
+    calls = []
+
+    def counting_walk(path):
+        calls.append(path)
+        return 0
+
+    monkeypatch.setattr(file_utils, "get_directory_size", counting_walk)
+    picked = fi.FilterIndexRule._rank([wide, narrow])
+    assert picked.name == "zNarrow"
+    assert calls == []  # metadata-only: the walk was never taken
+
+
 def test_filter_rule_ranking_prefers_populated_over_missing(session,
                                                             tmp_path):
     """An index whose data root vanished out-of-band (0 bytes) must not

@@ -75,9 +75,14 @@ def main():
         paths = generate(os.path.join(work, "data"), scale=SCALE)
         log(f"generate (scale={SCALE}): {time.perf_counter() - t0:.1f}s")
 
-        sess = HyperspaceSession(HyperspaceConf({
-            "hyperspace.warehouse.dir": os.path.join(work, "wh"),
-            "spark.hyperspace.index.num.buckets": "32"}))
+        conf = {"hyperspace.warehouse.dir": os.path.join(work, "wh"),
+                "spark.hyperspace.index.num.buckets": "32"}
+        # Dev-loop overrides, e.g. forcing the device lane at small scale:
+        # BENCH_TPCDS_CONF='{"spark.hyperspace.execution.min.device.rows":"0"}'
+        extra = os.environ.get("BENCH_TPCDS_CONF")
+        if extra:
+            conf.update(json.loads(extra))
+        sess = HyperspaceSession(HyperspaceConf(conf))
         hs = Hyperspace(sess)
         dfs = {n: sess.read_parquet(p) for n, p in paths.items()}
         selected = {n: q for n, q in QUERIES.items()

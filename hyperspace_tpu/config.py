@@ -85,6 +85,15 @@ class HyperspaceConf:
         return self.get(constants.TRACE_DIR)
 
     @property
+    def fusion_enabled(self) -> bool:
+        """Whole-stage fusion (engine/fusion.py): operator chains compile
+        into one jitted executable per chain instead of eager
+        per-operator dispatch."""
+        return (self.get(constants.FUSION_ENABLED,
+                         constants.FUSION_ENABLED_DEFAULT)
+                or "true").lower() == "true"
+
+    @property
     def min_device_rows(self) -> int:
         """Batches below this row count run on the host lane."""
         return self.get_int(constants.MIN_DEVICE_ROWS,

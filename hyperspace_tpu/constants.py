@@ -89,6 +89,12 @@ TRACE_DIR = "spark.hyperspace.trace.dir"
 MIN_DEVICE_ROWS = "spark.hyperspace.execution.min.device.rows"
 MIN_DEVICE_ROWS_DEFAULT = 4_194_304
 
+# Whole-stage fusion: compile Filter/Project/BroadcastHashJoin chains
+# into one jitted executable per chain (engine/fusion.py). "false"
+# restores eager per-operator execution.
+FUSION_ENABLED = "spark.hyperspace.execution.fusion.enabled"
+FUSION_ENABLED_DEFAULT = "true"
+
 WAREHOUSE_PATH = "spark.hyperspace.warehouse.dir"
 WAREHOUSE_PATH_DEFAULT = "warehouse"
 

@@ -154,11 +154,15 @@ class DataFrame:
         return self.collect().num_rows
 
     def explain_plans(self):
-        """(logical, optimized, physical) — used by plananalysis."""
+        """(logical, optimized, physical) — used by plananalysis. The
+        physical plan is UNFUSED: explain's contract is the operator
+        tree (the Exchange/Sort elision diff); stage grouping is an
+        execution detail (`engine/fusion.py`)."""
         from hyperspace_tpu.engine.executor import compile_plan
         optimized = self._optimized_plan()
         return self.plan, optimized, compile_plan(optimized,
-                                                  conf=self._conf())
+                                                  conf=self._conf(),
+                                                  fuse=False)
 
     def __repr__(self):
         return f"DataFrame[{', '.join(self.schema.names)}]"

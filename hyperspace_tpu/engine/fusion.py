@@ -1,0 +1,632 @@
+"""Whole-stage fusion: operator chains compiled into FEW XLA executables.
+
+The reference's rewrite exists to remove per-stage data movement
+(`index/rules/JoinIndexRule.scala:41-43`); on a TPU behind a dispatch
+link the same principle applies to OPERATORS: eager per-operator
+execution pays a dispatch round-trip per jnp op (~5 ms tunneled; a 26-join
+TPC-DS q64 chain runs thousands of them) plus an output-sizing host sync
+per operator (~100 ms each). This module fuses maximal chains of
+shape-preserving operators — Filter, Project, BroadcastHashJoin — into ONE
+jitted executable per chain with MASKED row semantics:
+
+- a Filter contributes its predicate to a running boolean selection mask
+  instead of compacting (no sizing sync, no mid-stage gather);
+- a Project computes its columns full-length (dead rows compute garbage
+  harmlessly — every operator in a region is row-local);
+- a BroadcastHashJoin with a unique-keyed build side is ONE gather per
+  output column plus a `matched` mask (the direct-address table from
+  `ops/broadcast_join.py`, prepared host-side and cached); inner joins
+  AND `matched` into the selection, outer joins null the build columns.
+
+One host sync per stage (the selection count, fetched with the stage
+output) replaces one-per-operator. Stage leaves (scans, sort-merge
+joins, aggregates, unions — anything with data-dependent output shape)
+execute eagerly as before and feed the stage as inputs.
+
+Executable reuse: `jax.jit` keys on a canonical stage program
+(`_StageProgram`) whose identity covers everything that shapes the trace
+— operator structure, expressions (serde dicts), schemas, validity
+presence, string-dictionary identity tokens, broadcast table packing —
+so re-running the same query hits the in-memory executable cache even
+though the physical plan objects are rebuilt per run.
+
+The same masked interpreter runs the host (numpy) lane eagerly — one
+implementation, both lanes, so the CPU test suite exercises exactly the
+semantics the device lane compiles.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import weakref
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from hyperspace_tpu.engine.physical import PhysicalNode
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import (ColumnBatch, DeviceColumn,
+                                        batch_to_tree, tree_to_batch)
+from hyperspace_tpu.plan.schema import Field, Schema
+
+
+class _FusionIneligible(Exception):
+    """Raised at trace/prep time when a region cannot run masked (e.g.
+    non-integer broadcast keys); the caller falls back to the original
+    eager operator graph — same results, without the fused executable."""
+
+
+# ---------------------------------------------------------------------------
+# Identity tokens: stable per-object ids for arrays whose CONTENT shapes a
+# trace (string dictionaries bake searchsorted constants; broadcast tables
+# bake their packing). Object identity is enough: warm runs re-serve the
+# same cached arrays, and a freed array can never reclaim its token.
+# ---------------------------------------------------------------------------
+
+_token_counter = itertools.count()
+_tokens: Dict[int, tuple] = {}
+
+
+def _token_of(obj) -> int:
+    if obj is None:
+        return -1
+    key = id(obj)
+    ent = _tokens.get(key)
+    if ent is not None and ent[0]() is obj:
+        return ent[1]
+    tok = next(_token_counter)
+
+    def _drop(_ref, k=key, t=tok):
+        # Entry self-removes when its array dies — but only if the slot
+        # still belongs to this token (the id may have been reused by a
+        # newer array by the time the callback fires).
+        cur = _tokens.get(k)
+        if cur is not None and cur[1] == t:
+            _tokens.pop(k, None)
+
+    try:
+        ref = weakref.ref(obj, _drop)
+    except TypeError:  # non-weakrefable: pin it (rare)
+        ref = (lambda o: (lambda: o))(obj)
+    _tokens[key] = (ref, tok)
+    return tok
+
+
+# ---------------------------------------------------------------------------
+# Device promotion cache: host (numpy) source columns — dimension tables
+# ride the host lane — become device-resident jit arguments ONCE and are
+# re-served by token while the host array lives. Without this every
+# execution re-transfers dimension payloads over the link.
+# ---------------------------------------------------------------------------
+
+_promote_cache: Dict[int, tuple] = {}  # token -> (ref(host src), device)
+
+
+def _evict(cache: dict, cap: int) -> None:
+    """Drop dead-source entries first, then oldest-inserted, to `cap`."""
+    if len(cache) <= cap:
+        return
+    for k in [k for k, v in cache.items()
+              if isinstance(v, tuple) and callable(v[0]) and v[0]() is None]:
+        cache.pop(k, None)
+    while len(cache) > cap:
+        cache.pop(next(iter(cache)))
+
+
+def _to_device(arr):
+    if arr is None or not isinstance(arr, np.ndarray):
+        return arr
+    tok = _token_of(arr)
+    ent = _promote_cache.get(tok)
+    if ent is not None and ent[0]() is arr:
+        return ent[1]
+    import jax
+    out = jax.device_put(arr)
+    _evict(_promote_cache, 512)
+    try:
+        ref = weakref.ref(arr)
+    except TypeError:
+        ref = (lambda o: (lambda: o))(arr)
+    _promote_cache[tok] = (ref, out)
+    return out
+
+
+def _promote_batch(batch: ColumnBatch) -> ColumnBatch:
+    if not batch.is_host:
+        return batch
+    columns = {}
+    for name, col in batch.columns.items():
+        hashes = col.dict_hashes
+        if hashes is not None:
+            hashes = (_to_device(hashes[0]), _to_device(hashes[1]))
+        columns[name] = DeviceColumn(_to_device(col.data), col.dtype,
+                                     _to_device(col.validity),
+                                     col.dictionary, hashes)
+    return ColumnBatch(batch.schema, columns)
+
+
+# ---------------------------------------------------------------------------
+# Broadcast table prep (host side, cached by build-column identity).
+# ---------------------------------------------------------------------------
+
+_bcast_cache: Dict[tuple, object] = {}
+
+
+def _prepare_broadcast(node, build_batch: ColumnBatch):
+    """(table ndarray, mins, ranges) for this join's build side, or None
+    when the direct-address path is ineligible (the caller then falls
+    back to the eager operator graph, whose own runtime fallback covers
+    duplicates/strings/wide ranges). Cached by build key-column identity
+    so warm runs skip the host scatter AND the device transfer."""
+    membership = node.how in ("left_semi", "left_anti")
+    keys = (node.right_keys if node.build_side == "right"
+            else node.left_keys)
+    if build_batch.num_rows == 0:
+        return None  # eager path has exact empty-side shortcuts
+    try:
+        ident = []
+        for k in keys:
+            col = build_batch.column(k)
+            ident.append((_token_of(col.data), _token_of(col.validity)))
+    except HyperspaceException:
+        return None
+    ck = (membership, tuple(k.lower() for k in keys), tuple(ident))
+    if ck in _bcast_cache:
+        return _bcast_cache[ck]
+    from hyperspace_tpu.ops.broadcast_join import (build_broadcast_table,
+                                                   build_membership_table)
+    builder = build_membership_table if membership else build_broadcast_table
+    out = builder(build_batch, keys)
+    if out is not None:
+        table, mins, ranges = out
+        out = (table, tuple(int(m) for m in mins),
+               tuple(int(r) for r in ranges))
+    _evict(_bcast_cache, 64)
+    _bcast_cache[ck] = out
+    return out
+
+
+_INT_KEY_DTYPES = ("int8", "int16", "int32", "int64", "date32",
+                   "timestamp", "bool")
+
+
+# ---------------------------------------------------------------------------
+# Region nodes
+# ---------------------------------------------------------------------------
+
+
+class _SourceExec(PhysicalNode):
+    """Region leaf: a materialized input. During a fused execution the
+    batch slot is pre-loaded; outside one it delegates to the wrapped
+    node (the eager-fallback and bucketed-protocol paths)."""
+
+    name = "StageInput"
+
+    def __init__(self, node, index: int):
+        self.node = node
+        self.index = index
+        self._batch: Optional[ColumnBatch] = None
+
+    @property
+    def children(self):
+        return [self.node]
+
+    def simple_string(self):
+        return "StageInput"
+
+    def execute(self, bucket=None):
+        if bucket is None and self._batch is not None:
+            return self._batch
+        return self.node.execute(bucket)
+
+    def execute_bucketed(self, num_buckets: int):
+        return self.node.execute_bucketed(num_buckets)
+
+
+def _region_nodes(root) -> List:
+    """All fused operator nodes of a region (stops at _SourceExec)."""
+    from hyperspace_tpu.engine.physical import (BroadcastHashJoinExec,
+                                                FilterExec, ProjectExec)
+    out = []
+
+    def walk(n):
+        if isinstance(n, _SourceExec):
+            return
+        out.append(n)
+        if isinstance(n, (FilterExec, ProjectExec)):
+            walk(n.child)
+        elif isinstance(n, BroadcastHashJoinExec):
+            walk(n.left if n.build_side == "right" else n.right)
+    walk(root)
+    return out
+
+
+class _StageProgram:
+    """Hashable static argument for the jitted stage interpreter. Two
+    equal programs MUST trace identically: the key covers the region
+    structure and every host-side constant the trace bakes in."""
+
+    def __init__(self, key: str, region, source_meta, tables_meta):
+        self.key = key
+        self.region = region
+        self.source_meta = source_meta  # [(schema, aux, num_rows)] by index
+        self.tables_meta = tables_meta  # {slot: (mins, ranges)}
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __eq__(self, other):
+        return (isinstance(other, _StageProgram)
+                and other.key == self.key)
+
+
+# out-batch metadata captured at trace time, re-served on executable
+# cache hits (the jit call only returns arrays).
+_OUT_META: Dict[str, tuple] = {}
+# program keys whose trace proved ineligible — skip straight to eager.
+_INELIGIBLE_KEYS: set = set()
+
+
+# ---------------------------------------------------------------------------
+# The masked interpreter (shared by the jitted device path and the eager
+# host lane — ONE implementation of the semantics).
+# ---------------------------------------------------------------------------
+
+
+def _interpret(node, env: Dict[int, ColumnBatch], tables: Dict[int, object]):
+    from hyperspace_tpu.engine.compiler import compile_predicate
+    from hyperspace_tpu.engine.physical import (BroadcastHashJoinExec,
+                                                FilterExec, ProjectExec)
+
+    if isinstance(node, _SourceExec):
+        return env[node.index], None
+    if isinstance(node, FilterExec):
+        batch, sel = _interpret(node.child, env, tables)
+        mask = compile_predicate(node.condition, batch)
+        return batch, (mask if sel is None else sel & mask)
+    if isinstance(node, ProjectExec):
+        batch, sel = _interpret(node.child, env, tables)
+        return node._project(batch), sel
+    if isinstance(node, BroadcastHashJoinExec):
+        return _interpret_bhj(node, env, tables)
+    raise HyperspaceException(f"Unfusible node in region: {node!r}")
+
+
+def _interpret_bhj(node, env, tables):
+    from hyperspace_tpu.ops.broadcast_join import _probe_lookup
+
+    probe_is_left = node.build_side == "right"
+    probe_node = node.left if probe_is_left else node.right
+    build_node = node.right if probe_is_left else node.left
+    probe_keys = node.left_keys if probe_is_left else node.right_keys
+    probe_batch, sel = _interpret(probe_node, env, tables)
+    build_batch = env[build_node.index]
+    table, mins, ranges = tables[node._table_slot]
+    for k in probe_keys:
+        col = probe_batch.column(k)
+        if col.is_string or col.dtype not in _INT_KEY_DTYPES:
+            raise _FusionIneligible(f"non-integer probe key {k}")
+    looked = _probe_lookup(probe_batch, probe_keys, table, list(mins),
+                           list(ranges))
+    if looked is None:
+        raise _FusionIneligible("probe lookup declined")
+    hit, matched = looked
+    if isinstance(hit, np.ndarray):
+        xp = np
+    else:
+        import jax.numpy as xp
+
+    if node.how in ("left_semi", "left_anti"):
+        want = ~matched if node.how == "left_anti" else matched
+        return probe_batch, (want if sel is None else sel & want)
+
+    if node.how == "inner":
+        sel = matched if sel is None else sel & matched
+    # THE shared output-naming contract (`join_output_plan`) keeps the
+    # fused lane and the eager assembly from ever diverging.
+    from hyperspace_tpu.ops.bucketed_join import join_output_plan
+    left_batch = probe_batch if probe_is_left else build_batch
+    right_batch = build_batch if probe_is_left else probe_batch
+    plan = join_output_plan(left_batch.schema, right_batch.schema,
+                            node.out_columns)
+
+    build_side_tag = "r" if probe_is_left else "l"
+    gather_idx = xp.clip(hit, 0, None)
+    fields, out_columns = [], {}
+    for out, side, src, dtype in plan:
+        if side == build_side_tag:
+            col = build_batch.column(src)
+            data = xp.take(col.data, gather_idx, axis=0)
+            validity = matched if col.validity is None else (
+                xp.take(col.validity, gather_idx, axis=0) & matched)
+            out_columns[out] = DeviceColumn(data, col.dtype, validity,
+                                            col.dictionary, col.dict_hashes)
+            fields.append(Field(out, dtype, True))
+        else:
+            # Probe rows are never unmatched-nulled (outer joins only
+            # broadcast their inner side), so probe fields keep their
+            # nullability.
+            col = probe_batch.column(src)
+            out_columns[out] = col
+            fields.append(Field(out, dtype,
+                                probe_batch.schema.field(src).nullable))
+    return ColumnBatch(Schema(fields), out_columns), sel
+
+
+# ---------------------------------------------------------------------------
+# Jitted stage runner (built lazily so importing this module does not pull
+# in jax — the package imports jax only at first device use).
+# ---------------------------------------------------------------------------
+
+_run_stage_jit = None
+
+
+def _run_stage(prog: _StageProgram, trees, table_args):
+    global _run_stage_jit
+    if _run_stage_jit is None:
+        import jax
+
+        @partial(jax.jit, static_argnames=("prog",))
+        def _run(prog: _StageProgram, trees, table_args):
+            import jax.numpy as jnp
+
+            env = {}
+            for i, (schema, aux, _rows) in enumerate(prog.source_meta):
+                env[i] = tree_to_batch(trees[i], schema, aux)
+            tables = {slot: (table_args[slot], mins, ranges)
+                      for slot, (mins, ranges) in prog.tables_meta.items()}
+            out_batch, sel = _interpret(prog.region, env, tables)
+            out_tree, out_aux = batch_to_tree(out_batch)
+            _evict(_OUT_META, 256)
+            _OUT_META[prog.key] = (out_batch.schema, out_aux)
+            if sel is None:
+                return out_tree, None, None
+            return out_tree, sel, jnp.sum(sel.astype(jnp.int64))
+
+        _run_stage_jit = _run
+    return _run_stage_jit(prog, trees, table_args)
+
+
+# ---------------------------------------------------------------------------
+# FusedStageExec
+# ---------------------------------------------------------------------------
+
+
+class FusedStageExec(PhysicalNode):
+    """Physical node executing a fused region. Sources run eagerly first;
+    the region then runs as ONE jitted executable (device lane) or one
+    masked numpy pass (host lane), with a single output-sizing sync."""
+
+    name = "FusedStage"
+
+    def __init__(self, root, sources: Sequence[_SourceExec], conf=None):
+        self.root = root
+        self.sources = list(sources)
+        self.conf = conf
+        from hyperspace_tpu.engine.physical import BroadcastHashJoinExec
+        self._bhj_nodes = [n for n in _region_nodes(root)
+                           if isinstance(n, BroadcastHashJoinExec)]
+        for slot, n in enumerate(self._bhj_nodes):
+            n._table_slot = slot
+
+    @property
+    def children(self):
+        return [self.root]
+
+    def simple_string(self):
+        return f"FusedStage ({len(_region_nodes(self.root))} ops)"
+
+    def execute_bucketed(self, num_buckets: int):
+        """Bucketed-protocol passthrough (regions never contain joins on
+        this path — only Filter/Project chains support it)."""
+        return self.root.execute_bucketed(num_buckets)
+
+    def execute(self, bucket: Optional[int] = None) -> ColumnBatch:
+        if bucket is not None:
+            return self.root.execute(bucket)
+        for s in self.sources:
+            s._batch = s.node.execute()
+        try:
+            out = self._execute_masked()
+            if out is not None:
+                return out
+            # Eager fallback: the original operator graph, sources served
+            # from the already-executed batches.
+            return self.root.execute()
+        finally:
+            for s in self.sources:
+                s._batch = None
+
+    # -- masked execution -------------------------------------------------
+
+    def _execute_masked(self) -> Optional[ColumnBatch]:
+        batches = [s._batch for s in self.sources]
+        if any(b.num_rows == 0 for b in batches):
+            return None  # eager path has exact empty-side shortcuts
+        from hyperspace_tpu.parallel.context import should_distribute
+        host = all(b.is_host for b in batches)
+        if should_distribute(self.conf, max(b.num_rows for b in batches),
+                             host_batch=host) is not None:
+            return None  # mesh execution owns these operators instead
+
+        preps = {}
+        for n in self._bhj_nodes:
+            build_node = n.right if n.build_side == "right" else n.left
+            prep = _prepare_broadcast(n, build_node._batch)
+            if prep is None:
+                return None
+            preps[n._table_slot] = prep
+
+        if host:
+            tables = {slot: p for slot, p in preps.items()}
+            env = {s.index: s._batch for s in self.sources}
+            try:
+                out_batch, sel = _interpret(self.root, env, tables)
+            except _FusionIneligible:
+                return None
+            if sel is None:
+                return out_batch
+            idx = np.nonzero(sel)[0].astype(np.int32)
+            return out_batch.take(idx)
+        return self._execute_device(batches, preps)
+
+    def _execute_device(self, batches, preps) -> Optional[ColumnBatch]:
+        import jax.numpy as jnp
+
+        key = self._program_key(batches, preps)
+        if key in _INELIGIBLE_KEYS:
+            return None
+        source_meta = []
+        trees = {}
+        for i, b in enumerate(batches):
+            b = _promote_batch(b)
+            tree, aux = batch_to_tree(b)
+            trees[i] = tree
+            source_meta.append((b.schema, aux, b.num_rows))
+        table_args = {slot: _to_device(p[0]) for slot, p in preps.items()}
+        tables_meta = {slot: (p[1], p[2]) for slot, p in preps.items()}
+        prog = _StageProgram(key, self.root, source_meta, tables_meta)
+        try:
+            out_tree, sel, cnt = _run_stage(prog, trees, table_args)
+        except _FusionIneligible:
+            _INELIGIBLE_KEYS.add(key)
+            return None
+        meta = _OUT_META.get(key)
+        if meta is None:
+            # Executable outlived its evicted metadata (>256 distinct
+            # stage programs since): run this one eagerly.
+            return None
+        schema, aux = meta
+        out_batch = tree_to_batch(out_tree, schema, aux)
+        if sel is None:
+            return out_batch
+        count = int(cnt)  # THE stage sync
+        (idx,) = jnp.nonzero(sel, size=count, fill_value=0)
+        return out_batch.take(idx.astype(jnp.int32))
+
+    def _program_key(self, batches, preps) -> str:
+        parts = [_node_key(self.root)]
+        for b in batches:
+            cols = []
+            for f in b.schema.fields:
+                col = b.columns[f.name]
+                cols.append((f.name, f.dtype, col.validity is not None,
+                             _token_of(col.dictionary)))
+            parts.append(repr(cols))
+        for slot in sorted(preps):
+            _t, mins, ranges = preps[slot]
+            parts.append(f"T{slot}:{mins}:{ranges}")
+        return "\x1e".join(parts)
+
+
+def _node_key(node) -> str:
+    from hyperspace_tpu.engine.physical import (BroadcastHashJoinExec,
+                                                FilterExec, ProjectExec)
+    if isinstance(node, _SourceExec):
+        return f"S{node.index}"
+    if isinstance(node, FilterExec):
+        return (f"F({json.dumps(node.condition.to_dict(), sort_keys=True)})"
+                f"[{_node_key(node.child)}]")
+    if isinstance(node, ProjectExec):
+        entries = [(name, src if isinstance(src, str)
+                    else json.dumps(src.to_dict(), sort_keys=True))
+                   for name, src in node.entries]
+        return f"P({entries!r})[{_node_key(node.child)}]"
+    if isinstance(node, BroadcastHashJoinExec):
+        probe = node.left if node.build_side == "right" else node.right
+        build = node.right if node.build_side == "right" else node.left
+        cols = (sorted(node.out_columns)
+                if node.out_columns is not None else None)
+        return (f"B({node.how},{node.build_side},{node.left_keys},"
+                f"{node.right_keys},{cols},{node._table_slot},"
+                f"S{build.index})[{_node_key(probe)}]")
+    raise HyperspaceException(f"Unfusible node in region: {node!r}")
+
+
+# ---------------------------------------------------------------------------
+# The fusion pass
+# ---------------------------------------------------------------------------
+
+
+def fuse_physical(root, conf=None):
+    """Rewrite a physical tree, replacing maximal Filter/Project/
+    BroadcastHashJoin regions with FusedStageExec. Sort-merge joins keep
+    their subtrees intact on the bucketed path (the (batch, lengths)
+    protocol and Exchange/Sort unwrapping are planner contracts); their
+    general-path inner children still fuse."""
+    from hyperspace_tpu.engine.physical import (BroadcastHashJoinExec,
+                                                ExchangeExec, FilterExec,
+                                                ProjectExec, ReusedExec,
+                                                SortExec, SortMergeJoinExec)
+    fusible = (FilterExec, ProjectExec, BroadcastHashJoinExec)
+    seen: Dict[int, object] = {}
+
+    def rec(node):
+        hit = seen.get(id(node))
+        if hit is not None:
+            return hit
+        if isinstance(node, fusible):
+            sources: List[_SourceExec] = []
+            new_root = build_region(node, sources)
+            out = FusedStageExec(new_root, sources, conf=conf)
+        elif isinstance(node, SortMergeJoinExec):
+            if not node.bucketed:
+                # General path: the join unwraps Sort(Exchange(child))
+                # wrappers itself — fuse the inner children, keep the
+                # wrapper chain.
+                for attr in ("left", "right"):
+                    side = getattr(node, attr)
+                    inner_holder, inner_attr = None, None
+                    probe = side
+                    if isinstance(probe, SortExec):
+                        inner_holder, inner_attr = probe, "child"
+                        probe = probe.child
+                    if isinstance(probe, ExchangeExec):
+                        inner_holder, inner_attr = probe, "child"
+                        probe = probe.child
+                    if inner_holder is None:
+                        setattr(node, attr, rec(side))
+                    else:
+                        setattr(inner_holder, inner_attr, rec(probe))
+            out = node
+        else:
+            if isinstance(node, ReusedExec):
+                node.child = rec(node.child)
+            elif hasattr(node, "_children"):  # UnionExec
+                node._children = [rec(c) for c in node._children]
+            else:
+                for attr in ("child", "left", "right"):
+                    c = getattr(node, attr, None)
+                    if c is not None and hasattr(c, "execute"):
+                        setattr(node, attr, rec(c))
+            out = node
+        seen[id(node)] = out
+        return out
+
+    def build_region(node, sources: List[_SourceExec]):
+        if isinstance(node, FilterExec):
+            return FilterExec(node.condition, build_region(node.child,
+                                                           sources),
+                              conf=node.conf)
+        if isinstance(node, ProjectExec):
+            return ProjectExec(list(node.entries),
+                               build_region(node.child, sources))
+        if isinstance(node, BroadcastHashJoinExec):
+            probe_attr = "left" if node.build_side == "right" else "right"
+            build_attr = "right" if node.build_side == "right" else "left"
+            probe = build_region(getattr(node, probe_attr), sources)
+            build = _SourceExec(rec(getattr(node, build_attr)),
+                                len(sources))
+            sources.append(build)
+            sides = {probe_attr: probe, build_attr: build}
+            return BroadcastHashJoinExec(
+                sides["left"], sides["right"], node.left_keys,
+                node.right_keys, node.build_side, how=node.how,
+                conf=node.conf, out_columns=node.out_columns)
+        src = _SourceExec(rec(node), len(sources))
+        sources.append(src)
+        return src
+
+    return rec(root)

@@ -182,6 +182,25 @@ def broadcast_join_indices(probe: ColumnBatch, build: ColumnBatch,
     return li, jnp.take(hit, li)
 
 
+def build_membership_table(build: ColumnBatch, build_keys: Sequence[str]):
+    """(table, mins, ranges) occupancy table over the build side's valid
+    key tuples (duplicates allowed — existence is all membership needs),
+    or None when ineligible. All-NULL build keys yield a 1-slot empty
+    table so the probe path stays uniform. Shared by the eager membership
+    probe below and the fused masked lane (`engine/fusion.py`)."""
+    prep = _int_key_arrays(build, build_keys, to_numpy=True)
+    if prep is None:
+        return None
+    arrays, valid = prep
+    arrays = [np.asarray(a, dtype=np.int64) for a in arrays]
+    if valid is not None:
+        arrays = [a[valid] for a in arrays]
+        if len(arrays[0]) == 0:
+            table = np.full(1, -1, dtype=np.int32)
+            return table, [0] * len(build_keys), [1] * len(build_keys)
+    return _membership_table(arrays)
+
+
 def broadcast_membership(probe: ColumnBatch, build: ColumnBatch,
                          probe_keys: Sequence[str],
                          build_keys: Sequence[str], anti: bool):
@@ -193,21 +212,7 @@ def broadcast_membership(probe: ColumnBatch, build: ColumnBatch,
     m = build.num_rows
     if m == 0:
         return None  # callers' empty-side fast paths are already exact
-    prep = _int_key_arrays(build, build_keys, to_numpy=True)
-    if prep is None:
-        return None
-    arrays, valid = prep
-    arrays = [np.asarray(a, dtype=np.int64) for a in arrays]
-    if valid is not None:
-        arrays = [a[valid] for a in arrays]
-        if len(arrays[0]) == 0:
-            table = np.full(1, -1, dtype=np.int32)
-            mins, ranges = [0] * len(probe_keys), [1] * len(probe_keys)
-            prep2: Optional[tuple] = (table, mins, ranges)
-        else:
-            prep2 = _membership_table(arrays)
-    else:
-        prep2 = _membership_table(arrays)
+    prep2 = build_membership_table(build, build_keys)
     if prep2 is None:
         return None
     looked = _probe_lookup(probe, probe_keys, *prep2)

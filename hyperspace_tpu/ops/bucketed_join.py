@@ -182,6 +182,29 @@ def _gather_side(batch: ColumnBatch, idx, names, may_unmatch: bool = True):
     return ColumnBatch(out.schema, columns)
 
 
+def join_output_plan(left_schema, right_schema, columns):
+    """THE join output-naming contract, shared by the eager assembly and
+    the fused masked lane (`engine/fusion.py`): [(out_name, side, src,
+    dtype)] where side is "l"/"r". Left names are kept; right-side
+    collisions get a `_r` suffix; `columns` (lowered OUTPUT names)
+    late-projects. A consumer needing no columns at all (count(*) over
+    the join) still needs the row count, which a ColumnBatch carries
+    only through its columns — one is kept."""
+    left_names = {f.name.lower() for f in left_schema.fields}
+    plan = []
+    for f in left_schema.fields:
+        if columns is None or f.name.lower() in columns:
+            plan.append((f.name, "l", f.name, f.dtype))
+    for f in right_schema.fields:
+        out = f.name if f.name.lower() not in left_names else f.name + "_r"
+        if columns is None or out.lower() in columns:
+            plan.append((out, "r", f.name, f.dtype))
+    if not plan:
+        f = left_schema.fields[0]
+        plan.append((f.name, "l", f.name, f.dtype))
+    return plan
+
+
 def assemble_join_output(left: ColumnBatch, right: ColumnBatch,
                          li, ri, how: str = "left_outer",
                          columns=None) -> ColumnBatch:
@@ -196,22 +219,7 @@ def assemble_join_output(left: ColumnBatch, right: ColumnBatch,
     never materializes the join keys or other dropped payload."""
     from hyperspace_tpu.plan.schema import Field, Schema
 
-    left_names = {f.name.lower() for f in left.schema.fields}
-    plan = []  # (out_name, side, source_name, dtype)
-    for f in left.schema.fields:
-        if columns is None or f.name.lower() in columns:
-            plan.append((f.name, "l", f.name, f.dtype))
-    for f in right.schema.fields:
-        out = f.name if f.name.lower() not in left_names else f.name + "_r"
-        if columns is None or out.lower() in columns:
-            plan.append((out, "r", f.name, f.dtype))
-
-    if not plan:
-        # A consumer needing no columns at all (count(*) over the join)
-        # still needs the row count, which a ColumnBatch carries only
-        # through its columns — keep one.
-        f = left.schema.fields[0]
-        plan.append((f.name, "l", f.name, f.dtype))
+    plan = join_output_plan(left.schema, right.schema, columns)
     lwanted = [src for _, side, src, _ in plan if side == "l"]
     rwanted = [src for _, side, src, _ in plan if side == "r"]
     left_out = _gather_side(left, li, lwanted,

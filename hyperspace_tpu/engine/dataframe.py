@@ -126,6 +126,38 @@ class DataFrame:
 
     drop_duplicates = distinct
 
+    def union(self, other: "DataFrame") -> "DataFrame":
+        """UNION ALL (SQL): row-wise concatenation; column names must
+        align. DISTINCT union = .union(o).distinct()."""
+        from hyperspace_tpu.plan.nodes import Union as UnionNode
+        return DataFrame(UnionNode([self.plan, other.plan]), self.session)
+
+    union_all = union
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """SQL INTERSECT (DISTINCT set semantics; NULL rows compare
+        equal, unlike joins)."""
+        from hyperspace_tpu.plan.nodes import Intersect
+        return DataFrame(Intersect(self.plan, other.plan), self.session)
+
+    def except_(self, other: "DataFrame") -> "DataFrame":
+        """SQL EXCEPT (DISTINCT set semantics)."""
+        from hyperspace_tpu.plan.nodes import Except
+        return DataFrame(Except(self.plan, other.plan), self.session)
+
+    def create_or_replace_temp_view(self, name: str) -> None:
+        """Register this query as a named temp view on the session
+        (Spark `createOrReplaceTempView` parity)."""
+        if self.session is None:
+            raise HyperspaceException("DataFrame has no session.")
+        self.session.create_or_replace_temp_view(name, self)
+
+    def as_scalar(self) -> E.Expression:
+        """This (one-column, at-most-one-row) query as a scalar value
+        expression — SQL's scalar subquery: `col("x") >
+        df.agg(("avg","x","a")).as_scalar()`."""
+        return E.ScalarSubquery(self.plan)
+
     def agg(self, *specs, **named) -> "DataFrame":
         """Global aggregation (no grouping); see GroupedData.agg."""
         return GroupedData(self, []).agg(*specs, **named)

@@ -40,9 +40,85 @@ def compile_plan(plan: LogicalPlan,
     return physical
 
 
+def _scalar_subqueries(plan: LogicalPlan):
+    """Every ScalarSubquery expression reachable from `plan` (conditions,
+    projections, aggregate inputs) — subquery plans are NOT descended
+    into here; resolution recurses through execute_plan instead."""
+    from hyperspace_tpu.plan import expr as E
+    from hyperspace_tpu.plan.nodes import (Aggregate, Filter, Join, Project,
+                                           Window)
+
+    found = []
+
+    def walk_expr(e):
+        if isinstance(e, E.ScalarSubquery):
+            found.append(e)
+            return
+        # children already includes In values and CaseWhen branches.
+        for c in e.children:
+            walk_expr(c)
+
+    def visit(node):
+        if isinstance(node, Filter):
+            walk_expr(node.condition)
+        elif isinstance(node, Project):
+            for c in node.columns:
+                if not isinstance(c, str):
+                    walk_expr(c)
+        elif isinstance(node, Join) and node.condition is not None:
+            walk_expr(node.condition)
+        elif isinstance(node, (Aggregate, Window)):
+            for spec in (node.aggregates if isinstance(node, Aggregate)
+                         else node.specs):
+                if spec.is_expression:
+                    walk_expr(spec.column)
+        for c in node.children:
+            visit(c)
+
+    visit(plan)
+    return found
+
+
+def _resolve_scalar_subqueries(plan: LogicalPlan, conf) -> None:
+    """Execute every unresolved scalar subquery in `plan` and cache its
+    value on the node (the subquery-execution phase; Spark does the same
+    before the main plan runs). One column required; one row -> value,
+    zero rows -> SQL NULL, more -> error. Nested subqueries resolve
+    through the recursive execute_plan call."""
+    import numpy as np
+
+    for sub in _scalar_subqueries(plan):
+        if sub._resolved:
+            continue
+        batch = execute_plan(sub.execution_plan(), conf=conf)
+        if batch.num_rows > 1:
+            from hyperspace_tpu.exceptions import HyperspaceException
+            raise HyperspaceException(
+                f"Scalar subquery returned {batch.num_rows} rows.")
+        if batch.num_rows == 0:
+            sub.resolve(None)
+            continue
+        (field,) = batch.schema.fields
+        col = batch.columns[field.name]
+        if col.validity is not None and not bool(
+                np.asarray(col.validity)[0]):
+            sub.resolve(None)
+            continue
+        raw = np.asarray(col.data)[0]
+        if col.is_string:
+            sub.resolve(str(col.dictionary[int(raw)]))
+        elif field.dtype == "bool":
+            sub.resolve(bool(raw))
+        elif field.dtype in ("float32", "float64"):
+            sub.resolve(float(raw))
+        else:
+            sub.resolve(int(raw))
+
+
 def execute_plan(plan: LogicalPlan,
                  projection: Optional[Sequence[str]] = None,
                  conf=None) -> ColumnBatch:
+    _resolve_scalar_subqueries(plan, conf)
     physical = compile_plan(plan, projection, conf)
     trace_dir = conf.trace_dir if conf is not None else None
     if not trace_dir:

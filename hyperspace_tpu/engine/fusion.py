@@ -265,6 +265,10 @@ class _StageProgram:
 # out-batch metadata captured at trace time, re-served on executable
 # cache hits (the jit call only returns arrays).
 _OUT_META: Dict[str, tuple] = {}
+# Diagnostics (perf work): stage executions, trace misses, seconds spent
+# blocked on the output-sizing sync.
+STATS = {"stage_execs": 0, "trace_misses": 0, "sync_s": 0.0,
+         "dispatch_s": 0.0}
 # program keys whose trace proved ineligible — skip straight to eager.
 _INELIGIBLE_KEYS: set = set()
 
@@ -379,7 +383,6 @@ def _run_stage(prog: _StageProgram, trees, table_args):
                       for slot, (mins, ranges) in prog.tables_meta.items()}
             out_batch, sel = _interpret(prog.region, env, tables)
             out_tree, out_aux = batch_to_tree(out_batch)
-            _evict(_OUT_META, 256)
             _OUT_META[prog.key] = (out_batch.schema, out_aux)
             if sel is None:
                 return out_tree, None, None
@@ -478,6 +481,18 @@ class FusedStageExec(PhysicalNode):
         key = self._program_key(batches, preps)
         if key in _INELIGIBLE_KEYS:
             return None
+        if len(_OUT_META) > 1024:
+            # Metadata and executables retire TOGETHER: evicting only
+            # _OUT_META would silently force evicted stages eager forever
+            # (a jit cache hit never re-runs the traced body that
+            # repopulates the metadata). Full reset -> next runs re-trace
+            # and re-populate both.
+            _OUT_META.clear()
+            try:
+                if _run_stage_jit is not None:
+                    _run_stage_jit.clear_cache()
+            except Exception:
+                pass
         source_meta = []
         trees = {}
         for i, b in enumerate(batches):
@@ -488,11 +503,17 @@ class FusedStageExec(PhysicalNode):
         table_args = {slot: _to_device(p[0]) for slot, p in preps.items()}
         tables_meta = {slot: (p[1], p[2]) for slot, p in preps.items()}
         prog = _StageProgram(key, self.root, source_meta, tables_meta)
+        import time as _time
+        STATS["stage_execs"] += 1
+        if key not in _OUT_META and key not in _INELIGIBLE_KEYS:
+            STATS["trace_misses"] += 1
+        t0 = _time.perf_counter()
         try:
             out_tree, sel, cnt = _run_stage(prog, trees, table_args)
         except _FusionIneligible:
             _INELIGIBLE_KEYS.add(key)
             return None
+        STATS["dispatch_s"] += _time.perf_counter() - t0
         meta = _OUT_META.get(key)
         if meta is None:
             # Executable outlived its evicted metadata (>256 distinct
@@ -502,7 +523,9 @@ class FusedStageExec(PhysicalNode):
         out_batch = tree_to_batch(out_tree, schema, aux)
         if sel is None:
             return out_batch
+        t0 = _time.perf_counter()
         count = int(cnt)  # THE stage sync
+        STATS["sync_s"] += _time.perf_counter() - t0
         (idx,) = jnp.nonzero(sel, size=count, fill_value=0)
         return out_batch.take(idx.astype(jnp.int32))
 

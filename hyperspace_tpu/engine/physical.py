@@ -17,9 +17,9 @@ from typing import List, Optional, Sequence, Set, Tuple
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io import columnar, parquet
 from hyperspace_tpu.plan import expr as E
-from hyperspace_tpu.plan.nodes import (Aggregate, BucketSpec, Filter, Join,
-                                       Limit, LogicalPlan, Project, Scan,
-                                       Sort, Union, Window)
+from hyperspace_tpu.plan.nodes import (Aggregate, BucketSpec, Except, Filter,
+                                       Join, Limit, LogicalPlan, Project,
+                                       Scan, SetOp, Sort, Union, Window)
 from hyperspace_tpu.plan.schema import Schema
 
 
@@ -650,6 +650,34 @@ class UnionExec(PhysicalNode):
         return combined.take(idx), total_lengths
 
 
+class SetOpExec(PhysicalNode):
+    """INTERSECT / EXCEPT (DISTINCT set semantics, NULL == NULL — see
+    `ops/setops.py`). Output rows come from the left side in
+    first-occurrence order; columns align across sides by name."""
+
+    def __init__(self, left: PhysicalNode, right: PhysicalNode,
+                 names: Sequence[str], anti: bool):
+        self.left = left
+        self.right = right
+        self.names = list(names)
+        self.anti = anti
+        self.name = "Except" if anti else "Intersect"
+
+    @property
+    def children(self):
+        return [self.left, self.right]
+
+    def simple_string(self) -> str:
+        return f"{self.name} [{', '.join(self.names)}]"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        from hyperspace_tpu.ops.setops import set_op_indices
+        lbatch = self.left.execute(bucket)
+        rbatch = self.right.execute(bucket)
+        idx = set_op_indices(lbatch, rbatch, self.names, self.anti)
+        return lbatch.select(self.names).take(idx)
+
+
 class ReusedExec(PhysicalNode):
     """Common-subplan reuse (Spark's ReuseExchange/ReuseSubquery analog):
     the planner routes every occurrence of an identical logical subtree
@@ -781,57 +809,22 @@ class SortMergeJoinExec(PhysicalNode):
                                             self.right_keys, how=self.how,
                                             columns=self.out_columns)
         # General path: the planner wrapped each side in
-        # Sort(Exchange(...)). Both are unwrapped here and the join picks
-        # the physical strategy:
-        # - host-lane sides: probe join (sorts only the build side) — the
-        #   planner's Exchange+Sort would be pure overhead;
-        # - device sides with co-partitionable Exchanges: REAL hash
-        #   repartition (mesh all_to_all when active), then the
-        #   co-partitioned bucketed merge join — the same machinery the
-        #   indexed path uses, minus the on-disk layout;
-        # - anything else: per-side device sort + merge join.
+        # Sort(Exchange(...)) — the Spark-shaped plan. BOTH wrappers are
+        # unwrapped and genuinely elided at execution: the counting join
+        # (`ops/join.py`) matches in ORIGINAL row space over unsorted
+        # ids with ONE flat sort, so a real hash repartition + per-side
+        # sort (what Spark must do, and what an earlier revision ran for
+        # co-partitionable sides) is strictly extra work — it cost ~2s of
+        # a 24s scale-30 q64 while feeding the same counting core.
         def unwrap(node):
-            sort_keys, exchange = None, None
             if isinstance(node, SortExec):
-                sort_keys = node.keys
                 node = node.child
             if isinstance(node, ExchangeExec):
-                exchange = node
                 node = node.child
-            return node, sort_keys, exchange
+            return node
 
-        lnode, lkeys, lex = unwrap(self.left)
-        rnode, rkeys, rex = unwrap(self.right)
-        lbatch = lnode.execute(bucket)
-        rbatch = rnode.execute(bucket)
-        host = lbatch.is_host and rbatch.is_host
-
-        def same_key_dtypes() -> bool:
-            # Each side hashes with its OWN column's lane decomposition;
-            # co-partitioning is only sound when the decompositions agree
-            # (int32 vs int64 would bucket equal values differently —
-            # the general path promotes dtypes instead).
-            for lk, rk in zip(self.left_keys, self.right_keys):
-                if lbatch.column(lk).dtype != rbatch.column(rk).dtype:
-                    return False
-            return True
-
-        if (not host and lex is not None and rex is not None
-                and lex.num_partitions == rex.num_partitions
-                and self.how in ("inner", "left_outer", "right_outer")
-                and same_key_dtypes()):
-            from hyperspace_tpu.ops.bucketed_join import (
-                bucketed_sort_merge_join)
-            lpart, llen = lex.partition(lbatch)
-            rpart, rlen = rex.partition(rbatch)
-            return bucketed_sort_merge_join(lpart, rpart, llen, rlen,
-                                            self.left_keys, self.right_keys,
-                                            how=self.how,
-                                            columns=self.out_columns)
-        # No pre-sort: the counting join (`ops/join.py`) matches in
-        # ORIGINAL row space over unsorted ids, so the Sort wrappers'
-        # work is genuinely elided here — sorting the payload batches
-        # first would buy nothing and cost two full device sorts.
+        lbatch = unwrap(self.left).execute(bucket)
+        rbatch = unwrap(self.right).execute(bucket)
         return sort_merge_join(lbatch, rbatch, self.left_keys,
                                self.right_keys, how=self.how,
                                columns=self.out_columns)
@@ -851,7 +844,11 @@ class SortMergeJoinExec(PhysicalNode):
             rbatch, r_lengths = rf.result()
         mesh = self._join_mesh(lbatch.num_rows + rbatch.num_rows,
                                host_batch=lbatch.is_host and rbatch.is_host)
-        if mesh is not None:
+        if mesh is not None and self.how == "full_outer":
+            # Hot buckets split across shards for every other join type
+            # (`parallel/join.shard_plan`); full_outer's unmatched-right
+            # detection needs whole buckets, so extreme skew still
+            # routes it single-chip.
             from hyperspace_tpu.parallel.context import mesh_size
             from hyperspace_tpu.parallel.join import shard_skew
             if shard_skew(l_lengths, r_lengths, mesh_size(mesh)):
@@ -1293,18 +1290,34 @@ def _plan_physical(plan: LogicalPlan,
     if required is None:
         required = set(plan.schema.names)
 
+    parent_count = ctx.get("parent_count", 1)
     reuse_key = None
+    count = parent_count
     if plan.children and not _is_prunable_chain(plan):
         # (leaves are covered by the decoded-read cache)
         subtree = _subtree_key(plan, ctx["keys"])
-        if ctx["counts"].get(subtree, 0) > 1:
+        count = ctx["counts"].get(subtree, 0)
+        # Only MAXIMAL shared subtrees get a ReusedExec: inside a shared
+        # subtree every descendant repeats as often as its ancestor, but
+        # the ancestor's memo already deduplicates the whole region —
+        # inner wrappers would only chop the operator chain into 1-op
+        # fragments (defeating whole-stage fusion) and pay per-node
+        # locking. A descendant shared MORE widely than its ancestor
+        # (used elsewhere too) still gets its own wrapper. The enclosing
+        # share count scopes through ctx (saved/restored around the
+        # subtree build).
+        if count > parent_count:
             reuse_key = (subtree,
                          frozenset(r.lower() for r in required))
             shared = ctx["built"].get(reuse_key)
             if shared is not None:
                 return shared
 
-    built = _plan_physical_node(plan, required, conf, ctx)
+    ctx["parent_count"] = max(parent_count, count)
+    try:
+        built = _plan_physical_node(plan, required, conf, ctx)
+    finally:
+        ctx["parent_count"] = parent_count
     if reuse_key is not None:
         built = ReusedExec(built)
         ctx["built"][reuse_key] = built
@@ -1396,6 +1409,16 @@ def _plan_physical_node(plan: LogicalPlan,
                          for n in wanted],
                         _plan_physical(c, set(wanted), conf, ctx))
             for c in plan.children])
+
+    if isinstance(plan, SetOp):
+        # Set-op identity is over FULL rows of the node schema: children
+        # must produce every column regardless of what the parent needs.
+        names = [f.name for f in plan.left.schema.fields]
+        left_phys = _plan_physical(plan.left, set(names), conf, ctx)
+        right_phys = _plan_physical(
+            plan.right, set(plan.right.schema.names), conf, ctx)
+        return SetOpExec(left_phys, right_phys, names,
+                         anti=isinstance(plan, Except))
 
     if isinstance(plan, Join):
         if plan.join_type == "cross":

@@ -25,6 +25,7 @@ class HyperspaceSession:
         self.conf = conf or HyperspaceConf()
         self._rules: List = []
         self._hyperspace_enabled = False
+        self._views: dict = {}
 
     # -- data sources -----------------------------------------------------
 
@@ -73,6 +74,34 @@ class HyperspaceSession:
         pq.write_table(table, os.path.join(tmpdir, "part-0.parquet"))
         return self.read_parquet(tmpdir)
 
+    # -- named sources (temp views) ---------------------------------------
+    #
+    # Spark temp-view parity (the reference's E2E suite covers view-served
+    # index queries, `E2EHyperspaceRulesTests` view cases): a view is a
+    # NAME bound to a logical plan, expanded at `table()` time — so the
+    # rewrite rules see the underlying relations and index signatures
+    # match exactly as for a directly-built DataFrame, and serialized
+    # plans (log entries) capture the expansion, never the name.
+
+    def create_or_replace_temp_view(self, name: str, df) -> None:
+        self._views[name.lower()] = df.plan
+
+    def create_temp_view(self, name: str, df) -> None:
+        if name.lower() in self._views:
+            raise HyperspaceException(f"Temp view already exists: {name}")
+        self._views[name.lower()] = df.plan
+
+    def table(self, name: str):
+        """DataFrame over a registered temp view (expanded plan)."""
+        from hyperspace_tpu.engine.dataframe import DataFrame
+        plan = self._views.get(name.lower())
+        if plan is None:
+            raise HyperspaceException(f"Unknown table or view: {name}")
+        return DataFrame(plan, self)
+
+    def drop_temp_view(self, name: str) -> bool:
+        return self._views.pop(name.lower(), None) is not None
+
     # -- optimizer plumbing ----------------------------------------------
 
     def enable_hyperspace(self) -> "HyperspaceSession":
@@ -97,4 +126,14 @@ class HyperspaceSession:
     def optimize(self, plan: LogicalPlan) -> LogicalPlan:
         for rule in self._rules:
             plan = rule.apply(plan)
+        # Scalar subqueries embedded in expressions carry their own
+        # plans; the rules rewrite those too (Spark applies the optimizer
+        # to subquery plans the same way). The rewrite lands in a
+        # side-slot (`_opt_plan`), refreshed EVERY optimize — including
+        # rules-off, which restores the plain plan — so the original
+        # expression the user holds is never mutated.
+        from hyperspace_tpu.engine.executor import _scalar_subqueries
+        for sub in _scalar_subqueries(plan):
+            sub._opt_plan = (self.optimize(sub.plan) if self._rules
+                             else None)
         return plan

@@ -150,10 +150,10 @@ def bucketed_join_indices(left: ColumnBatch, right: ColumnBatch,
         return host_bucketed_join_indices(
             left, right, np.asarray(l_lengths), np.asarray(r_lengths),
             left_keys, right_keys, how="left_outer" if left_outer else how)
-    from hyperspace_tpu.ops.join import counting_join_indices
-    l_ids, r_ids = encode_group_ids(left, right, left_keys, right_keys)
-    return counting_join_indices(l_ids, r_ids,
-                                 how="left_outer" if left_outer else how)
+    from hyperspace_tpu.ops.join import counting_join_batch_indices
+    return counting_join_batch_indices(
+        left, right, left_keys, right_keys,
+        how="left_outer" if left_outer else how)
 
 
 def _gather_side(batch: ColumnBatch, idx, names, may_unmatch: bool = True):

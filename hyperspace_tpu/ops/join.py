@@ -46,6 +46,122 @@ def encode_join_keys(left: ColumnBatch, right: ColumnBatch,
     return encode_group_ids(left, right, left_keys, right_keys)
 
 
+def _join_lane_operands(left: ColumnBatch, right: ColumnBatch,
+                        left_keys: Sequence[str],
+                        right_keys: Sequence[str]):
+    """Per-side 32-bit lane tuples for the ONE-SORT counting join: a
+    null-marker lane (0 = valid keys; 1 = left-null; 2 = right-null — so
+    null keys form single-side runs and match nothing, the shared join
+    null semantics) followed by the order-preserving value lanes
+    (`ops/keys.py`). Strings unify onto one merged dictionary first."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.io.columnar import unify_string_columns
+    from hyperspace_tpu.ops import keys as keymod
+
+    if len(left_keys) != len(right_keys) or not left_keys:
+        raise HyperspaceException("Join requires matching key column lists.")
+    n, m = left.num_rows, right.num_rows
+    l_valid = jnp.ones(n, dtype=bool)
+    r_valid = jnp.ones(m, dtype=bool)
+    l_lanes: List = []
+    r_lanes: List = []
+    for lk, rk in zip(left_keys, right_keys):
+        lcol, rcol = left.column(lk), right.column(rk)
+        if lcol.is_string != rcol.is_string:
+            raise HyperspaceException(f"Join key type mismatch: {lk} vs {rk}")
+        if lcol.is_string:
+            lcol, rcol = unify_string_columns(lcol, rcol)
+        if lcol.validity is not None:
+            l_valid = l_valid & lcol.validity
+        if rcol.validity is not None:
+            r_valid = r_valid & rcol.validity
+        ldata, rdata = lcol.data, rcol.data
+        if ldata.dtype != rdata.dtype:
+            common = jnp.promote_types(ldata.dtype, rdata.dtype)
+            ldata = ldata.astype(common)
+            rdata = rdata.astype(common)
+        l_lanes.extend(keymod.key_lanes(ldata))
+        r_lanes.extend(keymod.key_lanes(rdata))
+    marker_l = jnp.where(l_valid, jnp.int32(0), jnp.int32(1))
+    marker_r = jnp.where(r_valid, jnp.int32(0), jnp.int32(2))
+    return (marker_l, *l_lanes), (marker_r, *r_lanes)
+
+
+@__import__("functools").partial(__import__("jax").jit,
+                                 static_argnames=("left_outer",))
+def _counting_match_lanes(lanes_l, lanes_r, left_outer: bool):
+    """The counting match directly over raw key LANES — ONE staged sort
+    of (marker, *value lanes, side, orig) replaces the earlier two-sort
+    pipeline (dense-id encode sort + id/side match sort): runs come from
+    adjacent lane differences in the single sorted sequence. Orig
+    indices ride as trailing sort keys (unique, so equivalent to the
+    stable carried-value formulation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.keys import _staged_sort
+
+    n, m = lanes_l[0].shape[0], lanes_r[0].shape[0]
+    T = n + m
+    lanes = [jnp.concatenate([a, b]) for a, b in zip(lanes_l, lanes_r)]
+    side = jnp.concatenate([jnp.zeros(n, jnp.int32),
+                            jnp.ones(m, jnp.int32)])
+    orig = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                            jnp.arange(m, dtype=jnp.int32)])
+    _, sorted_ops = _staged_sort([*lanes, side, orig])
+    side_s = sorted_ops[-2]
+    orig_s = sorted_ops[-1]
+    keys_sorted = sorted_ops[:-2]
+    pos = jnp.arange(T, dtype=jnp.int32)
+    differs = jnp.zeros(T - 1, dtype=bool)
+    for k in keys_sorted:
+        differs = differs | (k[1:] != k[:-1])
+    run_start = jnp.concatenate([jnp.ones(1, bool), differs])
+    run_first = jax.lax.cummax(jnp.where(run_start, pos, 0))
+    nxt = jnp.flip(jax.lax.cummin(jnp.flip(
+        jnp.where(run_start, pos, jnp.int32(T)))))
+    run_last = jnp.concatenate([nxt[1:], jnp.full(1, T, jnp.int32)]) - 1
+    R = jnp.cumsum(side_s)  # inclusive right-element count
+    rights = (jnp.take(R, run_last) - jnp.take(R, run_first)
+              + jnp.take(side_s, run_first))
+    rstart = run_last - rights + 1
+    counts = jnp.where(side_s == 0, rights, 0).astype(jnp.int32)
+    if left_outer:
+        counts = jnp.where(side_s == 0, jnp.maximum(counts, 1), 0)
+    starts = jnp.cumsum(counts) - counts
+    return counts, starts, rights, rstart, orig_s
+
+
+def counting_join_batch_indices(left: ColumnBatch, right: ColumnBatch,
+                                left_keys: Sequence[str],
+                                right_keys: Sequence[str],
+                                how: str = "inner") -> Tuple:
+    """Device join row-index pairs straight from the key COLUMNS: one
+    fused sort+count executable (`_counting_match_lanes`) and one host
+    sync. Same null semantics and output order as the id-based
+    `counting_join_indices` (which remains for id-space callers)."""
+    import jax.numpy as jnp
+
+    left_outer = how == "left_outer"
+    n, m = left.num_rows, right.num_rows
+    empty = jnp.zeros(0, dtype=jnp.int32)
+    if n == 0 or (m == 0 and not left_outer):
+        return empty, empty
+    if m == 0:
+        return (jnp.arange(n, dtype=jnp.int32),
+                jnp.full(n, -1, dtype=jnp.int32))
+    lanes_l, lanes_r = _join_lane_operands(left, right, left_keys,
+                                           right_keys)
+    counts, starts, rights, rstart, orig_s = _counting_match_lanes(
+        lanes_l, lanes_r, left_outer)
+    total = int(jnp.sum(counts))  # the one host sync
+    if total == 0:
+        return empty, empty
+    return _counting_expand(counts, starts, rights, rstart, orig_s,
+                            total, left_outer)
+
+
 def counting_join_indices(l_ids, r_ids, how: str = "inner") -> Tuple:
     """Join row-index pairs over UNSORTED id arrays (original row space),
     via ONE joint sort + cumulative counting — no `searchsorted`.
@@ -225,15 +341,15 @@ def semi_anti_indices(left: ColumnBatch, right: ColumnBatch,
         if anti:
             return jnp.arange(left.num_rows, dtype=jnp.int32)
         return jnp.zeros(0, dtype=jnp.int32)
-    l_ids, r_ids = encode_join_keys(left, right, left_keys, right_keys)
-    # Membership via the counting match (same joint-sort core as the
-    # join; `searchsorted` is the slow primitive on TPU): with
-    # left_outer counting, counts > 0 marks exactly the LEFT elements in
-    # sorted space, and `rights` holds each element's run match count.
-    # Scatter-max back to original row order (right elements carry False
-    # so they never touch a left slot).
-    counts, _starts, rights, _rstart, orig_s = _counting_match(
-        l_ids, r_ids, True)
+    # Membership via the one-sort counting match over raw key lanes:
+    # with left_outer counting, counts > 0 marks exactly the LEFT
+    # elements in sorted space, and `rights` holds each element's run
+    # match count. Scatter-max back to original row order (right
+    # elements carry False so they never touch a left slot).
+    lanes_l, lanes_r = _join_lane_operands(left, right, left_keys,
+                                           right_keys)
+    counts, _starts, rights, _rstart, orig_s = _counting_match_lanes(
+        lanes_l, lanes_r, True)
     is_left = counts > 0
     hit = is_left & ((rights == 0) if anti else (rights > 0))
     # Right-side orig values (0..m-1) can exceed left.num_rows; they carry
@@ -286,14 +402,15 @@ def sort_merge_join(left: ColumnBatch, right: ColumnBatch,
                                     columns=columns)
 
     # Device lane: the counting join works in ORIGINAL row space over
-    # unsorted ids — no argsort, no pre-gather of payload batches, no
-    # searchsorted.
-    l_ids, r_ids = encode_join_keys(left, right, left_keys, right_keys)
+    # raw key lanes — ONE fused sort+count executable, no dense-id
+    # pre-encode, no argsort, no searchsorted.
     if how == "right_outer":
-        ri, li = counting_join_indices(r_ids, l_ids, how="left_outer")
+        ri, li = counting_join_batch_indices(right, left, right_keys,
+                                             left_keys, how="left_outer")
     else:
-        li, ri = counting_join_indices(
-            l_ids, r_ids, how="left_outer" if how == "full_outer" else how)
+        li, ri = counting_join_batch_indices(
+            left, right, left_keys, right_keys,
+            how="left_outer" if how == "full_outer" else how)
         if how == "full_outer":
             extra = unmatched_right_from_indices(ri, right.num_rows)
             li = jnp.concatenate(

@@ -1,10 +1,9 @@
 """Mesh-sharded co-bucketed join — the counting join over shard-local rows.
 
-Bucket b of both sides lives on shard `b % n_shards` (`parallel/mesh.py`),
-so once each shard holds its buckets' rows of BOTH sides the entire match
-phase runs with ZERO inter-chip traffic — the claim the JoinIndexRanker's
-equal-bucket preference encodes (reference
-`index/rankers/JoinIndexRanker.scala:40-55`).
+Each bucket's rows of BOTH sides land on one shard (`shard_plan`:
+load-balanced assignment), so the entire match phase runs with ZERO
+inter-chip traffic — the claim the JoinIndexRanker's equal-bucket
+preference encodes (reference `index/rankers/JoinIndexRanker.scala:40-55`).
 
 Layout: a host-side [S, C] gather plan maps (shard, slot) -> original row
 (C = largest shard's row count; padding is masked). Each shard's slice —
@@ -13,9 +12,12 @@ sharded `NamedSharding`, so per-chip live bytes are ~total/S. This
 replaces the round-3 design's two structural flaws (the round-3 review's
 item 3): key lanes replicated to every device (per-chip O(total rows)),
 and the padded [B, next_pow2(max_bucket)] layout where one hot bucket
-padded every bucket. Here a hot bucket inflates only its owner shard's
-capacity, and the match core is the same sort+cumulative-counting design
-the single-chip join uses (`ops/join.py` — skew-immune by construction).
+padded every bucket. Since round 5 HOT buckets SPLIT across shards
+(`shard_plan`: one side partitions, the other side's bucket rows
+replicate to the split shards) so skewed joins keep the whole mesh at
+near-ideal per-shard capacity; the match core is the same
+sort+cumulative-counting design the single-chip join uses
+(`ops/join.py` — skew-immune by construction).
 
 Per shard (all batched over the sharded axis, no collectives until the
 host sync that sizes the output):
@@ -92,27 +94,92 @@ def _side_lanes(left: ColumnBatch, right: ColumnBatch,
     return l_lanes, r_lanes, l_ok, r_ok
 
 
-def shard_layout(lengths, n_shards: int):
-    """Host-side [S, C] gather plan into a concat-in-bucket-order array:
-    shard s's slots are the rows of its buckets (b % S == s) in bucket
-    order; C = the largest shard's row count. Padding slots point at row
-    0 and are masked invalid."""
-    lengths = np.asarray(lengths, dtype=np.int64)
-    B = len(lengths)
-    starts = np.concatenate([[0], np.cumsum(lengths)[:-1]])
-    shard_rows_list = []
-    for s in range(n_shards):
-        owned = np.arange(s, B, n_shards)
-        if len(owned) == 0 or lengths[owned].sum() == 0:
-            shard_rows_list.append(np.zeros(0, dtype=np.int64))
+def shard_plan(l_lengths, r_lengths, n_shards: int, split: str):
+    """Host-side row->shard assignment for the co-bucketed join, with
+    HOT-BUCKET SPLITTING: a bucket whose rows dominate the ideal
+    per-shard load is split — one side's rows PARTITION across several
+    shards while the other side's rows of that bucket REPLICATE to each,
+    so every partitioned row still sees its complete match set and the
+    mesh keeps all its chips on skewed data (the round-4 review's item:
+    the ranker's parallelism rationale, `JoinIndexRanker.scala:40-55`,
+    carried to its TPU-native conclusion instead of a single-chip
+    fallback).
+
+    `split` picks which side may partition:
+      - "left":   only the left side partitions (LEFT OUTER and the
+                  semi/anti membership probes: every left row must see
+                  the FULL right set of its bucket, and must be emitted
+                  exactly once);
+      - "larger": either side may partition (INNER: matches are a union
+                  over chunks either way);
+      - "none":   whole-bucket assignment only (FULL OUTER: per-shard
+                  unmatched-right detection needs the whole bucket).
+    Non-split buckets place greedily on the least-loaded shard (LPT),
+    which already beats the former static `b % n_shards` ownership.
+
+    Returns ([l_rows per shard], [r_rows per shard]) as int64 index
+    arrays into the concat-in-bucket-order row space."""
+    l_lengths = np.asarray(l_lengths, dtype=np.int64)
+    r_lengths = np.asarray(r_lengths, dtype=np.int64)
+    B = len(l_lengths)
+    l_starts = np.concatenate([[0], np.cumsum(l_lengths)[:-1]])
+    r_starts = np.concatenate([[0], np.cumsum(r_lengths)[:-1]])
+    total = int(l_lengths.sum() + r_lengths.sum())
+    ideal = max(1, -(-total // n_shards))
+    loads = np.zeros(n_shards, dtype=np.int64)
+    l_rows: List[List] = [[] for _ in range(n_shards)]
+    r_rows: List[List] = [[] for _ in range(n_shards)]
+    order = np.argsort(-(l_lengths + r_lengths), kind="stable")
+    for b in order:
+        lb, rb = int(l_lengths[b]), int(r_lengths[b])
+        rows_b = lb + rb
+        if rows_b == 0:
             continue
-        shard_rows_list.append(np.concatenate(
-            [np.arange(starts[b], starts[b] + lengths[b]) for b in owned
-             if lengths[b] > 0]))
-    C = max(1, max(len(r) for r in shard_rows_list))
-    idx = np.zeros((n_shards, C), dtype=np.int32)
-    valid = np.zeros((n_shards, C), dtype=bool)
-    for s, rows in enumerate(shard_rows_list):
+        l_all = np.arange(l_starts[b], l_starts[b] + lb)
+        r_all = np.arange(r_starts[b], r_starts[b] + rb)
+        if split == "left" or lb >= rb:
+            part_rows, part_side = l_all, "l"
+            rep_rows = r_all
+        else:
+            part_rows, part_side = r_all, "r"
+            rep_rows = l_all
+        # Split only when the PARTITIONED side dominates the load: the
+        # replicated side multiplies by the split width, so partitioning
+        # a tiny side under a huge replicated one would inflate capacity
+        # instead of balancing it (review finding).
+        do_split = (split != "none" and n_shards > 1
+                    and len(part_rows) > max(ideal, 256))
+        if not do_split:
+            s = int(np.argmin(loads))
+            if lb:
+                l_rows[s].append(l_all)
+            if rb:
+                r_rows[s].append(r_all)
+            loads[s] += rows_b
+            continue
+        k = int(min(n_shards, max(2, -(-len(part_rows) // ideal)),
+                    len(part_rows)))
+        shards = np.argsort(loads, kind="stable")[:k]
+        for s, chunk in zip(shards, np.array_split(part_rows, k)):
+            s = int(s)
+            if len(chunk):
+                (l_rows if part_side == "l" else r_rows)[s].append(chunk)
+                if len(rep_rows):
+                    (r_rows if part_side == "l" else l_rows)[s].append(
+                        rep_rows)
+                loads[s] += len(chunk) + len(rep_rows)
+    cat = (lambda parts: np.concatenate(parts)
+           if parts else np.zeros(0, dtype=np.int64))
+    return [cat(p) for p in l_rows], [cat(p) for p in r_rows]
+
+
+def _rows_to_layout(rows_per_shard):
+    """[rows per shard] -> ([S, C] gather idx, valid mask, C)."""
+    S = len(rows_per_shard)
+    C = max(1, max(len(r) for r in rows_per_shard))
+    idx = np.zeros((S, C), dtype=np.int32)
+    valid = np.zeros((S, C), dtype=bool)
+    for s, rows in enumerate(rows_per_shard):
         idx[s, :len(rows)] = rows
         valid[s, :len(rows)] = True
     return idx, valid, C
@@ -120,7 +187,9 @@ def shard_layout(lengths, n_shards: int):
 
 def shard_skew(l_lengths, r_lengths, n_shards: int) -> bool:
     """True when hot-bucket skew would blow the [S, C] layout up far past
-    the true row count — route single-chip instead."""
+    the true row count. Only FULL OUTER still routes single-chip on this
+    (whole buckets are atomic there); every other join type splits hot
+    buckets across shards instead (`shard_plan`)."""
     l_lengths = np.asarray(l_lengths, dtype=np.int64)
     r_lengths = np.asarray(r_lengths, dtype=np.int64)
     B = len(l_lengths)
@@ -134,18 +203,20 @@ def shard_skew(l_lengths, r_lengths, n_shards: int) -> bool:
 
 
 def _sharded_inputs(left, right, l_lengths, r_lengths, left_keys,
-                    right_keys, mesh):
+                    right_keys, mesh, split: str = "none"):
     """Build the sharded [S, T] match inputs (T = Cl + Cr): combined key
-    lanes, pad mask, null mask, plus the [S, Cl]/[S, Cr] row-index plans.
-    Everything is gathered host-side from the 1-D lanes and device_put
-    with the sharded spec — per-device bytes ~ T, not total rows."""
+    lanes, pad mask, null mask, plus the [S, Cl]/[S, Cr] row-index plans
+    (load-balanced, hot buckets split per `shard_plan`). Everything is
+    gathered host-side from the 1-D lanes and device_put with the
+    sharded spec — per-device bytes ~ T, not total rows."""
     import jax
 
     n_shards = total_shards(mesh)
     l_lanes, r_lanes, l_ok, r_ok = _side_lanes(left, right, left_keys,
                                                right_keys)
-    l_idx, l_valid, Cl = shard_layout(l_lengths, n_shards)
-    r_idx, r_valid, Cr = shard_layout(r_lengths, n_shards)
+    l_rows, r_rows = shard_plan(l_lengths, r_lengths, n_shards, split)
+    l_idx, l_valid, Cl = _rows_to_layout(l_rows)
+    r_idx, r_valid, Cr = _rows_to_layout(r_rows)
 
     lanes2d = tuple(np.concatenate([ll[l_idx], rl[r_idx]], axis=1)
                     for ll, rl in zip(l_lanes, r_lanes))
@@ -307,9 +378,14 @@ def distributed_bucketed_join_indices(
             ri = jnp.concatenate([ri, jnp.arange(m, dtype=jnp.int32)])
         return li, ri
 
-    lanes2d, pad, null, l_idx, r_idx, Cl, Cr = _sharded_inputs(
-        left, right, l_lengths, r_lengths, left_keys, right_keys, mesh)
     full_outer = how == "full_outer"
+    lanes2d, pad, null, l_idx, r_idx, Cl, Cr = _sharded_inputs(
+        left, right, l_lengths, r_lengths, left_keys, right_keys, mesh,
+        # full_outer's unmatched-right scan needs whole buckets; inner
+        # may partition either side; left_outer must keep every left row
+        # exactly once with its full right set -> split left only.
+        split=("none" if full_outer
+               else ("larger" if how == "inner" else "left")))
     counts, starts, rights, rstart, pos_s, right_unmatched = \
         _shard_match_core(lanes2d, pad, null, Cl,
                           left_outer=how in ("left_outer", "full_outer"),
@@ -359,7 +435,10 @@ def distributed_semi_anti_indices(
         return (jnp.arange(left.num_rows, dtype=jnp.int32) if anti
                 else jnp.zeros(0, dtype=jnp.int32))
     lanes2d, pad, null, l_idx, r_idx, Cl, Cr = _sharded_inputs(
-        left, right, l_lengths, r_lengths, left_keys, right_keys, mesh)
+        left, right, l_lengths, r_lengths, left_keys, right_keys, mesh,
+        # Membership: every left row must see its bucket's FULL right
+        # set (anti requires NO match anywhere) -> only left partitions.
+        split="left")
     counts, _starts, rights, _rstart, pos_s, _ = _shard_match_core(
         lanes2d, pad, null, Cl, left_outer=True, need_right=False)
     counts2d = counts.reshape(pos_s.shape)

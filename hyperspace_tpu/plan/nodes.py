@@ -576,6 +576,55 @@ class Union(LogicalPlan):
         return f"Union ({len(self._children)} children)"
 
 
+class SetOp(LogicalPlan):
+    """SQL set operation with DISTINCT semantics (INTERSECT / EXCEPT):
+    output = DISTINCT rows of `left` present in (Intersect) / absent from
+    (Except) `right`. Row equality treats NULL as equal to NULL — SQL set
+    operations, UNLIKE joins, group nulls together. The reference's serde
+    zoo exists to make exactly these queries serializable
+    (`index/serde/package.scala:64-167`, IntersectWrapper/ExceptWrapper);
+    this IR carries them natively (TPC-DS q8/q14/q38/q87)."""
+
+    kind: str = ""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan):
+        ln = [n.lower() for n in left.schema.names]
+        rn = [n.lower() for n in right.schema.names]
+        if ln != rn:
+            raise HyperspaceException(
+                f"{type(self).__name__} sides must share column "
+                f"names/order; got {ln} vs {rn}.")
+        self.left = left
+        self.right = right
+
+    @property
+    def children(self) -> List[LogicalPlan]:
+        return [self.left, self.right]
+
+    @property
+    def schema(self) -> Schema:
+        return self.left.schema
+
+    def with_children(self, children):
+        left, right = children
+        return type(self)(left, right)
+
+    def to_dict(self) -> dict:
+        return {"node": self.kind, "left": self.left.to_dict(),
+                "right": self.right.to_dict()}
+
+    def simple_string(self) -> str:
+        return type(self).__name__
+
+
+class Intersect(SetOp):
+    kind = "intersect"
+
+
+class Except(SetOp):
+    kind = "except"
+
+
 _JOIN_TYPES = ("inner", "left_outer", "right_outer", "full_outer",
                "left_semi", "left_anti", "cross")
 

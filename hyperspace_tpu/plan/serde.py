@@ -16,9 +16,10 @@ import json
 
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.plan.expr import Expression
-from hyperspace_tpu.plan.nodes import (Aggregate, AggSpec, BucketSpec, Filter,
-                                       Join, Limit, LogicalPlan, Project,
-                                       Scan, Sort, Union, Window)
+from hyperspace_tpu.plan.nodes import (Aggregate, AggSpec, BucketSpec, Except,
+                                       Filter, Intersect, Join, Limit,
+                                       LogicalPlan, Project, Scan, Sort,
+                                       Union, Window)
 from hyperspace_tpu.plan.schema import Field, Schema
 
 
@@ -57,6 +58,11 @@ def plan_from_dict(d: dict) -> LogicalPlan:
         return Sort(d["columns"], plan_from_dict(d["child"]))
     if node == "limit":
         return Limit(d["n"], plan_from_dict(d["child"]))
+    if node == "intersect":
+        return Intersect(plan_from_dict(d["left"]),
+                         plan_from_dict(d["right"]))
+    if node == "except":
+        return Except(plan_from_dict(d["left"]), plan_from_dict(d["right"]))
     if node == "join":
         cond = d["condition"]
         return Join(plan_from_dict(d["left"]), plan_from_dict(d["right"]),

@@ -29,9 +29,12 @@ _BASE = {
 }
 
 TABLES = ("store_sales", "store_returns", "catalog_sales",
-          "catalog_returns", "date_dim", "store", "item", "customer",
-          "promotion", "customer_demographics", "household_demographics",
-          "customer_address", "time_dim", "reason", "income_band")
+          "catalog_returns", "web_sales", "web_returns", "inventory",
+          "date_dim", "store", "item", "customer", "promotion",
+          "customer_demographics", "household_demographics",
+          "customer_address", "time_dim", "reason", "income_band",
+          "warehouse", "ship_mode", "web_site", "web_page", "call_center",
+          "catalog_page")
 
 _QUARTERS = ["%dQ%d" % (y, q) for y in range(1998, 2004)
              for q in range(1, 5)]
@@ -57,6 +60,13 @@ def _date_dim(n_dates: int):
         "d_day_name": np.array([_DAYS[d] for d in (day % 7)]),
         "d_qoy": np.minimum(qoy, 4).astype(np.int64),
         "d_quarter_name": quarter_name,
+        # Sequential month/week counters (official d_month_seq/d_week_seq
+        # semantics: monotone over the calendar) — the year-over-year
+        # self-join queries (q2/q59) and month-window subqueries (q54)
+        # key on these.
+        "d_month_seq": ((year - 1998) * 12
+                        + np.minimum(moy, 12) - 1).astype(np.int64),
+        "d_week_seq": (day // 7 + 1).astype(np.int64),
     }
 
 
@@ -160,6 +170,17 @@ def generate(out_dir: str, scale: float = 1.0,
             1, _BASE["date_dim"] // 20 + 1, n_cust).astype(np.int64),
         "c_first_name": np.array(["fn_%d" % (i % 400) for i in range(n_cust)]),
         "c_last_name": np.array(["ln_%d" % (i % 700) for i in range(n_cust)]),
+        "c_preferred_cust_flag": np.array([["Y", "N"][i % 2]
+                                           for i in range(n_cust)]),
+        "c_birth_country": np.array([["UNITED STATES", "CANADA", "MEXICO",
+                                      "GERMANY", "JAPAN"][i % 5]
+                                     for i in range(n_cust)]),
+        "c_birth_year": (1940 + np.arange(n_cust) % 60).astype(np.int64),
+        "c_birth_month": (1 + np.arange(n_cust) % 12).astype(np.int64),
+        "c_salutation": np.array([["Mr.", "Mrs.", "Ms.", "Dr."][i % 4]
+                                  for i in range(n_cust)]),
+        "c_email_address": np.array(["c%d@example.com" % i
+                                     for i in range(n_cust)]),
     }
 
     tables["promotion"] = {
@@ -191,6 +212,16 @@ def generate(out_dir: str, scale: float = 1.0,
                                        for i in range(n_demo)]),
         "cd_education_status": np.array([_EDU[(i // 10) % 7]
                                          for i in range(n_demo)]),
+        "cd_dep_count": (np.arange(n_demo) % 7).astype(np.int64),
+        "cd_dep_employed_count": ((np.arange(n_demo) // 7) % 5
+                                  ).astype(np.int64),
+        "cd_dep_college_count": ((np.arange(n_demo) // 35) % 4
+                                 ).astype(np.int64),
+        "cd_purchase_estimate": (500 * (1 + np.arange(n_demo) % 20)
+                                 ).astype(np.int64),
+        "cd_credit_rating": np.array([["Low Risk", "Good", "Unknown",
+                                       "High Risk"][i % 4]
+                                      for i in range(n_demo)]),
     }
     tables["household_demographics"] = {
         "hd_demo_sk": np.arange(1, n_demo + 1, dtype=np.int64),
@@ -231,8 +262,14 @@ def generate(out_dir: str, scale: float = 1.0,
                             for i in range(n_addr)]),
         "ca_state": np.array([_STATES[i % len(_STATES)]
                               for i in range(n_addr)]),
+        "ca_county": np.array([["Williamson County", "Ziebach County",
+                                "Walker County", "Daviess County"][i % 4]
+                               for i in range(n_addr)]),
         "ca_country": np.array(["United States"] * n_addr),
         "ca_gmt_offset": np.full(n_addr, -5.0),
+        "ca_location_type": np.array([["apartment", "condo",
+                                       "single family"][i % 3]
+                                      for i in range(n_addr)]),
     }
     # Seconds 08:00:00 .. 20:59:59 (the selling day q96 probes).
     t_sk = np.arange(8 * 3600, 21 * 3600, dtype=np.int64)
@@ -318,6 +355,7 @@ def generate(out_dir: str, scale: float = 1.0,
                                           n_dates).astype(np.int64),
         "sr_item_sk": ss_item[ret_pick],
         "sr_customer_sk": ss_cust[ret_pick],
+        "sr_cdemo_sk": rng.integers(1, n_demo + 1, n_sr).astype(np.int64),
         "sr_store_sk": ss_store[ret_pick],
         "sr_reason_sk": (1 + rng.integers(0, 5, n_sr)).astype(np.int64),
         "sr_ticket_number": ss_ticket[ret_pick],
@@ -344,9 +382,20 @@ def generate(out_dir: str, scale: float = 1.0,
     cs_price = np.round(rng.uniform(1.0, 300.0, n_cs), 2)
     tables["catalog_sales"] = {
         "cs_sold_date_sk": cs_date,
+        "cs_sold_time_sk": rng.integers(8 * 3600, 21 * 3600,
+                                        n_cs).astype(np.int64),
         "cs_bill_customer_sk": cs_cust,
         "cs_bill_cdemo_sk": rng.integers(1, n_demo + 1,
                                          n_cs).astype(np.int64),
+        "cs_bill_addr_sk": rng.integers(1, n_addr + 1,
+                                        n_cs).astype(np.int64),
+        "cs_ship_addr_sk": rng.integers(1, n_addr + 1,
+                                        n_cs).astype(np.int64),
+        "cs_ship_date_sk": np.minimum(cs_date + rng.integers(1, 120, n_cs),
+                                      n_dates).astype(np.int64),
+        "cs_warehouse_sk": rng.integers(1, 6, n_cs).astype(np.int64),
+        "cs_ship_mode_sk": rng.integers(1, 21, n_cs).astype(np.int64),
+        "cs_call_center_sk": rng.integers(1, 5, n_cs).astype(np.int64),
         "cs_item_sk": cs_item,
         "cs_promo_sk": rng.integers(1, n_promo + 1, n_cs).astype(np.int64),
         "cs_order_number": cs_order,
@@ -378,6 +427,167 @@ def generate(out_dir: str, scale: float = 1.0,
         "cr_refunded_cash": np.round(rng.uniform(1.0, 150.0, n_cr), 2),
         "cr_reversed_charge": np.round(rng.uniform(0.0, 40.0, n_cr), 2),
         "cr_store_credit": np.round(rng.uniform(0.0, 40.0, n_cr), 2),
+    }
+
+    # -- web channel (round-5 breadth: the 3-channel query families) -----
+    n_wh = 5
+    tables["warehouse"] = {
+        "w_warehouse_sk": np.arange(1, n_wh + 1, dtype=np.int64),
+        "w_warehouse_name": np.array(["Warehouse %d" % i
+                                      for i in range(n_wh)]),
+        "w_warehouse_sq_ft": (50_000 + 25_000 * np.arange(n_wh)
+                              ).astype(np.int64),
+        "w_city": np.array([["Midway", "Fairview"][i % 2]
+                            for i in range(n_wh)]),
+        "w_county": np.array([["Williamson County", "Ziebach County"][i % 2]
+                              for i in range(n_wh)]),
+        "w_state": np.array([["TN", "CA", "WA"][i % 3] for i in range(n_wh)]),
+        "w_country": np.array(["United States"] * n_wh),
+    }
+    n_sm = 20
+    tables["ship_mode"] = {
+        "sm_ship_mode_sk": np.arange(1, n_sm + 1, dtype=np.int64),
+        "sm_type": np.array([["EXPRESS", "NEXT DAY", "OVERNIGHT",
+                              "REGULAR", "TWO DAY"][i % 5]
+                             for i in range(n_sm)]),
+        "sm_code": np.array([["AIR", "SURFACE", "SEA"][i % 3]
+                             for i in range(n_sm)]),
+        "sm_carrier": np.array([["UPS", "FEDEX", "AIRBORNE", "USPS"][i % 4]
+                                for i in range(n_sm)]),
+    }
+    n_web = 4
+    tables["web_site"] = {
+        "web_site_sk": np.arange(1, n_web + 1, dtype=np.int64),
+        "web_site_id": np.array(["WEB%04d" % i for i in range(n_web)]),
+        "web_name": np.array(["site_%d" % i for i in range(n_web)]),
+        "web_company_name": np.array([["pri", "ought"][i % 2]
+                                      for i in range(n_web)]),
+    }
+    n_wp = 10
+    tables["web_page"] = {
+        "wp_web_page_sk": np.arange(1, n_wp + 1, dtype=np.int64),
+        "wp_char_count": (4000 + 150 * np.arange(n_wp)).astype(np.int64),
+    }
+    n_cc = 4
+    tables["call_center"] = {
+        "cc_call_center_sk": np.arange(1, n_cc + 1, dtype=np.int64),
+        "cc_call_center_id": np.array(["CC%04d" % i for i in range(n_cc)]),
+        "cc_name": np.array(["center_%d" % i for i in range(n_cc)]),
+        "cc_county": np.array([["Williamson County",
+                                "Ziebach County"][i % 2]
+                               for i in range(n_cc)]),
+        "cc_manager": np.array(["mgr_%d" % i for i in range(n_cc)]),
+    }
+    n_cp = 100
+    tables["catalog_page"] = {
+        "cp_catalog_page_sk": np.arange(1, n_cp + 1, dtype=np.int64),
+        "cp_catalog_page_id": np.array(["CP%08d" % i for i in range(n_cp)]),
+    }
+
+    # -- web_sales: ~40% of store volume; half follow a store sale so
+    # cross-channel customer/item overlap exists (q38/q87 INTERSECT/
+    # EXCEPT, q11/q74 year-total ratios key on it) ----------------------
+    n_ws = n_ss * 4 // 10
+    ws_follow = rng.random(n_ws) < 0.5
+    wf_pick = rng.choice(n_ss, n_ws, replace=True)
+    ws_item = np.where(ws_follow, ss_item[wf_pick],
+                       rng.integers(1, n_item + 1, n_ws)).astype(np.int64)
+    ws_cust = np.where(ws_follow, ss_cust[wf_pick],
+                       rng.integers(1, n_cust + 1, n_ws)).astype(np.int64)
+    ws_date = np.minimum(
+        np.where(ws_follow, ss_sold_date[wf_pick]
+                 + rng.integers(0, 60, n_ws),
+                 rng.integers(lo_day, hi_day + 1, n_ws)),
+        n_dates).astype(np.int64)
+    ws_qty = rng.integers(1, 100, n_ws).astype(np.int64)
+    # Multi-line orders (~3 lines each): per-line warehouses can then
+    # differ within one order (q94/q95 probe exactly that).
+    ws_order = (np.arange(n_ws, dtype=np.int64) // 3) + 1
+    ws_price = np.round(rng.uniform(1.0, 300.0, n_ws), 2)
+    tables["web_sales"] = {
+        "ws_sold_date_sk": ws_date,
+        "ws_sold_time_sk": rng.integers(8 * 3600, 21 * 3600,
+                                        n_ws).astype(np.int64),
+        "ws_ship_date_sk": np.minimum(ws_date + rng.integers(1, 120, n_ws),
+                                      n_dates).astype(np.int64),
+        "ws_item_sk": ws_item,
+        "ws_bill_customer_sk": ws_cust,
+        "ws_bill_addr_sk": rng.integers(1, n_addr + 1,
+                                        n_ws).astype(np.int64),
+        "ws_ship_customer_sk": rng.integers(1, n_cust + 1,
+                                            n_ws).astype(np.int64),
+        "ws_ship_hdemo_sk": rng.integers(1, n_demo + 1,
+                                         n_ws).astype(np.int64),
+        "ws_ship_addr_sk": rng.integers(1, n_addr + 1,
+                                        n_ws).astype(np.int64),
+        "ws_web_page_sk": rng.integers(1, n_wp + 1, n_ws).astype(np.int64),
+        "ws_web_site_sk": rng.integers(1, n_web + 1, n_ws).astype(np.int64),
+        "ws_ship_mode_sk": rng.integers(1, n_sm + 1, n_ws).astype(np.int64),
+        "ws_warehouse_sk": rng.integers(1, n_wh + 1, n_ws).astype(np.int64),
+        "ws_promo_sk": rng.integers(1, n_promo + 1, n_ws).astype(np.int64),
+        "ws_order_number": ws_order,
+        "ws_quantity": ws_qty,
+        "ws_wholesale_cost": np.round(ws_price * 0.6, 2),
+        "ws_list_price": np.round(ws_price * 1.2, 2),
+        "ws_sales_price": ws_price,
+        "ws_ext_sales_price": np.round(ws_price * ws_qty, 2),
+        "ws_ext_list_price": np.round(ws_price * 1.2 * ws_qty, 2),
+        "ws_ext_wholesale_cost": np.round(ws_price * 0.6 * ws_qty, 2),
+        "ws_ext_discount_amt": np.round(
+            np.where(rng.random(n_ws) < 0.4,
+                     rng.uniform(0.0, 60.0, n_ws), 5.0), 2),
+        "ws_ext_ship_cost": np.round(rng.uniform(0.5, 30.0, n_ws), 2),
+        "ws_net_paid": np.round(ws_price * ws_qty * 0.95, 2),
+        "ws_net_profit": np.round(ws_price * ws_qty * 0.1
+                                  - rng.uniform(0, 50, n_ws), 2),
+    }
+
+    # -- web_returns: ~15% of web sales ----------------------------------
+    n_wr = n_ws * 15 // 100
+    wr_pick = rng.choice(n_ws, max(n_wr, 1), replace=False)
+    n_wr = len(wr_pick)
+    wr_qty = np.maximum(ws_qty[wr_pick] - rng.integers(0, 50, n_wr),
+                        1).astype(np.int64)
+    tables["web_returns"] = {
+        "wr_returned_date_sk": np.minimum(
+            ws_date[wr_pick] + rng.integers(1, 90, n_wr),
+            n_dates).astype(np.int64),
+        "wr_item_sk": ws_item[wr_pick],
+        "wr_order_number": ws_order[wr_pick],
+        "wr_returning_customer_sk": ws_cust[wr_pick],
+        "wr_refunded_customer_sk": ws_cust[wr_pick],
+        "wr_refunded_addr_sk": rng.integers(1, n_addr + 1,
+                                            n_wr).astype(np.int64),
+        "wr_returning_cdemo_sk": rng.integers(1, n_demo + 1,
+                                              n_wr).astype(np.int64),
+        "wr_refunded_cdemo_sk": rng.integers(1, n_demo + 1,
+                                             n_wr).astype(np.int64),
+        "wr_web_page_sk": rng.integers(1, n_wp + 1, n_wr).astype(np.int64),
+        "wr_reason_sk": (1 + rng.integers(0, 5, n_wr)).astype(np.int64),
+        "wr_return_quantity": wr_qty,
+        "wr_return_amt": np.round(ws_price[wr_pick] * wr_qty, 2),
+        "wr_fee": np.round(rng.uniform(0.5, 100.0, n_wr), 2),
+        "wr_refunded_cash": np.round(rng.uniform(1.0, 150.0, n_wr), 2),
+        "wr_net_loss": np.round(rng.uniform(1.0, 200.0, n_wr), 2),
+    }
+
+    # -- inventory: weekly on-hand snapshots over the dense sales window.
+    # Size is items x weeks x warehouses (does NOT scale with `scale`
+    # past the item cap — real TPC-DS inventory is similarly
+    # item-bounded).
+    inv_weeks = np.arange(lo_day, hi_day + 1, 7, dtype=np.int64)
+    n_inv_items = min(n_item, 4000)
+    inv_items = np.arange(1, n_inv_items + 1, dtype=np.int64)
+    inv_wh = np.arange(1, n_wh + 1, dtype=np.int64)
+    grid_d, grid_i, grid_w = np.meshgrid(inv_weeks, inv_items, inv_wh,
+                                         indexing="ij")
+    n_inv = grid_d.size
+    tables["inventory"] = {
+        "inv_date_sk": grid_d.reshape(-1),
+        "inv_item_sk": grid_i.reshape(-1),
+        "inv_warehouse_sk": grid_w.reshape(-1),
+        "inv_quantity_on_hand": rng.integers(0, 1000,
+                                             n_inv).astype(np.int64),
     }
 
     paths: Dict[str, str] = {}

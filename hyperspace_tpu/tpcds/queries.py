@@ -1927,3 +1927,7 @@ QUERIES: Dict[str, Tuple[Callable, Callable]] = {
     "q98": (q98, q98_pandas),
 }
 QUERIES.update(QUERIES_EXT)
+
+from hyperspace_tpu.tpcds.queries_ext2 import QUERIES_EXT2  # noqa: E402
+
+QUERIES.update(QUERIES_EXT2)

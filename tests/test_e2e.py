@@ -144,6 +144,40 @@ def test_index_lifecycle_and_catalog(env):
     assert list(hs.indexes()["state"]) == ["ACTIVE"]
 
 
+def test_view_query_is_index_served(env):
+    """Reference E2E view cases (`E2EHyperspaceRulesTests` temp-view
+    tests): a filter query over a NAMED view resolves to the same
+    underlying relation, so the index rule fires and results match."""
+    session, hs, src = env
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("viewIdx", ["clicks"], ["id"]))
+    df.create_or_replace_temp_view("sampleView")
+
+    query = session.table("sampleView").filter(
+        col("clicks") == 42).select("id")
+    plain, indexed = run_with_and_without(session, query, ["id"])
+    assert len(plain) > 0
+    pd.testing.assert_frame_equal(plain, indexed)
+    roots = scan_roots(query, session)
+    assert len(roots) == 1 and "viewIdx" in roots[0]
+
+    # Views layer over arbitrary queries too; rules still fire on the
+    # expanded relation underneath.
+    (df.filter(col("imprs") > 0)
+       .create_or_replace_temp_view("filteredView"))
+    q2 = session.table("filteredView").filter(col("clicks") == 42)
+    assert session.table("filteredView").count() > 0
+    session.disable_hyperspace()
+    a = q2.select("id").to_pandas().sort_values("id").reset_index(drop=True)
+    session.enable_hyperspace()
+    b = q2.select("id").to_pandas().sort_values("id").reset_index(drop=True)
+    session.disable_hyperspace()
+    pd.testing.assert_frame_equal(a, b)
+    assert session.drop_temp_view("sampleView")
+    with pytest.raises(HyperspaceException):
+        session.table("sampleView")
+
+
 def test_create_stamps_index_stats(env):
     """Every data-writing action persists on-disk size + row count in the
     log entry (`extra.stats`) at build time, so rule ranking never walks

@@ -96,9 +96,27 @@ def _write_sorted_runs(table, perm_chunks, starts, ends, path: str,
 BUILD_MIN_DEVICE_ROWS = 1_000_000
 
 
+def _host_lane_preferred(rows: int) -> bool:
+    """Single-chip builds of HOST-resident sources route by residency:
+    with the native radix lane available the permutation never needs the
+    device — the C++ sort runs at device-sort speed without paying key
+    H2D + permutation D2H over a (possibly degraded) tunneled link, and
+    its cost is link-independent. Without the native library the old
+    size threshold picks lexsort vs device. Device/mesh-resident batches
+    keep the on-chip path (`write_bucketed_batch`, `parallel/build.py`)."""
+    from hyperspace_tpu import native
+    if rows < BUILD_MIN_DEVICE_ROWS:
+        return True
+    return native.get_lib() is not None
+
+
 def _host_build_permutation(table, names: Sequence[str], num_buckets: int):
     """Host (bucket, *keys) stable sort permutation + bucket boundaries,
-    mirroring the device program's layout semantics."""
+    mirroring the device program's layout semantics. The sort itself runs
+    in the native C++ radix lane (`native.bucket_key_sort_perm`) when the
+    library is available — no device link traffic, ~radix-speed on the
+    1-core host — with np.lexsort as the always-correct fallback."""
+    from hyperspace_tpu import native
     from hyperspace_tpu.ops.host_hash import (host_column_hash_lanes,
                                               host_flat_hash32)
     from hyperspace_tpu.ops.keys import host_column_sort_lanes
@@ -109,10 +127,14 @@ def _host_build_permutation(table, names: Sequence[str], num_buckets: int):
         hash_lanes.extend(host_column_hash_lanes(batch.column(name)))
     bucket = (host_flat_hash32(hash_lanes)
               % np.uint32(num_buckets)).astype(np.int32)
-    sort_keys: List = [bucket]
+    sort_lanes: List = []
     for name in names:
-        sort_keys.extend(host_column_sort_lanes(batch.column(name)))
-    perm = np.lexsort(tuple(reversed(sort_keys)))
+        sort_lanes.extend(host_column_sort_lanes(batch.column(name)))
+    nat = native.bucket_key_sort_perm(bucket, num_buckets, sort_lanes)
+    if nat is not None:
+        perm, starts, ends = nat
+        return [perm], starts, ends
+    perm = np.lexsort(tuple(reversed([bucket] + sort_lanes)))
     sorted_bucket = bucket[perm]
     starts = np.searchsorted(sorted_bucket, np.arange(num_buckets), "left")
     ends = np.searchsorted(sorted_bucket, np.arange(num_buckets), "right")
@@ -181,7 +203,7 @@ def write_bucketed_table(table, indexed_columns: Sequence[str],
             raise HyperspaceException(
                 f"Column not found in table: {', '.join(missing)}")
         names = [by_lower[c.lower()] for c in indexed_columns]
-        if table.num_rows < BUILD_MIN_DEVICE_ROWS:
+        if _host_lane_preferred(table.num_rows):
             chunks, starts, ends = _host_build_permutation(
                 table, names, num_buckets)
         else:
@@ -217,14 +239,14 @@ def write_bucketed_from_files(files: Sequence[str],
 
     from hyperspace_tpu.ops.build import permutation_from_tree
 
-    key_table = parquet.read_table(files, columns=list(key_names))
-    n = key_table.num_rows
-    if n < BUILD_MIN_DEVICE_ROWS:
+    n = sum(parquet.file_row_counts(files))  # footers only, no decode
+    if _host_lane_preferred(n):
         table = parquet.read_table(files, columns=list(column_names))
         if lineage_ids is not None:
             table = append_lineage_column(table, files, lineage_ids)
         return write_bucketed_table(table, list(key_names), num_buckets,
                                     path, file_suffix=file_suffix)
+    key_table = parquet.read_table(files, columns=list(key_names))
     tree = _stage_key_tree(key_table, key_names)
     chunks, starts, ends = permutation_from_tree(tree, key_names, n,
                                                  num_buckets)

@@ -20,7 +20,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 # ABI version in the filename: a .so built from older sources simply
 # never matches the load path (no in-place overwrite of a possibly
 # mmapped stale library, no dlopen returning the cached stale handle).
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 _SO_PATH = os.path.join(_HERE, f"libhyperspace_host_v{_ABI_VERSION}.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -68,6 +68,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
                 ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p]
+            lib.bucket_key_sort_perm.restype = None
+            lib.bucket_key_sort_perm.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p]
             _lib = lib
         except (OSError, AttributeError) as exc:
             # AttributeError = missing symbol (a hand-built .so from other
@@ -120,6 +125,69 @@ def string_hash64(values) -> Optional["numpy.ndarray"]:
     if values.dtype.kind != "U":
         values = values.astype(object)
     return arrow_string_hash64(pa.array(values, type=pa.string()))
+
+
+def pack_sort_words(lanes):
+    """Pack order-preserving uint32 sort lanes (most significant first)
+    into uint64 words for `bucket_key_sort_perm`. Accepts the lane dtypes
+    `ops/keys.host_column_sort_lanes` produces: bool validity (False =
+    null sorts first), signed int32 (biased to order-equivalent uint32),
+    and uint32. Returns a list of C-contiguous uint64 arrays, or None when
+    a lane's dtype can't be mapped (caller falls back to np.lexsort)."""
+    import numpy as np
+
+    u32 = []
+    for lane in lanes:
+        lane = np.asarray(lane)
+        if lane.dtype == np.bool_:
+            u32.append(lane.astype(np.uint32))
+        elif lane.dtype == np.int32:
+            u32.append(lane.view(np.uint32) ^ np.uint32(0x80000000))
+        elif lane.dtype == np.uint32:
+            u32.append(lane)
+        elif lane.dtype in (np.int8, np.int16):
+            u32.append(lane.astype(np.int32).view(np.uint32)
+                       ^ np.uint32(0x80000000))
+        else:
+            return None
+    if len(u32) % 2:
+        u32.insert(0, None)  # zero-pad the most significant word's hi lane
+    words = []
+    for hi, lo in zip(u32[0::2], u32[1::2]):
+        w = lo.astype(np.uint64)
+        if hi is not None:
+            w |= hi.astype(np.uint64) << np.uint64(32)
+        words.append(np.ascontiguousarray(w))
+    return words
+
+
+def bucket_key_sort_perm(bucket_ids, num_buckets: int, lanes):
+    """Stable (bucket, *lanes) ascending sort permutation + per-bucket
+    bounds via the native radix sort — the index build's host lane.
+    Returns (perm int32, starts int64, ends int64) or None when the
+    library is unavailable or a lane dtype is unsupported."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    words = pack_sort_words(lanes)
+    if words is None:
+        return None
+    bucket_ids = np.ascontiguousarray(bucket_ids, dtype=np.int32)
+    n = len(bucket_ids)
+    perm = np.empty(n, dtype=np.int32)
+    starts = np.empty(num_buckets, dtype=np.int64)
+    ends = np.empty(num_buckets, dtype=np.int64)
+    word_ptrs = (ctypes.c_void_p * len(words))(
+        *[w.ctypes.data_as(ctypes.c_void_p).value for w in words])
+    lib.bucket_key_sort_perm(
+        bucket_ids.ctypes.data_as(ctypes.c_void_p), ctypes.c_int64(n),
+        ctypes.c_int64(num_buckets), word_ptrs, ctypes.c_int32(len(words)),
+        perm.ctypes.data_as(ctypes.c_void_p),
+        starts.ctypes.data_as(ctypes.c_void_p),
+        ends.ctypes.data_as(ctypes.c_void_p))
+    return perm, starts, ends
 
 
 def bucketed_merge_join_i64(lkey, rkey, lbounds, rbounds,

@@ -145,6 +145,100 @@ void bucketed_merge_join_fill_i64(const int64_t* lk, const int64_t* rk,
 
 }  // extern "C"
 
+namespace {
+
+// Stable LSD radix scatter of the current permutation by one 16-bit
+// digit of `w` (values gathered through the permutation). `hist` is the
+// digit histogram, already computed over the full array.
+void radix_pass_u64(const uint64_t* w, int shift, const int64_t* hist,
+                    const int32_t* cur, int32_t* nxt, int64_t n) {
+    int64_t offs[65536];
+    int64_t run = 0;
+    for (int d = 0; d < 65536; ++d) {
+        offs[d] = run;
+        run += hist[d];
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        const int32_t r = cur[i];
+        nxt[offs[(w[r] >> shift) & 0xFFFF]++] = r;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Stable (bucket, key-words) sort permutation — the index build's host
+// lane. `words` are big-endian-significant packed uint64 sort lanes
+// (words[0] most significant); rows sort ascending by
+// (bucket, words[0], ..., words[n_words-1]), ties keeping input order.
+// LSD: radix each word least-significant-first (16-bit digits, constant
+// digits skipped via the histogram), then one stable counting pass by
+// bucket. Outputs the int32 permutation plus per-bucket [start, end)
+// bounds. No device link traffic — this replaces a ~perm-sized D2H
+// transfer plus a host lexsort (the round-4 review's rung-1 residual).
+void bucket_key_sort_perm(const int32_t* bucket_ids, int64_t n,
+                          int64_t num_buckets,
+                          const uint64_t* const* words, int32_t n_words,
+                          int32_t* perm, int64_t* starts, int64_t* ends) {
+    if (n <= 0) {
+        for (int64_t d = 0; d < num_buckets; ++d) starts[d] = ends[d] = 0;
+        return;
+    }
+    std::vector<int32_t> cur(n), tmp(n);
+    for (int64_t i = 0; i < n; ++i) cur[i] = static_cast<int32_t>(i);
+    int32_t* a = cur.data();
+    int32_t* b = tmp.data();
+    std::vector<int64_t> hist(4 * 65536);
+    for (int32_t w = n_words - 1; w >= 0; --w) {
+        const uint64_t* W = words[w];
+        std::fill(hist.begin(), hist.end(), 0);
+        int64_t* h0 = hist.data();
+        int64_t* h1 = h0 + 65536;
+        int64_t* h2 = h1 + 65536;
+        int64_t* h3 = h2 + 65536;
+        for (int64_t i = 0; i < n; ++i) {
+            const uint64_t v = W[i];
+            ++h0[v & 0xFFFF];
+            ++h1[(v >> 16) & 0xFFFF];
+            ++h2[(v >> 32) & 0xFFFF];
+            ++h3[v >> 48];
+        }
+        const int64_t* hs[4] = {h0, h1, h2, h3};
+        for (int p = 0; p < 4; ++p) {
+            // A digit with a single occupied bin permutes nothing.
+            // Constant iff the first non-empty bin holds all n rows.
+            const int64_t* h = hs[p];
+            bool constant = false;
+            for (int d = 0; d < 65536; ++d) {
+                if (h[d] == n) { constant = true; break; }
+                if (h[d] != 0) break;
+            }
+            if (!constant) {
+                radix_pass_u64(W, 16 * p, h, a, b, n);
+                std::swap(a, b);
+            }
+        }
+    }
+    // Final stable counting pass by bucket id; writes land directly in
+    // `perm` when the parity works out, else through tmp.
+    std::vector<int64_t> boffs(num_buckets, 0);
+    for (int64_t i = 0; i < n; ++i) ++boffs[bucket_ids[i]];
+    int64_t run = 0;
+    for (int64_t d = 0; d < num_buckets; ++d) {
+        starts[d] = run;
+        run += boffs[d];
+        ends[d] = run;
+        boffs[d] = starts[d];
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        const int32_t r = a[i];
+        perm[boffs[bucket_ids[r]]++] = r;
+    }
+}
+
+}  // extern "C"
+
 extern "C" {
 
 // FNV-1a 64-bit over each of n strings; identical to the Python

@@ -1,0 +1,131 @@
+"""Per-operator-class profiling of a TPC-DS query against a PERSISTENT
+workspace (data + indexes reused across runs) — the q64 perf dev loop.
+
+Usage:
+  python scripts/prof_tpcds.py q64 [--scale 10] [--runs 3] [--work DIR]
+
+Prints per-PhysicalNode-class cumulative wall seconds and execute-call
+counts for one warm run, plus fusion-stage STATS (dispatch/sync seconds)
+and total wall per run.
+"""
+
+import argparse
+import collections
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("query")
+    ap.add_argument("--scale", type=float, default=10.0)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--work", default="/tmp/hs_prof")
+    ap.add_argument("--no-fuse", action="store_true")
+    ap.add_argument("--trace-dir", default=None,
+                    help="XLA profiler capture dir for the last run")
+    args = ap.parse_args()
+
+    from hyperspace_tpu import Hyperspace, HyperspaceConf, HyperspaceSession
+    from hyperspace_tpu.tpcds import QUERIES, generate
+    from hyperspace_tpu.tpcds.queries import create_indexes
+    from hyperspace_tpu.engine import physical, fusion
+
+    work = os.path.join(args.work, f"s{args.scale:g}")
+    data_dir = os.path.join(work, "data")
+    wh = os.path.join(work, "wh")
+    t0 = time.perf_counter()
+    paths = generate(data_dir, scale=args.scale)  # reuses existing files
+    print(f"generate/reuse: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    conf_map = {"hyperspace.warehouse.dir": wh,
+                "spark.hyperspace.index.num.buckets": "32"}
+    extra = os.environ.get("BENCH_TPCDS_CONF")
+    if extra:
+        conf_map.update(json.loads(extra))
+    if args.no_fuse:
+        conf_map["spark.hyperspace.execution.fusion.enabled"] = "false"
+    sess = HyperspaceSession(HyperspaceConf(conf_map))
+    hs = Hyperspace(sess)
+    dfs = {n: sess.read_parquet(p) for n, p in paths.items()}
+    idx_df = hs.indexes()
+    existing = set(idx_df["name"]) if len(idx_df) else set()
+    t0 = time.perf_counter()
+    create_indexes(hs, dfs, queries=[args.query], skip=existing)
+    print(f"index build (skip {len(existing)} existing): "
+          f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    sess.enable_hyperspace()
+    build, _oracle = QUERIES[args.query]
+
+    # -- per-class execute() timing hooks --------------------------------
+    stats = collections.defaultdict(lambda: [0, 0.0])  # cls -> [calls, secs]
+    depth = [0]  # attribute time to the OUTERMOST node only? No: self time
+    # is hard with nesting; report cumulative-inclusive but also track
+    # self time via a stack.
+    stack = []
+
+    def wrap(cls):
+        orig = cls.execute
+
+        def timed(self, bucket=None, _orig=orig, _name=cls.__name__):
+            t0 = time.perf_counter()
+            if stack:
+                stack[-1][1] += 0  # placeholder
+            stack.append([_name, 0.0])
+            try:
+                return _orig(self, bucket)
+            finally:
+                dt = time.perf_counter() - t0
+                _me = stack.pop()
+                child_s = _me[1]
+                if stack:
+                    stack[-1][1] += dt
+                st = stats[_name]
+                st[0] += 1
+                st[1] += dt - child_s  # SELF time
+        cls.execute = timed
+        return orig
+
+    classes = [getattr(physical, n) for n in dir(physical)
+               if isinstance(getattr(physical, n), type)
+               and issubclass(getattr(physical, n), physical.PhysicalNode)
+               and getattr(physical, n) is not physical.PhysicalNode]
+    classes.append(fusion.FusedStageExec)
+    classes.append(fusion._SourceExec)
+    origs = [(c, wrap(c)) for c in classes]
+
+    try:
+        build(dfs).collect()  # warm: compiles, file listings, caches
+        for st in stats.values():
+            st[0] = 0
+            st[1] = 0.0
+        for k in fusion.STATS:
+            fusion.STATS[k] = 0 if isinstance(fusion.STATS[k], int) else 0.0
+        walls = []
+        for r in range(args.runs):
+            if args.trace_dir and r == args.runs - 1:
+                sess.conf.set("spark.hyperspace.trace.dir", args.trace_dir)
+            t0 = time.perf_counter()
+            out = build(dfs).collect().to_pandas()
+            walls.append(time.perf_counter() - t0)
+        print(f"rows={len(out)} walls={[round(w, 3) for w in walls]}")
+        total = sum(walls)
+        print(f"\nper-class SELF seconds over {args.runs} warm runs:")
+        for name, (calls, secs) in sorted(stats.items(),
+                                          key=lambda kv: -kv[1][1]):
+            if calls:
+                print(f"  {name:26s} calls={calls:4d}  self={secs:8.3f}s "
+                      f"({100 * secs / total:4.1f}%)")
+        print(f"\nfusion STATS: {dict(fusion.STATS)}")
+    finally:
+        for c, o in origs:
+            c.execute = o
+
+
+if __name__ == "__main__":
+    main()

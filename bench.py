@@ -108,18 +108,18 @@ def cpu_build(table, out_dir):
 
 
 def rung1_build(table, work):
-    """PRODUCT build path: per build, keys staged to device (narrow 32-bit
-    lanes when the range allows), device computes the bucket+sort
-    permutation, host streams bucket files while permutation chunks are in
-    flight; the payload never crosses the link.
-
-    Besides the end-to-end time, the DEVICE-COMPUTE and KEY-STAGING (H2D
-    link) phases are timed separately: the tunneled link and the 1-core
-    host wobble ~2x by time of day, the XLA sort does not — the split
-    shows which part moved when the headline moves (round-3 review)."""
+    """PRODUCT build path. Builds route by data residency
+    (`io/builder._host_lane_preferred`): a host-resident source sorts in
+    the native C++ radix lane — zero link traffic, link-independent cost —
+    while device/mesh-resident batches keep the on-chip XLA sort. Both
+    lanes are phase-timed here: the product lane's sort and write phases,
+    AND the device path's key-staging (H2D), on-chip compute, and
+    permutation D2H, so the artifact shows what the link would have cost
+    and which part moved when the headline moves (round-3/4 reviews)."""
     import jax
 
-    from hyperspace_tpu.io.builder import (_stage_key_tree,
+    from hyperspace_tpu.io.builder import (_host_build_permutation,
+                                           _stage_key_tree,
                                            write_bucketed_table)
     from hyperspace_tpu.ops.build import permutation_from_tree
 
@@ -140,15 +140,31 @@ def rung1_build(table, work):
     t0 = time.perf_counter()
     dev()
     log(f"rung1 cold build (incl. compile): {time.perf_counter() - t0:.2f}s")
-    dev_s = best_of(dev, label="rung1 device")
+    dev_s = best_of(dev, label="rung1 product")
     # Same N runs for both sides: best-of over unequal sample counts
     # favors whichever side drew more (round-3 review).
     cpu_s = best_of(cpu, label="rung1 cpu")
 
-    # Phase split. Key staging = H2D over the link (fresh each run);
-    # compute = the bucket+sort permutation on ALREADY-staged keys,
-    # synced to completion; host write = the remainder of the end-to-end
-    # build (payload gather + parquet encode + perm D2H overlap).
+    # Product-lane phase: the host sort (hash + permutation). The lane
+    # label mirrors the routing predicate exactly
+    # (`io/builder._host_lane_preferred`): native radix when the library
+    # loads, host lexsort under the size threshold, device otherwise.
+    from hyperspace_tpu import native
+    from hyperspace_tpu.io.builder import BUILD_MIN_DEVICE_ROWS
+    if native.get_lib() is not None:
+        lane = "native-host"
+    elif table.num_rows < BUILD_MIN_DEVICE_ROWS:
+        lane = "host-lexsort"
+    else:
+        lane = "device"
+    sort_s = best_of(lambda: _host_build_permutation(table, ["key"],
+                                                     NUM_BUCKETS),
+                     label="rung1 host-sort") if lane != "device" else None
+
+    # Device-path phases (measured regardless of the chosen lane — this
+    # is what a device-resident build pays). Key staging = H2D over the
+    # link (fresh each run); compute = the bucket+sort permutation on
+    # ALREADY-staged keys, synced; d2h = the permutation's trip back.
     def stage():
         tree = _stage_key_tree(table, ["key"])
         jax.block_until_ready(jax.tree_util.tree_leaves(tree))
@@ -162,10 +178,20 @@ def rung1_build(table, work):
         chunks, starts, ends = permutation_from_tree(
             tree, ["key"], table.num_rows, NUM_BUCKETS)
         jax.block_until_ready([*chunks, starts, ends])
+        return chunks
 
     compute()  # warm compile for this call pattern
     compute_s = best_of(compute, label="rung1 device-compute")
-    return dev_s, cpu_s, stage_s, compute_s
+
+    def compute_and_fetch():
+        # Fresh dispatch each run: jax caches an array's host copy, so
+        # re-fetching the SAME chunks would time a no-op after run 0.
+        for c in compute():
+            np.asarray(c)
+
+    fetch_s = best_of(compute_and_fetch, label="rung1 compute+perm-d2h")
+    d2h_s = max(fetch_s - compute_s, 0.0)
+    return dev_s, cpu_s, stage_s, compute_s, d2h_s, sort_s, lane
 
 
 def rung1_partition_kernel(table):
@@ -502,16 +528,21 @@ def main():
         pq.write_table(left, os.path.join(work, "left", "part-0.parquet"))
         pq.write_table(right, os.path.join(work, "right", "part-0.parquet"))
 
-        dev1, cpu1, stage1, compute1 = rung1_build(left, work)
+        dev1, cpu1, stage1, compute1, d2h1, sort1, lane1 = \
+            rung1_build(left, work)
         part = rung1_partition_kernel(left)
         rate1 = N_ROWS / dev1
-        # Residual, NOT a phase time: the build overlaps host writes with
-        # in-flight permutation chunks, so end-to-end is closer to
-        # max-of-phases than sum-of-phases.
-        resid1 = max(dev1 - stage1 - compute1, 0.0)
-        log(f"rung1: device {dev1:.3f}s (compute {compute1:.3f}s, "
-            f"key-stage {stage1:.3f}s, residual host/link {resid1:.3f}s) "
-            f"vs cpu {cpu1:.3f}s ({rate1:,.0f} rows/s, x{cpu1 / dev1:.2f})")
+        # Product-lane write phase (gather + parquet encode) = end-to-end
+        # minus the sort phase; on the native lane nothing touches the
+        # link, so this split is exact rather than a residual.
+        write1 = max(dev1 - sort1, 0.0) if sort1 is not None else None
+        log(f"rung1 [{lane1}]: build {dev1:.3f}s"
+            + (f" (sort {sort1:.3f}s, write {write1:.3f}s)"
+               if sort1 is not None else "")
+            + f" vs cpu {cpu1:.3f}s ({rate1:,.0f} rows/s, "
+              f"x{cpu1 / dev1:.2f}); device path would pay: key-stage "
+              f"{stage1:.3f}s + compute {compute1:.3f}s + perm-d2h "
+              f"{d2h1:.3f}s")
 
         sess = make_session(work)
         from hyperspace_tpu import Hyperspace
@@ -540,12 +571,18 @@ def main():
             "vs_baseline": round(cpu1 / dev1, 3),
             "link_probe": probe,
             "rungs": {
-                "1_build": {"device_s": round(dev1, 3),
-                            "device_compute_s": round(compute1, 3),
-                            "key_stage_link_s": round(stage1, 3),
-                            "host_link_residual_s": round(resid1, 3),
-                            "device_compute_rows_per_sec": round(
-                                N_ROWS / compute1, 1),
+                "1_build": {"build_s": round(dev1, 3),
+                            "lane": lane1,
+                            "sort_s": (round(sort1, 3)
+                                       if sort1 is not None else None),
+                            "write_s": (round(write1, 3)
+                                        if write1 is not None else None),
+                            "device_path": {
+                                "key_stage_link_s": round(stage1, 3),
+                                "device_compute_s": round(compute1, 3),
+                                "perm_d2h_link_s": round(d2h1, 3),
+                                "device_compute_rows_per_sec": round(
+                                    N_ROWS / compute1, 1)},
                             "cpu_s": round(cpu1, 3),
                             "partition_kernel_s": (round(part[0], 4)
                                                    if part else None),

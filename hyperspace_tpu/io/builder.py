@@ -499,7 +499,7 @@ def compact_index(prev_entry, data_manager, out_path: str) -> List[str]:
                                          schema, num_buckets)
     if merge_perm is not None:
         chunks, starts, ends = merge_perm
-    elif table.num_rows < BUILD_MIN_DEVICE_ROWS:
+    elif _host_lane_preferred(table.num_rows):
         key_batch = columnar.from_arrow(table.select(names), device=False)
         chunks, starts, ends = host_bucket_sort_permutation(
             key_batch, names, lengths)

@@ -171,11 +171,15 @@ def bucket_key_sort_perm(bucket_ids, num_buckets: int, lanes):
     lib = get_lib()
     if lib is None:
         return None
+    bucket_ids = np.ascontiguousarray(bucket_ids, dtype=np.int32)
+    n = len(bucket_ids)
+    if n >= 1 << 31:
+        # int32 permutation indices would wrap; callers fall back to the
+        # lexsort/device lanes, which carry int64 permutations.
+        return None
     words = pack_sort_words(lanes)
     if words is None:
         return None
-    bucket_ids = np.ascontiguousarray(bucket_ids, dtype=np.int32)
-    n = len(bucket_ids)
     perm = np.empty(n, dtype=np.int32)
     starts = np.empty(num_buckets, dtype=np.int64)
     ends = np.empty(num_buckets, dtype=np.int64)

@@ -188,17 +188,28 @@ def host_merge_runs_permutation(key: np.ndarray, run_bounds):
 
 def host_bucket_sort_permutation(key_batch, sort_columns: Sequence[str],
                                  lengths: np.ndarray):
-    """Host (numpy) twin: stable lexsort keyed (bucket, *sort lanes) —
-    below the device-amortization row count a fresh XLA compile can never
-    pay for itself (`io/builder.BUILD_MIN_DEVICE_ROWS`)."""
+    """Host twin: stable sort keyed (bucket, *sort lanes) — the native C++
+    radix lane when available (`native.bucket_key_sort_perm`), np.lexsort
+    otherwise. Below the device-amortization row count a fresh XLA
+    compile can never pay for itself (`io/builder.BUILD_MIN_DEVICE_ROWS`);
+    with the native lane the host path also wins at size by skipping the
+    link round-trip entirely."""
+    from hyperspace_tpu import native
+
     lengths = np.asarray(lengths, dtype=np.int64)
-    bucket_of_row = np.repeat(np.arange(len(lengths), dtype=np.int64),
+    bucket_of_row = np.repeat(np.arange(len(lengths), dtype=np.int32),
                               lengths)
-    sort_keys: List = [bucket_of_row]
+    sort_lanes: List = []
     for name in sort_columns:
-        sort_keys.extend(keymod.host_column_sort_lanes(
+        sort_lanes.extend(keymod.host_column_sort_lanes(
             key_batch.column(name)))
-    perm = np.lexsort(tuple(reversed(sort_keys))).astype(np.int64)
     ends = np.cumsum(lengths)
     starts = ends - lengths
-    return [perm], starts, ends
+    nat = native.bucket_key_sort_perm(bucket_of_row, len(lengths),
+                                      sort_lanes)
+    if nat is not None:
+        perm, nstarts, nends = nat
+        # Bounds from lengths and from the sort must agree by construction.
+        return [perm], starts, ends
+    perm = np.lexsort(tuple(reversed([bucket_of_row] + sort_lanes)))
+    return [perm.astype(np.int64)], starts, ends

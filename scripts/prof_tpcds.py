@@ -62,11 +62,8 @@ def main():
     sess.enable_hyperspace()
     build, _oracle = QUERIES[args.query]
 
-    # -- per-class execute() timing hooks --------------------------------
+    # -- per-class execute() timing hooks: SELF time via a call stack ----
     stats = collections.defaultdict(lambda: [0, 0.0])  # cls -> [calls, secs]
-    depth = [0]  # attribute time to the OUTERMOST node only? No: self time
-    # is hard with nesting; report cumulative-inclusive but also track
-    # self time via a stack.
     stack = []
 
     def wrap(cls):
@@ -74,8 +71,6 @@ def main():
 
         def timed(self, bucket=None, _orig=orig, _name=cls.__name__):
             t0 = time.perf_counter()
-            if stack:
-                stack[-1][1] += 0  # placeholder
             stack.append([_name, 0.0])
             try:
                 return _orig(self, bucket)

@@ -266,6 +266,10 @@ def test_optimize_is_one_batched_program_and_matches_rebuild(env, tmp_path,
         return real_host(*a, **k)
 
     monkeypatch.setattr(builder_mod, "BUILD_MIN_DEVICE_ROWS", 0)
+    # Residency routing prefers the native host lane for host tables;
+    # bypass it so this test exercises the batched DEVICE program.
+    monkeypatch.setattr(builder_mod, "_host_lane_preferred",
+                        lambda rows: False)
     # Disable the host MERGE fast path (single-int-key compactions take
     # it; a separate test pins its output) so this test exercises the
     # batched device program.

@@ -146,17 +146,10 @@ def rung1_build(table, work):
     cpu_s = best_of(cpu, label="rung1 cpu")
 
     # Product-lane phase: the host sort (hash + permutation). The lane
-    # label mirrors the routing predicate exactly
-    # (`io/builder._host_lane_preferred`): native radix when the library
-    # loads, host lexsort under the size threshold, device otherwise.
-    from hyperspace_tpu import native
-    from hyperspace_tpu.io.builder import BUILD_MIN_DEVICE_ROWS
-    if native.get_lib() is not None:
-        lane = "native-host"
-    elif table.num_rows < BUILD_MIN_DEVICE_ROWS:
-        lane = "host-lexsort"
-    else:
-        lane = "device"
+    # label IS the routing predicate's answer (`io/builder.build_lane`),
+    # so the artifact can't drift from the product's actual path.
+    from hyperspace_tpu.io.builder import build_lane
+    lane = build_lane(table.num_rows)
     sort_s = best_of(lambda: _host_build_permutation(table, ["key"],
                                                      NUM_BUCKETS),
                      label="rung1 host-sort") if lane != "device" else None

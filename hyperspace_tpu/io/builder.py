@@ -96,18 +96,28 @@ def _write_sorted_runs(table, perm_chunks, starts, ends, path: str,
 BUILD_MIN_DEVICE_ROWS = 1_000_000
 
 
-def _host_lane_preferred(rows: int) -> bool:
-    """Single-chip builds of HOST-resident sources route by residency:
-    with the native radix lane available the permutation never needs the
-    device — the C++ sort runs at device-sort speed without paying key
-    H2D + permutation D2H over a (possibly degraded) tunneled link, and
-    its cost is link-independent. Without the native library the old
-    size threshold picks lexsort vs device. Device/mesh-resident batches
-    keep the on-chip path (`write_bucketed_batch`, `parallel/build.py`)."""
+def build_lane(rows: int) -> str:
+    """Which permutation engine a HOST-resident build of `rows` rows
+    takes: "native-host" (C++ radix — no device link traffic,
+    link-independent cost), "host-lexsort" (small build; an XLA compile
+    could never amortize), or "device" (no native library and the size
+    justifies the on-chip sort). THE routing predicate — the bench
+    reports this same value, so artifact labels can't drift from the
+    product's actual path. Device/mesh-resident batches are routed by
+    residency before this is consulted (`write_bucketed_batch`,
+    `parallel/build.py`). Above 2^31 rows the native lane's int32
+    permutation would wrap (`native.bucket_key_sort_perm` declines), so
+    sizing routes to the int64-permutation lanes instead."""
     from hyperspace_tpu import native
     if rows < BUILD_MIN_DEVICE_ROWS:
-        return True
-    return native.get_lib() is not None
+        return "host-lexsort"
+    if rows < 1 << 31 and native.get_lib() is not None:
+        return "native-host"
+    return "device"
+
+
+def _host_lane_preferred(rows: int) -> bool:
+    return build_lane(rows) != "device"
 
 
 def _host_build_permutation(table, names: Sequence[str], num_buckets: int):

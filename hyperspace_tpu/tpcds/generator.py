@@ -79,6 +79,11 @@ def generate(out_dir: str, scale: float = 1.0,
     import pyarrow.parquet as pq
 
     rng = np.random.default_rng(seed)
+    # Columns added in later rounds draw from a SEPARATE stream: inserting
+    # draws into `rng`'s sequence would silently reshuffle every
+    # previously-generated table (and the constants the query suite's
+    # filters were tuned against).
+    rng2 = np.random.default_rng(seed + 1)
     n_ss = max(int(_BASE["store_sales"] * scale), 1000)
     n_dates = _BASE["date_dim"] // 20  # ~6 years of days
     n_item = max(int(_BASE["item"] * min(scale, 4)), 200)
@@ -104,6 +109,8 @@ def generate(out_dir: str, scale: float = 1.0,
         "s_state": np.array([["TN", "CA", "WA", "NY", "TX"][i % 5]
                              for i in range(n_store)]),
         "s_zip": np.array(["%05d" % (35000 + 13 * i) for i in range(n_store)]),
+        # q24's market-grouped store pairing join.
+        "s_market_id": (1 + np.arange(n_store) % 10).astype(np.int64),
         # q50's full select list (street/county/company identity columns).
         "s_company_id": np.ones(n_store, dtype=np.int64),
         "s_street_number": np.array(["%d" % (100 + 7 * i)
@@ -317,6 +324,9 @@ def generate(out_dir: str, scale: float = 1.0,
     ss_ticket = tick + 1
     ss_qty = rng.integers(1, 100, n_ss).astype(np.int64)
     ss_price = np.round(rng.uniform(1.0, 300.0, n_ss), 2)
+    # ~2% of store rows carry a NULL store key (official store_sales has
+    # nullable dimension FKs; the null-key report q76 depends on them).
+    ss_store_null = rng2.random(n_ss) < 0.02
     tables["store_sales"] = {
         "ss_sold_date_sk": ss_sold_date,
         "ss_sold_time_sk": rng.integers(8 * 3600, 21 * 3600,
@@ -326,7 +336,7 @@ def generate(out_dir: str, scale: float = 1.0,
         "ss_cdemo_sk": t_cdemo[tick],
         "ss_hdemo_sk": t_hdemo[tick],
         "ss_addr_sk": t_addr[tick],
-        "ss_store_sk": ss_store,
+        "ss_store_sk": pa.array(ss_store, mask=ss_store_null),
         "ss_promo_sk": rng.integers(1, n_promo + 1, n_ss).astype(np.int64),
         "ss_ticket_number": ss_ticket,
         "ss_quantity": ss_qty,
@@ -340,6 +350,8 @@ def generate(out_dir: str, scale: float = 1.0,
         "ss_coupon_amt": np.round(
             np.where(rng.random(n_ss) < 0.3,
                      rng.uniform(0.0, 20.0, n_ss), 0.0), 2),
+        # q24/q49/q78: what the customer actually paid.
+        "ss_net_paid": np.round(ss_price * ss_qty * 0.97, 2),
         "ss_net_profit": np.round(ss_price * ss_qty * 0.1
                                   - rng.uniform(0, 50, n_ss), 2),
     }
@@ -380,6 +392,7 @@ def generate(out_dir: str, scale: float = 1.0,
     cs_qty = rng.integers(1, 100, n_cs).astype(np.int64)
     cs_order = np.arange(1, n_cs + 1, dtype=np.int64)
     cs_price = np.round(rng.uniform(1.0, 300.0, n_cs), 2)
+    cs_page = rng2.integers(1, 101, n_cs).astype(np.int64)
     tables["catalog_sales"] = {
         "cs_sold_date_sk": cs_date,
         "cs_sold_time_sk": rng.integers(8 * 3600, 21 * 3600,
@@ -389,13 +402,15 @@ def generate(out_dir: str, scale: float = 1.0,
                                          n_cs).astype(np.int64),
         "cs_bill_addr_sk": rng.integers(1, n_addr + 1,
                                         n_cs).astype(np.int64),
-        "cs_ship_addr_sk": rng.integers(1, n_addr + 1,
-                                        n_cs).astype(np.int64),
+        "cs_ship_addr_sk": pa.array(
+            rng.integers(1, n_addr + 1, n_cs).astype(np.int64),
+            mask=rng2.random(n_cs) < 0.02),
         "cs_ship_date_sk": np.minimum(cs_date + rng.integers(1, 120, n_cs),
                                       n_dates).astype(np.int64),
         "cs_warehouse_sk": rng.integers(1, 6, n_cs).astype(np.int64),
         "cs_ship_mode_sk": rng.integers(1, 21, n_cs).astype(np.int64),
         "cs_call_center_sk": rng.integers(1, 5, n_cs).astype(np.int64),
+        "cs_catalog_page_sk": cs_page,
         "cs_item_sk": cs_item,
         "cs_promo_sk": rng.integers(1, n_promo + 1, n_cs).astype(np.int64),
         "cs_order_number": cs_order,
@@ -410,6 +425,9 @@ def generate(out_dir: str, scale: float = 1.0,
             np.where(rng.random(n_cs) < 0.3,
                      rng.uniform(0.0, 20.0, n_cs), 0.0), 2),
         "cs_ext_list_price": np.round(rng.uniform(5.0, 500.0, n_cs), 2),
+        # q16 (shipping-cost report) and q49/q75/q78 (net paid).
+        "cs_ext_ship_cost": np.round(rng2.uniform(0.5, 30.0, n_cs), 2),
+        "cs_net_paid": np.round(cs_price * cs_qty * 0.95, 2),
         "cs_net_profit": np.round(rng.uniform(-50.0, 300.0, n_cs), 2),
     }
 
@@ -427,6 +445,13 @@ def generate(out_dir: str, scale: float = 1.0,
         "cr_refunded_cash": np.round(rng.uniform(1.0, 150.0, n_cr), 2),
         "cr_reversed_charge": np.round(rng.uniform(0.0, 40.0, n_cr), 2),
         "cr_store_credit": np.round(rng.uniform(0.0, 40.0, n_cr), 2),
+        # q5/q49/q77/q80/q83/q91 (returns reports over the catalog channel).
+        "cr_return_amount": np.round(rng2.uniform(1.0, 250.0, n_cr), 2),
+        "cr_net_loss": np.round(rng2.uniform(0.5, 80.0, n_cr), 2),
+        "cr_return_quantity": rng2.integers(1, 10, n_cr).astype(np.int64),
+        "cr_call_center_sk": rng2.integers(1, 5, n_cr).astype(np.int64),
+        "cr_reason_sk": rng2.integers(1, 6, n_cr).astype(np.int64),
+        "cr_catalog_page_sk": cs_page[cr_pick],
     }
 
     # -- web channel (round-5 breadth: the 3-channel query families) -----
@@ -514,8 +539,9 @@ def generate(out_dir: str, scale: float = 1.0,
         "ws_bill_customer_sk": ws_cust,
         "ws_bill_addr_sk": rng.integers(1, n_addr + 1,
                                         n_ws).astype(np.int64),
-        "ws_ship_customer_sk": rng.integers(1, n_cust + 1,
-                                            n_ws).astype(np.int64),
+        "ws_ship_customer_sk": pa.array(
+            rng.integers(1, n_cust + 1, n_ws).astype(np.int64),
+            mask=rng2.random(n_ws) < 0.02),
         "ws_ship_hdemo_sk": rng.integers(1, n_demo + 1,
                                          n_ws).astype(np.int64),
         "ws_ship_addr_sk": rng.integers(1, n_addr + 1,

@@ -1931,3 +1931,7 @@ QUERIES.update(QUERIES_EXT)
 from hyperspace_tpu.tpcds.queries_ext2 import QUERIES_EXT2  # noqa: E402
 
 QUERIES.update(QUERIES_EXT2)
+
+from hyperspace_tpu.tpcds.queries_ext3 import QUERIES_EXT3  # noqa: E402
+
+QUERIES.update(QUERIES_EXT3)

@@ -161,6 +161,17 @@ def pack_sort_words(lanes):
     return words
 
 
+def key_sort_perm(n: int, lanes):
+    """Stable ascending sort permutation over `lanes` alone (no bucket
+    grouping) via the native radix — the plain-sort entry the host sort
+    and group-encode lanes share. Returns an int32 permutation or None
+    (library unavailable, unsupported lane dtype, or n >= 2^31)."""
+    import numpy as np
+
+    out = bucket_key_sort_perm(np.zeros(n, dtype=np.int32), 1, lanes)
+    return None if out is None else out[0]
+
+
 def bucket_key_sort_perm(bucket_ids, num_buckets: int, lanes):
     """Stable (bucket, *lanes) ascending sort permutation + per-bucket
     bounds via the native radix sort — the index build's host lane.

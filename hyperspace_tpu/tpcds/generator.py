@@ -596,6 +596,13 @@ def generate(out_dir: str, scale: float = 1.0,
         "wr_refunded_cash": np.round(rng.uniform(1.0, 150.0, n_wr), 2),
         "wr_net_loss": np.round(rng.uniform(1.0, 200.0, n_wr), 2),
     }
+    # Returner == buyer for ~60% of returns (same demographics row) — the
+    # correlation the paired-demographics probes (q85) measure. Post-hoc
+    # fixup on rng2 so the main stream's draw sequence is untouched.
+    _wr = tables["web_returns"]
+    _wr["wr_returning_cdemo_sk"] = np.where(
+        rng2.random(n_wr) < 0.6, _wr["wr_refunded_cdemo_sk"],
+        _wr["wr_returning_cdemo_sk"]).astype(np.int64)
 
     # -- inventory: weekly on-hand snapshots over the dense sales window.
     # Size is items x weeks x warehouses (does NOT scale with `scale`

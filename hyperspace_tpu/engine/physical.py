@@ -441,6 +441,35 @@ class SortExec(PhysicalNode):
         return sort_batch(batch, self.keys)
 
 
+class TopKExec(PhysicalNode):
+    """Sort+Limit collapsed (`ops/sort.topk_batch`): ORDER BY + LIMIT n
+    computes the exact first n rows via a packed-prefix threshold pass
+    plus a small candidate sort, instead of fully sorting (and, on a
+    tunneled TPU, compiling the minutes-long wide chunked-LSD sort for)
+    millions of rows that the limit immediately discards."""
+
+    name = "TopK"
+
+    def __init__(self, n: int, keys: Sequence[str], child: PhysicalNode):
+        self.n = n
+        self.keys = list(keys)
+        self.child = child
+
+    @property
+    def children(self):
+        return [self.child]
+
+    def simple_string(self) -> str:
+        return f"TopK {self.n} [{', '.join(self.keys)}]"
+
+    def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        from hyperspace_tpu.ops.sort import topk_batch
+        batch = self.child.execute(bucket)
+        if batch.num_rows == 0:
+            return batch
+        return topk_batch(batch, self.keys, self.n)
+
+
 class AggregateExec(PhysicalNode):
     name = "Aggregate"
 
@@ -1397,6 +1426,13 @@ def _plan_physical_node(plan: LogicalPlan,
                                        ctx))
 
     if isinstance(plan, Limit):
+        if isinstance(plan.child, Sort):
+            from hyperspace_tpu.plan.nodes import sort_direction
+            child_required = (set(required) | {sort_direction(c)[0]
+                                               for c in plan.child.columns})
+            return TopKExec(plan.n, plan.child.columns,
+                            _plan_physical(plan.child.child, child_required,
+                                           conf, ctx))
         return LimitExec(plan.n,
                          _plan_physical(plan.child, required, conf, ctx))
 

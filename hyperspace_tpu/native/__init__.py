@@ -168,6 +168,8 @@ def key_sort_perm(n: int, lanes):
     (library unavailable, unsupported lane dtype, or n >= 2^31)."""
     import numpy as np
 
+    if get_lib() is None:  # before the O(n) dummy-bucket allocation
+        return None
     out = bucket_key_sort_perm(np.zeros(n, dtype=np.int32), 1, lanes)
     return None if out is None else out[0]
 

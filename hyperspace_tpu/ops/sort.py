@@ -20,30 +20,33 @@ from typing import List, Optional, Sequence, Tuple
 from hyperspace_tpu.io.columnar import ColumnBatch
 
 
+def _as_u32(lane, xp):
+    """Order-preserving uint32 form of a sort lane (host or device).
+    Signed lanes REINTERPRET (not convert) then bias: signed->unsigned
+    value conversion of negatives is backend-defined on TPU, the bit
+    pattern is not. (No float lanes exist: float keys always decompose
+    to uint32 bit-transform lanes, on every backend.)"""
+    import numpy as _np
+
+    dt = lane.dtype
+    if dt == bool:
+        return lane.astype(xp.uint32)
+    if xp.issubdtype(dt, xp.signedinteger):
+        if xp is _np:
+            return lane.astype(_np.int32).view(_np.uint32) \
+                ^ _np.uint32(0x80000000)
+        import jax
+        return jax.lax.bitcast_convert_type(
+            lane.astype(xp.int32), xp.uint32) ^ xp.uint32(0x80000000)
+    return lane.astype(xp.uint32)
+
+
 def _descend(lane, xp):
     """Map a sort lane to its DESCENDING-order equivalent: convert to the
     unsigned order-preserving form, then bitwise-invert. Applied to the
     validity lane too, which flips null placement to nulls-last —
     Spark's default for descending keys."""
-    import numpy as _np
-
-    dt = lane.dtype
-    # (No float lanes exist: float keys always decompose to uint32
-    # bit-transform lanes, on every backend.)
-    if dt == bool:
-        u = lane.astype(xp.uint32)
-    elif xp.issubdtype(dt, xp.signedinteger):
-        # Reinterpret (not convert): signed->unsigned value conversion of
-        # negatives is backend-defined on TPU, the bit pattern is not.
-        if xp is _np:
-            u = lane.view(_np.uint32) ^ _np.uint32(0x80000000)
-        else:
-            import jax
-            u = jax.lax.bitcast_convert_type(
-                lane.astype(xp.int32), xp.uint32) ^ xp.uint32(0x80000000)
-    else:
-        u = lane.astype(xp.uint32)
-    return ~u
+    return ~_as_u32(lane, xp)
 
 
 def _key_operands(batch: ColumnBatch, by: Sequence[str]) -> List:
@@ -96,6 +99,97 @@ def sort_permutation(batch: ColumnBatch, by: Sequence[str],
 def sort_batch(batch: ColumnBatch, by: Sequence[str],
                leading_keys: Optional[Sequence] = None) -> ColumnBatch:
     return batch.take(sort_permutation(batch, by, leading_keys))
+
+
+# ---------------------------------------------------------------------------
+# Top-k (ORDER BY + LIMIT collapsed): the full wide sort is wasted work
+# when only k rows survive — and on a tunneled TPU its chunked-LSD
+# executable costs minutes of one-time compile at novel shapes. The
+# device path sorts ONE packed prefix lane to find the k-th prefix value,
+# keeps the candidate rows (every true top-k row has prefix <= that
+# threshold, since > means at least k rows order strictly before it),
+# and finishes with an exact full-key host sort of the small candidate
+# set. Ties only ever grow the candidate set, never drop a winner.
+# ---------------------------------------------------------------------------
+
+# Candidate sets beyond this fall back to the full sort (low-cardinality
+# leading keys: the threshold no longer prunes).
+TOPK_CANDIDATE_CAP = 1 << 21
+
+_topk_threshold_jit = None
+
+
+def _jnp_empty_i32():
+    import jax.numpy as jnp
+    return jnp.empty(0, dtype=jnp.int32)
+
+
+def _topk_threshold(prefix, k: int):
+    """(mask, count) for rows whose packed prefix is <= the k-th smallest
+    prefix value — ONE module-level jitted program (cached across calls;
+    a per-call wrapper would recompile every execution)."""
+    global _topk_threshold_jit
+    if _topk_threshold_jit is None:
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("k",))
+        def run(prefix, k):
+            (sorted_prefix,) = jax.lax.sort([prefix], num_keys=1)
+            thresh = sorted_prefix[k - 1]
+            mask = prefix <= thresh
+            return mask, jnp.sum(mask.astype(jnp.int64))
+
+        _topk_threshold_jit = run
+    return _topk_threshold_jit(prefix, k)
+
+
+def topk_batch(batch: ColumnBatch, by: Sequence[str], n: int) -> ColumnBatch:
+    """First `n` rows of `batch` ordered by `by` (stable, identical to
+    sort_batch(...)[:n])."""
+    import numpy as np
+
+    if n == 0:
+        return batch.take(np.empty(0, dtype=np.int32)
+                          if batch.is_host else _jnp_empty_i32())
+    if batch.num_rows <= n:
+        return sort_batch(batch, by)
+    if batch.is_host:
+        perm = sort_permutation(batch, by)
+        return batch.take(np.asarray(perm)[:n].astype(np.int32))
+
+    import jax.numpy as jnp
+
+    operands = _key_operands(batch, by)
+    prefix = _as_u32(operands[0], jnp).astype(jnp.uint64) << jnp.uint64(32)
+    if len(operands) > 1:
+        prefix = prefix | _as_u32(operands[1], jnp).astype(jnp.uint64)
+    mask, count_dev = _topk_threshold(prefix, n)
+    count = int(count_dev)  # the one sizing sync
+    if count > max(TOPK_CANDIDATE_CAP, 4 * n):
+        full = sort_batch(batch, by)
+        return full.take(jnp.arange(n, dtype=jnp.int32))
+    # Pad the gather size to powers of two so distinct candidate counts
+    # reuse a handful of compiled executables; nonzero places real hits
+    # first, so the host slice [:count] drops the padding exactly.
+    size = 1 << max(count - 1, 1).bit_length()
+    (idx,) = jnp.nonzero(mask, size=size, fill_value=0)
+    cand = batch.take(idx.astype(jnp.int32))
+    host_cols = {}
+    from hyperspace_tpu.io.columnar import DeviceColumn
+    for name, col in cand.columns.items():
+        host_cols[name] = DeviceColumn(
+            data=np.asarray(col.data)[:count],
+            dtype=col.dtype,
+            validity=(np.asarray(col.validity)[:count]
+                      if col.validity is not None else None),
+            dictionary=col.dictionary,
+            dict_hashes=(tuple(np.asarray(h) for h in col.dict_hashes)
+                         if col.dict_hashes is not None else None))
+    host_cand = ColumnBatch(cand.schema, host_cols)
+    perm = sort_permutation(host_cand, by)
+    return host_cand.take(np.asarray(perm)[:n].astype(np.int32))
 
 
 def bucket_boundaries(sorted_bucket_ids, num_buckets: int) -> Tuple:

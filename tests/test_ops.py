@@ -559,3 +559,37 @@ def test_staged_sort_permutation_matches_wide_sort():
     want2 = jax.lax.sort([*operands[:3], iota], num_keys=3,
                          is_stable=True)[-1]
     assert (np.asarray(got2) == np.asarray(want2)).all()
+
+
+def test_topk_matches_full_sort():
+    """topk_batch == sort_batch[:n] on both lanes, across ties, nulls,
+    descending keys, and low-cardinality prefixes (candidate blow-up)."""
+    import numpy as np
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops.sort import sort_batch, topk_batch
+
+    rng = np.random.default_rng(5)
+    n = 50_000
+    import pyarrow as pa
+    mask = rng.random(n) < 0.05
+    table = pa.table({
+        "a": pa.array(rng.integers(0, 40, n).astype(np.int64)),  # heavy ties
+        "b": pa.array(rng.integers(-1000, 1000, n).astype(np.int64),
+                      mask=mask),
+        "c": pa.array(rng.random(n)),
+        "s": pa.array(np.array(["x", "y", "zz", "w"])[
+            rng.integers(0, 4, n)]),
+    })
+    for device in (False, True):
+        batch = columnar.from_arrow(table, device=device)
+        for keys in (["a", "b", "s"], ["-a", "c"], ["s", "-b"]):
+            for k in (1, 100, 4096):
+                want = sort_batch(batch, keys)
+                got = topk_batch(batch, keys, k)
+                import pandas as pd
+                w = columnar.to_arrow(want).to_pandas().head(k) \
+                    .reset_index(drop=True)
+                g = columnar.to_arrow(got).to_pandas() \
+                    .reset_index(drop=True)
+                pd.testing.assert_frame_equal(g, w, check_dtype=False)

@@ -45,6 +45,61 @@ def _group_phase_a(operands):
     return perm, segment_ids
 
 
+# Wide groupings (q64's 15 columns -> ~25 lanes) pay the chunked-LSD
+# sort's data movement AND its minutes-long one-time XLA compile at each
+# novel shape over a tunneled link. Above this lane count the HASHED
+# phase sorts ONE u64 hash lane instead and verifies no collision split
+# a group (fallback: the full sort). 64-bit hash over ~10^7 rows makes
+# the fallback astronomically rare; correctness never depends on it.
+HASH_GROUP_MIN_LANES = 5
+
+
+@__import__("jax").jit
+def _group_phase_a_hashed(operands):
+    """(perm, segment ids, collision flag) via ONE u64-hash-lane sort.
+    Equal keys share a hash, so a stable hash sort puts every group in
+    one contiguous run unless two DIFFERENT keys collide; `collision` is
+    true iff any adjacent-row group boundary (full-lane difference)
+    occurs INSIDE an equal-hash run — exactly the split-group case. The
+    caller re-runs the exact full-lane sort when it fires. The last
+    output packs (num_segments, collision) into one int64 scalar so the
+    caller's sizing sync is a single fetch."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.hash_partition import _combine, _fmix32
+    from hyperspace_tpu.ops.sort import _as_u32
+
+    ops = list(operands)
+    n = ops[0].shape[0]
+    # _as_u32 bitcasts signed lanes (value-converting astype of negatives
+    # is backend-defined on TPU and would collapse distinct keys, firing
+    # the collision fallback on every query with negative keys).
+    u0 = _as_u32(ops[0], jnp)
+    h1 = _fmix32(u0)
+    h2 = _fmix32(u0 ^ jnp.uint32(0x6A09E667))
+    for lane in ops[1:]:
+        u = _as_u32(lane, jnp)
+        h1 = _combine(h1, _fmix32(u))
+        h2 = _combine(h2, _fmix32(u ^ jnp.uint32(0x6A09E667)))
+    h = (h1.astype(jnp.uint64) << jnp.uint64(32)) | h2.astype(jnp.uint64)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    sorted_h, perm = jax.lax.sort([h, iota], num_keys=1, is_stable=True)
+    zero = jnp.zeros(1, dtype=jnp.int32)
+    differs = zero
+    for k in ops:
+        ks = jnp.take(k, perm)
+        differs = differs | jnp.concatenate(
+            [zero, (ks[1:] != ks[:-1]).astype(jnp.int32)])
+    h_differs = jnp.concatenate(
+        [zero, (sorted_h[1:] != sorted_h[:-1]).astype(jnp.int32)])
+    collision = jnp.any((differs == 1) & (h_differs == 0))
+    segment_ids = jnp.cumsum(differs, dtype=jnp.int32)
+    packed = (segment_ids[-1].astype(jnp.int64) * jnp.int64(2)
+              + collision.astype(jnp.int64))
+    return perm, segment_ids, packed
+
+
 def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
                     aggregates: Sequence[AggSpec],
                     out_schema: Schema) -> ColumnBatch:
@@ -103,13 +158,23 @@ def group_aggregate(batch: ColumnBatch, group_columns: Sequence[str],
         operands: List = []
         for name in group_columns:
             operands.extend(column_sort_lanes(batch.column(name)))
-        # ONE fused executable: staged narrow-pass sort (wide groupings —
-        # q64's 15 columns — explode XLA's variadic comparator compile
-        # time) + segment-id derivation. Separate eager ops would each
-        # pay a compile round-trip over the tunneled backend.
-        perm, segment_ids = _group_phase_a(
-            tuple(jnp.asarray(op) for op in operands))
-        num_groups = int(segment_ids[-1]) + 1  # the one host sync
+        # ONE fused executable: hash-lane sort for wide groupings (full
+        # staged sort re-run on the astronomically-rare collision),
+        # staged narrow-pass sort otherwise + segment-id derivation.
+        # Separate eager ops would each pay a compile round-trip over
+        # the tunneled backend.
+        ops = tuple(jnp.asarray(op) for op in operands)
+        if len(ops) >= HASH_GROUP_MIN_LANES:
+            perm, segment_ids, packed = _group_phase_a_hashed(ops)
+            packed = int(packed)  # the one host sync
+            if packed & 1:  # hash collision split a group: exact re-run
+                perm, segment_ids = _group_phase_a(ops)
+                num_groups = int(segment_ids[-1]) + 1
+            else:
+                num_groups = (packed >> 1) + 1
+        else:
+            perm, segment_ids = _group_phase_a(ops)
+            num_groups = int(segment_ids[-1]) + 1  # the one host sync
         sorted_batch = batch.take(perm)
         # Representative row (first of each segment) carries the group keys.
         firsts = jnp.searchsorted(segment_ids,

@@ -159,14 +159,22 @@ def topk_batch(batch: ColumnBatch, by: Sequence[str], n: int) -> ColumnBatch:
         perm = sort_permutation(batch, by)
         return batch.take(np.asarray(perm)[:n].astype(np.int32))
 
+    import os
+    import time as _time
+
     import jax.numpy as jnp
 
-    operands = _key_operands(batch, by)
+    dbg = os.environ.get("HYPERSPACE_TOPK_DEBUG")
+    t0 = _time.perf_counter()
+    # Only the first two prefix lanes are consumed; building all ~34
+    # lanes of a wide ORDER BY would waste dozens of device dispatches.
+    operands = _key_operands(batch, list(by)[:2])
     prefix = _as_u32(operands[0], jnp).astype(jnp.uint64) << jnp.uint64(32)
     if len(operands) > 1:
         prefix = prefix | _as_u32(operands[1], jnp).astype(jnp.uint64)
     mask, count_dev = _topk_threshold(prefix, n)
     count = int(count_dev)  # the one sizing sync
+    t1 = _time.perf_counter()
     if count > max(TOPK_CANDIDATE_CAP, 4 * n):
         full = sort_batch(batch, by)
         return full.take(jnp.arange(n, dtype=jnp.int32))
@@ -176,6 +184,16 @@ def topk_batch(batch: ColumnBatch, by: Sequence[str], n: int) -> ColumnBatch:
     size = 1 << max(count - 1, 1).bit_length()
     (idx,) = jnp.nonzero(mask, size=size, fill_value=0)
     cand = batch.take(idx.astype(jnp.int32))
+    t2 = _time.perf_counter()
+    # Issue every candidate array's D2H before the first blocking read:
+    # per-column np.asarray would pay ~40 sequential link round-trips.
+    for col in cand.columns.values():
+        for arr in (col.data, col.validity, *(col.dict_hashes or ())):
+            if arr is not None and hasattr(arr, "copy_to_host_async"):
+                try:
+                    arr.copy_to_host_async()
+                except Exception:
+                    pass  # best-effort prefetch only
     host_cols = {}
     from hyperspace_tpu.io.columnar import DeviceColumn
     for name, col in cand.columns.items():
@@ -189,7 +207,12 @@ def topk_batch(batch: ColumnBatch, by: Sequence[str], n: int) -> ColumnBatch:
                          if col.dict_hashes is not None else None))
     host_cand = ColumnBatch(cand.schema, host_cols)
     perm = sort_permutation(host_cand, by)
-    return host_cand.take(np.asarray(perm)[:n].astype(np.int32))
+    out = host_cand.take(np.asarray(perm)[:n].astype(np.int32))
+    if dbg:
+        print(f"[topk] n={batch.num_rows} count={count} "
+              f"threshold+sync={t1 - t0:.2f}s gather={t2 - t1:.2f}s "
+              f"pull+sort={_time.perf_counter() - t2:.2f}s", flush=True)
+    return out
 
 
 def bucket_boundaries(sorted_bucket_ids, num_buckets: int) -> Tuple:

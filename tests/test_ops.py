@@ -584,8 +584,8 @@ def test_topk_matches_full_sort():
     for device in (False, True):
         batch = columnar.from_arrow(table, device=device)
         for keys in (["a", "b", "s"], ["-a", "c"], ["s", "-b"]):
+            want = sort_batch(batch, keys)
             for k in (1, 100, 4096):
-                want = sort_batch(batch, keys)
                 got = topk_batch(batch, keys, k)
                 import pandas as pd
                 w = columnar.to_arrow(want).to_pandas().head(k) \
@@ -593,3 +593,92 @@ def test_topk_matches_full_sort():
                 g = columnar.to_arrow(got).to_pandas() \
                     .reset_index(drop=True)
                 pd.testing.assert_frame_equal(g, w, check_dtype=False)
+
+
+def test_hashed_group_phase_matches_exact():
+    """Wide (>=5-lane) groupings route through the u64 hash-lane sort;
+    aggregation results must be identical to the exact full-lane sort
+    path (same groups, same reductions — order may differ)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops import aggregate as agg_mod
+    from hyperspace_tpu.ops.aggregate import group_aggregate
+    from hyperspace_tpu.plan.nodes import AggSpec
+    from hyperspace_tpu.plan.schema import Field, Schema
+
+    rng = np.random.default_rng(12)
+    n = 30_000
+    table = pa.table({
+        "a": rng.integers(0, 8, n).astype(np.int64),
+        "b": rng.integers(0, 7, n).astype(np.int64),
+        "c": rng.integers(-5, 5, n).astype(np.int64),
+        "v": rng.random(n),
+    })
+    batch = columnar.from_arrow(table, device=True)
+    specs = [AggSpec("sum", "v", "s"), AggSpec("count", "*", "n")]
+    out_schema = Schema([Field("a", "int64", True), Field("b", "int64", True),
+                         Field("c", "int64", True), Field("s", "float64", True),
+                         Field("n", "int64", True)])
+    # 3 int64 group columns -> 6 lanes >= HASH_GROUP_MIN_LANES
+    assert 6 >= agg_mod.HASH_GROUP_MIN_LANES
+    got = columnar.to_arrow(group_aggregate(
+        batch, ["a", "b", "c"], specs, out_schema)).to_pandas()
+    # exact path for reference
+    old = agg_mod.HASH_GROUP_MIN_LANES
+    agg_mod.HASH_GROUP_MIN_LANES = 10**9
+    try:
+        want = columnar.to_arrow(group_aggregate(
+            batch, ["a", "b", "c"], specs, out_schema)).to_pandas()
+    finally:
+        agg_mod.HASH_GROUP_MIN_LANES = old
+    import pandas as pd
+    key = ["a", "b", "c"]
+    pd.testing.assert_frame_equal(
+        got.sort_values(key).reset_index(drop=True),
+        want.sort_values(key).reset_index(drop=True), check_dtype=False)
+
+
+def test_hashed_group_phase_collision_fallback():
+    """A colliding hash must trigger the exact-sort re-run, not a wrong
+    answer: force collisions by stubbing the packed flag via a degenerate
+    hash (monkeypatch _fmix32 to a constant)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops import aggregate as agg_mod
+    from hyperspace_tpu.ops import hash_partition as hp
+    from hyperspace_tpu.plan.nodes import AggSpec
+    from hyperspace_tpu.plan.schema import Field, Schema
+
+    rng = np.random.default_rng(13)
+    n = 5_000
+    table = pa.table({
+        "a": rng.integers(0, 5, n).astype(np.int64),
+        "b": rng.integers(0, 4, n).astype(np.int64),
+        "c": rng.integers(0, 3, n).astype(np.int64),
+        "v": rng.random(n),
+    })
+    batch = columnar.from_arrow(table, device=True)
+    specs = [AggSpec("sum", "v", "s")]
+    out_schema = Schema([Field("a", "int64", True), Field("b", "int64", True),
+                         Field("c", "int64", True),
+                         Field("s", "float64", True)])
+    orig = hp._fmix32
+    agg_mod._group_phase_a_hashed.clear_cache()
+    hp._fmix32 = lambda h: h * 0  # every key collides
+    try:
+        got = columnar.to_arrow(agg_mod.group_aggregate(
+            batch, ["a", "b", "c"], specs, out_schema)).to_pandas()
+    finally:
+        hp._fmix32 = orig
+        agg_mod._group_phase_a_hashed.clear_cache()
+    want = (table.to_pandas().groupby(["a", "b", "c"], as_index=False)
+            .agg(s=("v", "sum")))
+    import pandas as pd
+    key = ["a", "b", "c"]
+    pd.testing.assert_frame_equal(
+        got.sort_values(key).reset_index(drop=True),
+        want.sort_values(key).reset_index(drop=True), check_dtype=False)

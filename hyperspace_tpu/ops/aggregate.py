@@ -67,22 +67,11 @@ def _group_phase_a_hashed(operands):
     import jax
     import jax.numpy as jnp
 
-    from hyperspace_tpu.ops.hash_partition import _combine, _fmix32
-    from hyperspace_tpu.ops.sort import _as_u32
+    from hyperspace_tpu.ops.hash_partition import dual_hash64
 
     ops = list(operands)
     n = ops[0].shape[0]
-    # _as_u32 bitcasts signed lanes (value-converting astype of negatives
-    # is backend-defined on TPU and would collapse distinct keys, firing
-    # the collision fallback on every query with negative keys).
-    u0 = _as_u32(ops[0], jnp)
-    h1 = _fmix32(u0)
-    h2 = _fmix32(u0 ^ jnp.uint32(0x6A09E667))
-    for lane in ops[1:]:
-        u = _as_u32(lane, jnp)
-        h1 = _combine(h1, _fmix32(u))
-        h2 = _combine(h2, _fmix32(u ^ jnp.uint32(0x6A09E667)))
-    h = (h1.astype(jnp.uint64) << jnp.uint64(32)) | h2.astype(jnp.uint64)
+    h = dual_hash64(ops)
     iota = jnp.arange(n, dtype=jnp.int32)
     sorted_h, perm = jax.lax.sort([h, iota], num_keys=1, is_stable=True)
     zero = jnp.zeros(1, dtype=jnp.int32)

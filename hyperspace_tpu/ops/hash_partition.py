@@ -42,6 +42,28 @@ def _combine(h1, h2):
     return h1 ^ (h2 + jnp.uint32(0x9E3779B9) + (h1 << 6) + (h1 >> 2))
 
 
+def dual_hash64(lanes):
+    """u64 hash per row from two independent 32-bit mixes over the
+    BITCAST (order-preserving-uint32) forms of the given sort lanes —
+    THE hash identity of the hashed group/match fast paths
+    (`ops/aggregate._group_phase_a_hashed`,
+    `ops/join._counting_match_lanes_hashed`). Distinct from the bucket
+    identity `flat_hash32`: this one may change freely (no on-disk
+    layout depends on it), but both fast paths MUST share it."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.sort import _as_u32
+
+    u0 = _as_u32(lanes[0], jnp)
+    h1 = _fmix32(u0)
+    h2 = _fmix32(u0 ^ jnp.uint32(0x6A09E667))
+    for lane in lanes[1:]:
+        u = _as_u32(lane, jnp)
+        h1 = _combine(h1, _fmix32(u))
+        h2 = _combine(h2, _fmix32(u ^ jnp.uint32(0x6A09E667)))
+    return (h1.astype(jnp.uint64) << jnp.uint64(32)) | h2.astype(jnp.uint64)
+
+
 def column_hash_lanes(col: DeviceColumn) -> List:
     """The column's hash-input lanes: uint32 arrays, one value hash input
     per lane. Strings contribute their gathered per-dictionary-entry value

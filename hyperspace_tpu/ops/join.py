@@ -88,35 +88,15 @@ def _join_lane_operands(left: ColumnBatch, right: ColumnBatch,
     return (marker_l, *l_lanes), (marker_r, *r_lanes)
 
 
-@__import__("functools").partial(__import__("jax").jit,
-                                 static_argnames=("left_outer",))
-def _counting_match_lanes(lanes_l, lanes_r, left_outer: bool):
-    """The counting match directly over raw key LANES — ONE staged sort
-    of (marker, *value lanes, side, orig) replaces the earlier two-sort
-    pipeline (dense-id encode sort + id/side match sort): runs come from
-    adjacent lane differences in the single sorted sequence. Orig
-    indices ride as trailing sort keys (unique, so equivalent to the
-    stable carried-value formulation)."""
+def _runs_to_counts(differs, side_s, left_outer: bool):
+    """Shared tail of the counting match: per-run right-counts and
+    bracket starts from the (T-1) adjacent-key-difference vector over
+    the sorted (key, side, orig) sequence."""
     import jax
     import jax.numpy as jnp
 
-    from hyperspace_tpu.ops.keys import _staged_sort
-
-    n, m = lanes_l[0].shape[0], lanes_r[0].shape[0]
-    T = n + m
-    lanes = [jnp.concatenate([a, b]) for a, b in zip(lanes_l, lanes_r)]
-    side = jnp.concatenate([jnp.zeros(n, jnp.int32),
-                            jnp.ones(m, jnp.int32)])
-    orig = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
-                            jnp.arange(m, dtype=jnp.int32)])
-    _, sorted_ops = _staged_sort([*lanes, side, orig])
-    side_s = sorted_ops[-2]
-    orig_s = sorted_ops[-1]
-    keys_sorted = sorted_ops[:-2]
+    T = side_s.shape[0]
     pos = jnp.arange(T, dtype=jnp.int32)
-    differs = jnp.zeros(T - 1, dtype=bool)
-    for k in keys_sorted:
-        differs = differs | (k[1:] != k[:-1])
     run_start = jnp.concatenate([jnp.ones(1, bool), differs])
     run_first = jax.lax.cummax(jnp.where(run_start, pos, 0))
     nxt = jnp.flip(jax.lax.cummin(jnp.flip(
@@ -130,7 +110,105 @@ def _counting_match_lanes(lanes_l, lanes_r, left_outer: bool):
     if left_outer:
         counts = jnp.where(side_s == 0, jnp.maximum(counts, 1), 0)
     starts = jnp.cumsum(counts) - counts
+    return counts, starts, rights, rstart
+
+
+@__import__("functools").partial(__import__("jax").jit,
+                                 static_argnames=("left_outer",))
+def _counting_match_lanes(lanes_l, lanes_r, left_outer: bool):
+    """The counting match directly over raw key LANES — ONE staged sort
+    of (marker, *value lanes, side, orig) replaces the earlier two-sort
+    pipeline (dense-id encode sort + id/side match sort): runs come from
+    adjacent lane differences in the single sorted sequence. Orig
+    indices ride as trailing sort keys (unique, so equivalent to the
+    stable carried-value formulation)."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.keys import _staged_sort
+
+    n, m = lanes_l[0].shape[0], lanes_r[0].shape[0]
+    lanes = [jnp.concatenate([a, b]) for a, b in zip(lanes_l, lanes_r)]
+    side = jnp.concatenate([jnp.zeros(n, jnp.int32),
+                            jnp.ones(m, jnp.int32)])
+    orig = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                            jnp.arange(m, dtype=jnp.int32)])
+    _, sorted_ops = _staged_sort([*lanes, side, orig])
+    side_s = sorted_ops[-2]
+    orig_s = sorted_ops[-1]
+    keys_sorted = sorted_ops[:-2]
+    T = n + m
+    differs = jnp.zeros(T - 1, dtype=bool)
+    for k in keys_sorted:
+        differs = differs | (k[1:] != k[:-1])
+    counts, starts, rights, rstart = _runs_to_counts(differs, side_s,
+                                                     left_outer)
     return counts, starts, rights, rstart, orig_s
+
+
+# Wide join keys route through ONE u64-hash-lane sort instead of the
+# chunked multi-lane sort (same trick, same collision fallback as
+# `ops/aggregate._group_phase_a_hashed`). Below this lane count (incl.
+# the null-marker lane) the narrow sort is already a single pass.
+HASH_MATCH_MIN_LANES = 4
+
+
+@__import__("functools").partial(__import__("jax").jit,
+                                 static_argnames=("left_outer",))
+def _counting_match_lanes_hashed(lanes_l, lanes_r, left_outer: bool):
+    """Hashed counting match: sort (u64 key-hash, side, orig) — one
+    3-operand sort regardless of key width — then derive runs from the
+    FULL lane differences (gathered through the permutation). Equal keys
+    share a hash so runs stay contiguous unless two different keys
+    collide; `collision` (any full-key boundary inside an equal-hash
+    run, exactly the split/interleave case) tells the caller to re-run
+    the exact path. Run order within a key run is (side, orig), same as
+    the exact sort's trailing operands."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.hash_partition import dual_hash64
+
+    n, m = lanes_l[0].shape[0], lanes_r[0].shape[0]
+    T = n + m
+    lanes = [jnp.concatenate([a, b]) for a, b in zip(lanes_l, lanes_r)]
+    h = dual_hash64(lanes)
+
+    side = jnp.concatenate([jnp.zeros(n, jnp.int32),
+                            jnp.ones(m, jnp.int32)])
+    orig = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                            jnp.arange(m, dtype=jnp.int32)])
+    h_s, side_s, orig_s = jax.lax.sort([h, side, orig], num_keys=3,
+                                       is_stable=False)
+    gidx = orig_s + side_s * jnp.int32(n)
+    differs = jnp.zeros(T - 1, dtype=bool)
+    for k in lanes:
+        ks = jnp.take(k, gidx)
+        differs = differs | (ks[1:] != ks[:-1])
+    h_differs = h_s[1:] != h_s[:-1]
+    collision = jnp.any(differs & ~h_differs)
+    counts, starts, rights, rstart = _runs_to_counts(differs, side_s,
+                                                     left_outer)
+    return counts, starts, rights, rstart, orig_s, collision
+
+
+def _match_lanes(lanes_l, lanes_r, left_outer: bool):
+    """(counts, starts, rights, rstart, orig_s, collision|None): the
+    hashed match for wide keys, the exact narrow sort otherwise. A None
+    collision needs no verification; a device-scalar collision must be
+    folded into the caller's sizing sync, and a truthy value means
+    re-running via `_counting_match_lanes`."""
+    if len(lanes_l) >= HASH_MATCH_MIN_LANES:
+        return _counting_match_lanes_hashed(lanes_l, lanes_r, left_outer)
+    return (*_counting_match_lanes(lanes_l, lanes_r, left_outer), None)
+
+
+def _packed_sync(value_dev, collision):
+    """ONE device fetch carrying (sizing value, collision flag): returns
+    (int value, collided). `value_dev` must be an int64 device scalar."""
+    import jax.numpy as jnp
+
+    packed = int(value_dev * jnp.int64(2) + collision.astype(jnp.int64))
+    return packed >> 1, bool(packed & 1)
 
 
 def counting_join_batch_indices(left: ColumnBatch, right: ColumnBatch,
@@ -138,9 +216,11 @@ def counting_join_batch_indices(left: ColumnBatch, right: ColumnBatch,
                                 right_keys: Sequence[str],
                                 how: str = "inner") -> Tuple:
     """Device join row-index pairs straight from the key COLUMNS: one
-    fused sort+count executable (`_counting_match_lanes`) and one host
-    sync. Same null semantics and output order as the id-based
-    `counting_join_indices` (which remains for id-space callers)."""
+    fused sort+count executable and one host sync. Same null semantics
+    as the id-based `counting_join_indices` (which remains for id-space
+    callers); pair ORDER is deterministic per path but unspecified —
+    wide keys (>= HASH_MATCH_MIN_LANES lanes) come back in hash-run
+    order, narrow keys in key-sorted order."""
     import jax.numpy as jnp
 
     left_outer = how == "left_outer"
@@ -153,9 +233,18 @@ def counting_join_batch_indices(left: ColumnBatch, right: ColumnBatch,
                 jnp.full(n, -1, dtype=jnp.int32))
     lanes_l, lanes_r = _join_lane_operands(left, right, left_keys,
                                            right_keys)
-    counts, starts, rights, rstart, orig_s = _counting_match_lanes(
+    counts, starts, rights, rstart, orig_s, collision = _match_lanes(
         lanes_l, lanes_r, left_outer)
-    total = int(jnp.sum(counts))  # the one host sync
+    if collision is None:
+        total = int(jnp.sum(counts, dtype=jnp.int64))  # the one host sync
+    else:
+        # One sync carries (total, collision); a collision re-runs exact.
+        total, collided = _packed_sync(jnp.sum(counts, dtype=jnp.int64),
+                                       collision)
+        if collided:
+            counts, starts, rights, rstart, orig_s = _counting_match_lanes(
+                lanes_l, lanes_r, left_outer)
+            total = int(jnp.sum(counts, dtype=jnp.int64))
     if total == 0:
         return empty, empty
     return _counting_expand(counts, starts, rights, rstart, orig_s,
@@ -348,16 +437,29 @@ def semi_anti_indices(left: ColumnBatch, right: ColumnBatch,
     # elements carry False so they never touch a left slot).
     lanes_l, lanes_r = _join_lane_operands(left, right, left_keys,
                                            right_keys)
-    counts, _starts, rights, _rstart, orig_s = _counting_match_lanes(
+
+    def membership_mask(counts, rights, orig_s):
+        is_left = counts > 0
+        hit = is_left & ((rights == 0) if anti else (rights > 0))
+        # Right-side orig values (0..m-1) can exceed left.num_rows; they
+        # carry hit=False, but drop them explicitly rather than relying
+        # on JAX's default out-of-bounds scatter behavior.
+        return jnp.zeros(left.num_rows, dtype=bool).at[orig_s].max(
+            hit, mode="drop")
+
+    counts, _starts, rights, _rstart, orig_s, collision = _match_lanes(
         lanes_l, lanes_r, True)
-    is_left = counts > 0
-    hit = is_left & ((rights == 0) if anti else (rights > 0))
-    # Right-side orig values (0..m-1) can exceed left.num_rows; they carry
-    # hit=False, but drop them explicitly rather than relying on JAX's
-    # default out-of-bounds scatter behavior.
-    mask = jnp.zeros(left.num_rows, dtype=bool).at[orig_s].max(
-        hit, mode="drop")
-    count = int(jnp.sum(mask))  # host sync
+    mask = membership_mask(counts, rights, orig_s)
+    if collision is None:
+        count = int(jnp.sum(mask))  # host sync
+    else:
+        count, collided = _packed_sync(jnp.sum(mask, dtype=jnp.int64),
+                                       collision)
+        if collided:  # hash collision: exact re-run
+            counts, _starts, rights, _rstart, orig_s = \
+                _counting_match_lanes(lanes_l, lanes_r, True)
+            mask = membership_mask(counts, rights, orig_s)
+            count = int(jnp.sum(mask))
     if count == 0:
         return jnp.zeros(0, dtype=jnp.int32)
     (idx,) = jnp.nonzero(mask, size=count, fill_value=0)

@@ -682,3 +682,74 @@ def test_hashed_group_phase_collision_fallback():
     pd.testing.assert_frame_equal(
         got.sort_values(key).reset_index(drop=True),
         want.sort_values(key).reset_index(drop=True), check_dtype=False)
+
+
+def test_hashed_counting_match_matches_exact():
+    """Wide join keys (>=4 lanes) route through the hashed counting
+    match; the join result must equal the exact multi-lane sort path."""
+    import numpy as np
+    import pyarrow as pa
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops import join as join_mod
+
+    rng = np.random.default_rng(21)
+    n, m = 20_000, 15_000
+    left = columnar.from_arrow(pa.table({
+        "k1": rng.integers(0, 50, n).astype(np.int64),
+        "k2": rng.integers(-20, 20, n).astype(np.int64),
+        "v": rng.random(n)}), device=True)
+    right = columnar.from_arrow(pa.table({
+        "k1": rng.integers(0, 50, m).astype(np.int64),
+        "k2": rng.integers(-20, 20, m).astype(np.int64),
+        "w": rng.random(m)}), device=True)
+    # marker + 2x int64 lanes = 5 >= HASH_MATCH_MIN_LANES
+    assert 5 >= join_mod.HASH_MATCH_MIN_LANES
+    for how in ("inner", "left_outer"):
+        li, ri = join_mod.counting_join_batch_indices(
+            left, right, ["k1", "k2"], ["k1", "k2"], how=how)
+        old = join_mod.HASH_MATCH_MIN_LANES
+        join_mod.HASH_MATCH_MIN_LANES = 10**9
+        try:
+            li2, ri2 = join_mod.counting_join_batch_indices(
+                left, right, ["k1", "k2"], ["k1", "k2"], how=how)
+        finally:
+            join_mod.HASH_MATCH_MIN_LANES = old
+        got = sorted(zip(np.asarray(li).tolist(), np.asarray(ri).tolist()))
+        want = sorted(zip(np.asarray(li2).tolist(),
+                          np.asarray(ri2).tolist()))
+        assert got == want, how
+
+
+def test_hashed_counting_match_collision_fallback():
+    """A degenerate hash (every key collides) must trigger the exact
+    re-run, not a wrong join."""
+    import numpy as np
+    import pyarrow as pa
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops import hash_partition as hp
+    from hyperspace_tpu.ops import join as join_mod
+
+    rng = np.random.default_rng(22)
+    n, m = 3_000, 2_500
+    left = columnar.from_arrow(pa.table({
+        "k1": rng.integers(0, 20, n).astype(np.int64),
+        "k2": rng.integers(0, 10, n).astype(np.int64)}), device=True)
+    right = columnar.from_arrow(pa.table({
+        "k1": rng.integers(0, 20, m).astype(np.int64),
+        "k2": rng.integers(0, 10, m).astype(np.int64)}), device=True)
+    li2, ri2 = join_mod.counting_join_batch_indices(
+        left, right, ["k1", "k2"], ["k1", "k2"], how="inner")
+    orig = hp._fmix32
+    join_mod._counting_match_lanes_hashed.clear_cache()
+    hp._fmix32 = lambda h: h * 0
+    try:
+        li, ri = join_mod.counting_join_batch_indices(
+            left, right, ["k1", "k2"], ["k1", "k2"], how="inner")
+    finally:
+        hp._fmix32 = orig
+        join_mod._counting_match_lanes_hashed.clear_cache()
+    got = sorted(zip(np.asarray(li).tolist(), np.asarray(ri).tolist()))
+    want = sorted(zip(np.asarray(li2).tolist(), np.asarray(ri2).tolist()))
+    assert got == want

@@ -273,6 +273,81 @@ STATS = {"stage_execs": 0, "trace_misses": 0, "sync_s": 0.0,
 _INELIGIBLE_KEYS: set = set()
 
 
+def _gather_build(src_data, src_validity, hit, matched, xp):
+    """THE build-side gather semantics (data, validity) — shared by the
+    eager interpreter branch, lazy materialization, and the post-
+    compaction finalize, so the three sites can never diverge."""
+    g = xp.clip(hit, 0, None)
+    data = xp.take(src_data, g, axis=0)
+    validity = (matched if src_validity is None
+                else xp.take(src_validity, g, axis=0) & matched)
+    return data, validity
+
+
+class _LazyGatherColumn:
+    """A broadcast join's build-side column inside a traced stage,
+    DEFERRED: most dim payload is only CARRIED to the stage output, where
+    the selection then discards the vast majority of rows — gathering it
+    full-length through every join would be the stage's dominant data
+    movement. The gather materializes lazily if a mid-stage expression
+    actually reads the column (trace-time property access; the result is
+    cached and re-used); columns still lazy at stage end ship only their
+    join's (hit, matched) pair through the executable, and the runtime
+    gathers them AFTER compaction — at selection size, not row count.
+
+    Duck-types DeviceColumn (`io/columnar.py`); valid only within one
+    traced stage execution."""
+
+    __slots__ = ("_src", "hit", "matched", "dtype", "dictionary",
+                 "pair_slot", "source_index", "src_name", "_mat")
+
+    def __init__(self, src, hit, matched, pair_slot: int,
+                 source_index: int, src_name: str):
+        self._src = src
+        self.hit = hit
+        self.matched = matched
+        self.dtype = src.dtype
+        self.dictionary = src.dictionary
+        self.pair_slot = pair_slot
+        self.source_index = source_index
+        self.src_name = src_name
+        self._mat = None
+
+    @property
+    def materialized(self) -> bool:
+        return self._mat is not None
+
+    def _materialize(self):
+        if self._mat is None:
+            import jax.numpy as jnp
+            self._mat = _gather_build(self._src.data, self._src.validity,
+                                      self.hit, self.matched, jnp)
+        return self._mat
+
+    @property
+    def data(self):
+        return self._materialize()[0]
+
+    @property
+    def validity(self):
+        return self._materialize()[1]
+
+    @property
+    def dict_hashes(self):
+        return self._src.dict_hashes
+
+    @property
+    def is_string(self) -> bool:
+        return self.dictionary is not None
+
+    @property
+    def is_host(self) -> bool:
+        return False
+
+    def __len__(self) -> int:
+        return int(self.hit.shape[0])
+
+
 # ---------------------------------------------------------------------------
 # The masked interpreter (shared by the jitted device path and the eager
 # host lane — ONE implementation of the semantics).
@@ -337,16 +412,23 @@ def _interpret_bhj(node, env, tables):
                             node.out_columns)
 
     build_side_tag = "r" if probe_is_left else "l"
-    gather_idx = xp.clip(hit, 0, None)
+    device_lane = xp is not np
     fields, out_columns = [], {}
     for out, side, src, dtype in plan:
         if side == build_side_tag:
             col = build_batch.column(src)
-            data = xp.take(col.data, gather_idx, axis=0)
-            validity = matched if col.validity is None else (
-                xp.take(col.validity, gather_idx, axis=0) & matched)
-            out_columns[out] = DeviceColumn(data, col.dtype, validity,
-                                            col.dictionary, col.dict_hashes)
+            if device_lane:
+                # Deferred: gathers only if a mid-stage expression reads
+                # it; otherwise the runtime gathers post-compaction.
+                out_columns[out] = _LazyGatherColumn(
+                    col, hit, matched, node._table_slot,
+                    build_node.index, src)
+            else:
+                data, validity = _gather_build(col.data, col.validity,
+                                               hit, matched, xp)
+                out_columns[out] = DeviceColumn(data, col.dtype, validity,
+                                                col.dictionary,
+                                                col.dict_hashes)
             fields.append(Field(out, dtype, True))
         else:
             # Probe rows are never unmatched-nulled (outer joins only
@@ -382,14 +464,72 @@ def _run_stage(prog: _StageProgram, trees, table_args):
             tables = {slot: (table_args[slot], mins, ranges)
                       for slot, (mins, ranges) in prog.tables_meta.items()}
             out_batch, sel = _interpret(prog.region, env, tables)
-            out_tree, out_aux = batch_to_tree(out_batch)
-            _OUT_META[prog.key] = (out_batch.schema, out_aux)
+            # Columns still lazy at stage end ship only their join's
+            # (hit, matched) pair; the runtime gathers them at selection
+            # size after the compaction sync.
+            keep_fields, keep_cols = [], {}
+            lazy_specs, lazy_pairs = [], {}
+            for f in out_batch.schema.fields:
+                col = out_batch.columns[f.name]
+                if (isinstance(col, _LazyGatherColumn)
+                        and not col.materialized):
+                    lazy_pairs[col.pair_slot] = (col.hit, col.matched)
+                    lazy_specs.append((f.name, col.pair_slot,
+                                       col.source_index, col.src_name,
+                                       f.dtype))
+                else:
+                    keep_fields.append(f)
+                    keep_cols[f.name] = col
+            reduced = ColumnBatch(Schema(keep_fields), keep_cols)
+            out_tree, out_aux = batch_to_tree(reduced)
+            _OUT_META[prog.key] = (out_batch.schema, reduced.schema,
+                                   out_aux, tuple(lazy_specs))
             if sel is None:
-                return out_tree, None, None
-            return out_tree, sel, jnp.sum(sel.astype(jnp.int64))
+                return out_tree, lazy_pairs, None, None
+            return (out_tree, lazy_pairs, sel,
+                    jnp.sum(sel.astype(jnp.int64)))
 
         _run_stage_jit = _run
     return _run_stage_jit(prog, trees, table_args)
+
+
+_finalize_lazy_jit = None
+
+
+def _finalize_lazy(idx, lazy_pairs, srcs, spec):
+    """ONE jitted gather for every deferred build column of a stage:
+    composes hit∘idx per slot and applies `_gather_build`. `spec` is the
+    static structure ((slot, has_src_validity), ...); `srcs` pairs each
+    spec entry with (src_data, src_validity|None). `idx` None = no
+    compaction (full-length gathers)."""
+    global _finalize_lazy_jit
+    if _finalize_lazy_jit is None:
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("spec", "has_idx"))
+        def run(idx, lazy_pairs, srcs, spec, has_idx):
+            import jax.numpy as jnp
+
+            composed = {}
+            for slot, _ in spec:
+                if slot not in composed:
+                    hit, matched = lazy_pairs[slot]
+                    if has_idx:
+                        hit = jnp.take(hit, idx)
+                        matched = jnp.take(matched, idx)
+                    composed[slot] = (hit, matched)
+            out = []
+            for (slot, _has_validity), (sd, sv) in zip(spec, srcs):
+                hit, matched = composed[slot]
+                out.append(_gather_build(sd, sv, hit, matched, jnp))
+            return tuple(out)
+
+        _finalize_lazy_jit = run
+    import jax.numpy as jnp
+    return _finalize_lazy_jit(
+        idx if idx is not None else jnp.zeros(0, dtype=jnp.int32),
+        lazy_pairs, srcs, spec, idx is not None)
 
 
 # ---------------------------------------------------------------------------
@@ -495,8 +635,10 @@ class FusedStageExec(PhysicalNode):
                 pass
         source_meta = []
         trees = {}
+        promoted = []
         for i, b in enumerate(batches):
             b = _promote_batch(b)
+            promoted.append(b)
             tree, aux = batch_to_tree(b)
             trees[i] = tree
             source_meta.append((b.schema, aux, b.num_rows))
@@ -509,7 +651,8 @@ class FusedStageExec(PhysicalNode):
             STATS["trace_misses"] += 1
         t0 = _time.perf_counter()
         try:
-            out_tree, sel, cnt = _run_stage(prog, trees, table_args)
+            out_tree, lazy_pairs, sel, cnt = _run_stage(prog, trees,
+                                                        table_args)
         except _FusionIneligible:
             _INELIGIBLE_KEYS.add(key)
             return None
@@ -519,15 +662,40 @@ class FusedStageExec(PhysicalNode):
             # Executable outlived its evicted metadata (>256 distinct
             # stage programs since): run this one eagerly.
             return None
-        schema, aux = meta
-        out_batch = tree_to_batch(out_tree, schema, aux)
-        if sel is None:
-            return out_batch
-        t0 = _time.perf_counter()
-        count = int(cnt)  # THE stage sync
-        STATS["sync_s"] += _time.perf_counter() - t0
-        (idx,) = jnp.nonzero(sel, size=count, fill_value=0)
-        return out_batch.take(idx.astype(jnp.int32))
+        schema, reduced_schema, aux, lazy_specs = meta
+        base = tree_to_batch(out_tree, reduced_schema, aux)
+        idx = None
+        if sel is not None:
+            t0 = _time.perf_counter()
+            count = int(cnt)  # THE stage sync
+            STATS["sync_s"] += _time.perf_counter() - t0
+            (idx,) = jnp.nonzero(sel, size=count, fill_value=0)
+            idx = idx.astype(jnp.int32)
+            base = base.take(idx)
+        if not lazy_specs:
+            return base
+        # Deferred build-side gathers, AT SELECTION SIZE: compose each
+        # lazy column's hit chain with the compaction index and gather
+        # from the promoted source batch (same arrays the trace saw) —
+        # all columns through ONE jitted executable, not per-column
+        # eager dispatches (`ColumnBatch.take`'s own rationale).
+        spec = []
+        srcs = []
+        src_cols = []
+        for out_name, slot, source_index, src_name, dtype in lazy_specs:
+            src = promoted[source_index].column(src_name)
+            spec.append((slot, src.validity is not None))
+            srcs.append((src.data, src.validity))
+            src_cols.append((out_name, dtype, src))
+        gathered = _finalize_lazy(idx, lazy_pairs, tuple(srcs),
+                                  tuple(spec))
+        columns = dict(base.columns)
+        for (out_name, dtype, src), (data, validity) in zip(src_cols,
+                                                            gathered):
+            columns[out_name] = DeviceColumn(data, dtype, validity,
+                                             src.dictionary,
+                                             src.dict_hashes)
+        return ColumnBatch(schema, columns)
 
     def _program_key(self, batches, preps) -> str:
         parts = [_node_key(self.root)]

@@ -179,3 +179,26 @@ def test_fusion_falls_back_on_string_join_keys(tmp_path):
 
     pd.testing.assert_frame_equal(norm(run(True)), norm(run(False)),
                                   check_dtype=False)
+
+
+def test_build_columns_defer_to_post_compaction(env):
+    """Carried build-side columns must reach the runtime DEFERRED (only
+    their join's hit/matched pair crosses the executable) and still
+    decode to the exact eager values — including strings with nulls."""
+    from hyperspace_tpu.engine import fusion
+
+    session, fact, dim = env
+    sess = session()
+    fusion._OUT_META.clear()
+    out = run_query(sess, fact, dim, "left_outer")
+    # name/w are carried (never filtered on) -> recorded as lazy specs.
+    lazy_names = {spec[0]
+                  for meta in fusion._OUT_META.values()
+                  for spec in meta[3]}
+    assert {"name", "w"} <= lazy_names, lazy_names
+    sess2 = session(**{"spark.hyperspace.execution.fusion.enabled":
+                       "false"})
+    want = run_query(sess2, fact, dim, "left_outer")
+    import pandas as pd
+    pd.testing.assert_frame_equal(norm(out), norm(want),
+                                  check_dtype=False)

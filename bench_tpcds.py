@@ -22,7 +22,7 @@ BENCH_TPCDS_SCALE scales the fact tables (1.0 ~ 300k store_sales rows).
 BENCH_TPCDS_QUERIES selects a comma-separated subset. The metric key is
 "tpcds_q17_q25_q64_wall_s" only for exactly that trio (the BASELINE.md
 headline set; artifact continuity with earlier rounds); any other
-selection — including the 12-query default — reports
+selection — including the ALL-99 default — reports
 "tpcds_<N>q_wall_s", an intentional break because it measures a
 different workload.
 """

@@ -30,9 +30,11 @@ presence, string-dictionary identity tokens, broadcast table packing —
 so re-running the same query hits the in-memory executable cache even
 though the physical plan objects are rebuilt per run.
 
-The same masked interpreter runs the host (numpy) lane eagerly — one
-implementation, both lanes, so the CPU test suite exercises exactly the
-semantics the device lane compiles.
+Host-lane stages run the ORIGINAL eager operator graph instead: on
+numpy a compaction is free, so eager filters cutting the row count early
+beat masked full-length evaluation. The traced masked semantics get CPU
+coverage through the device lane on the CPU backend (tests force it via
+execution.min.device.rows=0).
 """
 
 from __future__ import annotations
@@ -274,9 +276,9 @@ _INELIGIBLE_KEYS: set = set()
 
 
 def _gather_build(src_data, src_validity, hit, matched, xp):
-    """THE build-side gather semantics (data, validity) — shared by the
-    eager interpreter branch, lazy materialization, and the post-
-    compaction finalize, so the three sites can never diverge."""
+    """THE build-side gather semantics (data, validity) — shared by lazy
+    materialization and the post-compaction finalize, so the sites can
+    never diverge."""
     g = xp.clip(hit, 0, None)
     data = xp.take(src_data, g, axis=0)
     validity = (matched if src_validity is None
@@ -342,15 +344,15 @@ class _LazyGatherColumn:
 
     @property
     def is_host(self) -> bool:
-        return False
+        return False  # exists only inside the jitted device trace
 
     def __len__(self) -> int:
         return int(self.hit.shape[0])
 
 
 # ---------------------------------------------------------------------------
-# The masked interpreter (shared by the jitted device path and the eager
-# host lane — ONE implementation of the semantics).
+# The masked interpreter (runs INSIDE the jitted device trace; the host
+# lane routes to the eager operator graph instead).
 # ---------------------------------------------------------------------------
 
 
@@ -412,23 +414,16 @@ def _interpret_bhj(node, env, tables):
                             node.out_columns)
 
     build_side_tag = "r" if probe_is_left else "l"
-    device_lane = xp is not np
     fields, out_columns = [], {}
     for out, side, src, dtype in plan:
         if side == build_side_tag:
             col = build_batch.column(src)
-            if device_lane:
-                # Deferred: gathers only if a mid-stage expression reads
-                # it; otherwise the runtime gathers post-compaction.
-                out_columns[out] = _LazyGatherColumn(
-                    col, hit, matched, node._table_slot,
-                    build_node.index, src)
-            else:
-                data, validity = _gather_build(col.data, col.validity,
-                                               hit, matched, xp)
-                out_columns[out] = DeviceColumn(data, col.dtype, validity,
-                                                col.dictionary,
-                                                col.dict_hashes)
+            # Deferred: gathers only if a mid-stage expression reads it;
+            # otherwise the stage end gathers at selection size
+            # (post-sync) instead of full row count per join.
+            out_columns[out] = _LazyGatherColumn(
+                col, hit, matched, node._table_slot,
+                build_node.index, src)
             fields.append(Field(out, dtype, True))
         else:
             # Probe rows are never unmatched-nulled (outer joins only
@@ -539,8 +534,9 @@ def _finalize_lazy(idx, lazy_pairs, srcs, spec):
 
 class FusedStageExec(PhysicalNode):
     """Physical node executing a fused region. Sources run eagerly first;
-    the region then runs as ONE jitted executable (device lane) or one
-    masked numpy pass (host lane), with a single output-sizing sync."""
+    the region then runs as ONE jitted executable with a single
+    output-sizing sync (device lane) or as the eager operator graph
+    (host lane — early compaction wins on numpy)."""
 
     name = "FusedStage"
 
@@ -593,6 +589,18 @@ class FusedStageExec(PhysicalNode):
         if should_distribute(self.conf, max(b.num_rows for b in batches),
                              host_batch=host) is not None:
             return None  # mesh execution owns these operators instead
+        if host:
+            # Host lane: run the ORIGINAL eager operator graph (before
+            # any broadcast-table prep — the eager join builds its own).
+            # Masked execution exists to batch device dispatches and
+            # syncs; on numpy a compaction is free, so eager filters
+            # cutting the row count EARLY beat full-length masked
+            # evaluation of every downstream operator (q27-class
+            # selective star queries were ~4x slower masked). The traced
+            # masked semantics still get CPU coverage through the device
+            # lane on the CPU backend (tests force it via
+            # execution.min.device.rows=0).
+            return self.root.execute()
 
         preps = {}
         for n in self._bhj_nodes:
@@ -601,18 +609,6 @@ class FusedStageExec(PhysicalNode):
             if prep is None:
                 return None
             preps[n._table_slot] = prep
-
-        if host:
-            tables = {slot: p for slot, p in preps.items()}
-            env = {s.index: s._batch for s in self.sources}
-            try:
-                out_batch, sel = _interpret(self.root, env, tables)
-            except _FusionIneligible:
-                return None
-            if sel is None:
-                return out_batch
-            idx = np.nonzero(sel)[0].astype(np.int32)
-            return out_batch.take(idx)
         return self._execute_device(batches, preps)
 
     def _execute_device(self, batches, preps) -> Optional[ColumnBatch]:

@@ -269,6 +269,9 @@ def rung2_filter(sess, hs, ldf, left, work):
     assert any("v__=" in p for p in roots), f"rung2 not index-served: {roots}"
     q()  # warm compile
     dev_s = best_of(q, label="rung2 device")
+    # Operator-level telemetry of the last timed run rides in the
+    # artifact (collect always records onto the session).
+    qm = sess.last_query_metrics().summary()
     sess.disable_hyperspace()
 
     src_files = sorted(
@@ -283,7 +286,7 @@ def rung2_filter(sess, hs, ldf, left, work):
         return t.select(["id", "score"]).take(np.nonzero(mask)[0])
 
     cpu_s = best_of(cpu, label="rung2 cpu")
-    return dev_s, cpu_s
+    return dev_s, cpu_s, qm
 
 
 # ---------------------------------------------------------------------------
@@ -310,6 +313,7 @@ def rung3_join(sess, hs, ldf, rdf, work):
     assert all(s.bucket_spec is not None for s in scans), "rung3 not bucketed"
     q()
     dev_s = best_of(q, label="rung3 device")
+    qm = sess.last_query_metrics().summary()
     sess.disable_hyperspace()
 
     lfiles = [os.path.join(work, "left", f)
@@ -324,7 +328,7 @@ def rung3_join(sess, hs, ldf, rdf, work):
         return lt.merge(rt, on="key")[["id", "val"]]
 
     cpu_s = best_of(cpu, label="rung3 cpu")
-    return dev_s, cpu_s
+    return dev_s, cpu_s, qm
 
 
 # ---------------------------------------------------------------------------
@@ -379,6 +383,7 @@ def rung4_hybrid(sess, hs, left, work):
     assert found_union[0], "rung4 not hybrid-served (no Union in plan)"
     q()
     dev_s = best_of(q, label="rung4 device")
+    qm = sess.last_query_metrics().summary()
     sess.disable_hyperspace()
 
     files = sorted(os.path.join(hdir, f) for f in os.listdir(hdir))
@@ -390,7 +395,7 @@ def rung4_hybrid(sess, hs, left, work):
         return t.select(["id", "score"]).take(np.nonzero(mask)[0])
 
     cpu_s = best_of(cpu, label="rung4 cpu")
-    return dev_s, cpu_s
+    return dev_s, cpu_s, qm
 
 
 # ---------------------------------------------------------------------------
@@ -428,6 +433,7 @@ def rung4b_hybrid_join(sess, hs, rdf, work):
 
     q()
     dev_s = best_of(q, label="rung4b device")
+    qm = sess.last_query_metrics().summary()
     sess.disable_hyperspace()
 
     lfiles = sorted(os.path.join(hdir, f) for f in os.listdir(hdir))
@@ -440,7 +446,7 @@ def rung4b_hybrid_join(sess, hs, rdf, work):
         return lt.merge(rt, on="key")[["id", "val"]]
 
     cpu_s = best_of(cpu, label="rung4b cpu")
-    return dev_s, cpu_s
+    return dev_s, cpu_s, qm
 
 
 # ---------------------------------------------------------------------------
@@ -543,13 +549,13 @@ def main():
         ldf = sess.read_parquet(os.path.join(work, "left"))
         rdf = sess.read_parquet(os.path.join(work, "right"))
 
-        dev2, cpu2 = rung2_filter(sess, hs, ldf, left, work)
+        dev2, cpu2, met2 = rung2_filter(sess, hs, ldf, left, work)
         log(f"rung2: device {dev2:.3f}s vs cpu {cpu2:.3f}s (x{cpu2 / dev2:.2f})")
-        dev3, cpu3 = rung3_join(sess, hs, ldf, rdf, work)
+        dev3, cpu3, met3 = rung3_join(sess, hs, ldf, rdf, work)
         log(f"rung3: device {dev3:.3f}s vs cpu {cpu3:.3f}s (x{cpu3 / dev3:.2f})")
-        dev4, cpu4 = rung4_hybrid(sess, hs, left, work)
+        dev4, cpu4, met4 = rung4_hybrid(sess, hs, left, work)
         log(f"rung4: device {dev4:.3f}s vs cpu {cpu4:.3f}s (x{cpu4 / dev4:.2f})")
-        dev4b, cpu4b = rung4b_hybrid_join(sess, hs, rdf, work)
+        dev4b, cpu4b, met4b = rung4b_hybrid_join(sess, hs, rdf, work)
         log(f"rung4b: device {dev4b:.3f}s vs cpu {cpu4b:.3f}s "
             f"(x{cpu4b / dev4b:.2f})")
         inc5, opt5, full5 = rung5_compaction(sess, hs, work)
@@ -584,16 +590,20 @@ def main():
                             "vs_baseline": round(cpu1 / dev1, 3)},
                 "2_filter_query": {"device_s": round(dev2, 3),
                                    "cpu_s": round(cpu2, 3),
-                                   "vs_baseline": round(cpu2 / dev2, 3)},
+                                   "vs_baseline": round(cpu2 / dev2, 3),
+                                   "metrics": met2},
                 "3_bucketed_smj": {"device_s": round(dev3, 3),
                                    "cpu_s": round(cpu3, 3),
-                                   "vs_baseline": round(cpu3 / dev3, 3)},
+                                   "vs_baseline": round(cpu3 / dev3, 3),
+                                   "metrics": met3},
                 "4_hybrid_scan": {"device_s": round(dev4, 3),
                                   "cpu_s": round(cpu4, 3),
-                                  "vs_baseline": round(cpu4 / dev4, 3)},
+                                  "vs_baseline": round(cpu4 / dev4, 3),
+                                  "metrics": met4},
                 "4b_hybrid_join": {"device_s": round(dev4b, 3),
                                    "cpu_s": round(cpu4b, 3),
-                                   "vs_baseline": round(cpu4b / dev4b, 3)},
+                                   "vs_baseline": round(cpu4b / dev4b, 3),
+                                   "metrics": met4b},
                 "5_compaction": {"incremental_refresh_s": round(inc5, 3),
                                  "optimize_s": round(opt5, 3),
                                  "full_refresh_s": round(full5, 3),

@@ -109,6 +109,12 @@ def main():
             on_s, on_med, got_on = best_of(
                 lambda: build(dfs).collect().to_pandas(),
                 label=f"{name} rules-on")
+            # Per-operator telemetry for the artifact: the recorder of
+            # the LAST timed rules-on run (collect always records) —
+            # operator self-times, fusion lanes, rule decisions, and
+            # index usage ride next to the wall-clock numbers so later
+            # rounds see operator-level trajectories, not just totals.
+            qmetrics = sess.last_query_metrics()
             sess.disable_hyperspace()
             off_s, off_med, got_off = best_of(
                 lambda: build(dfs).collect().to_pandas(),
@@ -127,7 +133,8 @@ def main():
                              "pandas_median_s": round(cpu_med, 4),
                              "vs_baseline": round(cpu_s / on_s, 3),
                              "vs_no_index": round(off_s / on_s, 3),
-                             "rows": int(len(expected))}
+                             "rows": int(len(expected)),
+                             "metrics": qmetrics.summary()}
             tot_on += on_s
             tot_off += off_s
             tot_cpu += cpu_s

@@ -172,12 +172,29 @@ class DataFrame:
     def _conf(self):
         return self.session.conf if self.session is not None else None
 
-    def collect(self):
-        """Execute and return an Arrow table."""
+    def collect(self, with_metrics: bool = False):
+        """Execute and return an Arrow table. `with_metrics=True` returns
+        `(table, telemetry.QueryMetrics)` instead — per-operator timings
+        and row counts, optimizer-rule and fusion-lane decision events,
+        and index-usage records for THIS query. Metrics are recorded for
+        every session-attached collect (the recorder is a handful of
+        perf_counter reads per operator) and stashed as
+        `session.last_query_metrics()`; the optimizer runs inside the
+        recording so rule fired/skipped events are captured too."""
+        from hyperspace_tpu import telemetry
         from hyperspace_tpu.engine.executor import execute_plan
         from hyperspace_tpu.io.columnar import to_arrow
-        return to_arrow(execute_plan(self._optimized_plan(),
-                                     conf=self._conf()))
+
+        metrics = telemetry.QueryMetrics(
+            description=", ".join(self.schema.names[:6]))
+        with telemetry.recording(metrics):
+            plan = self._optimized_plan()
+            batch = execute_plan(plan, conf=self._conf())
+        metrics.finish()
+        if self.session is not None:
+            self.session._last_query_metrics = metrics
+        table = to_arrow(batch)
+        return (table, metrics) if with_metrics else table
 
     def to_pandas(self):
         return self.collect().to_pandas()

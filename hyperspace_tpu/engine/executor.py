@@ -118,8 +118,16 @@ def _resolve_scalar_subqueries(plan: LogicalPlan, conf) -> None:
 def execute_plan(plan: LogicalPlan,
                  projection: Optional[Sequence[str]] = None,
                  conf=None) -> ColumnBatch:
+    import time as _time
+
+    from hyperspace_tpu import telemetry
+
     _resolve_scalar_subqueries(plan, conf)
+    t0 = _time.perf_counter()
     physical = compile_plan(plan, projection, conf)
+    # Physical planning + fusion grouping time, per query (device-side
+    # XLA compiles happen lazily inside operators, not here).
+    telemetry.add_seconds("plan_s", _time.perf_counter() - t0)
     trace_dir = conf.trace_dir if conf is not None else None
     if not trace_dir:
         return physical.execute()
@@ -132,6 +140,7 @@ def execute_plan(plan: LogicalPlan,
 
     seq = next(_trace_seq)
     capture = f"{trace_dir.rstrip('/')}/query-{_trace_run_id}-{seq:05d}"
+    telemetry.event("profiler", "capture", path=capture)
     with _trace_lock:
         with jax.profiler.trace(capture):
             out = physical.execute()

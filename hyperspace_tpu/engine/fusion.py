@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from hyperspace_tpu import telemetry
 from hyperspace_tpu.engine.physical import PhysicalNode
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io.columnar import (ColumnBatch, DeviceColumn,
@@ -267,10 +268,22 @@ class _StageProgram:
 # out-batch metadata captured at trace time, re-served on executable
 # cache hits (the jit call only returns arrays).
 _OUT_META: Dict[str, tuple] = {}
-# Diagnostics (perf work): stage executions, trace misses, seconds spent
-# blocked on the output-sizing sync.
+# PROCESS-WIDE diagnostics aggregate: stage executions, trace misses,
+# seconds spent dispatching / blocked on the output-sizing sync. Kept for
+# existing consumers (scripts/prof_tpcds.py); per-QUERY attribution of
+# the same quantities lands on the active `telemetry.QueryMetrics`
+# (counters `fusion.*`) so concurrent queries don't smear each other.
 STATS = {"stage_execs": 0, "trace_misses": 0, "sync_s": 0.0,
          "dispatch_s": 0.0}
+
+
+def _stat(key: str, value) -> None:
+    """Accumulate into the module aggregate AND the per-query recorder."""
+    STATS[key] += value
+    if isinstance(value, float):
+        telemetry.add_seconds(f"fusion.{key}", value)
+    else:
+        telemetry.add_count(f"fusion.{key}", value)
 # program keys whose trace proved ineligible — skip straight to eager.
 _INELIGIBLE_KEYS: set = set()
 
@@ -583,11 +596,15 @@ class FusedStageExec(PhysicalNode):
     def _execute_masked(self) -> Optional[ColumnBatch]:
         batches = [s._batch for s in self.sources]
         if any(b.num_rows == 0 for b in batches):
+            telemetry.event("fusion", "lane", lane="eager",
+                            trigger="empty-source")
             return None  # eager path has exact empty-side shortcuts
         from hyperspace_tpu.parallel.context import should_distribute
         host = all(b.is_host for b in batches)
         if should_distribute(self.conf, max(b.num_rows for b in batches),
                              host_batch=host) is not None:
+            telemetry.event("fusion", "lane", lane="eager",
+                            trigger="mesh-distribution")
             return None  # mesh execution owns these operators instead
         if host:
             # Host lane: run the ORIGINAL eager operator graph (before
@@ -600,6 +617,8 @@ class FusedStageExec(PhysicalNode):
             # masked semantics still get CPU coverage through the device
             # lane on the CPU backend (tests force it via
             # execution.min.device.rows=0).
+            telemetry.event("fusion", "lane", lane="eager-host",
+                            trigger="host-resident sources")
             return self.root.execute()
 
         preps = {}
@@ -607,6 +626,8 @@ class FusedStageExec(PhysicalNode):
             build_node = n.right if n.build_side == "right" else n.left
             prep = _prepare_broadcast(n, build_node._batch)
             if prep is None:
+                telemetry.event("fusion", "lane", lane="eager",
+                                trigger="broadcast-prep-declined")
                 return None
             preps[n._table_slot] = prep
         return self._execute_device(batches, preps)
@@ -616,6 +637,8 @@ class FusedStageExec(PhysicalNode):
 
         key = self._program_key(batches, preps)
         if key in _INELIGIBLE_KEYS:
+            telemetry.event("fusion", "lane", lane="eager",
+                            trigger="trace-ineligible (cached)")
             return None
         if len(_OUT_META) > 1024:
             # Metadata and executables retire TOGETHER: evicting only
@@ -642,29 +665,38 @@ class FusedStageExec(PhysicalNode):
         tables_meta = {slot: (p[1], p[2]) for slot, p in preps.items()}
         prog = _StageProgram(key, self.root, source_meta, tables_meta)
         import time as _time
-        STATS["stage_execs"] += 1
-        if key not in _OUT_META and key not in _INELIGIBLE_KEYS:
-            STATS["trace_misses"] += 1
+        _stat("stage_execs", 1)
+        cache_hit = key in _OUT_META
+        if not cache_hit and key not in _INELIGIBLE_KEYS:
+            _stat("trace_misses", 1)
+        telemetry.event("fusion", "trace-cache",
+                        hit=cache_hit, ops=len(_region_nodes(self.root)))
         t0 = _time.perf_counter()
         try:
             out_tree, lazy_pairs, sel, cnt = _run_stage(prog, trees,
                                                         table_args)
-        except _FusionIneligible:
+        except _FusionIneligible as exc:
             _INELIGIBLE_KEYS.add(key)
+            telemetry.event("fusion", "lane", lane="eager",
+                            trigger=f"trace-ineligible ({exc})")
             return None
-        STATS["dispatch_s"] += _time.perf_counter() - t0
+        _stat("dispatch_s", _time.perf_counter() - t0)
         meta = _OUT_META.get(key)
         if meta is None:
             # Executable outlived its evicted metadata (>256 distinct
             # stage programs since): run this one eagerly.
+            telemetry.event("fusion", "lane", lane="eager",
+                            trigger="metadata-evicted")
             return None
+        telemetry.event("fusion", "lane", lane="masked-device",
+                        trigger="device-resident sources")
         schema, reduced_schema, aux, lazy_specs = meta
         base = tree_to_batch(out_tree, reduced_schema, aux)
         idx = None
         if sel is not None:
             t0 = _time.perf_counter()
             count = int(cnt)  # THE stage sync
-            STATS["sync_s"] += _time.perf_counter() - t0
+            _stat("sync_s", _time.perf_counter() - t0)
             (idx,) = jnp.nonzero(sel, size=count, fill_value=0)
             idx = idx.astype(jnp.int32)
             base = base.take(idx)

@@ -12,8 +12,11 @@ Exchange/Sort elision, and execution actually skips the work.
 
 from __future__ import annotations
 
+import functools
+
 from typing import List, Optional, Sequence, Set, Tuple
 
+from hyperspace_tpu import telemetry
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io import columnar, parquet
 from hyperspace_tpu.plan import expr as E
@@ -23,8 +26,62 @@ from hyperspace_tpu.plan.nodes import (Aggregate, BucketSpec, Except, Filter,
 from hyperspace_tpu.plan.schema import Schema
 
 
+def _batch_rows(out) -> Optional[int]:
+    """Output row count of an execute/execute_bucketed result, without
+    forcing any device sync (ColumnBatch.num_rows is a static shape)."""
+    if isinstance(out, columnar.ColumnBatch):
+        return out.num_rows
+    if isinstance(out, tuple) and out \
+            and isinstance(out[0], columnar.ColumnBatch):
+        return out[0].num_rows
+    return None
+
+
+def _instrument(fn, bucketed: bool):
+    """Wrap an execute/execute_bucketed implementation with the telemetry
+    operator hook. With no active recorder the cost is one ContextVar
+    read + None check. Applied automatically to every PhysicalNode
+    subclass by `PhysicalNode.__init_subclass__`, so a new operator can
+    never silently execute unmetered (`scripts/check_metrics_coverage.py`
+    enforces the marker repo-wide)."""
+
+    @functools.wraps(fn)
+    def wrapper(self, arg=None):
+        rec = telemetry.current()
+        if rec is None:
+            return fn(self, arg)
+        op = rec.start_operator(self.name, self, bucketed=bucketed)
+        if bucketed:
+            op.detail["num_buckets"] = arg
+        elif arg is not None:
+            op.detail["bucket"] = arg
+        try:
+            out = fn(self, arg)
+        except BaseException as exc:
+            rec.finish_operator(op, error=repr(exc))
+            raise
+        rec.finish_operator(op, rows_out=_batch_rows(out))
+        return out
+
+    wrapper.__telemetry_instrumented__ = True
+    return wrapper
+
+
 class PhysicalNode:
     name: str = "Physical"
+
+    def __init_subclass__(cls, **kwargs):
+        # EVERY subclass's execute/execute_bucketed emits an operator
+        # metrics record; opting out is not supported by design (the
+        # metrics-coverage lint would flag it).
+        super().__init_subclass__(**kwargs)
+        for attr, bucketed in (("execute", False),
+                               ("execute_bucketed", True)):
+            fn = cls.__dict__.get(attr)
+            if fn is not None and callable(fn) \
+                    and not getattr(fn, "__telemetry_instrumented__",
+                                    False):
+                setattr(cls, attr, _instrument(fn, bucketed))
 
     @property
     def children(self) -> List["PhysicalNode"]:
@@ -90,6 +147,28 @@ class ScanExec(PhysicalNode):
         return (self.conf.device_cache_bytes if device
                 else self.conf.read_cache_bytes)
 
+    def _annotate_read(self, files: List[str], host: bool,
+                       files_total: Optional[int] = None) -> None:
+        """Index-usage detail on this scan's operator record: lane, files
+        scanned vs total, buckets scanned vs total. `files_total` is
+        passed by the caller FROM THE LISTING IT ALREADY MADE — this
+        hook performs no IO of its own (telemetry must not add a listing
+        to the scan hot path)."""
+        if telemetry.current() is None:
+            return
+        detail = {"lane": "host" if host else "device",
+                  "files_scanned": len(files),
+                  "roots": list(self.scan.root_paths)}
+        spec = self.scan.bucket_spec
+        if spec is not None:
+            detail["buckets_total"] = spec.num_buckets
+            detail["buckets_scanned"] = (len(self.allowed_buckets)
+                                         if self.allowed_buckets is not None
+                                         else spec.num_buckets)
+        if files_total is not None:
+            detail["files_total"] = files_total
+        telemetry.annotate(**detail)
+
     def simple_string(self) -> str:
         bucket = (f", buckets={self.scan.bucket_spec.num_buckets}"
                   if self.scan.bucket_spec else "")
@@ -101,6 +180,7 @@ class ScanExec(PhysicalNode):
                 f"{self.scan.root_paths}{bucket}{pruned}")
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        files_total: Optional[int] = None
         if bucket is not None:
             if self.scan.bucket_spec is None:
                 raise HyperspaceException("Bucket read on unbucketed scan.")
@@ -109,12 +189,15 @@ class ScanExec(PhysicalNode):
                 files.extend(parquet.bucket_files(root).get(bucket, []))
         elif self.allowed_buckets is not None and self.scan.bucket_spec:
             files = []
+            files_total = 0
             for root in self.scan.root_paths:
                 per_bucket = parquet.bucket_files(root)
+                files_total += sum(len(v) for v in per_bucket.values())
                 for b in sorted(self.allowed_buckets):
                     files.extend(per_bucket.get(b, []))
         else:
             files = self.scan.files()
+            files_total = len(files)
         if not files:
             return _empty_batch(self.out_schema)
         # Adaptive lane: small reads (e.g. a pruned point-filter bucket)
@@ -129,6 +212,7 @@ class ScanExec(PhysicalNode):
         # reads don't make — keep the metadata pass off that hot path.
         host = (bucket is None
                 and sum(parquet.file_row_counts(files)) < min_dev)
+        self._annotate_read(files, host, files_total)
         if host:
             batch = parquet.read_host_batch(files, self.columns,
                                             self.out_schema,
@@ -156,8 +240,10 @@ class ScanExec(PhysicalNode):
         if self.scan.bucket_spec is None:
             raise HyperspaceException("Bucketed read on unbucketed scan.")
         per_bucket = {}
+        files_total = 0
         for root in self.scan.root_paths:
             for b, files in parquet.bucket_files(root).items():
+                files_total += len(files)
                 if (self.allowed_buckets is not None
                         and b not in self.allowed_buckets):
                     # Pruned by the filter above: no row in this bucket can
@@ -178,7 +264,9 @@ class ScanExec(PhysicalNode):
         from hyperspace_tpu.constants import MIN_DEVICE_ROWS_DEFAULT
         min_dev = (self.conf.min_device_rows if self.conf is not None
                    else MIN_DEVICE_ROWS_DEFAULT)
-        if int(lengths.sum()) < min_dev:
+        host = int(lengths.sum()) < min_dev
+        self._annotate_read(files, host, files_total)
+        if host:
             return parquet.read_host_batch(
                 files, self.columns, self.out_schema,
                 budget=self._budget(device=False)), lengths
@@ -739,6 +827,8 @@ class ReusedExec(PhysicalNode):
         with self._lock:
             if self._memo is None:
                 self._memo = self.child.execute()
+            else:
+                telemetry.annotate(reused=True)
             return self._memo
 
     def execute_bucketed(self, num_buckets: int):
@@ -746,6 +836,8 @@ class ReusedExec(PhysicalNode):
             if num_buckets not in self._memo_bucketed:
                 self._memo_bucketed[num_buckets] = \
                     self.child.execute_bucketed(num_buckets)
+            else:
+                telemetry.annotate(reused=True)
             return self._memo_bucketed[num_buckets]
 
 
@@ -867,8 +959,13 @@ class SortMergeJoinExec(PhysicalNode):
         rows). Shared by the payload join and the membership branch."""
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(max_workers=2) as pool:
-            lf = pool.submit(self.left.execute_bucketed, self.num_buckets)
-            rf = pool.submit(self.right.execute_bucketed, self.num_buckets)
+            # telemetry.propagating: pool threads don't inherit the
+            # query's recorder context — re-establish it so each side's
+            # scans record under this join.
+            lf = pool.submit(telemetry.propagating(
+                self.left.execute_bucketed), self.num_buckets)
+            rf = pool.submit(telemetry.propagating(
+                self.right.execute_bucketed), self.num_buckets)
             lbatch, l_lengths = lf.result()
             rbatch, r_lengths = rf.result()
         mesh = self._join_mesh(lbatch.num_rows + rbatch.num_rows,
@@ -882,6 +979,11 @@ class SortMergeJoinExec(PhysicalNode):
             from hyperspace_tpu.parallel.join import shard_skew
             if shard_skew(l_lengths, r_lengths, mesh_size(mesh)):
                 mesh = None
+                telemetry.event("join", "mesh-declined",
+                                reason="full_outer hot-bucket skew")
+        telemetry.annotate(lane="mesh" if mesh is not None else
+                           ("host" if lbatch.is_host and rbatch.is_host
+                            else "device"))
         return lbatch, rbatch, l_lengths, r_lengths, mesh
 
     def _join_mesh(self, total_rows: int, host_batch: bool = False):

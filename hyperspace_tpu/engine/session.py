@@ -26,6 +26,16 @@ class HyperspaceSession:
         self._rules: List = []
         self._hyperspace_enabled = False
         self._views: dict = {}
+        self._last_query_metrics = None
+
+    def last_query_metrics(self):
+        """`telemetry.QueryMetrics` of the most recent query executed
+        through this session (collect/to_pandas/count), or None. Each
+        query records into its own instance — concurrent sessions (and
+        concurrent queries on one session) never share a recorder; this
+        slot simply holds whichever query on this session FINISHED
+        last."""
+        return self._last_query_metrics
 
     # -- data sources -----------------------------------------------------
 

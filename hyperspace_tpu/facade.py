@@ -83,9 +83,15 @@ class Hyperspace:
         """Catalog as a pandas DataFrame (reference `Hyperspace.scala:33-36`)."""
         return self._manager.indexes_df()
 
-    def explain(self, df, verbose: bool = False, redirect=None) -> None:
-        """Plan diff with rules on vs off (reference `Hyperspace.scala:101-104`)."""
+    def explain(self, df, verbose: bool = False, redirect=None,
+                metrics=None) -> None:
+        """Plan diff with rules on vs off (reference
+        `Hyperspace.scala:101-104`). Pass `metrics` (a
+        `telemetry.QueryMetrics`, e.g. `session.last_query_metrics()`)
+        to append the runtime numbers of an actual execution under the
+        diff — plan change and cost in one view."""
         from hyperspace_tpu.plananalysis.analyzer import PlanAnalyzer
         out = PlanAnalyzer.explain_string(df, self.session,
-                                          self._manager.indexes(), verbose)
+                                          self._manager.indexes(), verbose,
+                                          metrics=metrics)
         (redirect or print)(out)

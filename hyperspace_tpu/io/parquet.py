@@ -45,10 +45,17 @@ def bucket_of_file(path: str) -> Optional[int]:
 
 def _read_one(path: str, cols):
     import pyarrow.parquet as pq
+
+    # partitioning=None: the index layout's `v__=N` version directories
+    # LOOK like hive partitions, and newer pyarrow infers a synthetic
+    # `v__` dictionary column from the path (even for single-file
+    # reads) — which is not data, collides with files that were written
+    # while such inference was active, and must never enter a batch.
     if storage.is_url(path):
         fs, real = storage.get_fs(path)
-        return pq.read_table(real, columns=cols, filesystem=fs)
-    return pq.read_table(path, columns=cols)
+        return pq.read_table(real, columns=cols, filesystem=fs,
+                             partitioning=None)
+    return pq.read_table(path, columns=cols, partitioning=None)
 
 
 # Decoded-read cache: query trees that reference the same relation more

@@ -20,7 +20,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 # ABI version in the filename: a .so built from older sources simply
 # never matches the load path (no in-place overwrite of a possibly
 # mmapped stale library, no dlopen returning the cached stale handle).
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 _SO_PATH = os.path.join(_HERE, f"libhyperspace_host_v{_ABI_VERSION}.so")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -73,6 +73,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p]
+            lib.key_sort_perm_u64.restype = None
+            lib.key_sort_perm_u64.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int32,
+                ctypes.c_void_p]
             _lib = lib
         except (OSError, AttributeError) as exc:
             # AttributeError = missing symbol (a hand-built .so from other
@@ -164,14 +168,27 @@ def pack_sort_words(lanes):
 def key_sort_perm(n: int, lanes):
     """Stable ascending sort permutation over `lanes` alone (no bucket
     grouping) via the native radix — the plain-sort entry the host sort
-    and group-encode lanes share. Returns an int32 permutation or None
-    (library unavailable, unsupported lane dtype, or n >= 2^31)."""
+    and group-encode lanes share. Calls the dedicated no-bucket kernel:
+    no O(n) dummy bucket-id allocation, no final counting pass. Returns
+    an int32 permutation or None (library unavailable, unsupported lane
+    dtype, or n >= 2^31)."""
     import numpy as np
 
-    if get_lib() is None:  # before the O(n) dummy-bucket allocation
+    lib = get_lib()
+    if lib is None:
         return None
-    out = bucket_key_sort_perm(np.zeros(n, dtype=np.int32), 1, lanes)
-    return None if out is None else out[0]
+    if n >= 1 << 31:
+        return None  # int32 permutation indices would wrap
+    words = pack_sort_words(lanes)
+    if words is None:
+        return None
+    perm = np.empty(n, dtype=np.int32)
+    word_ptrs = (ctypes.c_void_p * len(words))(
+        *[w.ctypes.data_as(ctypes.c_void_p).value for w in words])
+    lib.key_sort_perm_u64(ctypes.c_int64(n), word_ptrs,
+                          ctypes.c_int32(len(words)),
+                          perm.ctypes.data_as(ctypes.c_void_p))
+    return perm
 
 
 def bucket_key_sort_perm(bucket_ids, num_buckets: int, lanes):

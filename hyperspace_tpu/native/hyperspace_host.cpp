@@ -149,10 +149,13 @@ namespace {
 
 // Stable LSD radix scatter of the current permutation by one 16-bit
 // digit of `w` (values gathered through the permutation). `hist` is the
-// digit histogram, already computed over the full array.
+// digit histogram, already computed over the full array; `offs` is a
+// caller-provided 65536-slot scratch — like the histogram it lives on
+// the heap, not this frame: a 512 KB stack array would overflow
+// small-stack worker threads (musl/pthread defaults).
 void radix_pass_u64(const uint64_t* w, int shift, const int64_t* hist,
-                    const int32_t* cur, int32_t* nxt, int64_t n) {
-    int64_t offs[65536];
+                    const int32_t* cur, int32_t* nxt, int64_t n,
+                    int64_t* offs) {
     int64_t run = 0;
     for (int d = 0; d < 65536; ++d) {
         offs[d] = run;
@@ -164,32 +167,14 @@ void radix_pass_u64(const uint64_t* w, int shift, const int64_t* hist,
     }
 }
 
-}  // namespace
-
-extern "C" {
-
-// Stable (bucket, key-words) sort permutation — the index build's host
-// lane. `words` are big-endian-significant packed uint64 sort lanes
-// (words[0] most significant); rows sort ascending by
-// (bucket, words[0], ..., words[n_words-1]), ties keeping input order.
-// LSD: radix each word least-significant-first (16-bit digits, constant
-// digits skipped via the histogram), then one stable counting pass by
-// bucket. Outputs the int32 permutation plus per-bucket [start, end)
-// bounds. No device link traffic — this replaces a ~perm-sized D2H
-// transfer plus a host lexsort (the round-4 review's rung-1 residual).
-void bucket_key_sort_perm(const int32_t* bucket_ids, int64_t n,
-                          int64_t num_buckets,
-                          const uint64_t* const* words, int32_t n_words,
-                          int32_t* perm, int64_t* starts, int64_t* ends) {
-    if (n <= 0) {
-        for (int64_t d = 0; d < num_buckets; ++d) starts[d] = ends[d] = 0;
-        return;
-    }
-    std::vector<int32_t> cur(n), tmp(n);
-    for (int64_t i = 0; i < n; ++i) cur[i] = static_cast<int32_t>(i);
-    int32_t* a = cur.data();
-    int32_t* b = tmp.data();
+// Stable ascending LSD radix over the packed uint64 sort words
+// (words[0] most significant), starting from the identity permutation
+// in `a` with scratch `b`. Returns whichever buffer holds the final
+// order. Shared by the bucketed and plain entry points.
+int32_t* radix_words_lsd(const uint64_t* const* words, int32_t n_words,
+                         int64_t n, int32_t* a, int32_t* b) {
     std::vector<int64_t> hist(4 * 65536);
+    std::vector<int64_t> offs(65536);
     for (int32_t w = n_words - 1; w >= 0; --w) {
         const uint64_t* W = words[w];
         std::fill(hist.begin(), hist.end(), 0);
@@ -215,11 +200,38 @@ void bucket_key_sort_perm(const int32_t* bucket_ids, int64_t n,
                 if (h[d] != 0) break;
             }
             if (!constant) {
-                radix_pass_u64(W, 16 * p, h, a, b, n);
+                radix_pass_u64(W, 16 * p, h, a, b, n, offs.data());
                 std::swap(a, b);
             }
         }
     }
+    return a;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Stable (bucket, key-words) sort permutation — the index build's host
+// lane. `words` are big-endian-significant packed uint64 sort lanes
+// (words[0] most significant); rows sort ascending by
+// (bucket, words[0], ..., words[n_words-1]), ties keeping input order.
+// LSD: radix each word least-significant-first (16-bit digits, constant
+// digits skipped via the histogram), then one stable counting pass by
+// bucket. Outputs the int32 permutation plus per-bucket [start, end)
+// bounds. No device link traffic — this replaces a ~perm-sized D2H
+// transfer plus a host lexsort (the round-4 review's rung-1 residual).
+void bucket_key_sort_perm(const int32_t* bucket_ids, int64_t n,
+                          int64_t num_buckets,
+                          const uint64_t* const* words, int32_t n_words,
+                          int32_t* perm, int64_t* starts, int64_t* ends) {
+    if (n <= 0) {
+        for (int64_t d = 0; d < num_buckets; ++d) starts[d] = ends[d] = 0;
+        return;
+    }
+    std::vector<int32_t> cur(n), tmp(n);
+    for (int64_t i = 0; i < n; ++i) cur[i] = static_cast<int32_t>(i);
+    int32_t* a = radix_words_lsd(words, n_words, n, cur.data(), tmp.data());
     // Final stable counting pass by bucket id; writes land directly in
     // `perm` when the parity works out, else through tmp.
     std::vector<int64_t> boffs(num_buckets, 0);
@@ -235,6 +247,19 @@ void bucket_key_sort_perm(const int32_t* bucket_ids, int64_t n,
         const int32_t r = a[i];
         perm[boffs[bucket_ids[r]]++] = r;
     }
+}
+
+// Plain (no-bucket) stable key-words sort permutation — the entry the
+// host ORDER BY and group-encode lanes use. Skips the bucket counting
+// pass entirely (a memcpy of the final buffer replaces it), and lets
+// the Python side skip allocating an O(n) all-zeros bucket-id array.
+void key_sort_perm_u64(int64_t n, const uint64_t* const* words,
+                       int32_t n_words, int32_t* perm) {
+    if (n <= 0) return;
+    std::vector<int32_t> cur(n), tmp(n);
+    for (int64_t i = 0; i < n; ++i) cur[i] = static_cast<int32_t>(i);
+    int32_t* a = radix_words_lsd(words, n_words, n, cur.data(), tmp.data());
+    std::memcpy(perm, a, static_cast<size_t>(n) * sizeof(int32_t));
 }
 
 }  // extern "C"

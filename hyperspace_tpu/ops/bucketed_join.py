@@ -249,6 +249,9 @@ def bucketed_sort_merge_join(left: ColumnBatch, right: ColumnBatch,
     """Full bucketed join over concat-in-bucket-order sides. full_outer =
     the left_outer expansion plus one appended row per unmatched right
     row (both sides share one hash layout, so membership is global)."""
+    from hyperspace_tpu import telemetry
+    telemetry.annotate(join_buckets=len(np.asarray(l_lengths)),
+                       left_rows=left.num_rows, right_rows=right.num_rows)
     if how == "right_outer":
         ri, li = bucketed_join_indices(right, left, np.asarray(r_lengths),
                                        np.asarray(l_lengths), right_keys,

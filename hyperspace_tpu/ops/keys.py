@@ -150,7 +150,15 @@ def host_dense_group_ids(keys):
     host aggregation so the grouping invariants live in one place. The
     sort permutation comes from the native C++ radix lane when the keys
     decompose to packable lanes (4-7x np.lexsort on wide key sets);
-    np.lexsort otherwise — both stable, identical order."""
+    np.lexsort otherwise. Both are stable, and for int/bool/string keys
+    they produce the SAME permutation; float keys only agree up to NaN
+    placement — the native lane orders by the normalized IEEE
+    total-order bit transform while the np.lexsort fallback sorts the
+    RAW floats (numpy puts every NaN last, ignoring payload/sign bits) —
+    so the two lanes may interleave NaN rows differently. Group CONTENT
+    is unaffected either way (equal keys stay contiguous and NaNs group
+    together under the normalized lane identity); only the permutation,
+    which no grouping consumer depends on, can differ."""
     import numpy as np
 
     keys = [np.asarray(k) for k in keys]

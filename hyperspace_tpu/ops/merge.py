@@ -208,8 +208,10 @@ def host_bucket_sort_permutation(key_batch, sort_columns: Sequence[str],
     nat = native.bucket_key_sort_perm(bucket_of_row, len(lengths),
                                       sort_lanes)
     if nat is not None:
-        perm, nstarts, nends = nat
-        # Bounds from lengths and from the sort must agree by construction.
-        return [perm], starts, ends
+        # Only the permutation is consumed: the native starts/ends are
+        # redundant here — bounds computed from `lengths` above agree
+        # with the sort's by construction (rows were labeled with the
+        # bucket ids those same lengths induce).
+        return [nat[0]], starts, ends
     perm = np.lexsort(tuple(reversed([bucket_of_row] + sort_lanes)))
     return [perm.astype(np.int64)], starts, ends

@@ -147,7 +147,21 @@ def _topk_threshold(prefix, k: int):
 
 def topk_batch(batch: ColumnBatch, by: Sequence[str], n: int) -> ColumnBatch:
     """First `n` rows of `batch` ordered by `by` (stable, identical to
-    sort_batch(...)[:n])."""
+    sort_batch(...)[:n]).
+
+    Residency contract (downstream lane selection keys on `is_host`):
+    - host input -> HOST output (pure numpy path);
+    - device input, threshold path -> HOST output: the candidate set is
+      pulled to the host for the exact full-key finish, and at <= n +
+      ties rows re-uploading it would only pay the link again;
+    - device input, candidate-cap fallback (low-cardinality prefix; see
+      TOPK_CANDIDATE_CAP) -> DEVICE output from the full device sort.
+    So a device caller gets a host batch on the common path and a device
+    batch on the fallback — by design, not drift: each path leaves the
+    rows where its last computation put them, and TopK is a root-adjacent
+    operator (ORDER BY + LIMIT) whose small output promotes or transfers
+    cheaply either way. The fallback is recorded as a telemetry event
+    (`topk.candidate-cap-fallback`) so lane surprises stay diagnosable."""
     import numpy as np
 
     if n == 0:
@@ -176,6 +190,10 @@ def topk_batch(batch: ColumnBatch, by: Sequence[str], n: int) -> ColumnBatch:
     count = int(count_dev)  # the one sizing sync
     t1 = _time.perf_counter()
     if count > max(TOPK_CANDIDATE_CAP, 4 * n):
+        from hyperspace_tpu import telemetry
+        telemetry.event("topk", "candidate-cap-fallback",
+                        candidates=count, n=n, rows=batch.num_rows,
+                        residency="device")
         full = sort_batch(batch, by)
         return full.take(jnp.arange(n, dtype=jnp.int32))
     # Pad the gather size to powers of two so distinct candidate counts

@@ -113,16 +113,15 @@ def _shard_partials(tree, num_lanes: int, specs_meta: Tuple[Tuple[str, bool],
 
 def make_partial_step(mesh, num_lanes: int, specs_meta, capacity: int):
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from hyperspace_tpu.parallel.mesh import row_spec
+    from hyperspace_tpu.parallel.mesh import compat_shard_map, row_spec
     rows_spec = row_spec(mesh)
 
     def step(tree):
         body = partial(_shard_partials, num_lanes=num_lanes,
                        specs_meta=specs_meta, capacity=capacity)
-        return shard_map(
+        return compat_shard_map(
             body, mesh=mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: rows_spec, tree),),
             out_specs=rows_spec, check_vma=False)(tree)

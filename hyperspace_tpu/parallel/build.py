@@ -169,9 +169,9 @@ def make_distributed_build_step(mesh, key_names: Tuple[str, ...],
     2-axis (dcn, shard) mesh the row axis shards over BOTH axes and the
     body runs the hierarchical two-stage exchange."""
     import jax
-    from jax import shard_map
 
-    from hyperspace_tpu.parallel.mesh import dcn_size, row_spec
+    from hyperspace_tpu.parallel.mesh import (compat_shard_map, dcn_size,
+                                              row_spec)
 
     n_ici = mesh.shape[SHARD_AXIS]
     n_dcn = dcn_size(mesh)
@@ -184,9 +184,10 @@ def make_distributed_build_step(mesh, key_names: Tuple[str, ...],
         body = partial(_shard_step, key_names=key_names,
                        num_buckets=num_buckets, n_ici=n_ici, n_dcn=n_dcn,
                        capacity_factor=capacity_factor)
-        sharded = shard_map(body, mesh=mesh, in_specs=(spec_like(tree),),
-                            out_specs=rows_spec,
-                            check_vma=False)
+        sharded = compat_shard_map(body, mesh=mesh,
+                                   in_specs=(spec_like(tree),),
+                                   out_specs=rows_spec,
+                                   check_vma=False)
         return sharded(tree)
 
     return jax.jit(step)

@@ -33,6 +33,23 @@ SHARD_AXIS = "shard"
 DCN_AXIS = "dcn"
 
 
+def compat_shard_map(body, mesh, in_specs, out_specs,
+                     check_vma: bool = False):
+    """`jax.shard_map` across jax versions: newer jax exports it
+    top-level with `check_vma`; older jax ships
+    `jax.experimental.shard_map` with the same semantics under
+    `check_rep`. ONE shim here so every mesh kernel stays
+    version-agnostic."""
+    try:
+        from jax import shard_map as sm
+        return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
+
 def make_mesh(num_devices: Optional[int] = None,
               dcn_size: Optional[int] = None):
     """1-axis `(shard,)` mesh, or — with `dcn_size` > 1 — a 2-axis

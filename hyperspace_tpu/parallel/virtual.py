@@ -21,10 +21,27 @@ def ensure_devices(n_devices: int) -> None:
     PROCESS-DESTRUCTIVE in the fallback path: it pins jax_platforms=cpu
     for the rest of the process and invalidates every live jax array and
     compiled computation. Call it before any device work (tests do it at
-    conftest import; the dryrun gate does it first thing). Subprocesses
-    are unaffected (nothing is written to ``os.environ``).
+    conftest import; the dryrun gate does it first thing). On jax
+    versions without the ``jax_num_cpu_devices`` config (< 0.5) the
+    device count is forced through ``XLA_FLAGS`` instead — that path
+    DOES write ``os.environ`` (inherited by subprocesses), the flag XLA
+    reads at CPU-client init.
     """
     import jax
+
+    if not hasattr(jax.config, "jax_num_cpu_devices"):
+        # Older jax: the only knob is the XLA host-platform flag, and
+        # XLA parses XLA_FLAGS ONCE per process — it must be in the
+        # environment before the first backend init (clear_backends +
+        # re-init does NOT re-read it). ensure_devices is documented to
+        # run before any device work, so set it ahead of our own probe.
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
 
     try:
         if len(jax.devices()) >= n_devices:
@@ -36,7 +53,8 @@ def ensure_devices(n_devices: int) -> None:
 
     jax.extend.backend.clear_backends()
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    if hasattr(jax.config, "jax_num_cpu_devices"):
+        jax.config.update("jax_num_cpu_devices", n_devices)
     if len(jax.devices()) < n_devices:
         raise RuntimeError(
             f"virtual mesh bootstrap failed: have {len(jax.devices())} "

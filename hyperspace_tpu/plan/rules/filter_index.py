@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 from typing import List, Optional, Sequence
 
+from hyperspace_tpu import telemetry
 from hyperspace_tpu.index.log_entry import IndexLogEntry
 from hyperspace_tpu.plan.nodes import Filter, LogicalPlan, Project, Scan
 from hyperspace_tpu.plan.rules.base import Rule
@@ -83,10 +84,21 @@ class FilterIndexRule(Rule):
         if index is not None:
             source: LogicalPlan = self.index_scan(index, bucketed=True)
             logger.info("FilterIndexRule: applying index %s", index.name)
+            telemetry.event(
+                "rule", "FilterIndexRule", action="applied",
+                indexes=[{"name": index.name, "root": index.content.root,
+                          "num_buckets": index.num_buckets,
+                          "side": "filter"}])
         else:
             source = self._hybrid_scan_source(filt, scan, project_columns,
                                               filter_columns)
             if source is None:
+                telemetry.event(
+                    "rule", "FilterIndexRule", action="skipped",
+                    reason="no ACTIVE covering index matches the plan "
+                           "signature (filter must reference the first "
+                           "indexed column; all columns must be covered)",
+                    filter_columns=list(filter_columns))
                 return node
 
         rewritten: LogicalPlan = Filter(filt.condition, source)
@@ -151,6 +163,13 @@ class FilterIndexRule(Rule):
             logger.info("FilterIndexRule: hybrid scan with index %s "
                         "(+%d appended files, -%d deleted files)",
                         entry.name, len(appended), len(deleted_ids))
+            telemetry.event(
+                "rule", "FilterIndexRule", action="applied",
+                indexes=[{"name": entry.name, "root": entry.content.root,
+                          "num_buckets": entry.num_buckets,
+                          "side": "filter", "hybrid": True,
+                          "appended_files": len(appended),
+                          "deleted_files": len(deleted_ids)}])
             if not appended:
                 return Project(needed_cols, index_source)
             appended_scan = Scan(scan.root_paths, scan.schema,

@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from hyperspace_tpu import telemetry
 from hyperspace_tpu.index.log_entry import IndexLogEntry
 from hyperspace_tpu.plan import expr as E
 from hyperspace_tpu.plan.nodes import Join, LogicalPlan, Scan
@@ -32,6 +33,13 @@ from hyperspace_tpu.plan.rules.base import Rule
 from hyperspace_tpu.plan.rules.ranker import JoinIndexRanker
 
 logger = logging.getLogger(__name__)
+
+
+def _skip(reason: str, **detail) -> None:
+    """Structured whyNot record (the reference's `PlanAnalyzer.whyNot`
+    analog): the rule looked at a join and declined, with the reason."""
+    telemetry.event("rule", "JoinIndexRule", action="skipped",
+                    reason=reason, **detail)
 
 
 class JoinIndexRule(Rule):
@@ -54,18 +62,25 @@ class JoinIndexRule(Rule):
             return node  # cross join: nothing to bucket on
         mapping = self._column_mapping(join)
         if mapping is None:
+            _skip("condition is not an AND-only CNF of one-to-one "
+                  "column equalities")
             return node
         if not (join.left.is_linear() and join.right.is_linear()):
+            _skip("non-linear join subplan")
             return node
         left_scan = self._base_scan(join.left)
         right_scan = self._base_scan(join.right)
         if left_scan is None or right_scan is None:
+            _skip("join side does not resolve to a single base relation")
             return node
         if left_scan.bucket_spec is not None or right_scan.bucket_spec is not None:
+            _skip("relation already bucketed (rule already applied)")
             return node  # already rewritten
 
         pair = self._best_index_pair(join, mapping)
         if pair is None:
+            _skip("no usable/compatible index pair",
+                  join_columns=sorted(mapping))
             return node
         ((left_index, left_appended, left_deleted),
          (right_index, right_appended, right_deleted)) = pair
@@ -80,6 +95,16 @@ class JoinIndexRule(Rule):
                     else "",
                     f" (-{len(right_deleted)} deleted)" if right_deleted
                     else "")
+        telemetry.event(
+            "rule", "JoinIndexRule", action="applied",
+            indexes=[{"name": e.name, "root": e.content.root,
+                      "num_buckets": e.num_buckets, "side": side,
+                      "appended_files": len(app or ()),
+                      "deleted_files": len(dele or ())}
+                     for e, app, dele, side in
+                     ((left_index, left_appended, left_deleted, "left"),
+                      (right_index, right_appended, right_deleted,
+                       "right"))])
 
         def swap(side_plan: LogicalPlan, entry: IndexLogEntry,
                  appended, deleted_ids) -> LogicalPlan:

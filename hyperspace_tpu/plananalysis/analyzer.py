@@ -20,8 +20,13 @@ from hyperspace_tpu.plananalysis.display_mode import get_display_mode
 class PlanAnalyzer:
     @staticmethod
     def explain_string(df, session, index_summaries: Sequence,
-                       verbose: bool = False) -> str:
-        """Reference `PlanAnalyzer.scala:45-126`."""
+                       verbose: bool = False, metrics=None) -> str:
+        """Reference `PlanAnalyzer.scala:45-126`. Pass a
+        `telemetry.QueryMetrics` (e.g. `session.last_query_metrics()` or
+        the `collect(with_metrics=True)` companion) as `metrics` to
+        append the runtime numbers — per-operator timings/rows, lane and
+        rule decision events — under the plan diff, so the what-changed
+        and the what-it-cost views read as one report."""
         was_enabled = session.is_hyperspace_enabled
         try:
             session.enable_hyperspace()
@@ -79,6 +84,14 @@ class PlanAnalyzer:
                 buffer.write_line(line)
             buffer.write_line()
 
+        if metrics is not None:
+            buffer.write_line("=============================================================")
+            buffer.write_line("Runtime metrics (last execution):")
+            buffer.write_line("=============================================================")
+            for line in metrics.format_tree().splitlines():
+                buffer.write_line(line)
+            buffer.write_line()
+
         return buffer.to_string()
 
     # -- lockstep subtree diff -------------------------------------------
@@ -131,20 +144,12 @@ class PlanAnalyzer:
     def _indexes_used(plan: PhysicalNode, index_summaries: Sequence
                       ) -> List[tuple]:
         """Match scan root paths against the index catalog (reference
-        `PlanAnalyzer.scala:209-221`, scan equality = root path equality)."""
-        import os
+        `PlanAnalyzer.scala:209-221`, scan equality = root path equality);
+        the containment matching itself lives in `index/manager.py`
+        (shared with the telemetry index-usage reports)."""
+        from hyperspace_tpu.index.manager import summaries_for_roots
 
-        def contains(parent: str, child: str) -> bool:
-            parent = os.path.normpath(parent)
-            child = os.path.normpath(child)
-            return child == parent or child.startswith(parent + os.sep)
-
-        used = []
         roots = [root for node in plan.collect() if isinstance(node, ScanExec)
                  for root in node.scan.root_paths]
-        for summary in index_summaries:
-            if any(contains(summary.index_location, root)
-                   or contains(root, summary.index_location)
-                   for root in roots):
-                used.append((summary.name, summary.index_location))
-        return used
+        return [(s.name, s.index_location)
+                for s in summaries_for_roots(index_summaries, roots)]

@@ -67,6 +67,12 @@ def canonical(path: str) -> str:
 # precondition instead.
 _ATOMIC_X_PROTOCOLS = {"file", "local", "memory"}
 
+# Serializes the memory-fs exclusive-create fallback (fsspec versions
+# without mode "x" support on MemoryFileSystem).
+import threading as _threading
+
+_memory_x_lock = _threading.Lock()
+
 
 def _protocols(fs) -> set:
     proto = getattr(fs, "protocol", ())
@@ -202,6 +208,18 @@ def exclusive_create(path: str, data: bytes) -> bool:
             return True
         except FileExistsError:
             return False
+        except ValueError:
+            # fsspec versions whose MemoryFileSystem rejects mode "x":
+            # the memory fs is in-process only, so a process-wide lock
+            # around check-then-write IS exclusive-create for it.
+            if "memory" not in protos:
+                raise
+            with _memory_x_lock:
+                if fs.exists(real):
+                    return False
+                with fs.open(real, "wb") as f:
+                    f.write(data)
+                return True
     raise PreconditionUnsupported(
         f"Backend {sorted(protos)} has no atomic create-if-absent; "
         "concurrent index operations could corrupt the operation log. "

@@ -595,6 +595,47 @@ def test_topk_matches_full_sort():
                 pd.testing.assert_frame_equal(g, w, check_dtype=False)
 
 
+def test_topk_residency_contract():
+    """The documented topk_batch residency contract (`ops/sort.py`):
+    host input -> host output; device input -> HOST output on the
+    threshold path, DEVICE output on the candidate-cap fallback (the
+    low-cardinality prefix where the threshold stops pruning)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops import sort as sort_mod
+    from hyperspace_tpu.ops.sort import topk_batch
+
+    rng = np.random.default_rng(9)
+    n = 20_000
+    table = pa.table({
+        "a": rng.integers(0, 1_000_000, n).astype(np.int64),
+        "v": rng.random(n),
+    })
+    host_batch = columnar.from_arrow(table, device=False)
+    assert topk_batch(host_batch, ["a"], 10).is_host
+
+    dev_batch = columnar.from_arrow(table, device=True)
+    # Selective prefix: threshold path -> host-resident result.
+    out = topk_batch(dev_batch, ["a"], 10)
+    assert out.num_rows == 10 and out.is_host
+    # Candidate blow-up (constant prefix, cap forced tiny): the full
+    # device sort serves the query -> device-resident result.
+    const = pa.table({
+        "a": np.zeros(n, dtype=np.int64),
+        "v": rng.random(n),
+    })
+    dev_const = columnar.from_arrow(const, device=True)
+    old_cap = sort_mod.TOPK_CANDIDATE_CAP
+    sort_mod.TOPK_CANDIDATE_CAP = 64
+    try:
+        out2 = topk_batch(dev_const, ["a", "v"], 10)
+    finally:
+        sort_mod.TOPK_CANDIDATE_CAP = old_cap
+    assert out2.num_rows == 10 and not out2.is_host
+
+
 def test_hashed_group_phase_matches_exact():
     """Wide (>=5-lane) groupings route through the u64 hash-lane sort;
     aggregation results must be identical to the exact full-lane sort

@@ -520,6 +520,12 @@ def main():
         import jax
         log(f"devices: {jax.devices()}")
         import pyarrow.parquet as pq
+        from hyperspace_tpu import telemetry
+        # Span tracing across the whole ladder: queries, operators,
+        # fusion stages, maintenance actions, and link transfers on
+        # their real threads. Exported when BENCH_TRACE_OUT names a
+        # path; the bounded ring costs nothing measurable either way.
+        telemetry.enable_tracing()
         probe = link_probe()
         left, right = make_tables()
         os.makedirs(os.path.join(work, "left"))
@@ -612,7 +618,16 @@ def main():
                                      full5 / inc5, 3)},
             },
             "phase_medians_s": dict(MEDIANS),
+            # Process-lifetime aggregates over the WHOLE ladder: action
+            # reports (create/refresh/optimize counts, rows/bytes),
+            # fusion stage stats, link-transfer totals, mesh dispatches.
+            "process_metrics": telemetry.get_registry().counters_dict(),
         }
+        trace_out = os.environ.get("BENCH_TRACE_OUT")
+        if trace_out:
+            result["trace"] = telemetry.export_trace(trace_out)
+            log(f"trace: {result['trace']['events']} events -> "
+                f"{trace_out}")
         print(json.dumps(result))
     finally:
         shutil.rmtree(work, ignore_errors=True)

@@ -21,6 +21,9 @@ _LAZY = {
     "DataFrame": ("hyperspace_tpu.engine.dataframe", "DataFrame"),
     "col": ("hyperspace_tpu.plan.expr", "col"),
     "lit": ("hyperspace_tpu.plan.expr", "lit"),
+    # the observability surface: `hs.telemetry.enable_tracing()`,
+    # `hs.telemetry.export_trace(path)`, `hs.telemetry.get_registry()`
+    "telemetry": ("hyperspace_tpu.telemetry", None),
 }
 
 
@@ -30,11 +33,11 @@ def __getattr__(name):
         raise AttributeError(f"module 'hyperspace_tpu' has no attribute {name!r}")
     import importlib
     module = importlib.import_module(target[0])
-    value = getattr(module, target[1])
+    value = getattr(module, target[1]) if target[1] is not None else module
     globals()[name] = value
     return value
 
 
 __all__ = ["HyperspaceException", "HyperspaceConf", "IndexConfig",
            "Hyperspace", "HyperspaceSession", "DataFrame", "col", "lit",
-           "__version__"]
+           "telemetry", "__version__"]

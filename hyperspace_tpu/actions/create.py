@@ -161,10 +161,13 @@ class CreateActionBase(Action):
         parquet under `path`.
         """
         from hyperspace_tpu.io.builder import write_index
-        write_index(df, list(index_config.indexed_columns),
-                    list(index_config.included_columns),
-                    self.num_buckets(), path, conf=self.conf,
-                    lineage_ids=self.lineage_id_map(df))
+        written = write_index(df, list(index_config.indexed_columns),
+                              list(index_config.included_columns),
+                              self.num_buckets(), path, conf=self.conf,
+                              lineage_ids=self.lineage_id_map(df))
+        self.annotate_report(files_written=len(written),
+                             num_buckets=self.num_buckets(),
+                             source_files=len(self.source_files(df)))
 
     def stamp_stats(self) -> None:
         """Persist the written index data's on-disk size and row count in
@@ -177,8 +180,12 @@ class CreateActionBase(Action):
         data-writing `op()`, before `end()` serializes the entry."""
         if self._entry is None:
             return
-        self._entry.extra["stats"] = index_data_stats(
-            self._entry.content.root)
+        stats = index_data_stats(self._entry.content.root)
+        self._entry.extra["stats"] = stats
+        # The SAME numbers land in the action report: rows/bytes the
+        # operation left on disk, measured once.
+        self.annotate_report(rows=stats["rowCount"],
+                             bytes=stats["dataSizeBytes"])
 
 
 class CreateAction(CreateActionBase):

@@ -59,7 +59,14 @@ class OptimizeAction(CreateActionBase):
         return IndexLogEntry.from_dict(self._entry.to_dict())
 
     def op(self) -> None:
+        from hyperspace_tpu.io import parquet
         from hyperspace_tpu.io.builder import compact_index
-        compact_index(self.previous_entry, self.data_manager,
-                      self.index_data_path)
+        runs_before = sum(
+            len(files) for files in
+            parquet.bucket_files(self.previous_entry.content.root)
+            .values())
+        written = compact_index(self.previous_entry, self.data_manager,
+                                self.index_data_path)
+        self.annotate_report(runs_compacted=runs_before,
+                             files_written=len(written))
         self.stamp_stats()

@@ -186,6 +186,8 @@ class RefreshIncrementalAction(RefreshAction):
         out_dir = self.index_data_path
         prev_root = self.previous_entry.content.root
         appended, deleted_ids = self.source_delta()
+        self.annotate_report(appended_files=len(appended),
+                             deleted_lineage_ids=len(deleted_ids))
         file_utils.create_directory(out_dir)
         self._carry_previous_runs(out_dir, deleted_ids)
         spec_path = os.path.join(prev_root, parquet.BUCKET_SPEC_FILE)
@@ -210,6 +212,9 @@ class RefreshIncrementalAction(RefreshAction):
             from hyperspace_tpu.io.builder import append_lineage_column
             table = append_lineage_column(table, appended, lineage_ids)
         delta_version = os.path.basename(out_dir).split("=")[-1]
-        write_bucketed_table(table, cfg.indexed_columns, self.num_buckets(),
-                             out_dir, file_suffix=f"delta{delta_version}")
+        written = write_bucketed_table(table, cfg.indexed_columns,
+                                       self.num_buckets(), out_dir,
+                                       file_suffix=f"delta{delta_version}")
+        self.annotate_report(delta_files_written=len(written),
+                             delta_rows=table.num_rows)
         self.stamp_stats()

@@ -40,3 +40,5 @@ class VacuumAction(Action):
         if latest is not None:
             for version in range(latest, -1, -1):
                 self.data_manager.delete(version)
+        self.annotate_report(
+            versions_removed=(latest + 1 if latest is not None else 0))

@@ -185,12 +185,18 @@ class DataFrame:
         from hyperspace_tpu.engine.executor import execute_plan
         from hyperspace_tpu.io.columnar import to_arrow
 
-        metrics = telemetry.QueryMetrics(
-            description=", ".join(self.schema.names[:6]))
-        with telemetry.recording(metrics):
+        description = ", ".join(self.schema.names[:6])
+        metrics = telemetry.QueryMetrics(description=description)
+        with telemetry.recording(metrics), \
+                telemetry.span("query", "query", description=description):
             plan = self._optimized_plan()
             batch = execute_plan(plan, conf=self._conf())
         metrics.finish()
+        # Process-lifetime aggregates next to the per-query recorder.
+        reg = telemetry.get_registry()
+        reg.counter("queries.total").inc()
+        reg.counter("queries.seconds").inc(metrics.wall_s)
+        reg.histogram("query.wall_s").observe(metrics.wall_s)
         if self.session is not None:
             self.session._last_query_metrics = metrics
         table = to_arrow(batch)

@@ -42,6 +42,7 @@ from __future__ import annotations
 import itertools
 import json
 import weakref
+from collections.abc import MutableMapping as _MutableMapping
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -126,7 +127,11 @@ def _to_device(arr):
     if ent is not None and ent[0]() is arr:
         return ent[1]
     import jax
-    out = jax.device_put(arr)
+    # Cache MISSES are exactly the executions that pay the link; the
+    # transfer record (registry histogram + optional span) makes the
+    # promotion cost attributable instead of folded into dispatch_s.
+    with telemetry.link_transfer("h2d", arr.nbytes):
+        out = jax.device_put(arr)
     _evict(_promote_cache, 512)
     try:
         ref = weakref.ref(arr)
@@ -268,18 +273,56 @@ class _StageProgram:
 # out-batch metadata captured at trace time, re-served on executable
 # cache hits (the jit call only returns arrays).
 _OUT_META: Dict[str, tuple] = {}
-# PROCESS-WIDE diagnostics aggregate: stage executions, trace misses,
-# seconds spent dispatching / blocked on the output-sizing sync. Kept for
-# existing consumers (scripts/prof_tpcds.py); per-QUERY attribution of
-# the same quantities lands on the active `telemetry.QueryMetrics`
-# (counters `fusion.*`) so concurrent queries don't smear each other.
-STATS = {"stage_execs": 0, "trace_misses": 0, "sync_s": 0.0,
-         "dispatch_s": 0.0}
+
+
+class _RegistryStats(_MutableMapping):
+    """PROCESS-WIDE diagnostics aggregate — stage executions, trace
+    misses, seconds dispatching / blocked on the output-sizing sync —
+    now BACKED BY the metrics registry (counters `fusion.<key>`): one
+    storage, two views. The dict-shaped surface keeps the existing
+    consumer contract (`scripts/profile_tpcds.py` resets by key and
+    reads after runs); the registry exposes the same numbers to
+    `session.metrics_registry()` and the Prometheus dump. Per-QUERY
+    attribution of the same quantities lands on the active
+    `telemetry.QueryMetrics` (counters `fusion.*`) so concurrent
+    queries don't smear each other."""
+
+    _KEYS = ("stage_execs", "trace_misses", "sync_s", "dispatch_s")
+    _INT_KEYS = ("stage_execs", "trace_misses")
+
+    def _counter(self, key: str):
+        if key not in self._KEYS:
+            raise KeyError(key)
+        return telemetry.get_registry().counter(f"fusion.{key}")
+
+    def __getitem__(self, key):
+        value = self._counter(key).value
+        return int(value) if key in self._INT_KEYS else value
+
+    def __setitem__(self, key, value):
+        self._counter(key).set(float(value))
+
+    def __delitem__(self, key):
+        raise TypeError("fusion.STATS keys are fixed")
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def __repr__(self):
+        return repr(dict(self))
+
+
+STATS = _RegistryStats()
 
 
 def _stat(key: str, value) -> None:
-    """Accumulate into the module aggregate AND the per-query recorder."""
-    STATS[key] += value
+    """THE single mutation path for fusion stage statistics: the
+    process registry (which `STATS` views) AND the per-query recorder,
+    in one place — so the two scopes cannot drift."""
+    telemetry.get_registry().counter(f"fusion.{key}").inc(value)
     if isinstance(value, float):
         telemetry.add_seconds(f"fusion.{key}", value)
     else:
@@ -673,8 +716,11 @@ class FusedStageExec(PhysicalNode):
                         hit=cache_hit, ops=len(_region_nodes(self.root)))
         t0 = _time.perf_counter()
         try:
-            out_tree, lazy_pairs, sel, cnt = _run_stage(prog, trees,
-                                                        table_args)
+            with telemetry.span("fusion:dispatch", "fusion",
+                                ops=len(_region_nodes(self.root)),
+                                cache_hit=cache_hit):
+                out_tree, lazy_pairs, sel, cnt = _run_stage(prog, trees,
+                                                            table_args)
         except _FusionIneligible as exc:
             _INELIGIBLE_KEYS.add(key)
             telemetry.event("fusion", "lane", lane="eager",
@@ -695,7 +741,8 @@ class FusedStageExec(PhysicalNode):
         idx = None
         if sel is not None:
             t0 = _time.perf_counter()
-            count = int(cnt)  # THE stage sync
+            with telemetry.span("fusion:sync", "fusion"):
+                count = int(cnt)  # THE stage sync
             _stat("sync_s", _time.perf_counter() - t0)
             (idx,) = jnp.nonzero(sel, size=count, fill_value=0)
             idx = idx.astype(jnp.int32)

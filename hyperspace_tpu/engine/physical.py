@@ -39,28 +39,43 @@ def _batch_rows(out) -> Optional[int]:
 
 def _instrument(fn, bucketed: bool):
     """Wrap an execute/execute_bucketed implementation with the telemetry
-    operator hook. With no active recorder the cost is one ContextVar
-    read + None check. Applied automatically to every PhysicalNode
-    subclass by `PhysicalNode.__init_subclass__`, so a new operator can
-    never silently execute unmetered (`scripts/check_metrics_coverage.py`
+    operator hook: a per-query operator record (active recorder) and a
+    trace span on the executing thread (active tracer). With neither,
+    the cost is one ContextVar read + one global read + None checks.
+    Applied automatically to every PhysicalNode subclass by
+    `PhysicalNode.__init_subclass__`, so a new operator can never
+    silently execute unmetered (`scripts/check_metrics_coverage.py`
     enforces the marker repo-wide)."""
 
     @functools.wraps(fn)
     def wrapper(self, arg=None):
         rec = telemetry.current()
-        if rec is None:
+        tr = telemetry.tracer()
+        if rec is None and tr is None:
             return fn(self, arg)
-        op = rec.start_operator(self.name, self, bucketed=bucketed)
-        if bucketed:
-            op.detail["num_buckets"] = arg
-        elif arg is not None:
-            op.detail["bucket"] = arg
+        op = None
+        if rec is not None:
+            op = rec.start_operator(self.name, self, bucketed=bucketed)
+            if bucketed:
+                op.detail["num_buckets"] = arg
+            elif arg is not None:
+                op.detail["bucket"] = arg
+        ts = tr.now_us() if tr is not None else 0.0
         try:
             out = fn(self, arg)
         except BaseException as exc:
-            rec.finish_operator(op, error=repr(exc))
+            if tr is not None:
+                tr.complete(self.name, "operator", ts, tr.now_us() - ts,
+                            args={"error": repr(exc)})
+            if op is not None:
+                rec.finish_operator(op, error=repr(exc))
             raise
-        rec.finish_operator(op, rows_out=_batch_rows(out))
+        if tr is not None:
+            rows = _batch_rows(out)
+            tr.complete(self.name, "operator", ts, tr.now_us() - ts,
+                        args=(None if rows is None else {"rows": rows}))
+        if op is not None:
+            rec.finish_operator(op, rows_out=_batch_rows(out))
         return out
 
     wrapper.__telemetry_instrumented__ = True

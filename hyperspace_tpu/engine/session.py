@@ -37,6 +37,16 @@ class HyperspaceSession:
         last."""
         return self._last_query_metrics
 
+    def metrics_registry(self):
+        """The PROCESS-WIDE metrics registry: counters, gauges, and
+        log-bucketed histograms aggregating across every query, session,
+        and index-maintenance action since process start (fusion stage
+        stats, link-transfer bytes/seconds, action-report counters,
+        mesh dispatch stats). One registry per process — sessions share
+        it; `registry.to_text()` is the Prometheus scrape payload."""
+        from hyperspace_tpu import telemetry
+        return telemetry.get_registry()
+
     # -- data sources -----------------------------------------------------
 
     def read_parquet(self, *paths: str, schema: Optional[Schema] = None):

@@ -83,6 +83,20 @@ class Hyperspace:
         """Catalog as a pandas DataFrame (reference `Hyperspace.scala:33-36`)."""
         return self._manager.indexes_df()
 
+    # -- observability ----------------------------------------------------
+
+    def metrics_registry(self):
+        """The process-wide metrics registry (delegates to the
+        session; see `HyperspaceSession.metrics_registry`)."""
+        return self.session.metrics_registry()
+
+    def export_trace(self, path: str) -> dict:
+        """Export collected spans as Chrome trace-event JSON (requires
+        a prior `telemetry.enable_tracing()`); loads in
+        chrome://tracing and ui.perfetto.dev."""
+        from hyperspace_tpu import telemetry
+        return telemetry.export_trace(path)
+
     def explain(self, df, verbose: bool = False, redirect=None,
                 metrics=None) -> None:
         """Plan diff with rules on vs off (reference

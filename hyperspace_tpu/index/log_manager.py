@@ -47,6 +47,18 @@ class IndexLogManager(ABC):
     @abstractmethod
     def write_log(self, log_id: int, entry: LogEntry) -> bool: ...
 
+    # Action reports (observability sidecar, not part of the OCC
+    # protocol): default no-ops so in-memory/test managers need not
+    # care. `get_latest_id` only parses all-digit filenames, so the
+    # `<id>.report.json` sidecars never perturb log-id resolution.
+
+    def write_action_report(self, log_id: int, report: dict) -> bool:
+        """Persist a structured action report next to log `<log_id>`."""
+        return False
+
+    def get_action_report(self, log_id: int) -> Optional[dict]:
+        return None
+
 
 class IndexLogManagerImpl(IndexLogManager):
     """Filesystem-backed impl (reference `index/IndexLogManager.scala:56-157`).
@@ -150,3 +162,29 @@ class IndexLogManagerImpl(IndexLogManager):
         return file_utils.atomic_write_if_absent(
             self._path_for(log_id), entry.to_json(indent=2),
             single_writer=self._single_writer())
+
+    # -- action reports ---------------------------------------------------
+
+    ACTION_REPORT_SUFFIX = ".report.json"
+
+    def _report_path(self, log_id: int) -> str:
+        return os.path.join(self.log_dir,
+                            f"{log_id}{self.ACTION_REPORT_SUFFIX}")
+
+    def write_action_report(self, log_id: int, report: dict) -> bool:
+        """Persist the action report alongside the log entry it
+        finalized. Best-effort: the log entry is already durable, a
+        failed report write must not fail the action."""
+        try:
+            file_utils.create_file(
+                self._report_path(log_id),
+                json.dumps(report, indent=2, default=str))
+            return True
+        except OSError:
+            return False
+
+    def get_action_report(self, log_id: int) -> Optional[dict]:
+        path = self._report_path(log_id)
+        if not file_utils.exists(path):
+            return None
+        return json.loads(file_utils.read_contents(path))

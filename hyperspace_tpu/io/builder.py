@@ -58,12 +58,22 @@ def _write_sorted_runs(table, perm_chunks, starts, ends, path: str,
                 pass  # best-effort prefetch only
     starts, ends = np.asarray(starts), np.asarray(ends)
     written: List[str] = []
+    from hyperspace_tpu import telemetry
     from hyperspace_tpu.utils import file_utils
     file_utils.create_directory(path)
     multi = len(perm_chunks) > 1
     offset = 0
     for ci, chunk in enumerate(perm_chunks):
-        perm_np = np.asarray(chunk)
+        if not isinstance(chunk, np.ndarray):
+            # Device-resident permutation chunk: this np.asarray IS the
+            # D2H link crossing (the async prefetch above may have
+            # already landed it — the histogram then shows a near-zero
+            # wall for the same bytes, which is the overlap working).
+            with telemetry.link_transfer("d2h",
+                                         getattr(chunk, "nbytes", 0)):
+                perm_np = np.asarray(chunk)
+        else:
+            perm_np = chunk
         m = len(perm_np)
         if m == 0:
             continue
@@ -170,6 +180,7 @@ def _stage_key_tree(table, names: Sequence[str]):
             vals = chunk.to_numpy(zero_copy_only=False)
             if len(vals) and vals.min() >= 0 and vals.max() < 1 << 32:
                 lo = vals.astype(np.uint32)
+                from hyperspace_tpu import telemetry
                 from hyperspace_tpu.ops.build import (LINK_CHUNK_ROWS,
                                                       LINK_CHUNKS)
                 if len(lo) >= LINK_CHUNK_ROWS:
@@ -177,10 +188,12 @@ def _stage_key_tree(table, names: Sequence[str]):
                     # on the tunneled link; the program concatenates.
                     import jax
                     parts = np.array_split(lo, LINK_CHUNKS)
-                    tree[name] = {"lo32_chunks": tuple(
-                        jax.device_put(p) for p in parts)}
+                    with telemetry.link_transfer("h2d", lo.nbytes):
+                        tree[name] = {"lo32_chunks": tuple(
+                            jax.device_put(p) for p in parts)}
                 else:
-                    tree[name] = {"lo32": jnp.asarray(lo)}
+                    with telemetry.link_transfer("h2d", lo.nbytes):
+                        tree[name] = {"lo32": jnp.asarray(lo)}
                 continue
         wide.append(name)
     if wide:

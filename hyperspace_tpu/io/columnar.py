@@ -256,9 +256,25 @@ def from_arrow(table, schema: Optional[Schema] = None,
     columns in host memory (numpy) for the adaptive host lane — small
     batches where a device round-trip would dominate the work."""
     if device:
+        import time as _time
+
         import jax.numpy as jnp
-        _asarray = jnp.asarray
+
+        # THE scan-side H2D site: decoded host columns become device
+        # arrays here. Staged bytes/dispatch-wall accumulate across the
+        # batch's columns and land in the link histograms as one
+        # transfer record (`telemetry.record_link_transfer`).
+        _staged = {"bytes": 0, "s": 0.0}
+
+        def _asarray(arr):
+            arr = np.asarray(arr)
+            t0 = _time.perf_counter()
+            out = jnp.asarray(arr)
+            _staged["s"] += _time.perf_counter() - t0
+            _staged["bytes"] += arr.nbytes
+            return out
     else:
+        _staged = None
         _asarray = np.asarray
 
     if schema is None:
@@ -292,6 +308,10 @@ def from_arrow(table, schema: Optional[Schema] = None,
             columns[f.name] = DeviceColumn(
                 data=_asarray(np_vals), dtype=f.dtype,
                 validity=(_asarray(mask) if has_nulls else None))
+    if _staged is not None and _staged["bytes"]:
+        from hyperspace_tpu import telemetry
+        telemetry.record_link_transfer("h2d", _staged["bytes"],
+                                       _staged["s"])
     return ColumnBatch(schema, columns)
 
 
@@ -312,12 +332,24 @@ def to_arrow(batch: ColumnBatch):
                 except Exception:
                     pass  # best-effort prefetch only
 
+    import time as _time
+
     arrays = []
     names = []
+    d2h_bytes = 0
+    d2h_s = 0.0
     for f in batch.schema.fields:
         col = batch.columns[f.name]
+        # Result-side D2H: device arrays cross the link in these
+        # np.asarray calls (the async prefetch above may already have
+        # landed them — near-zero wall for the same bytes = overlap).
+        t0 = _time.perf_counter()
         data = np.asarray(col.data)
         validity = np.asarray(col.validity) if col.validity is not None else None
+        if not isinstance(col.data, np.ndarray):
+            d2h_s += _time.perf_counter() - t0
+            d2h_bytes += data.nbytes + (validity.nbytes
+                                        if validity is not None else 0)
         if col.is_string:
             values = col.dictionary[data]
             arr = pa.array(values, type=pa.string(),
@@ -337,6 +369,9 @@ def to_arrow(batch: ColumnBatch):
                                mask=(~validity if validity is not None else None))
         arrays.append(arr)
         names.append(f.name)
+    if d2h_bytes:
+        from hyperspace_tpu import telemetry
+        telemetry.record_link_transfer("d2h", d2h_bytes, d2h_s)
     return pa.table(dict(zip(names, arrays)))
 
 

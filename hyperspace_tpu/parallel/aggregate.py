@@ -135,14 +135,30 @@ def distributed_group_aggregate(batch: ColumnBatch,
                                 out_schema: Schema, mesh) -> ColumnBatch:
     """SPMD partial aggregation over the mesh + host combine. Requires at
     least one group column (global aggregates are cheap single-chip)."""
-    import jax.numpy as jnp
-
-    from hyperspace_tpu.ops.keys import column_sort_lanes
-
     if not group_columns:
         raise HyperspaceException(
             "distributed aggregation requires group columns")
+    from hyperspace_tpu import telemetry
     n_shards = total_shards(mesh)
+    reg = telemetry.get_registry()
+    reg.counter("mesh.aggregate.execs").inc()
+    telemetry.event("mesh", "aggregate", shards=n_shards,
+                    rows=batch.num_rows, groups=len(group_columns))
+    with telemetry.span("mesh:aggregate", "mesh", rows=batch.num_rows,
+                        shards=n_shards):
+        return _distributed_group_aggregate(
+            batch, group_columns, aggregates, out_schema, mesh, n_shards,
+            reg)
+
+
+def _distributed_group_aggregate(batch, group_columns, aggregates,
+                                 out_schema, mesh, n_shards, reg):
+    import jax.numpy as jnp
+    import time as _time
+
+    from hyperspace_tpu import telemetry
+    from hyperspace_tpu.ops.keys import column_sort_lanes
+
     sharded, row_valid = shard_batch(batch, mesh)
 
     tree = {"valid": row_valid}
@@ -171,8 +187,14 @@ def distributed_group_aggregate(batch: ColumnBatch,
         step = make_partial_step(mesh, len(lane_cols), tuple(specs_meta),
                                  capacity)
         out = step(tree)
-        if int(np.asarray(out["overflow"]).sum()) == 0:
+        t0 = _time.perf_counter()
+        overflowed = int(np.asarray(out["overflow"]).sum())  # host sync
+        sync_s = _time.perf_counter() - t0
+        reg.counter("mesh.aggregate.sync_s").inc(sync_s)
+        telemetry.add_seconds("mesh.sync_s", sync_s)
+        if overflowed == 0:
             break
+        reg.counter("mesh.aggregate.overflow_retries").inc()
         capacity *= 2  # exact recovery: rerun wider
 
     return _combine_partials(batch, out, group_columns, aggregates,

@@ -203,9 +203,12 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
     (XLA moves the host arrays to the right chips). Exact overflow recovery:
     if any shard overflowed its per-peer capacity, retry with 2x capacity.
     """
+    import time as _time
+
     import jax
     import jax.numpy as jnp
 
+    from hyperspace_tpu import telemetry
     from hyperspace_tpu.parallel.mesh import total_shards
 
     n_shards = total_shards(mesh)
@@ -213,6 +216,10 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
     n = batch.num_rows
     local = -(-n // n_shards)  # ceil
     padded = local * n_shards
+
+    tracer = telemetry.tracer()
+    reg = telemetry.get_registry()
+    span_ts = tracer.now_us() if tracer is not None else 0.0
 
     tree, aux = batch_to_tree(batch)
     # Pad rows to a multiple of the shard count; padding rows are invalid.
@@ -240,10 +247,20 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
     while True:
         step = make_distributed_build_step(mesh, key_names, num_buckets,
                                            factor)
-        out = step(in_tree)
-        overflow = int(jnp.sum(out["__overflow__"]["data"]))
+        t0 = _time.perf_counter()
+        with telemetry.span("mesh:build:dispatch", "mesh",
+                            shards=n_shards, rows=n):
+            out = step(in_tree)
+        reg.counter("mesh.build.dispatch_s").inc(
+            _time.perf_counter() - t0)
+        t0 = _time.perf_counter()
+        overflow = int(jnp.sum(out["__overflow__"]["data"]))  # host sync
+        sync_s = _time.perf_counter() - t0
+        reg.counter("mesh.build.sync_s").inc(sync_s)
+        telemetry.add_seconds("mesh.sync_s", sync_s)
         if overflow == 0:
             break
+        reg.counter("mesh.build.overflow_retries").inc()
         factor *= 2  # exact recovery: nothing was lost, rerun wider
 
     result_tree = {}
@@ -272,4 +289,17 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
         num_segments=num_buckets + 1))[:num_buckets].astype(np.int64)
     total = int(lengths.sum())
     final = full.take(order[:total])
+    # Per-device attribution: flat shard s owns every bucket with
+    # b % n_shards == s, so the length vector yields each chip's row
+    # load exactly — the histogram + device-track spans are where
+    # multi-chip skew becomes visible.
+    shard_rows = [int(lengths[s::n_shards].sum()) for s in range(n_shards)]
+    for rows in shard_rows:
+        reg.histogram("mesh.build.shard_rows").observe(rows)
+    reg.counter("mesh.build.execs").inc()
+    telemetry.event("mesh", "build", shards=n_shards, rows=n,
+                    buckets=num_buckets, shard_rows=shard_rows)
+    if tracer is not None:
+        tracer.device_spans("build", span_ts, shard_rows,
+                            buckets=num_buckets)
     return final, lengths

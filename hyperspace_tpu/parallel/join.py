@@ -208,13 +208,18 @@ def _sharded_inputs(left, right, l_lengths, r_lengths, left_keys,
     lanes, pad mask, null mask, plus the [S, Cl]/[S, Cr] row-index plans
     (load-balanced, hot buckets split per `shard_plan`). Everything is
     gathered host-side from the 1-D lanes and device_put with the
-    sharded spec — per-device bytes ~ T, not total rows."""
+    sharded spec — per-device bytes ~ T, not total rows. Also returns
+    the per-shard assigned row counts (the load-balance attribution the
+    mesh telemetry reports)."""
     import jax
+
+    from hyperspace_tpu import telemetry
 
     n_shards = total_shards(mesh)
     l_lanes, r_lanes, l_ok, r_ok = _side_lanes(left, right, left_keys,
                                                right_keys)
     l_rows, r_rows = shard_plan(l_lengths, r_lengths, n_shards, split)
+    shard_assigned = [len(lr) + len(rr) for lr, rr in zip(l_rows, r_rows)]
     l_idx, l_valid, Cl = _rows_to_layout(l_rows)
     r_idx, r_valid, Cr = _rows_to_layout(r_rows)
 
@@ -230,8 +235,12 @@ def _sharded_inputs(left, right, l_lengths, r_lengths, left_keys,
     # device only its slice.
     sharding = shard_rows(mesh)
     put = partial(jax.device_put, device=sharding)
-    return (tuple(put(x) for x in lanes2d), put(pad), put(null),
-            put(l_idx), put(r_idx), Cl, Cr)
+    nbytes = (sum(x.nbytes for x in lanes2d) + pad.nbytes + null.nbytes
+              + l_idx.nbytes + r_idx.nbytes)
+    with telemetry.link_transfer("h2d", nbytes):
+        staged = (tuple(put(x) for x in lanes2d), put(pad), put(null),
+                  put(l_idx), put(r_idx))
+    return staged + (Cl, Cr, shard_assigned)
 
 
 @partial(__import__("jax").jit, static_argnames=("Cl", "left_outer",
@@ -379,18 +388,40 @@ def distributed_bucketed_join_indices(
         return li, ri
 
     full_outer = how == "full_outer"
-    lanes2d, pad, null, l_idx, r_idx, Cl, Cr = _sharded_inputs(
-        left, right, l_lengths, r_lengths, left_keys, right_keys, mesh,
-        # full_outer's unmatched-right scan needs whole buckets; inner
-        # may partition either side; left_outer must keep every left row
-        # exactly once with its full right set -> split left only.
-        split=("none" if full_outer
-               else ("larger" if how == "inner" else "left")))
-    counts, starts, rights, rstart, pos_s, right_unmatched = \
-        _shard_match_core(lanes2d, pad, null, Cl,
-                          left_outer=how in ("left_outer", "full_outer"),
-                          need_right=full_outer)
-    total = int(jnp.sum(counts))  # the one host sync sizing the output
+    from hyperspace_tpu import telemetry
+    import time as _time
+    tracer = telemetry.tracer()
+    span_ts = tracer.now_us() if tracer is not None else 0.0
+    lanes2d, pad, null, l_idx, r_idx, Cl, Cr, shard_assigned = \
+        _sharded_inputs(
+            left, right, l_lengths, r_lengths, left_keys, right_keys,
+            mesh,
+            # full_outer's unmatched-right scan needs whole buckets;
+            # inner may partition either side; left_outer must keep
+            # every left row exactly once with its full right set ->
+            # split left only.
+            split=("none" if full_outer
+                   else ("larger" if how == "inner" else "left")))
+    with telemetry.span("mesh:join:match", "mesh", how=how,
+                        shards=n_shards):
+        counts, starts, rights, rstart, pos_s, right_unmatched = \
+            _shard_match_core(lanes2d, pad, null, Cl,
+                              left_outer=how in ("left_outer",
+                                                 "full_outer"),
+                              need_right=full_outer)
+        t0 = _time.perf_counter()
+        total = int(jnp.sum(counts))  # the one host sync sizing the output
+        sync_s = _time.perf_counter() - t0
+    reg = telemetry.get_registry()
+    reg.counter("mesh.join.execs").inc()
+    reg.counter("mesh.join.sync_s").inc(sync_s)
+    telemetry.add_seconds("mesh.sync_s", sync_s)
+    for rows in shard_assigned:
+        reg.histogram("mesh.join.shard_rows").observe(rows)
+    telemetry.event("mesh", "join", how=how, shards=n_shards,
+                    pairs=total, shard_rows=shard_assigned)
+    if tracer is not None:
+        tracer.device_spans("join", span_ts, shard_assigned, how=how)
     empty = jnp.zeros(0, dtype=jnp.int32)
     if total == 0:
         li, ri = empty, empty
@@ -434,13 +465,26 @@ def distributed_semi_anti_indices(
     if right.num_rows == 0:
         return (jnp.arange(left.num_rows, dtype=jnp.int32) if anti
                 else jnp.zeros(0, dtype=jnp.int32))
-    lanes2d, pad, null, l_idx, r_idx, Cl, Cr = _sharded_inputs(
-        left, right, l_lengths, r_lengths, left_keys, right_keys, mesh,
-        # Membership: every left row must see its bucket's FULL right
-        # set (anti requires NO match anywhere) -> only left partitions.
-        split="left")
-    counts, _starts, rights, _rstart, pos_s, _ = _shard_match_core(
-        lanes2d, pad, null, Cl, left_outer=True, need_right=False)
+    from hyperspace_tpu import telemetry
+    lanes2d, pad, null, l_idx, r_idx, Cl, Cr, shard_assigned = \
+        _sharded_inputs(
+            left, right, l_lengths, r_lengths, left_keys, right_keys,
+            mesh,
+            # Membership: every left row must see its bucket's FULL
+            # right set (anti requires NO match anywhere) -> only left
+            # partitions.
+            split="left")
+    reg = telemetry.get_registry()
+    reg.counter("mesh.join.execs").inc()
+    for rows in shard_assigned:
+        reg.histogram("mesh.join.shard_rows").observe(rows)
+    telemetry.event("mesh", "join", how=("anti" if anti else "semi"),
+                    shards=n_shards, shard_rows=shard_assigned)
+    with telemetry.span("mesh:join:match", "mesh",
+                        how=("anti" if anti else "semi"),
+                        shards=n_shards):
+        counts, _starts, rights, _rstart, pos_s, _ = _shard_match_core(
+            lanes2d, pad, null, Cl, left_outer=True, need_right=False)
     counts2d = counts.reshape(pos_s.shape)
     is_left = counts2d > 0  # left_outer counting marks exactly left slots
     hit = is_left & ((rights == 0) if anti else (rights > 0))

@@ -63,6 +63,8 @@ def make_mesh(num_devices: Optional[int] = None,
             raise ValueError(
                 f"Requested {num_devices} devices, have {len(devices)}.")
         devices = devices[:num_devices]
+    from hyperspace_tpu import telemetry
+    telemetry.get_registry().gauge("mesh.devices").set(len(devices))
     import numpy as np
     if dcn_size is not None and dcn_size > 1:
         if len(devices) % dcn_size != 0:

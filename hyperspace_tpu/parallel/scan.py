@@ -10,8 +10,12 @@ gather crosses chips.
 
 from __future__ import annotations
 
+import time
 from typing import Dict
 
+import numpy as np
+
+from hyperspace_tpu import telemetry
 from hyperspace_tpu.io.columnar import ColumnBatch, DeviceColumn
 from hyperspace_tpu.parallel.mesh import shard_rows, total_shards
 
@@ -35,16 +39,24 @@ def shard_batch(batch: ColumnBatch, mesh):
                 [arr, jnp.full((pad,) + arr.shape[1:], fill, arr.dtype)])
         return jax.device_put(arr, sharding)
 
-    columns: Dict[str, DeviceColumn] = {}
-    for name, col in batch.columns.items():
-        columns[name] = DeviceColumn(
-            data=place(col.data, 0),
-            dtype=col.dtype,
-            validity=(place(col.validity, False)
-                      if col.validity is not None else None),
-            dictionary=col.dictionary,
-            dict_hashes=col.dict_hashes)
-    row_valid = place(jnp.ones(n, dtype=bool), False)
+    # Host-resident columns pay the device link on placement; device
+    # columns only re-lay out. Record the former so mesh staging shows
+    # up in the link histograms next to the fusion promotions.
+    host_bytes = sum(
+        col.data.nbytes for col in batch.columns.values()
+        if isinstance(col.data, np.ndarray))
+    with telemetry.link_transfer("h2d", host_bytes) \
+            if host_bytes else telemetry.span("mesh:place", "mesh"):
+        columns: Dict[str, DeviceColumn] = {}
+        for name, col in batch.columns.items():
+            columns[name] = DeviceColumn(
+                data=place(col.data, 0),
+                dtype=col.dtype,
+                validity=(place(col.validity, False)
+                          if col.validity is not None else None),
+                dictionary=col.dictionary,
+                dict_hashes=col.dict_hashes)
+        row_valid = place(jnp.ones(n, dtype=bool), False)
     return ColumnBatch(batch.schema, columns), row_valid
 
 
@@ -56,8 +68,19 @@ def distributed_filter(batch: ColumnBatch, expression, mesh) -> ColumnBatch:
 
     from hyperspace_tpu.engine.compiler import compile_predicate
 
-    sharded, row_valid = shard_batch(batch, mesh)
-    mask = compile_predicate(expression, sharded) & row_valid
-    count = int(jnp.sum(mask))  # host sync — sizes the output
-    (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
-    return sharded.take(indices)
+    n_shards = total_shards(mesh)
+    reg = telemetry.get_registry()
+    with telemetry.span("mesh:filter", "mesh", rows=batch.num_rows,
+                        shards=n_shards):
+        sharded, row_valid = shard_batch(batch, mesh)
+        mask = compile_predicate(expression, sharded) & row_valid
+        t0 = time.perf_counter()
+        count = int(jnp.sum(mask))  # host sync — sizes the output
+        sync_s = time.perf_counter() - t0
+        reg.counter("mesh.filter.execs").inc()
+        reg.counter("mesh.filter.sync_s").inc(sync_s)
+        telemetry.add_seconds("mesh.sync_s", sync_s)
+        telemetry.event("mesh", "filter", shards=n_shards,
+                        rows=batch.num_rows, selected=count)
+        (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
+        return sharded.take(indices)

@@ -28,6 +28,15 @@ next to the result; `session.last_query_metrics()` returns the most
 recent one; `to_json()` / `format_tree()` render reports, and
 `PlanAnalyzer.explain_string(..., metrics=...)` places the runtime
 numbers next to the plan diff.
+
+Process-wide observability rides in two sibling modules re-exported
+here: `registry` (named counters/gauges/log-bucketed histograms
+aggregating across queries and sessions + the structured action-report
+ring; Prometheus text dump) and `trace` (span tracer with Chrome
+trace-event / Perfetto export — `enable_tracing()` then
+`export_trace(path)`; spans cover queries, operators, fusion stages,
+maintenance-action phases, mesh dispatches, and H2D/D2H link
+transfers on their real threads).
 """
 
 from __future__ import annotations
@@ -40,9 +49,20 @@ import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+from hyperspace_tpu.telemetry.registry import (MetricsRegistry,
+                                               get_registry)
+from hyperspace_tpu.telemetry.trace import (Tracer, disable_tracing,
+                                            enable_tracing, export_trace,
+                                            link_transfer,
+                                            record_link_transfer, span,
+                                            tracer, tracing_enabled)
+
 __all__ = [
     "QueryMetrics", "OperatorRecord", "current", "recording",
     "propagating", "event", "annotate", "add_seconds", "add_count",
+    "MetricsRegistry", "get_registry", "Tracer", "enable_tracing",
+    "disable_tracing", "tracing_enabled", "tracer", "span",
+    "link_transfer", "record_link_transfer", "export_trace",
 ]
 
 

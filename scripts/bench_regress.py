@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Pre-merge perf gate: diff the newest BENCH_*.json artifact against
+the previous one and exit nonzero on a >15% regression in any rung's
+`vs_baseline` ratio (or the headline ratio).
+
+  python scripts/bench_regress.py                 # newest two BENCH_r*.json
+  python scripts/bench_regress.py OLD.json NEW.json
+  python scripts/bench_regress.py --threshold 0.10 --glob 'BENCH_r*.json'
+
+Artifacts are the driver-wrapped form ({"parsed": {...}}) or the raw
+bench.py output ({"rungs": {...}}); both load. Rungs present in only
+one artifact are reported but never gate (a new rung has no baseline;
+a removed rung is a review question, not a perf fact). The 15%
+default leaves headroom for the shared tunneled link's ~2x
+time-of-day wobble on sub-ratios that sit near 1 (see `link_probe` in
+bench_common.py) while still catching real order-of-magnitude cliffs;
+artifacts carry the probe so a borderline failure can be attributed
+to link vs code before overriding the gate.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        raise SystemExit(f"{path}: not a bench artifact object")
+    return doc
+
+
+def _round_key(path: str):
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return (m is None, int(m.group(1)) if m else 0, path)
+
+
+def pick_latest_two(pattern: str):
+    paths = sorted(glob.glob(os.path.join(REPO_ROOT, pattern)),
+                   key=_round_key)
+    if len(paths) < 2:
+        raise SystemExit(
+            f"need at least two artifacts matching {pattern!r}; "
+            f"found {len(paths)}")
+    return paths[-2], paths[-1]
+
+
+def compare(old: dict, new: dict, threshold: float):
+    """[(name, old_ratio, new_ratio, change, gated)] for every
+    comparable vs_baseline, headline first."""
+    rows = []
+
+    def add(name, old_v, new_v):
+        if not (isinstance(old_v, (int, float))
+                and isinstance(new_v, (int, float)) and old_v > 0):
+            return
+        change = new_v / old_v - 1.0
+        rows.append((name, old_v, new_v, change, change < -threshold))
+
+    add("headline", old.get("vs_baseline"), new.get("vs_baseline"))
+    old_rungs = old.get("rungs") or {}
+    new_rungs = new.get("rungs") or {}
+    for rung in sorted(set(old_rungs) | set(new_rungs)):
+        o, n = old_rungs.get(rung), new_rungs.get(rung)
+        if o is None or n is None:
+            rows.append((rung, (o or {}).get("vs_baseline"),
+                         (n or {}).get("vs_baseline"), None, False))
+            continue
+        add(rung, o.get("vs_baseline"), n.get("vs_baseline"))
+    return rows
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("artifacts", nargs="*",
+                    help="explicit OLD NEW artifact paths")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="max tolerated vs_baseline drop (default 0.15)")
+    ap.add_argument("--glob", default="BENCH_r*.json",
+                    help="artifact family when paths are not given")
+    args = ap.parse_args()
+
+    if len(args.artifacts) == 2:
+        old_path, new_path = args.artifacts
+    elif not args.artifacts:
+        old_path, new_path = pick_latest_two(args.glob)
+    else:
+        ap.error("pass exactly two artifact paths, or none for auto")
+
+    old = load_artifact(old_path)
+    new = load_artifact(new_path)
+    rows = compare(old, new, args.threshold)
+
+    print(f"bench_regress: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)} "
+          f"(gate: vs_baseline drop > {args.threshold:.0%})")
+    regressions = []
+    for name, old_v, new_v, change, gated in rows:
+        if change is None:
+            print(f"  {name:18s} {old_v!s:>9} -> {new_v!s:>9}   "
+                  "(not in both artifacts; not gated)")
+            continue
+        flag = "REGRESSION" if gated else "ok"
+        print(f"  {name:18s} {old_v:9.3f} -> {new_v:9.3f}   "
+              f"{change:+7.1%}  {flag}")
+        if gated:
+            regressions.append(name)
+    if regressions:
+        print(f"bench_regress: FAILED — {len(regressions)} rung(s) "
+              f"regressed >{args.threshold:.0%}: "
+              + ", ".join(regressions), file=sys.stderr)
+        return 1
+    print("bench_regress: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

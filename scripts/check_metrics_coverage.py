@@ -1,14 +1,18 @@
 #!/usr/bin/env python
-"""Lint: every PhysicalNode subclass must emit operator metrics records.
+"""Lint: every PhysicalNode subclass must emit operator metrics
+records, and every Action subclass must emit an action report.
 
 `PhysicalNode.__init_subclass__` (engine/physical.py) wraps each
 subclass's `execute` / `execute_bucketed` with the telemetry operator
-hook and stamps the wrapper with `__telemetry_instrumented__`. This
-check imports EVERY module under `hyperspace_tpu`, walks the live
-subclass tree, and fails if any subclass resolves either entry point to
-an unstamped callable — i.e. an operator that could execute without a
-metrics record (assigned after class creation, shadowed by a plain
-function, or otherwise routed around the instrumentation).
+hook and stamps the wrapper with `__telemetry_instrumented__`;
+`Action.__init_subclass__` (actions/base.py) does the same for `run`
+with `__action_report_instrumented__`. This check imports EVERY module
+under `hyperspace_tpu`, walks both live subclass trees, and fails if
+any subclass resolves an entry point to an unstamped callable — i.e.
+an operator that could execute without a metrics record, or an index
+maintenance action that could run without emitting its structured
+report (assigned after class creation, shadowed by a plain function,
+or otherwise routed around the instrumentation).
 
 Runs in the tier-1 flow via `tests/test_telemetry.py`; also runnable
 standalone:  python scripts/check_metrics_coverage.py
@@ -61,6 +65,21 @@ def main() -> int:
                     f"{cls.__module__}.{cls.__name__}.{attr} executes "
                     "without emitting a telemetry operator record")
 
+    # Mirror check for index-maintenance actions: run() must resolve to
+    # the report-instrumented wrapper on every subclass.
+    from hyperspace_tpu.actions.base import Action
+
+    checked_actions = 0
+    for cls in sorted(set(_all_subclasses(Action)),
+                      key=lambda c: (c.__module__, c.__name__)):
+        checked_actions += 1
+        fn = getattr(cls, "run", None)
+        if fn is None or not getattr(fn, "__action_report_instrumented__",
+                                     False):
+            failures.append(
+                f"{cls.__module__}.{cls.__name__}.run can execute "
+                "without emitting an action report")
+
     if import_errors:
         print("check_metrics_coverage: module import failures "
               "(coverage cannot be proven):", file=sys.stderr)
@@ -73,7 +92,8 @@ def main() -> int:
     if failures or import_errors:
         return 1
     print(f"check_metrics_coverage: OK "
-          f"({checked} PhysicalNode subclasses instrumented)")
+          f"({checked} PhysicalNode subclasses and {checked_actions} "
+          f"Action subclasses instrumented)")
     return 0
 
 

@@ -181,8 +181,9 @@ def test_distributed_join_memory_is_sharded(mesh):
     right = make_batch(2000, seed=13, with_strings=False)
     lb, ll = distributed_build(left, ["k"], 16, mesh)
     rb, rl = distributed_build(right, ["k"], 16, mesh)
-    lanes2d, pad, null, l_idx, r_idx, Cl, Cr = _sharded_inputs(
+    lanes2d, pad, null, l_idx, r_idx, Cl, Cr, shard_rows = _sharded_inputs(
         lb, rb, ll, rl, ["k"], ["k"], mesh)
+    assert len(shard_rows) == 8 and sum(shard_rows) >= lb.num_rows
     for arr in (*lanes2d, pad, null, l_idx, r_idx):
         shards = arr.addressable_shards
         assert len(shards) == 8

@@ -180,8 +180,8 @@ def test_fusion_stats_consumers_and_per_query_scoping(tpch_shaped):
     li = sess.read_parquet(li_dir)
     q = li.filter(col("l_quantity") > lit(10)).select("l_orderkey")
 
-    # The module-global consumer contract (scripts/prof_tpcds.py): reset
-    # by key, read after runs.
+    # The module-global consumer contract (scripts/profile_tpcds.py):
+    # reset by key, read after runs (registry-backed since PR 2).
     for k in fusion.STATS:
         fusion.STATS[k] = 0 if isinstance(fusion.STATS[k], int) else 0.0
     _, m1 = q.collect(with_metrics=True)

@@ -622,6 +622,11 @@ def main():
             # reports (create/refresh/optimize counts, rows/bytes),
             # fusion stage stats, link-transfer totals, mesh dispatches.
             "process_metrics": telemetry.get_registry().counters_dict(),
+            # The resource story next to the timings: per-device peak
+            # HBM, per-cache hit/miss/eviction/bytes-held series,
+            # compile trace/cache-hit counts. bench_regress.py gates on
+            # peak_hbm_bytes growing >15% between rounds.
+            "memory": telemetry.memory.artifact_section(),
         }
         trace_out = os.environ.get("BENCH_TRACE_OUT")
         if trace_out:

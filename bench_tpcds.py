@@ -46,6 +46,7 @@ QUERY_FILTER = [q for q in os.environ.get(
 
 
 from bench_common import link_probe, log, timed_runs  # noqa: E402
+from hyperspace_tpu import telemetry  # noqa: E402
 
 
 def best_of(fn, runs=WARM_RUNS, label=""):
@@ -150,6 +151,8 @@ def main():
             "index_build_s": round(index_build_s, 2),
             "link_probe": probe,
             "queries": queries,
+            "process_metrics": telemetry.get_registry().counters_dict(),
+            "memory": telemetry.memory.artifact_section(),
         }))
     finally:
         shutil.rmtree(work, ignore_errors=True)

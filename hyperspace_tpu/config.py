@@ -131,6 +131,20 @@ class HyperspaceConf:
         return int(value) if value is not None else None
 
     @property
+    def fusion_promote_cache_bytes(self) -> int:
+        """Byte budget for the fusion device-promotion cache (host
+        source columns held device-resident between executions); evicts
+        dead-source entries first, then oldest-inserted."""
+        return self.get_int(constants.FUSION_PROMOTE_CACHE_BYTES,
+                            constants.FUSION_PROMOTE_CACHE_BYTES_DEFAULT)
+
+    @property
+    def fusion_bcast_cache_bytes(self) -> int:
+        """Byte budget for the broadcast direct-address table cache."""
+        return self.get_int(constants.FUSION_BCAST_CACHE_BYTES,
+                            constants.FUSION_BCAST_CACHE_BYTES_DEFAULT)
+
+    @property
     def cache_expiry_seconds(self) -> int:
         return self.get_int(
             constants.INDEX_CACHE_EXPIRY_DURATION_SECONDS,

@@ -31,6 +31,19 @@ INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
 READ_CACHE_BYTES_KEY = "spark.hyperspace.cache.read.bytes"
 DEVICE_CACHE_BYTES_KEY = "spark.hyperspace.cache.device.bytes"
 
+# Fusion cache byte budgets: the device-promotion cache (host source
+# columns promoted to device-resident jit arguments, keyed by host-array
+# identity) and the broadcast-table cache (direct-address join tables,
+# keyed by build-column identity) evict dead-source entries first, then
+# oldest-inserted, until held bytes fit the budget. Both hold REAL HBM
+# on device backends — size them against the chip, and read their
+# residency as `cache.fusion_promote.*` / `cache.fusion_bcast.*` in the
+# metrics registry.
+FUSION_PROMOTE_CACHE_BYTES = "spark.hyperspace.fusion.cache.promote.bytes"
+FUSION_PROMOTE_CACHE_BYTES_DEFAULT = 1 * 1024 ** 3
+FUSION_BCAST_CACHE_BYTES = "spark.hyperspace.fusion.cache.broadcast.bytes"
+FUSION_BCAST_CACHE_BYTES_DEFAULT = 256 * 1024 * 1024
+
 # Broadcast-join size threshold in estimated decoded bytes; <= 0 disables
 # (the analog of Spark's `spark.sql.autoBroadcastJoinThreshold`, which
 # the reference leans on for dimension joins and its E2E suite pins to

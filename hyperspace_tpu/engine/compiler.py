@@ -440,12 +440,23 @@ def compile_predicate(expression: E.Expression, batch: ColumnBatch):
 def apply_filter(batch: ColumnBatch, expression: E.Expression) -> ColumnBatch:
     """Filter a batch: fused mask eval + one compaction gather. On the
     device lane the row count is the single host sync (it sizes the
-    result); on the host lane everything is numpy — no device traffic."""
+    result); on the host lane everything is numpy — no device traffic.
+
+    Compile accounting: the expressions compiled here carry no jit
+    entry point of their own — host batches evaluate eagerly in numpy,
+    and device batches either run op-by-op (dispatch cost, no trace) or
+    inside `engine/fusion.py`'s instrumented stage executable, where
+    the `compile.*` counters and retrace events are recorded."""
+    from hyperspace_tpu import telemetry
+
     mask = compile_predicate(expression, batch)
     if isinstance(mask, np.ndarray):
         return batch.take(np.nonzero(mask)[0].astype(np.int32))
     import jax.numpy as jnp
 
     count = int(jnp.sum(mask))  # host sync — sizes the output
+    # The sync is a true span boundary: input + mask + output are all
+    # device-resident here — fold an HBM sample into the watermark.
+    telemetry.memory.maybe_sample()
     (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
     return batch.take(indices)

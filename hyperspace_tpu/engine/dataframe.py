@@ -191,6 +191,18 @@ class DataFrame:
                 telemetry.span("query", "query", description=description):
             plan = self._optimized_plan()
             batch = execute_plan(plan, conf=self._conf())
+            if not batch.is_host:
+                # Query-end HBM watermark, FORCED (throttling may have
+                # swallowed every span-boundary sample of a fast query)
+                # and inside the recording so it attributes here.
+                telemetry.memory.sample()
+            else:
+                import sys as _sys
+                if "jax" in _sys.modules:
+                    # Host result, but intermediates may have ridden the
+                    # device; throttled sample — and never an import of
+                    # jax just to find zero bytes.
+                    telemetry.memory.maybe_sample()
         metrics.finish()
         # Process-lifetime aggregates next to the per-query recorder.
         reg = telemetry.get_registry()

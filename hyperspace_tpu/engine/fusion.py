@@ -103,20 +103,62 @@ def _token_of(obj) -> int:
 # ride the host lane — become device-resident jit arguments ONCE and are
 # re-served by token while the host array lives. Without this every
 # execution re-transfers dimension payloads over the link.
+#
+# Both fusion caches hold REAL device memory, so they evict on a BYTE
+# budget (conf `spark.hyperspace.fusion.cache.{promote,broadcast}.bytes`
+# — the effective values are refreshed from the session conf at each
+# fused execution) and report `cache.fusion_{promote,bcast}.*` series
+# to the metrics registry.
 # ---------------------------------------------------------------------------
 
 _promote_cache: Dict[int, tuple] = {}  # token -> (ref(host src), device)
 
+from hyperspace_tpu import constants as _constants  # noqa: E402
 
-def _evict(cache: dict, cap: int) -> None:
-    """Drop dead-source entries first, then oldest-inserted, to `cap`."""
-    if len(cache) <= cap:
+_promote_budget = [_constants.FUSION_PROMOTE_CACHE_BYTES_DEFAULT]
+_bcast_budget = [_constants.FUSION_BCAST_CACHE_BYTES_DEFAULT]
+
+
+def _configure_cache_budgets(conf) -> None:
+    """Refresh the effective byte budgets from the session conf (the
+    caches are process-wide; sessions sharing a process should agree,
+    same caveat as the parquet cache budgets)."""
+    if conf is None:
         return
-    for k in [k for k, v in cache.items()
-              if isinstance(v, tuple) and callable(v[0]) and v[0]() is None]:
-        cache.pop(k, None)
-    while len(cache) > cap:
-        cache.pop(next(iter(cache)))
+    _promote_budget[0] = conf.fusion_promote_cache_bytes
+    _bcast_budget[0] = conf.fusion_bcast_cache_bytes
+
+
+def _promote_nbytes(ent) -> int:
+    return int(getattr(ent[1], "nbytes", 0))
+
+
+def _promote_dead(ent) -> bool:
+    return ent[0]() is None
+
+
+def _bcast_nbytes(ent) -> int:
+    return int(getattr(ent[0], "nbytes", 0)) if ent is not None else 0
+
+
+def _evict(cache: dict, name: str, budget_bytes: int, nbytes_of,
+           dead=None) -> None:
+    """Byte-budget eviction, run on every insert: sweep dead-source
+    entries FIRST and unconditionally (a GC'd host source must not pin
+    its device buffer until byte pressure — that was a silent HBM
+    leak), then drop oldest-inserted entries until held bytes fit the
+    budget. Residency lands as `cache.<name>.{bytes_held,entries}`."""
+    evicted = 0
+    if dead is not None:
+        for k in [k for k, v in cache.items() if dead(v)]:
+            cache.pop(k, None)
+            evicted += 1
+    total = sum(nbytes_of(v) for v in cache.values())
+    while total > budget_bytes and cache:
+        total -= nbytes_of(cache.pop(next(iter(cache))))
+        evicted += 1
+    telemetry.memory.cache_eviction(name, evicted)
+    telemetry.memory.cache_stats(name, total, len(cache))
 
 
 def _to_device(arr):
@@ -125,19 +167,22 @@ def _to_device(arr):
     tok = _token_of(arr)
     ent = _promote_cache.get(tok)
     if ent is not None and ent[0]() is arr:
+        telemetry.memory.cache_hit("fusion_promote")
         return ent[1]
+    telemetry.memory.cache_miss("fusion_promote")
     import jax
     # Cache MISSES are exactly the executions that pay the link; the
     # transfer record (registry histogram + optional span) makes the
     # promotion cost attributable instead of folded into dispatch_s.
     with telemetry.link_transfer("h2d", arr.nbytes):
         out = jax.device_put(arr)
-    _evict(_promote_cache, 512)
     try:
         ref = weakref.ref(arr)
     except TypeError:
         ref = (lambda o: (lambda: o))(arr)
     _promote_cache[tok] = (ref, out)
+    _evict(_promote_cache, "fusion_promote", _promote_budget[0],
+           _promote_nbytes, dead=_promote_dead)
     return out
 
 
@@ -182,7 +227,9 @@ def _prepare_broadcast(node, build_batch: ColumnBatch):
         return None
     ck = (membership, tuple(k.lower() for k in keys), tuple(ident))
     if ck in _bcast_cache:
+        telemetry.memory.cache_hit("fusion_bcast")
         return _bcast_cache[ck]
+    telemetry.memory.cache_miss("fusion_bcast")
     from hyperspace_tpu.ops.broadcast_join import (build_broadcast_table,
                                                    build_membership_table)
     builder = build_membership_table if membership else build_broadcast_table
@@ -191,8 +238,8 @@ def _prepare_broadcast(node, build_batch: ColumnBatch):
         table, mins, ranges = out
         out = (table, tuple(int(m) for m in mins),
                tuple(int(r) for r in ranges))
-    _evict(_bcast_cache, 64)
     _bcast_cache[ck] = out
+    _evict(_bcast_cache, "fusion_bcast", _bcast_budget[0], _bcast_nbytes)
     return out
 
 
@@ -268,6 +315,12 @@ class _StageProgram:
     def __eq__(self, other):
         return (isinstance(other, _StageProgram)
                 and other.key == self.key)
+
+    def __repr__(self):
+        # Stable across instances of the SAME program (the compile
+        # tracker's retrace-cause diff keys on argument reprs; the
+        # default object repr would make every run look like a delta).
+        return f"_StageProgram({hash(self.key) & 0xFFFFFFFF:08x})"
 
 
 # out-batch metadata captured at trace time, re-served on executable
@@ -503,9 +556,10 @@ _run_stage_jit = None
 def _run_stage(prog: _StageProgram, trees, table_args):
     global _run_stage_jit
     if _run_stage_jit is None:
-        import jax
-
-        @partial(jax.jit, static_argnames=("prog",))
+        # instrumented_jit: each actual trace records a compile span,
+        # compile.* counters, and the retrace cause on the query.
+        @partial(telemetry.instrumented_jit, "fusion.run_stage",
+                 static_argnames=("prog",))
         def _run(prog: _StageProgram, trees, table_args):
             import jax.numpy as jnp
 
@@ -555,10 +609,8 @@ def _finalize_lazy(idx, lazy_pairs, srcs, spec):
     compaction (full-length gathers)."""
     global _finalize_lazy_jit
     if _finalize_lazy_jit is None:
-        import jax
-        from functools import partial
-
-        @partial(jax.jit, static_argnames=("spec", "has_idx"))
+        @partial(telemetry.instrumented_jit, "fusion.finalize_lazy",
+                 static_argnames=("spec", "has_idx"))
         def run(idx, lazy_pairs, srcs, spec, has_idx):
             import jax.numpy as jnp
 
@@ -621,6 +673,7 @@ class FusedStageExec(PhysicalNode):
     def execute(self, bucket: Optional[int] = None) -> ColumnBatch:
         if bucket is not None:
             return self.root.execute(bucket)
+        _configure_cache_budgets(self.conf)
         for s in self.sources:
             s._batch = s.node.execute()
         try:
@@ -689,6 +742,8 @@ class FusedStageExec(PhysicalNode):
             # (a jit cache hit never re-runs the traced body that
             # repopulates the metadata). Full reset -> next runs re-trace
             # and re-populate both.
+            telemetry.memory.cache_eviction("fusion_trace",
+                                            len(_OUT_META))
             _OUT_META.clear()
             try:
                 if _run_stage_jit is not None:
@@ -712,6 +767,11 @@ class FusedStageExec(PhysicalNode):
         cache_hit = key in _OUT_META
         if not cache_hit and key not in _INELIGIBLE_KEYS:
             _stat("trace_misses", 1)
+        if cache_hit:
+            telemetry.memory.cache_hit("fusion_trace")
+        else:
+            telemetry.memory.cache_miss("fusion_trace")
+        telemetry.memory.cache_stats("fusion_trace", None, len(_OUT_META))
         telemetry.event("fusion", "trace-cache",
                         hit=cache_hit, ops=len(_region_nodes(self.root)))
         t0 = _time.perf_counter()
@@ -727,6 +787,9 @@ class FusedStageExec(PhysicalNode):
                             trigger=f"trace-ineligible ({exc})")
             return None
         _stat("dispatch_s", _time.perf_counter() - t0)
+        # Span boundary of the stage dispatch: the working set (sources,
+        # broadcast tables, stage outputs) is device-resident here.
+        telemetry.memory.maybe_sample()
         meta = _OUT_META.get(key)
         if meta is None:
             # Executable outlived its evicted metadata (>256 distinct

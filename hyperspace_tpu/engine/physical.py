@@ -76,6 +76,10 @@ def _instrument(fn, bucketed: bool):
                         args=(None if rows is None else {"rows": rows}))
         if op is not None:
             rec.finish_operator(op, rows_out=_batch_rows(out))
+        # Operator-span boundary: fold a device-memory sample into the
+        # per-query HBM watermark (throttled; after the span close so
+        # the accounting walk never inflates the operator's wall).
+        telemetry.memory.maybe_sample()
         return out
 
     wrapper.__telemetry_instrumented__ = True

@@ -97,6 +97,16 @@ class Hyperspace:
         from hyperspace_tpu import telemetry
         return telemetry.export_trace(path)
 
+    def device_memory(self) -> dict:
+        """Snapshot of the device-memory accountant: per-device
+        live/peak HBM bytes and which backend measured them
+        (`memory_stats` on real accelerators, the live-arrays
+        accounting fallback on CPU/virtual meshes). Takes a fresh
+        sample first so the answer is current, not last-span-stale."""
+        from hyperspace_tpu import telemetry
+        telemetry.memory.sample()
+        return telemetry.memory.snapshot()
+
     def explain(self, df, verbose: bool = False, redirect=None,
                 metrics=None) -> None:
         """Plan diff with rules on vs off (reference

@@ -48,10 +48,11 @@ def _fused_take(arrays, indices):
     ColumnBatch.take)."""
     global _fused_take_jit
     if _fused_take_jit is None:
-        import jax
         import jax.numpy as jnp
 
-        @jax.jit
+        from hyperspace_tpu.telemetry import instrumented_jit
+
+        @instrumented_jit("columnar.fused_take")
         def _take_all(arrs, idx):
             return tuple(jnp.take(a, idx, axis=0) for a in arrs)
 

@@ -124,6 +124,8 @@ def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
     when every file is unchanged."""
     import pyarrow as pa
 
+    from hyperspace_tpu.telemetry import memory as _mem
+
     if not paths:
         raise HyperspaceException("No parquet inputs to read.")
     cols = list(columns) if columns else None
@@ -134,7 +136,9 @@ def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
             hit = _read_cache.get(key)
             if hit is not None and hit[0] == stamps:
                 _read_cache.move_to_end(key)  # LRU touch
+                _mem.cache_hit("parquet_read")
                 return hit[1]
+    _mem.cache_miss("parquet_read")
 
     if len(paths) == 1:
         table = _read_one(paths[0], cols)
@@ -154,9 +158,14 @@ def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
         with _read_cache_lock:
             _read_cache[key] = (stamps, table)
             total = sum(t.nbytes for _, t in _read_cache.values())
+            evictions = 0
             while total > READ_CACHE_BYTES and len(_read_cache) > 1:
                 _, (_, evicted) = _read_cache.popitem(last=False)
                 total -= evicted.nbytes
+                evictions += 1
+            entries = len(_read_cache)
+        _mem.cache_eviction("parquet_read", evictions)
+        _mem.cache_stats("parquet_read", total, entries)
     return table
 
 
@@ -219,9 +228,14 @@ def _stamped_batch_read(paths: Sequence[str],
     """ONE stamped-LRU read for both decoded-batch caches (host and
     device): get with stamp validation, decode on miss, insert with
     re-stat (a file rewritten during the read must not cache under the
-    old stamp), evict LRU entries until within budget."""
+    old stamp), evict LRU entries until within budget. Hit/miss/
+    eviction/bytes-held series land as `cache.device_batch.*` /
+    `cache.host_batch.*` — on device backends the device-batch bytes
+    ARE resident HBM, the first number to read in an OOM."""
     from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.telemetry import memory as _mem
 
+    name = "device_batch" if device else "host_batch"
     key = (tuple(paths), tuple(columns) if columns is not None else None,
            schema.to_json() if schema is not None else None)
     # Enforce the effective budget on ENTRY, not only on insert: a budget
@@ -230,22 +244,31 @@ def _stamped_batch_read(paths: Sequence[str],
     # batches, and budget 0 must empty the cache, or the memory being
     # tuned away stays pinned.
     with lock:
+        evictions = 0
         if budget <= 0:
+            evictions = len(cache)
             cache.clear()
+            total = 0
         else:
             total = sum(b for _, _, b in cache.values())
             while total > budget and cache:
                 _, (_, _, evicted) = cache.popitem(last=False)
                 total -= evicted
+                evictions += 1
+        entries = len(cache)
+    _mem.cache_eviction(name, evictions)
+    _mem.cache_stats(name, total, entries)
     stamps = _stamps(paths)
     if stamps is not None and budget > 0:
         with lock:
             hit = cache.get(key)
             if hit is not None and hit[0] == stamps:
                 cache.move_to_end(key)
+                _mem.cache_hit(name)
                 return hit[1]
             if hit is not None:
                 del cache[key]
+    _mem.cache_miss(name)
     table = read_table(paths, columns=columns)
     batch = columnar.from_arrow(table, schema, device=device)
     if stamps is not None and budget > 0:
@@ -256,9 +279,14 @@ def _stamped_batch_read(paths: Sequence[str],
             with lock:
                 cache[key] = (stamps, batch, nbytes)
                 total = sum(b for _, _, b in cache.values())
+                evictions = 0
                 while total > budget and len(cache) > 1:
                     _, (_, _, evicted) = cache.popitem(last=False)
                     total -= evicted
+                    evictions += 1
+                entries = len(cache)
+            _mem.cache_eviction(name, evictions)
+            _mem.cache_stats(name, total, entries)
     return batch
 
 

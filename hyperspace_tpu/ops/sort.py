@@ -134,7 +134,10 @@ def _topk_threshold(prefix, k: int):
         import jax.numpy as jnp
         from functools import partial
 
-        @partial(jax.jit, static_argnames=("k",))
+        from hyperspace_tpu.telemetry import instrumented_jit
+
+        @partial(instrumented_jit, "sort.topk_threshold",
+                 static_argnames=("k",))
         def run(prefix, k):
             (sorted_prefix,) = jax.lax.sort([prefix], num_keys=1)
             thresh = sorted_prefix[k - 1]

@@ -126,7 +126,8 @@ def make_partial_step(mesh, num_lanes: int, specs_meta, capacity: int):
             in_specs=(jax.tree_util.tree_map(lambda _: rows_spec, tree),),
             out_specs=rows_spec, check_vma=False)(tree)
 
-    return jax.jit(step)
+    from hyperspace_tpu.telemetry import instrumented_jit
+    return instrumented_jit("mesh.aggregate_step", step)
 
 
 def distributed_group_aggregate(batch: ColumnBatch,

@@ -190,7 +190,11 @@ def make_distributed_build_step(mesh, key_names: Tuple[str, ...],
                                    check_vma=False)
         return sharded(tree)
 
-    return jax.jit(step)
+    # A fresh jit per call means every dispatch traces; the compile
+    # tracker makes that cost (and any future retrace storm here)
+    # visible as compile.mesh.build_step.traces instead of silent wall.
+    from hyperspace_tpu.telemetry import instrumented_jit
+    return instrumented_jit("mesh.build_step", step)
 
 
 def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],

@@ -29,14 +29,19 @@ recent one; `to_json()` / `format_tree()` render reports, and
 `PlanAnalyzer.explain_string(..., metrics=...)` places the runtime
 numbers next to the plan diff.
 
-Process-wide observability rides in two sibling modules re-exported
-here: `registry` (named counters/gauges/log-bucketed histograms
-aggregating across queries and sessions + the structured action-report
-ring; Prometheus text dump) and `trace` (span tracer with Chrome
-trace-event / Perfetto export — `enable_tracing()` then
-`export_trace(path)`; spans cover queries, operators, fusion stages,
-maintenance-action phases, mesh dispatches, and H2D/D2H link
-transfers on their real threads).
+Process-wide observability rides in sibling modules re-exported here:
+`registry` (named counters/gauges/log-bucketed histograms aggregating
+across queries and sessions + the structured action-report ring;
+Prometheus text dump), `trace` (span tracer with Chrome trace-event /
+Perfetto export — `enable_tracing()` then `export_trace(path)`; spans
+cover queries, operators, fusion stages, maintenance-action phases,
+mesh dispatches, and H2D/D2H link transfers on their real threads),
+`memory` (the device-memory accountant — per-device live/peak HBM
+gauges, per-query `peak_hbm_bytes` watermarks, Perfetto counter
+tracks — plus the byte-aware `cache.<name>.*` instrumentation every
+cache in the system reports through), and `compilation`
+(`instrumented_jit`: compile spans, trace/cache-hit counters, and
+retrace-cause decision events for every jit entry point).
 """
 
 from __future__ import annotations
@@ -56,6 +61,11 @@ from hyperspace_tpu.telemetry.trace import (Tracer, disable_tracing,
                                             link_transfer,
                                             record_link_transfer, span,
                                             tracer, tracing_enabled)
+from hyperspace_tpu.telemetry import memory  # noqa: F401
+from hyperspace_tpu.telemetry import compilation  # noqa: F401
+from hyperspace_tpu.telemetry.compilation import instrumented_jit
+from hyperspace_tpu.telemetry.memory import (DeviceMemoryAccountant,
+                                             get_accountant)
 
 __all__ = [
     "QueryMetrics", "OperatorRecord", "current", "recording",
@@ -63,6 +73,8 @@ __all__ = [
     "MetricsRegistry", "get_registry", "Tracer", "enable_tracing",
     "disable_tracing", "tracing_enabled", "tracer", "span",
     "link_transfer", "record_link_transfer", "export_trace",
+    "memory", "compilation", "instrumented_jit",
+    "DeviceMemoryAccountant", "get_accountant",
 ]
 
 
@@ -138,6 +150,17 @@ def add_count(counter: str, n: int = 1) -> None:
         rec.add_count(counter, n)
 
 
+def _fmt_bytes(n: int) -> str:
+    """Human-readable bytes for report rendering (binary units)."""
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if value < 1024 or unit == "TiB":
+            return (f"{int(value)}{unit}" if unit == "B"
+                    else f"{value:.1f}{unit}")
+        value /= 1024
+    return f"{n}B"
+
+
 class OperatorRecord:
     """One physical operator execution: identity, tree position, wall
     time, and output rows. `rows_out` for device batches is the static
@@ -206,6 +229,13 @@ class QueryMetrics:
         self.operators: List[OperatorRecord] = []
         self.events: List[dict] = []
         self.counters: Dict[str, float] = {}
+        # Peak HBM watermarks observed while this query was recording:
+        # per device, and the peak TOTAL across devices (the headline).
+        # Fed by the device-memory accountant at span boundaries and
+        # link transfers (`telemetry/memory.py`); 0/{} when the query
+        # never touched a device (pure host lane).
+        self.peak_hbm_bytes: int = 0
+        self.peak_hbm_per_device: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._ids = itertools.count()
         self._tls = threading.local()
@@ -279,6 +309,17 @@ class QueryMetrics:
         with self._lock:
             self.counters[counter] = self.counters.get(counter, 0) + n
 
+    def observe_hbm(self, live_bytes_per_device: Dict[str, int]) -> None:
+        """Fold one device-memory sample into this query's peak
+        watermarks (called by the accountant while recording)."""
+        with self._lock:
+            for dev, b in live_bytes_per_device.items():
+                if b > self.peak_hbm_per_device.get(dev, 0):
+                    self.peak_hbm_per_device[dev] = int(b)
+            total = sum(live_bytes_per_device.values())
+            if total > self.peak_hbm_bytes:
+                self.peak_hbm_bytes = int(total)
+
     def finish(self) -> "QueryMetrics":
         self.wall_s = time.perf_counter() - self._t0
         for op in self.operators:
@@ -286,6 +327,21 @@ class QueryMetrics:
         return self
 
     # -- user side (reports) -------------------------------------------
+
+    @property
+    def compile(self) -> dict:
+        """This query's compile story: how many XLA traces it caused,
+        how many jit dispatches were served from the executable cache,
+        and the seconds spent tracing/compiling. A warmed query re-run
+        must show traces == 0 — nonzero here on a repeat run is a
+        retrace, and the `[compile] retrace` events name the
+        shape/dtype delta that caused it."""
+        return {
+            "traces": int(self.counters.get("compile.traces", 0)),
+            "cache_hits": int(self.counters.get("compile.cache_hits", 0)),
+            "seconds": round(
+                float(self.counters.get("compile.seconds", 0.0)), 6),
+        }
 
     def events_of(self, category: str, name: Optional[str] = None
                   ) -> List[dict]:
@@ -349,6 +405,9 @@ class QueryMetrics:
             "counters": {k: (round(v, 6) if isinstance(v, float) else v)
                          for k, v in self.counters.items()},
             "index_usage": self.index_usage(),
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+            "peak_hbm_per_device": dict(self.peak_hbm_per_device),
+            "compile": self.compile,
         }
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -391,6 +450,8 @@ class QueryMetrics:
             "counters": {k: (round(v, 4) if isinstance(v, float) else v)
                          for k, v in self.counters.items()},
             "index_usage": self.index_usage(),
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+            "compile": self.compile,
         }
 
     def format_tree(self) -> str:
@@ -440,6 +501,17 @@ class QueryMetrics:
                 lines.append(f"  {k} = "
                              + (f"{v:.4f}" if isinstance(v, float)
                                 else str(v)))
+        if self.peak_hbm_bytes:
+            per_dev = ", ".join(
+                f"{dev}={_fmt_bytes(b)}"
+                for dev, b in sorted(self.peak_hbm_per_device.items()))
+            lines.append(f"Peak HBM: {_fmt_bytes(self.peak_hbm_bytes)}"
+                         + (f" ({per_dev})" if per_dev else ""))
+        comp = self.compile
+        if comp["traces"] or comp["cache_hits"]:
+            lines.append(f"Compile: {comp['traces']} traces, "
+                         f"{comp['cache_hits']} cache hits, "
+                         f"{comp['seconds']:.4f}s")
         return "\n".join(lines)
 
     def __repr__(self) -> str:

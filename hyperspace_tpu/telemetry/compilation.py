@@ -1,0 +1,203 @@
+"""Compile observability: THE `jax.jit` wrapper for every engine entry
+point.
+
+A retrace storm is invisible in wall-time telemetry — the cost hides
+inside whichever dispatch happened to trace — so every jitted entry
+point in the package routes through `instrumented_jit(name)` instead of
+calling `jax.jit` directly (`scripts/check_metrics_coverage.py` fails
+the build on any direct `jax.jit` call outside this module). Each call
+then records:
+
+- a `compile` span on the executing thread whenever XLA actually traced
+  (its own category — and track — in the Perfetto export), covering
+  trace + lowering + backend compile (the first dispatch is dominated
+  by them);
+- registry counters `compile.{traces,cache_hits,seconds}` plus
+  per-entry-point `compile.<name>.traces`, and the jit executable-cache
+  series `cache.jit.{hits,misses,entries}`;
+- the same counters per-query on the active `QueryMetrics`
+  (`metrics.compile` digests them — re-running an identical query must
+  show ZERO new traces);
+- the retrace CAUSE as a per-query decision event: the shape/dtype
+  signature delta against this entry point's previous trace
+  (`[compile] retrace {"target": ..., "cause": "shape: f64[100] ->
+  f64[200]"}`).
+
+Trace detection uses the one property jit guarantees: the wrapped
+Python body executes exactly when XLA traces (a cache hit never re-runs
+it). The wrapper pushes a per-thread frame, the body marks it, and the
+call site reads the mark after dispatch — nested instrumented jits keep
+their own frames.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Dict, Optional
+
+from hyperspace_tpu.telemetry import registry as _registry
+
+__all__ = ["instrumented_jit", "REGISTRY"]
+
+# name -> instrumented wrapper (the coverage lint audits the stamps).
+REGISTRY: Dict[str, object] = {}
+
+# name -> last traced signature, PROCESS-wide (not per wrapper): entry
+# points that rebuild their jit per call (the mesh step factories) must
+# diff against the previous trace of the same NAME, or every trace
+# would read "first trace" and a fresh-jit retrace storm would hide its
+# cause ("signature unchanged (executable cache dropped)").
+_last_sigs: Dict[str, tuple] = {}
+_sig_lock = threading.Lock()
+
+_tls = threading.local()
+
+
+def _frames() -> list:
+    frames = getattr(_tls, "frames", None)
+    if frames is None:
+        frames = []
+        _tls.frames = frames
+    return frames
+
+
+def _abstract(leaf) -> str:
+    """One signature atom: dtype[shape] for arrays, repr for statics
+    (truncated — stage-program keys can be long)."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(s) for s in shape)}]"
+    r = repr(leaf)
+    return r if len(r) <= 80 else r[:77] + "..."
+
+
+def _signature(args, kwargs):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return str(treedef), tuple(_abstract(l) for l in leaves)
+
+
+def _retrace_cause(prev, sig) -> str:
+    """Human-readable delta between the previous trace's signature and
+    this one's — the 'why did this retrace' answer."""
+    if prev is None:
+        return "first trace"
+    prev_tree, prev_leaves = prev
+    tree, leaves = sig
+    if prev_tree != tree:
+        return "argument structure changed"
+    if len(prev_leaves) != len(leaves):
+        return (f"argument count changed "
+                f"({len(prev_leaves)} -> {len(leaves)})")
+    deltas = [f"{a} -> {b}" for a, b in zip(prev_leaves, leaves)
+              if a != b]
+    if not deltas:
+        # Same abstract signature yet jax re-traced: the executable
+        # cache was dropped (clear_cache / eviction), not a shape delta.
+        return "signature unchanged (executable cache dropped)"
+    shown = "; ".join(deltas[:3])
+    more = f" (+{len(deltas) - 3} more)" if len(deltas) > 3 else ""
+    return f"shape/dtype: {shown}{more}"
+
+
+class _Frame:
+    __slots__ = ("traced",)
+
+    def __init__(self):
+        self.traced = False
+
+
+def instrumented_jit(name: str, fn=None, **jit_kwargs):
+    """`jax.jit` with compile observability. Use exactly like jit:
+
+        run = instrumented_jit("fusion.run_stage",
+                               static_argnames=("prog",))(body)
+
+    The returned callable forwards `clear_cache` and exposes
+    `cache_size()` (the live executable count, where jax provides it).
+    Usable as `instrumented_jit(name, fn)` or as a decorator factory.
+    """
+    if fn is None:
+        return lambda f: instrumented_jit(name, f, **jit_kwargs)
+
+    import jax
+
+    @functools.wraps(fn)
+    def body(*args, **kwargs):
+        frames = _frames()
+        if frames:
+            frames[-1].traced = True
+        return fn(*args, **kwargs)
+
+    jfn = jax.jit(body, **jit_kwargs)
+
+    def cache_size() -> Optional[int]:
+        probe = getattr(jfn, "_cache_size", None)
+        try:
+            return int(probe()) if callable(probe) else None
+        except Exception:
+            return None
+
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        from hyperspace_tpu import telemetry
+
+        frames = _frames()
+        frame = _Frame()
+        frames.append(frame)
+        tracer = telemetry.tracer()
+        ts = tracer.now_us() if tracer is not None else 0.0
+        t0 = time.perf_counter()
+        try:
+            out = jfn(*args, **kwargs)
+        finally:
+            if frames and frames[-1] is frame:
+                frames.pop()
+        elapsed = time.perf_counter() - t0
+        reg = _registry.get_registry()
+        if frame.traced:
+            sig = _signature(args, kwargs)
+            with _sig_lock:
+                cause = _retrace_cause(_last_sigs.get(name), sig)
+                _last_sigs[name] = sig
+            reg.counter("compile.traces").inc()
+            reg.counter("compile.seconds").inc(elapsed)
+            reg.counter(f"compile.{name}.traces").inc()
+            telemetry.memory.cache_miss("jit")
+            entries = cache_size()
+            if entries is not None:
+                reg.gauge(f"cache.jit.{name}.entries").set(entries)
+            telemetry.add_count("compile.traces")
+            telemetry.add_seconds("compile.seconds", elapsed)
+            telemetry.event("compile",
+                            "trace" if cause == "first trace"
+                            else "retrace",
+                            target=name, cause=cause,
+                            seconds=round(elapsed, 4))
+            if tracer is not None:
+                tracer.complete(f"compile {name}", "compile", ts,
+                                elapsed * 1e6,
+                                args={"target": name, "cause": cause})
+        else:
+            reg.counter("compile.cache_hits").inc()
+            telemetry.memory.cache_hit("jit")
+            telemetry.add_count("compile.cache_hits")
+        return out
+
+    call.__compile_span_instrumented__ = True
+    call.__wrapped_jit__ = jfn
+    call.cache_size = cache_size
+    # Drop-in jit surface: forward the introspection/maintenance API so
+    # callers (HLO probes via `.lower()`, cache resets, existing
+    # `_cache_size` call sites) need not know about the wrapper.
+    for attr in ("clear_cache", "lower", "eval_shape", "trace",
+                 "_cache_size"):
+        impl = getattr(jfn, attr, None)
+        if impl is not None:
+            setattr(call, attr, impl)
+    REGISTRY[name] = call
+    return call

@@ -77,6 +77,19 @@ class Tracer:
             self.events.append(ev)
             self.emitted += 1
 
+    def counter(self, name: str, values: Dict[str, float],
+                pid: int = PID_ENGINE, tid: int = 0) -> None:
+        """One Chrome "C" (counter) event: Perfetto renders each
+        distinct `name` as its own counter track, plotting the numeric
+        `values` series over time — the per-device HBM tracks the
+        memory accountant emits (`telemetry/memory.py`)."""
+        ev = {"name": name, "cat": "memory", "ph": "C",
+              "ts": round(self.now_us(), 1), "pid": pid, "tid": tid,
+              "args": {k: float(v) for k, v in values.items()}}
+        with self._lock:
+            self.events.append(ev)
+            self.emitted += 1
+
     def instant(self, name: str, cat: str,
                 args: Optional[dict] = None) -> None:
         tid = threading.get_ident()
@@ -208,6 +221,10 @@ def record_link_transfer(direction: str, nbytes: int, seconds: float,
         t.complete(f"{direction} {int(nbytes):,}B", "link", start,
                    end - start,
                    args={"bytes": int(nbytes), "direction": direction})
+    # Every instrumented transfer moves device residency: fold a memory
+    # sample (throttled; no-op unless a recorder or tracer is active).
+    from hyperspace_tpu.telemetry import memory as _memory
+    _memory.maybe_sample()
 
 
 @contextmanager

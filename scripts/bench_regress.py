@@ -1,7 +1,11 @@
 #!/usr/bin/env python
 """Pre-merge perf gate: diff the newest BENCH_*.json artifact against
 the previous one and exit nonzero on a >15% regression in any rung's
-`vs_baseline` ratio (or the headline ratio).
+`vs_baseline` ratio (or the headline ratio) — or a >15% GROWTH in
+peak HBM bytes (`memory.peak_hbm_bytes`, the per-device-peak total
+the memory accountant embeds): a query ladder that suddenly holds
+more device memory is a pre-OOM regression even when its wall times
+still pass. Artifacts predating the memory section simply don't gate.
 
   python scripts/bench_regress.py                 # newest two BENCH_r*.json
   python scripts/bench_regress.py OLD.json NEW.json
@@ -55,15 +59,18 @@ def pick_latest_two(pattern: str):
 
 def compare(old: dict, new: dict, threshold: float):
     """[(name, old_ratio, new_ratio, change, gated)] for every
-    comparable vs_baseline, headline first."""
+    comparable vs_baseline (higher is better), headline first, plus
+    the peak-HBM row (lower is better — it gates on GROWTH)."""
     rows = []
 
-    def add(name, old_v, new_v):
+    def add(name, old_v, new_v, lower_is_better=False):
         if not (isinstance(old_v, (int, float))
                 and isinstance(new_v, (int, float)) and old_v > 0):
             return
         change = new_v / old_v - 1.0
-        rows.append((name, old_v, new_v, change, change < -threshold))
+        gated = (change > threshold if lower_is_better
+                 else change < -threshold)
+        rows.append((name, old_v, new_v, change, gated))
 
     add("headline", old.get("vs_baseline"), new.get("vs_baseline"))
     old_rungs = old.get("rungs") or {}
@@ -75,6 +82,10 @@ def compare(old: dict, new: dict, threshold: float):
                          (n or {}).get("vs_baseline"), None, False))
             continue
         add(rung, o.get("vs_baseline"), n.get("vs_baseline"))
+    add("peak_hbm_bytes",
+        (old.get("memory") or {}).get("peak_hbm_bytes"),
+        (new.get("memory") or {}).get("peak_hbm_bytes"),
+        lower_is_better=True)
     return rows
 
 

@@ -14,6 +14,15 @@ maintenance action that could run without emitting its structured
 report (assigned after class creation, shadowed by a plain function,
 or otherwise routed around the instrumentation).
 
+Compile coverage rides the same check: every `jax.jit` entry point
+must route through `telemetry.compilation.instrumented_jit` (the
+compile-span stamp — trace counters, retrace-cause events, Perfetto
+compile track). A direct `jax.jit(...)` / `partial(jax.jit, ...)`
+call anywhere in the package besides telemetry/compilation.py is a
+jit entry point that can trace without being seen, and fails the
+lint; so does a registered wrapper missing its
+`__compile_span_instrumented__` stamp.
+
 Runs in the tier-1 flow via `tests/test_telemetry.py`; also runnable
 standalone:  python scripts/check_metrics_coverage.py
 """
@@ -21,6 +30,7 @@ standalone:  python scripts/check_metrics_coverage.py
 import importlib
 import os
 import pkgutil
+import re
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -31,6 +41,43 @@ def _all_subclasses(cls):
     for sub in cls.__subclasses__():
         yield sub
         yield from _all_subclasses(sub)
+
+
+# Direct jit construction — the only sanctioned caller is the
+# instrumented_jit wrapper itself. Doc mentions of the NAME don't
+# match (the pattern requires a call/partial form).
+_RAW_JIT_RE = re.compile(r"jax\.jit\s*\(|partial\(\s*jax\.jit\b")
+_JIT_ALLOWED = os.path.join("telemetry", "compilation.py")
+
+
+def check_jit_entry_points(package_dir: str):
+    """Source lint: no direct `jax.jit` outside the sanctioned wrapper
+    module, and every registered wrapper carries the compile-span
+    stamp."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _JIT_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_JIT_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: jit entry "
+                            "point lacks the compile-span stamp — route "
+                            "it through telemetry.instrumented_jit")
+    from hyperspace_tpu.telemetry import compilation
+    for name, wrapper in sorted(compilation.REGISTRY.items()):
+        if not getattr(wrapper, "__compile_span_instrumented__", False):
+            failures.append(
+                f"instrumented jit {name!r} lost its compile-span stamp")
+    return failures
 
 
 def main() -> int:
@@ -80,6 +127,9 @@ def main() -> int:
                 f"{cls.__module__}.{cls.__name__}.run can execute "
                 "without emitting an action report")
 
+    failures.extend(check_jit_entry_points(
+        os.path.dirname(hyperspace_tpu.__file__)))
+
     if import_errors:
         print("check_metrics_coverage: module import failures "
               "(coverage cannot be proven):", file=sys.stderr)
@@ -91,9 +141,11 @@ def main() -> int:
             print(f"  {line}", file=sys.stderr)
     if failures or import_errors:
         return 1
+    from hyperspace_tpu.telemetry import compilation
     print(f"check_metrics_coverage: OK "
-          f"({checked} PhysicalNode subclasses and {checked_actions} "
-          f"Action subclasses instrumented)")
+          f"({checked} PhysicalNode subclasses, {checked_actions} "
+          f"Action subclasses, and {len(compilation.REGISTRY)} jit "
+          f"entry points instrumented)")
     return 0
 
 

@@ -39,6 +39,37 @@ def conf(tmp_path):
 
 
 @pytest.fixture
+def leak_sentinel():
+    """Device-array leak sentinel, reusable by any suite: asserts the
+    `jax.live_arrays()` count is unchanged across the enclosed block.
+    Warm the caches FIRST (run the workload once before entering), then
+    wrap the repeat runs — a steady state that still accretes arrays is
+    a leak (e.g. a cache retaining buffers for dead host sources).
+
+        with leak_sentinel():
+            for _ in range(3):
+                df.collect()
+
+    `tolerance` forgives a bounded number of new arrays (jit constants
+    materialized lazily on first post-warm dispatch)."""
+    import gc
+    from contextlib import contextmanager
+
+    @contextmanager
+    def sentinel(tolerance: int = 0):
+        gc.collect()
+        before = len(jax.live_arrays())
+        yield
+        gc.collect()
+        after = len(jax.live_arrays())
+        assert after - before <= tolerance, (
+            f"device-array leak: {after - before} new live arrays "
+            f"(tolerance {tolerance}; {before} -> {after})")
+
+    return sentinel
+
+
+@pytest.fixture
 def sample_parquet(tmp_path):
     """Deterministic sample dataset written to parquet (parity with the
     reference's `SampleData` fixture, `SampleData.scala:22-34`)."""

@@ -11,7 +11,8 @@ jax/XLA/Pallas over a TPU device mesh.
 
 __version__ = "0.1.0"
 
-from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.exceptions import (HyperspaceException,
+                                       IndexDataUnavailableError)
 from hyperspace_tpu.config import HyperspaceConf
 from hyperspace_tpu.index.index_config import IndexConfig
 
@@ -38,6 +39,7 @@ def __getattr__(name):
     return value
 
 
-__all__ = ["HyperspaceException", "HyperspaceConf", "IndexConfig",
+__all__ = ["HyperspaceException", "IndexDataUnavailableError",
+           "HyperspaceConf", "IndexConfig",
            "Hyperspace", "HyperspaceSession", "DataFrame", "col", "lit",
            "telemetry", "__version__"]

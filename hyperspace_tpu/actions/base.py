@@ -5,7 +5,12 @@ Parity: reference `actions/Action.scala:33-96`:
 `base_id+1` with a *transient* state; `end()` writes id `base_id+2` with the
 *final* state and deletes + recreates `latestStable`. `base_id` = latest log
 id or -1. A failure between begin and end strands the index in a transient
-state; only `cancel()` can recover (reference `actions/CancelAction.scala`).
+state; the Cancel FSM transition recovers it (reference
+`actions/CancelAction.scala`) — run explicitly via
+`Hyperspace.recover_index`/`cancel`, or automatically by the next
+create/refresh/optimize once the stranded entry outlives
+`spark.hyperspace.maintenance.lease.seconds` (lease-based recovery,
+`CreateActionBase._recover_stale_writer`).
 Optimistic concurrency: `write_log` refuses existing ids, so exactly one of
 two racing actions wins the `base_id+1` slot.
 
@@ -33,6 +38,7 @@ from hyperspace_tpu import telemetry
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.index.log_entry import LogEntry
 from hyperspace_tpu.index.log_manager import IndexLogManager
+from hyperspace_tpu.utils import faults
 
 logger = logging.getLogger(__name__)
 
@@ -160,6 +166,11 @@ class Action(ABC):
             self._report["detail"].update(detail)
 
     def _timed_phase(self, name: str, fn) -> None:
+        # Fault-injection point at every phase BOUNDARY: a "crash" rule
+        # matching `action.<Class>.<phase>` aborts just before that phase
+        # runs — i.e. between the preceding phase and this one, the
+        # stranded-writer scenario recovery must unwind.
+        faults.fire(f"action.{type(self).__name__}.{name}")
         if self._report is None:  # phase called directly, not via run()
             fn()
             return

@@ -52,13 +52,61 @@ class CreateActionBase(Action):
         super().__init__(log_manager)
         self.data_manager = data_manager
         self.conf = conf
+        self._data_version: Optional[int] = None
 
     @property
     def index_data_path(self) -> str:
-        """Next `v__=N` dir (reference `CreateActionBase.scala:31-36`)."""
-        latest = self.data_manager.get_latest_version_id()
-        next_version = latest + 1 if latest is not None else 0
-        return self.data_manager.get_path(next_version)
+        """Next free `v__=N` dir (reference `CreateActionBase.scala:31-36`).
+        Allocated over ALL existing dirs — a crashed build's uncommitted
+        dir is skipped, never written into — and memoized so every phase
+        of this action sees the same target."""
+        if self._data_version is None:
+            self._data_version = self.data_manager.next_version_id()
+        return self.data_manager.get_path(self._data_version)
+
+    def commit_data_version(self) -> None:
+        """Finalize the version dir this action wrote — the `_committed`
+        marker is the build's LAST data write; until it lands the version
+        is invisible to `get_latest_version_id` and the rules."""
+        if self._data_version is not None:
+            self.data_manager.commit(self._data_version)
+
+    def _recover_stale_writer(self) -> None:
+        """Lease-based crash recovery, run at the head of validate():
+        when the latest log entry is TRANSIENT (a writer died between
+        begin and end) and older than
+        `spark.hyperspace.maintenance.lease.seconds`, run the Cancel FSM
+        transition back to the last stable state so the crashed writer
+        stops blocking the index forever. Within the lease the entry is
+        presumed live and validation fails as before (exactly one writer
+        may hold the transient slot)."""
+        import time as _time
+
+        from hyperspace_tpu import telemetry
+        from hyperspace_tpu.actions.cancel import CancelAction
+        from hyperspace_tpu.constants import STABLE_STATES
+
+        latest = self.log_manager.get_latest_log()
+        if latest is None or latest.state in STABLE_STATES:
+            return
+        age_s = _time.time() - (latest.timestamp or 0) / 1000.0
+        if age_s <= self.conf.maintenance_lease_seconds:
+            return
+        CancelAction(self.log_manager).run()
+        telemetry.get_registry().counter("resilience.recoveries").inc()
+        telemetry.event("resilience", "recovered",
+                        index=getattr(latest, "name", None),
+                        stale_state=latest.state, age_s=round(age_s, 3))
+        # Cancel appended two log entries; drop every cached view of the
+        # log so this action re-reads the recovered state.
+        self._base_id = None
+        self._latest_entry = None
+        self._data_version = None
+        for attr in ("_previous", "_entry", "_df", "_delta"):
+            if hasattr(self, attr):
+                setattr(self, attr, None)
+        if hasattr(self, "_lineage_map"):  # sentinel-cached, so delete
+            delattr(self, "_lineage_map")
 
     def num_buckets(self) -> int:
         return self.conf.num_buckets
@@ -213,6 +261,7 @@ class CreateAction(CreateActionBase):
         """Reference `CreateAction.scala:42-62`: source must be a plain file
         scan (no filter/project/join on top), index columns must exist in the
         source schema, and no non-DOESNOTEXIST index of the same name."""
+        self._recover_stale_writer()
         if not isinstance(self.df.plan, Scan):
             raise HyperspaceException(
                 "Only creating index over a plain file scan is supported.")
@@ -232,4 +281,5 @@ class CreateAction(CreateActionBase):
 
     def op(self) -> None:
         self.write(self.df, self.index_config, self.index_data_path)
+        self.commit_data_version()
         self.stamp_stats()

@@ -44,6 +44,7 @@ class OptimizeAction(CreateActionBase):
         return self.previous_entry.num_buckets
 
     def validate(self) -> None:
+        self._recover_stale_writer()
         if self.previous_entry.state != States.ACTIVE:
             raise HyperspaceException(
                 f"Optimize is only supported in {States.ACTIVE} state; "
@@ -69,4 +70,5 @@ class OptimizeAction(CreateActionBase):
                                 self.index_data_path)
         self.annotate_report(runs_compacted=runs_before,
                              files_written=len(written))
+        self.commit_data_version()
         self.stamp_stats()

@@ -71,6 +71,7 @@ class RefreshAction(CreateActionBase):
 
     def validate(self) -> None:
         """Reference `RefreshAction.scala:64-70`: state must be ACTIVE."""
+        self._recover_stale_writer()
         if self.previous_entry.state != States.ACTIVE:
             raise HyperspaceException(
                 f"Refresh is only supported in {States.ACTIVE} state; "
@@ -86,4 +87,5 @@ class RefreshAction(CreateActionBase):
         """Reference `RefreshAction.scala:72-77` — rebuild into the next
         version dir; the old dir is retained for in-flight readers."""
         self.write(self.df, self.index_config, self.index_data_path)
+        self.commit_data_version()
         self.stamp_stats()

@@ -196,6 +196,7 @@ class RefreshIncrementalAction(RefreshAction):
                           os.path.join(out_dir, parquet.BUCKET_SPEC_FILE))
 
         if not appended:
+            self.commit_data_version()
             self.stamp_stats()
             return  # metadata-only refresh (signature/file set catches up)
         cfg = self.index_config
@@ -217,4 +218,5 @@ class RefreshIncrementalAction(RefreshAction):
                                        file_suffix=f"delta{delta_version}")
         self.annotate_report(delta_files_written=len(written),
                              delta_rows=table.num_rows)
+        self.commit_data_version()
         self.stamp_stats()

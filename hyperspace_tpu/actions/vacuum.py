@@ -34,11 +34,14 @@ class VacuumAction(Action):
         return IndexLogEntry.from_dict(self.latest_entry("vacuum").to_dict())
 
     def op(self) -> None:
-        """Delete every data version dir latest -> 0 (reference
-        `VacuumAction.scala:45-51`)."""
-        latest = self.data_manager.get_latest_version_id()
-        if latest is not None:
-            for version in range(latest, -1, -1):
-                self.data_manager.delete(version)
-        self.annotate_report(
-            versions_removed=(latest + 1 if latest is not None else 0))
+        """Delete every data version dir that actually EXISTS, newest
+        first (reference `VacuumAction.scala:45-51` walks a dense
+        latest..0 range — but a sparse layout, a partially vacuumed
+        index, or a crashed build's uncommitted dir must not abort the
+        hard delete, and uncommitted partials are invisible to
+        `get_latest_version_id` by design)."""
+        versions = sorted(self.data_manager.all_version_ids(),
+                          reverse=True)
+        for version in versions:
+            self.data_manager.delete(version)
+        self.annotate_report(versions_removed=len(versions))

@@ -145,6 +145,33 @@ class HyperspaceConf:
                             constants.FUSION_BCAST_CACHE_BYTES_DEFAULT)
 
     @property
+    def io_retry_attempts(self) -> int:
+        """Total tries (first call included) for transient storage-IO
+        failures; see `utils/retry.py`."""
+        return self.get_int(constants.IO_RETRY_ATTEMPTS,
+                            constants.IO_RETRY_ATTEMPTS_DEFAULT)
+
+    @property
+    def io_retry_base_ms(self) -> float:
+        """First backoff delay; doubles per retry (jittered)."""
+        return float(self.get(constants.IO_RETRY_BASE_MS,
+                              str(constants.IO_RETRY_BASE_MS_DEFAULT)))
+
+    @property
+    def io_retry_max_ms(self) -> float:
+        """Backoff ceiling per retry."""
+        return float(self.get(constants.IO_RETRY_MAX_MS,
+                              str(constants.IO_RETRY_MAX_MS_DEFAULT)))
+
+    @property
+    def maintenance_lease_seconds(self) -> int:
+        """Age past which a transient op-log entry is treated as a crashed
+        writer and auto-recovered (Cancel FSM) by the next maintenance
+        action; `Hyperspace.recover_index` forces it immediately."""
+        return self.get_int(constants.MAINTENANCE_LEASE_SECONDS,
+                            constants.MAINTENANCE_LEASE_SECONDS_DEFAULT)
+
+    @property
     def cache_expiry_seconds(self) -> int:
         return self.get_int(
             constants.INDEX_CACHE_EXPIRY_DURATION_SECONDS,

@@ -59,6 +59,28 @@ BROADCAST_THRESHOLD_DEFAULT = 10 * 1024 * 1024
 # semantics.
 SINGLE_WRITER = "spark.hyperspace.single.writer"
 
+# Storage-IO retry policy (`utils/retry.py`, the ONE backoff point in the
+# package — the metrics-coverage lint fails any ad-hoc sleep-in-except
+# loop elsewhere). Exponential backoff with deterministic per-operation
+# jitter; transient errors (connection resets, timeouts, HTTP 429/5xx,
+# torn reads of in-flight publishes) retry up to `attempts` total tries,
+# permanent errors (not-found, permission, 4xx) fail immediately.
+IO_RETRY_ATTEMPTS = "spark.hyperspace.io.retry.attempts"
+IO_RETRY_ATTEMPTS_DEFAULT = 5
+IO_RETRY_BASE_MS = "spark.hyperspace.io.retry.base.ms"
+IO_RETRY_BASE_MS_DEFAULT = 20
+IO_RETRY_MAX_MS = "spark.hyperspace.io.retry.max.ms"
+IO_RETRY_MAX_MS_DEFAULT = 2000
+
+# Crash recovery lease: a maintenance action that finds the op log's
+# latest entry in a TRANSIENT state (CREATING/REFRESHING/...) treats the
+# in-flight writer as crashed once the entry is older than this many
+# seconds, and runs the Cancel FSM transition back to the last stable
+# state before proceeding (`Hyperspace.recover_index` forces the same
+# recovery immediately). Size it above the longest expected build.
+MAINTENANCE_LEASE_SECONDS = "spark.hyperspace.maintenance.lease.seconds"
+MAINTENANCE_LEASE_SECONDS_DEFAULT = 600
+
 HYBRID_SCAN_ENABLED = "spark.hyperspace.index.hybridscan.enabled"
 
 # Per-row lineage (extension; the reference's v0.2 direction): when enabled
@@ -115,6 +137,14 @@ WAREHOUSE_PATH_DEFAULT = "warehouse"
 HYPERSPACE_LOG = "_hyperspace_log"
 INDEX_VERSION_DIRECTORY_PREFIX = "v__"
 LATEST_STABLE_LOG = "latestStable"
+
+# Commit marker written LAST into every `v__=N` data dir (the Delta-style
+# finalize): readers (`IndexDataManager.get_latest_version_id`, optimize/
+# incremental refresh picking the "current" version) only see versions
+# carrying it, so a crashed build's partially-written dir is invisible —
+# it is skipped for the next version number and hard-deleted by vacuum.
+# The leading underscore keeps it out of every parquet file listing.
+INDEX_DATA_COMMIT_MARKER = "_committed"
 
 # Explain display mode (reference `index/IndexConstants.scala:42-49`).
 DISPLAY_MODE = "spark.hyperspace.explain.displayMode"

@@ -183,6 +183,7 @@ class DataFrame:
         recording so rule fired/skipped events are captured too."""
         from hyperspace_tpu import telemetry
         from hyperspace_tpu.engine.executor import execute_plan
+        from hyperspace_tpu.exceptions import IndexDataUnavailableError
         from hyperspace_tpu.io.columnar import to_arrow
 
         description = ", ".join(self.schema.names[:6])
@@ -190,7 +191,25 @@ class DataFrame:
         with telemetry.recording(metrics), \
                 telemetry.span("query", "query", description=description):
             plan = self._optimized_plan()
-            batch = execute_plan(plan, conf=self._conf())
+            try:
+                batch = execute_plan(plan, conf=self._conf())
+            except IndexDataUnavailableError as exc:
+                if plan is self.plan:
+                    raise  # no rewrite to fall back from
+                # Graceful degradation: a rule-selected index's data is
+                # missing/unreadable at scan time — answer from the
+                # SOURCE plan instead of failing the query, and make the
+                # silent downgrade visible to the telemetry stack.
+                import logging
+                logging.getLogger(__name__).warning(
+                    "Index data unavailable (%s); falling back to the "
+                    "source plan", exc)
+                telemetry.get_registry() \
+                    .counter("resilience.fallbacks").inc()
+                metrics.add_count("resilience.fallbacks")
+                metrics.event("resilience", "degraded",
+                              index=exc.index_name, reason=str(exc))
+                batch = execute_plan(self.plan, conf=self._conf())
             if not batch.is_host:
                 # Query-end HBM watermark, FORCED (throttling may have
                 # swallowed every span-boundary sample of a fast query)

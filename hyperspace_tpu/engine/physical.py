@@ -198,7 +198,42 @@ class ScanExec(PhysicalNode):
         return (f"Scan parquet [{', '.join(self.columns)}] "
                 f"{self.scan.root_paths}{bucket}{pruned}")
 
+    def _guard_index_read(self, fn):
+        """Run one read attempt with the graceful-degradation contract:
+        for a RULE-SELECTED index scan (scan.index_name set), data that
+        turns out missing or unreadable — root dir gone, files corrupt,
+        storage failing past the retry policy — raises the typed
+        IndexDataUnavailableError that `DataFrame.collect` converts into
+        a fallback to the source plan. Source-data scans keep their raw
+        errors: there is nothing to degrade to. HyperspaceExceptions
+        (planner contract violations) and BaseExceptions (injected
+        crashes) pass through untouched."""
+        from hyperspace_tpu.exceptions import IndexDataUnavailableError
+
+        name = self.scan.index_name
+        if name is None:
+            return fn()
+        from hyperspace_tpu.utils import file_utils
+        missing = [r for r in self.scan.root_paths
+                   if not file_utils.is_dir(r)
+                   and not file_utils.is_file(r)]
+        if missing:
+            raise IndexDataUnavailableError(
+                f"Index {name!r} data root(s) missing: "
+                f"{', '.join(missing)}", index_name=name)
+        try:
+            return fn()
+        except HyperspaceException:
+            raise
+        except Exception as exc:
+            raise IndexDataUnavailableError(
+                f"Index {name!r} data unreadable: {exc!r}",
+                index_name=name) from exc
+
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        return self._guard_index_read(lambda: self._execute(bucket))
+
+    def _execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         files_total: Optional[int] = None
         if bucket is not None:
             if self.scan.bucket_spec is None:
@@ -251,6 +286,10 @@ class ScanExec(PhysicalNode):
         return batch
 
     def execute_bucketed(self, num_buckets: int):
+        return self._guard_index_read(
+            lambda: self._execute_bucketed(num_buckets))
+
+    def _execute_bucketed(self, num_buckets: int):
         """Read all bucket files in bucket order; lengths come from parquet
         metadata — no device work. (The batched join sorts per-bucket ids
         itself, so multi-run buckets need no pre-sort here.)"""

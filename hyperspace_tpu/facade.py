@@ -79,6 +79,15 @@ class Hyperspace:
     def cancel(self, index_name: str) -> None:
         self._manager.cancel(index_name)
 
+    def recover_index(self, index_name: str) -> bool:
+        """Force crash recovery: if a writer died mid-operation (the log's
+        latest entry is transient), run the Cancel FSM transition back to
+        the last stable state immediately — no waiting for the
+        `spark.hyperspace.maintenance.lease.seconds` lease that gates
+        AUTOMATIC recovery by the next create/refresh/optimize. Returns
+        True iff a recovery ran (False: index already stable)."""
+        return self._manager.recover(index_name)
+
     def indexes(self):
         """Catalog as a pandas DataFrame (reference `Hyperspace.scala:33-36`)."""
         return self._manager.indexes_df()

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from abc import ABC, abstractmethod
 from typing import Optional
 
@@ -21,6 +20,7 @@ from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.index.log_entry import LogEntry
 from hyperspace_tpu.utils import storage
 from hyperspace_tpu.utils import file_utils
+from hyperspace_tpu.utils import retry
 
 
 class IndexLogManager(ABC):
@@ -82,20 +82,26 @@ class IndexLogManagerImpl(IndexLogManager):
         return os.path.join(self.log_dir, str(log_id))
 
     def _read_entry(self, path: str) -> tuple[LogEntry, str]:
-        """Read + parse a log file, retrying briefly on a torn read: on
-        no-hardlink filesystems the OCC fallback publishes the filename
-        before its contents (see file_utils.atomic_write_if_absent). ALL
-        log-file reads must come through here, not just get_log."""
-        last_error: Exception | None = None
-        for _ in range(5):
-            try:
-                contents = file_utils.read_contents(path)
-                return LogEntry.from_json(contents), contents
-            except (json.JSONDecodeError, ValueError) as exc:
-                last_error = exc
-                time.sleep(0.02)
-        raise HyperspaceException(
-            f"Corrupt log entry at {path}: {last_error}")
+        """Read + parse a log file through the retry seam: transient IO
+        errors retry per policy, and so do torn reads (on no-hardlink
+        filesystems the OCC fallback publishes the filename before its
+        contents — see file_utils.atomic_write_if_absent — so a parse
+        failure may just mean the writer hasn't finished). A read that
+        stays unparseable through the policy is a genuinely corrupt
+        entry. ALL log-file reads must come through here, not just
+        get_log."""
+
+        def read():
+            contents = file_utils.read_contents(path)
+            return LogEntry.from_json(contents), contents
+
+        try:
+            return retry.call(read, operation=f"log.read:{path}",
+                              policy=retry.policy_for(self.conf),
+                              retryable=(json.JSONDecodeError, ValueError))
+        except (json.JSONDecodeError, ValueError) as exc:
+            raise HyperspaceException(
+                f"Corrupt log entry at {path}: {exc}")
 
     def get_log(self, log_id: int) -> Optional[LogEntry]:
         path = self._path_for(log_id)
@@ -133,15 +139,25 @@ class IndexLogManagerImpl(IndexLogManager):
         return None
 
     def create_latest_stable_log(self, log_id: int) -> bool:
-        """Copy `<id>` -> `latestStable` (reference `IndexLogManager.scala:112-122`)."""
+        """Copy `<id>` -> `latestStable` (reference `IndexLogManager.scala:112-122`).
+
+        The copy publishes ATOMICALLY (temp file + rename locally, one
+        object put on stores): `latestStable` is rewritten in place, so a
+        reader racing a plain streamed write could observe a torn JSON —
+        the one log file the OCC torn-read retry does not protect (a torn
+        id file means "writer still publishing"; a torn latestStable used
+        to parse as corruption). Transient write failures retry per the
+        io.retry policy."""
         source = self._path_for(log_id)
         if not file_utils.exists(source):
             return False
         entry, contents = self._read_entry(source)
         if entry.state not in constants.STABLE_STATES:
             return False
-        file_utils.create_file(os.path.join(self.log_dir, constants.LATEST_STABLE_LOG),
-                               contents)
+        stable_path = os.path.join(self.log_dir, constants.LATEST_STABLE_LOG)
+        retry.call(lambda: file_utils.atomic_publish(stable_path, contents),
+                   operation=f"log.latest_stable:{stable_path}",
+                   policy=retry.policy_for(self.conf))
         return True
 
     def delete_latest_stable_log(self) -> bool:
@@ -159,9 +175,17 @@ class IndexLogManagerImpl(IndexLogManager):
         if file_utils.exists(self._path_for(log_id)):
             return False
         entry.id = log_id
-        return file_utils.atomic_write_if_absent(
-            self._path_for(log_id), entry.to_json(indent=2),
-            single_writer=self._single_writer())
+        # Transient failures retry. If a failed-looking attempt actually
+        # landed the object (response lost), the retry reports False and
+        # the action aborts as a conflict — leaving ITS OWN transient
+        # entry as latest, which lease-based recovery (or recover_index)
+        # unwinds; correctness of the OCC log is never at risk.
+        return retry.call(
+            lambda: file_utils.atomic_write_if_absent(
+                self._path_for(log_id), entry.to_json(indent=2),
+                single_writer=self._single_writer()),
+            operation=f"log.write:{self._path_for(log_id)}",
+            policy=retry.policy_for(self.conf))
 
     # -- action reports ---------------------------------------------------
 
@@ -174,13 +198,20 @@ class IndexLogManagerImpl(IndexLogManager):
     def write_action_report(self, log_id: int, report: dict) -> bool:
         """Persist the action report alongside the log entry it
         finalized. Best-effort: the log entry is already durable, a
-        failed report write must not fail the action."""
+        failed sidecar write must NEVER fail the action — and fsspec
+        object-store backends raise library-specific errors (aiohttp
+        client errors, botocore ClientError, ...), so the guard is ANY
+        Exception, not just OSError. Transient failures get the standard
+        retries first."""
         try:
-            file_utils.create_file(
-                self._report_path(log_id),
-                json.dumps(report, indent=2, default=str))
+            retry.call(
+                lambda: file_utils.create_file(
+                    self._report_path(log_id),
+                    json.dumps(report, indent=2, default=str)),
+                operation=f"log.report:{self._report_path(log_id)}",
+                policy=retry.policy_for(self.conf))
             return True
-        except OSError:
+        except Exception:
             return False
 
     def get_action_report(self, log_id: int) -> Optional[dict]:

@@ -127,6 +127,9 @@ class IndexManager(ABC):
     def cancel(self, index_name: str) -> None: ...
 
     @abstractmethod
+    def recover(self, index_name: str) -> bool: ...
+
+    @abstractmethod
     def get_indexes(self, states: Optional[Sequence[str]] = None) -> List[IndexLogEntry]: ...
 
 
@@ -181,6 +184,28 @@ class IndexCollectionManager(IndexManager):
     def cancel(self, index_name: str) -> None:
         log_manager, _ = self._managers(index_name)
         CancelAction(log_manager).run()
+
+    def recover(self, index_name: str) -> bool:
+        """Force crash recovery NOW, without waiting out the maintenance
+        lease: if the index's latest log entry is transient (a writer
+        died between begin and end), run the Cancel FSM transition back
+        to the last stable state. Returns True iff a recovery ran; a
+        stable index is a no-op (unlike `cancel`, which raises), so the
+        call is safe to fire on suspicion."""
+        from hyperspace_tpu import telemetry
+        from hyperspace_tpu.constants import STABLE_STATES
+
+        log_manager, _ = self._managers(index_name)
+        latest = log_manager.get_latest_log()
+        if latest is None:
+            raise HyperspaceException(f"No such index: {index_name}.")
+        if latest.state in STABLE_STATES:
+            return False
+        CancelAction(log_manager).run()
+        telemetry.get_registry().counter("resilience.recoveries").inc()
+        telemetry.event("resilience", "recovered", index=index_name,
+                        stale_state=latest.state, forced=True)
+        return True
 
     def indexes(self) -> List[IndexSummary]:
         """All indexes not in DOESNOTEXIST, as summary rows (reference
@@ -280,3 +305,7 @@ class CachingIndexCollectionManager(IndexCollectionManager):
     def cancel(self, index_name: str) -> None:
         self.clear_cache()
         super().cancel(index_name)
+
+    def recover(self, index_name: str) -> bool:
+        self.clear_cache()
+        return super().recover(index_name)

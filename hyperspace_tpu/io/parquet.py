@@ -46,16 +46,26 @@ def bucket_of_file(path: str) -> Optional[int]:
 def _read_one(path: str, cols):
     import pyarrow.parquet as pq
 
+    from hyperspace_tpu.utils import faults, retry
+
     # partitioning=None: the index layout's `v__=N` version directories
     # LOOK like hive partitions, and newer pyarrow infers a synthetic
     # `v__` dictionary column from the path (even for single-file
     # reads) — which is not data, collides with files that were written
     # while such inference was active, and must never enter a batch.
-    if storage.is_url(path):
-        fs, real = storage.get_fs(path)
-        return pq.read_table(real, columns=cols, filesystem=fs,
-                             partitioning=None)
-    return pq.read_table(path, columns=cols, partitioning=None)
+    def read():
+        faults.fire("parquet.read", path)
+        if storage.is_url(path):
+            fs, real = storage.get_fs(path)
+            return pq.read_table(real, columns=cols, filesystem=fs,
+                                 partitioning=None)
+        return pq.read_table(path, columns=cols, partitioning=None)
+
+    # Transient storage failures (connection resets, 5xx from object
+    # stores) retry per the io.retry policy; a corrupt file or missing
+    # path is permanent and raises through (index scans convert it into
+    # graceful degradation upstream).
+    return retry.call(read, operation=f"parquet.read:{path}")
 
 
 # Decoded-read cache: query trees that reference the same relation more
@@ -360,18 +370,27 @@ def write_table(table, path: str) -> None:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
+    from hyperspace_tpu.utils import faults, retry
+
     string_cols = [f.name for f in table.schema
                    if pa.types.is_string(f.type) or pa.types.is_large_string(f.type)
                    or pa.types.is_dictionary(f.type)]
     kwargs = dict(use_dictionary=string_cols or False,
                   write_statistics=False, compression="snappy")
-    if storage.is_url(path):
-        fs, real = storage.get_fs(path)
-        fs.makedirs(os.path.dirname(real), exist_ok=True)
-        pq.write_table(table, real, filesystem=fs, **kwargs)
-        return
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    pq.write_table(table, path, **kwargs)
+
+    def write():
+        faults.fire("parquet.write", path)
+        if storage.is_url(path):
+            fs, real = storage.get_fs(path)
+            fs.makedirs(os.path.dirname(real), exist_ok=True)
+            pq.write_table(table, real, filesystem=fs, **kwargs)
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        pq.write_table(table, path, **kwargs)
+
+    # A retried attempt rewrites the whole file — safe: version dirs are
+    # private to their writing action until the commit marker lands.
+    retry.call(write, operation=f"parquet.write:{path}")
 
 
 def write_bucket_spec(directory: str, spec: BucketSpec, schema: Schema) -> None:

@@ -119,12 +119,22 @@ class Scan(LogicalPlan):
     def __init__(self, root_paths: Sequence[str], schema: Schema,
                  file_format: str = "parquet",
                  bucket_spec: Optional[BucketSpec] = None,
-                 files: Optional[Sequence[str]] = None):
+                 files: Optional[Sequence[str]] = None,
+                 index_name: Optional[str] = None):
         from hyperspace_tpu.utils.storage import canonical
         self.root_paths = [canonical(p) for p in root_paths]
         self._schema = schema
         self.file_format = file_format
         self.bucket_spec = bucket_spec
+        # Set iff a rewrite rule swapped this scan in over INDEX data
+        # (`Rule.index_scan`): the execution-time marker the graceful-
+        # degradation path keys on — an index scan whose data is missing
+        # or unreadable raises IndexDataUnavailableError instead of
+        # silently serving empty, and the query falls back to the source
+        # plan. In-process only: deliberately excluded from to_dict()
+        # (identity/serde), since a serialized plan never carries rule
+        # rewrites.
+        self.index_name = index_name
         # An EXPLICIT file list (hybrid scan / incremental deltas) restricts
         # the scan and is part of its identity; a lazily-cached glob is not.
         self._explicit_files = files is not None

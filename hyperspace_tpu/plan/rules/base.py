@@ -111,7 +111,12 @@ class Rule:
             bucket_spec = BucketSpec(entry.num_buckets,
                                      tuple(entry.indexed_columns),
                                      tuple(entry.indexed_columns))
-        return Scan([entry.content.root], schema, bucket_spec=bucket_spec)
+        # index_name marks the scan as rule-selected index data: if that
+        # data is missing/unreadable at execution time the scan raises
+        # IndexDataUnavailableError and the query degrades to the source
+        # plan instead of failing (graceful degradation).
+        return Scan([entry.content.root], schema, bucket_spec=bucket_spec,
+                    index_name=entry.name)
 
     @staticmethod
     def lineage_exclusion(deleted_ids):

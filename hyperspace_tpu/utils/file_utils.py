@@ -14,22 +14,30 @@ import os
 import shutil
 import uuid
 
-from hyperspace_tpu.utils import storage
+from hyperspace_tpu.utils import faults, storage
 
 
 def create_file(path: str, contents: str) -> None:
+    directive = faults.fire("file.create", path)
+    data = contents.encode("utf-8")
+    if directive == faults.TORN:
+        # Writer "dies" mid-write: a prefix of the payload lands.
+        data = data[:max(1, len(data) // 2)]
     if storage.is_url(path):
         fs, real = storage.get_fs(path)
         fs.makedirs(os.path.dirname(real), exist_ok=True)
         with fs.open(real, "wb") as f:
-            f.write(contents.encode("utf-8"))
-        return
-    create_directory(os.path.dirname(path))
-    with open(path, "w", encoding="utf-8") as f:
-        f.write(contents)
+            f.write(data)
+    else:
+        create_directory(os.path.dirname(path))
+        with open(path, "wb") as f:
+            f.write(data)
+    if directive == faults.TORN:
+        raise faults.TornWriteError(f"injected torn write at {path}")
 
 
 def read_contents(path: str) -> str:
+    faults.fire("file.read", path)
     if storage.is_url(path):
         fs, real = storage.get_fs(path)
         with fs.open(real, "rb") as f:
@@ -84,6 +92,7 @@ def is_file(path: str) -> bool:
 
 
 def delete(path: str) -> None:
+    faults.fire("file.delete", path)
     if storage.is_url(path):
         fs, real = storage.get_fs(path)
         if fs.exists(real):
@@ -96,6 +105,7 @@ def delete(path: str) -> None:
 
 
 def remove_file(path: str) -> None:
+    faults.fire("file.delete", path)
     if storage.is_url(path):
         fs, real = storage.get_fs(path)
         fs.rm_file(real)
@@ -104,6 +114,7 @@ def remove_file(path: str) -> None:
 
 
 def save_byte_array(path: str, data: bytes) -> None:
+    faults.fire("file.write", path)
     if storage.is_url(path):
         fs, real = storage.get_fs(path)
         fs.makedirs(os.path.dirname(real), exist_ok=True)
@@ -116,12 +127,61 @@ def save_byte_array(path: str, data: bytes) -> None:
 
 
 def load_byte_array(path: str) -> bytes:
+    faults.fire("file.read", path)
     if storage.is_url(path):
         fs, real = storage.get_fs(path)
         with fs.open(real, "rb") as f:
             return f.read()
     with open(path, "rb") as f:
         return f.read()
+
+
+def atomic_publish(path: str, contents: str) -> None:
+    """Publish `contents` at `path` so that a concurrent reader observes
+    either the previous contents or the new ones IN FULL — never a torn
+    mix. Local filesystems write a temp file (fsynced) and `os.replace`
+    it over the target (atomic on POSIX, overwrite allowed — unlike the
+    OCC primitive above, which must FAIL on an existing target). URL
+    paths publish with a single object put: object stores materialize an
+    object only when its upload completes, and the in-process memory fs
+    swaps the buffer under the GIL, so a plain streamed open/write (which
+    CAN tear on some backends) is avoided.
+
+    Used for `latestStable`: it is a rewritten-in-place convenience copy,
+    the one log file whose readers do not tolerate torn contents via the
+    OCC torn-read retry (a half-written id file is retried until its
+    writer finishes; a half-written latestStable used to parse as
+    corruption)."""
+    data = contents.encode("utf-8")
+    directive = faults.fire("file.publish", path)
+    if storage.is_url(path):
+        fs, real = storage.get_fs(path)
+        fs.makedirs(os.path.dirname(real), exist_ok=True)
+        if directive == faults.TORN:
+            # The torn upload never completes: no object materializes,
+            # the previous one (if any) stays intact.
+            raise faults.TornWriteError(f"injected torn publish at {path}")
+        fs.pipe_file(real, data)
+        return
+    create_directory(os.path.dirname(path))
+    tmp = path + ".tmp" + uuid.uuid4().hex
+    try:
+        with open(tmp, "wb") as f:
+            if directive == faults.TORN:
+                f.write(data[:max(1, len(data) // 2)])
+                f.flush()
+                os.fsync(f.fileno())
+                raise faults.TornWriteError(
+                    f"injected torn publish at {path}")
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
 
 
 def atomic_write_if_absent(path: str, contents: str,
@@ -141,6 +201,7 @@ def atomic_write_if_absent(path: str, contents: str,
     check-then-create semantics.
     Returns True iff this caller won the write.
     """
+    faults.fire("file.write_if_absent", path)
     if storage.is_url(path):
         from hyperspace_tpu.exceptions import HyperspaceException
         try:

@@ -147,6 +147,9 @@ def exclusive_create(path: str, data: bytes) -> bool:
     (reference `IndexLogManager.scala:139-156`)."""
     import os
 
+    from hyperspace_tpu.utils import faults
+
+    faults.fire("storage.exclusive_create", path)
     fs, real = get_fs(path)
     fs.makedirs(posixpath.dirname(real) or os.path.dirname(real),
                 exist_ok=True)
@@ -168,11 +171,12 @@ def exclusive_create(path: str, data: bytes) -> bool:
         # S3 conditional put (If-None-Match: *), supported by AWS S3
         # since 2024 and by MinIO. Concurrent conditional puts against
         # the same key may return 409 ConflictError while another upload
-        # is in flight (AWS documents retry); retry briefly, then treat
-        # a persistent conflict as the other writer winning.
-        import time
-        last_conflict = None
-        for attempt in range(5):
+        # is in flight (AWS documents retry); retry through the package
+        # retry seam, then treat a persistent conflict as the other
+        # writer winning.
+        from hyperspace_tpu.utils import retry
+
+        def conditional_put():
             try:
                 fs.pipe_file(real, data, IfNoneMatch="*")
                 return True
@@ -183,24 +187,30 @@ def exclusive_create(path: str, data: bytes) -> bool:
             except Exception as exc:
                 if _lost_race(fs, real, exc):
                     return False
-                if _is_conflict(exc):
-                    last_conflict = exc
-                    time.sleep(0.05 * (attempt + 1))
-                    continue
                 raise
-        # Persistent 409: "another writer won" is only true if their
-        # object actually landed — a crashed/aborted upload also 409s,
-        # and silently reporting a loss then would corrupt the OCC log
-        # (the caller would trust a log entry that never exists). Drop
-        # any cached listing first: s3fs serves exists() from its
-        # dircache, which predates the race.
+
         try:
-            fs.invalidate_cache(posixpath.dirname(real))
-        except Exception:
-            pass
-        if fs.exists(real):
-            return False
-        raise last_conflict
+            return retry.call(conditional_put,
+                              operation=f"s3.exclusive_create:{real}",
+                              retryable=_is_conflict)
+        except PreconditionUnsupported:
+            raise
+        except Exception as exc:
+            if not _is_conflict(exc):
+                raise
+            # Persistent 409: "another writer won" is only true if their
+            # object actually landed — a crashed/aborted upload also
+            # 409s, and silently reporting a loss then would corrupt the
+            # OCC log (the caller would trust a log entry that never
+            # exists). Drop any cached listing first: s3fs serves
+            # exists() from its dircache, which predates the race.
+            try:
+                fs.invalidate_cache(posixpath.dirname(real))
+            except Exception:
+                pass
+            if fs.exists(real):
+                return False
+            raise
     if protos & _ATOMIC_X_PROTOCOLS:
         try:
             with fs.open(real, "xb") as f:

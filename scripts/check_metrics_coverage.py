@@ -27,6 +27,7 @@ Runs in the tier-1 flow via `tests/test_telemetry.py`; also runnable
 standalone:  python scripts/check_metrics_coverage.py
 """
 
+import ast
 import importlib
 import os
 import pkgutil
@@ -80,6 +81,59 @@ def check_jit_entry_points(package_dir: str):
     return failures
 
 
+# The ONE sanctioned backoff point: every storage retry routes through
+# the policy in utils/retry.py (typed classification, conf-driven
+# backoff, io.retries/io.giveups counters, fault-injection coverage).
+_RETRY_ALLOWED = os.path.join("utils", "retry.py")
+
+
+def check_retry_seams(package_dir: str):
+    """AST lint: a `sleep` call lexically inside an `except` handler is
+    an ad-hoc retry loop — invisible to the retry conf, uncounted by the
+    io.* counters, unreachable by the fault-injection tests. Only
+    utils/retry.py may back off."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _RETRY_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError:
+                    continue  # surfaced by the import walk instead
+
+            class Visitor(ast.NodeVisitor):
+                def __init__(self):
+                    self.except_depth = 0
+
+                def visit_ExceptHandler(self, node):
+                    self.except_depth += 1
+                    self.generic_visit(node)
+                    self.except_depth -= 1
+
+                def visit_Call(self, node):
+                    func = node.func
+                    name = (func.attr if isinstance(func, ast.Attribute)
+                            else func.id if isinstance(func, ast.Name)
+                            else None)
+                    if name == "sleep" and self.except_depth:
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{node.lineno}: ad-hoc "
+                            "retry loop (sleep inside an except block) — "
+                            "route the backoff through utils/retry.py")
+                    self.generic_visit(node)
+
+            Visitor().visit(tree)
+    return failures
+
+
 def main() -> int:
     import hyperspace_tpu
 
@@ -128,6 +182,8 @@ def main() -> int:
                 "without emitting an action report")
 
     failures.extend(check_jit_entry_points(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_retry_seams(
         os.path.dirname(hyperspace_tpu.__file__)))
 
     if import_errors:

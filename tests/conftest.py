@@ -70,6 +70,28 @@ def leak_sentinel():
 
 
 @pytest.fixture
+def fault_injector():
+    """Arm the plan-driven fault injector at the storage seam and the
+    Action phase boundaries, with guaranteed uninstall:
+
+        inj = fault_injector(FaultRule("action.CreateAction.op",
+                                       kind="crash"))
+        with pytest.raises(InjectedCrash):
+            hs.create_index(df, cfg)
+        assert inj.fired("action.*") == 1
+
+    Calling the fixture again replaces the active plan."""
+    from hyperspace_tpu.utils import faults
+
+    def arm(*rules, seed: int = 0) -> faults.FaultInjector:
+        return faults.install(faults.FaultInjector(rules, seed=seed))
+
+    yield arm
+    from hyperspace_tpu.utils import faults as _faults
+    _faults.uninstall()
+
+
+@pytest.fixture
 def sample_parquet(tmp_path):
     """Deterministic sample dataset written to parquet (parity with the
     reference's `SampleData` fixture, `SampleData.scala:22-34`)."""

@@ -89,12 +89,21 @@ class FakeDataManager(IndexDataManager):
     def __init__(self, versions=()):
         self.versions = set(versions)
         self.deleted: List[int] = []
+        self.committed: List[int] = []
 
     def get_latest_version_id(self):
         return max(self.versions) if self.versions else None
 
+    def all_version_ids(self):
+        # Real listing semantics: only versions that exist — sparse sets
+        # enumerate as-is (vacuum must not assume a dense 0..latest).
+        return sorted(self.versions)
+
     def get_path(self, version_id):
         return f"/fake/v__={version_id}"
+
+    def commit(self, version_id):
+        self.committed.append(version_id)
 
     def delete(self, version_id):
         self.versions.discard(version_id)

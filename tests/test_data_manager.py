@@ -15,8 +15,27 @@ def test_version_scan(tmp_path):
     os.makedirs(os.path.join(root, "v__=3"))
     os.makedirs(os.path.join(root, "_hyperspace_log"))
     os.makedirs(os.path.join(root, "v__=bogus"))
+    mgr.commit(0)
+    mgr.commit(3)
     assert mgr.get_latest_version_id() == 3
     assert mgr.get_path(4) == os.path.join(root, "v__=4")
+
+
+def test_uncommitted_version_invisible_to_readers(tmp_path):
+    """A `v__=N` dir without the `_committed` marker (a crashed build's
+    partial write) must never be SERVED — but the next build must skip
+    its number and vacuum must still hard-delete it."""
+    root = str(tmp_path / "idx")
+    mgr = IndexDataManagerImpl(root)
+    os.makedirs(os.path.join(root, "v__=0"))
+    mgr.commit(0)
+    os.makedirs(os.path.join(root, "v__=1"))  # partial: no marker
+    assert mgr.get_latest_version_id() == 0
+    assert mgr.all_version_ids() == [0, 1]
+    assert mgr.next_version_id() == 2
+    assert mgr.is_committed(0) and not mgr.is_committed(1)
+    mgr.commit(1)
+    assert mgr.get_latest_version_id() == 1
 
 
 def test_delete_version(tmp_path):

@@ -1,0 +1,636 @@
+"""Fault-injected storage resilience: the retry seam, the fault
+injector, crash consistency + recovery, and graceful query degradation.
+
+These tests exercise the failure paths the happy-path suites never
+reach: every Action phase boundary aborts and recovers (crash-point
+matrix), concurrent writers race the op log on memory://, torn writes
+land partial bytes, and queries over vanished index data degrade to the
+source plan instead of failing.
+"""
+
+import json
+import os
+import shutil
+import threading
+import uuid
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (Hyperspace, HyperspaceConf, HyperspaceSession,
+                            IndexConfig)
+from hyperspace_tpu.constants import STABLE_STATES, States
+from hyperspace_tpu.exceptions import (HyperspaceException,
+                                       IndexDataUnavailableError)
+from hyperspace_tpu.index.log_manager import IndexLogManagerImpl
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.utils import faults, file_utils, retry
+from hyperspace_tpu.utils.faults import (FaultRule, InjectedCrash,
+                                         InjectedPermanentError,
+                                         InjectedTransientError,
+                                         TornWriteError)
+
+from fakes import FakeDataManager, FakeLogManager, make_entry
+
+
+# -- retry policy ----------------------------------------------------------
+
+
+def _sleep_recorder():
+    delays = []
+    return delays, delays.append
+
+
+def test_retry_succeeds_after_transient():
+    delays, sleep = _sleep_recorder()
+    policy = retry.RetryPolicy(attempts=5, base_ms=10, max_ms=100,
+                               sleep=sleep)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    assert retry.call(flaky, operation="t.flaky", policy=policy) == "ok"
+    assert calls["n"] == 3
+    assert len(delays) == 2
+    assert delays[1] > delays[0]  # exponential growth
+
+
+def test_retry_permanent_fails_immediately():
+    delays, sleep = _sleep_recorder()
+    policy = retry.RetryPolicy(attempts=5, sleep=sleep)
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        retry.call(broken, operation="t.broken", policy=policy)
+    assert calls["n"] == 1 and not delays
+
+
+def test_retry_gives_up_after_attempts():
+    delays, sleep = _sleep_recorder()
+    policy = retry.RetryPolicy(attempts=3, sleep=sleep)
+
+    def always():
+        raise TimeoutError("still down")
+
+    from hyperspace_tpu import telemetry
+    before = telemetry.get_registry().counters_dict()
+    with pytest.raises(TimeoutError):
+        retry.call(always, operation="t.always", policy=policy)
+    assert len(delays) == 2  # attempts-1 backoffs
+    after = telemetry.get_registry().counters_dict()
+    assert after.get("io.retries", 0) - before.get("io.retries", 0) == 2
+    assert after.get("io.giveups", 0) - before.get("io.giveups", 0) == 1
+
+
+def test_retryable_extension_and_predicate():
+    policy = retry.RetryPolicy(attempts=3, sleep=lambda s: None)
+    calls = {"n": 0}
+
+    def torn_then_ok():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("torn json")
+        return 42
+
+    # ValueError is permanent by default...
+    with pytest.raises(ValueError):
+        retry.call(lambda: (_ for _ in ()).throw(ValueError("x")),
+                   operation="t.v", policy=policy)
+    # ...but call sites can extend the classification.
+    assert retry.call(torn_then_ok, operation="t.torn", policy=policy,
+                      retryable=(ValueError,)) == 42
+
+
+def test_classification_typed_and_status_based():
+    assert retry.is_transient(ConnectionResetError("x"))
+    assert retry.is_transient(TimeoutError("x"))
+    assert retry.is_transient(TornWriteError("x"))
+    assert not retry.is_transient(FileNotFoundError("x"))
+    assert not retry.is_transient(PermissionError("x"))
+    assert not retry.is_transient(ValueError("x"))
+
+    class Http(Exception):
+        def __init__(self, status):
+            self.status = status
+
+    assert retry.is_transient(Http(503))
+    assert retry.is_transient(Http(429))
+    assert not retry.is_transient(Http(404))
+
+
+def test_backoff_deterministic_and_capped():
+    policy = retry.RetryPolicy(attempts=10, base_ms=20, max_ms=100)
+    first = [policy.delay_s("op.a", i) for i in range(1, 8)]
+    again = [policy.delay_s("op.a", i) for i in range(1, 8)]
+    assert first == again  # deterministic jitter
+    assert first != [policy.delay_s("op.b", i) for i in range(1, 8)]
+    assert all(d <= 0.100 for d in first)  # capped at max_ms
+    assert all(d >= 0.5 * 0.020 for d in first[:1])
+
+
+def test_policy_from_conf():
+    conf = HyperspaceConf({"spark.hyperspace.io.retry.attempts": "7",
+                           "spark.hyperspace.io.retry.base.ms": "5",
+                           "spark.hyperspace.io.retry.max.ms": "50"})
+    policy = retry.policy_for(conf)
+    assert (policy.attempts, policy.base_ms, policy.max_ms) == (7, 5.0, 50.0)
+    assert retry.policy_for(None) is retry.DEFAULT_POLICY
+
+
+# -- fault injector --------------------------------------------------------
+
+
+def test_injector_nth_and_times(fault_injector):
+    inj = fault_injector(FaultRule("seam.*", kind="transient", nth=2,
+                                   times=2))
+    assert faults.fire("seam.x") is None  # call 1: before nth
+    for _ in range(2):  # calls 2-3 fire
+        with pytest.raises(InjectedTransientError):
+            faults.fire("seam.x")
+    assert faults.fire("seam.x") is None  # exhausted
+    assert inj.fired("seam.*") == 2
+    assert faults.fire("other.op") is None  # pattern mismatch
+
+
+def test_injector_path_filter_and_kinds(fault_injector):
+    fault_injector(
+        FaultRule("file.create", kind="permanent", path="*report*",
+                  times=-1))
+    assert faults.fire("file.create", "/x/data.parquet") is None
+    with pytest.raises(InjectedPermanentError):
+        faults.fire("file.create", "/x/7.report.json")
+    with pytest.raises(InjectedPermanentError):  # times=-1: forever
+        faults.fire("file.create", "/x/8.report.json")
+
+
+def test_injector_crash_is_baseexception(fault_injector):
+    fault_injector(FaultRule("boom", kind="crash"))
+    with pytest.raises(InjectedCrash):
+        faults.fire("boom")
+    assert not issubclass(InjectedCrash, Exception)
+
+
+def test_injector_seeded_probability_replays(fault_injector):
+    def pattern(seed):
+        inj = faults.FaultInjector(
+            [FaultRule("p.*", kind="transient", probability=0.5,
+                       times=-1)], seed=seed)
+        out = []
+        for _ in range(32):
+            try:
+                inj.check("p.op")
+                out.append(0)
+            except InjectedTransientError:
+                out.append(1)
+        return out
+
+    assert pattern(7) == pattern(7)  # same seed -> same chaos
+    assert pattern(7) != pattern(8)
+    assert 0 < sum(pattern(7)) < 32
+
+
+def test_uninstalled_fire_is_noop():
+    faults.uninstall()
+    assert faults.fire("anything", "/p") is None
+
+
+# -- log manager resilience ------------------------------------------------
+
+
+def test_log_read_retries_transient_io(tmp_path, fault_injector):
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    assert mgr.write_log(0, make_entry(state=States.ACTIVE))
+    fault_injector(FaultRule("file.read", kind="transient", times=2,
+                             path="*_hyperspace_log*"))
+    entry = mgr.get_log(0)  # survives two injected read failures
+    assert entry.state == States.ACTIVE
+
+
+def test_log_read_retries_torn_json(tmp_path, monkeypatch):
+    """A parse failure during read is retried (the OCC fallback publishes
+    the filename before its contents); the writer 'finishing' during the
+    retry window makes the read succeed."""
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    assert mgr.write_log(0, make_entry(state=States.ACTIVE))
+    real_read = file_utils.read_contents
+    calls = {"n": 0}
+
+    def torn_then_full(path):
+        calls["n"] += 1
+        contents = real_read(path)
+        return contents[: len(contents) // 2] if calls["n"] < 3 else contents
+
+    monkeypatch.setattr(
+        "hyperspace_tpu.index.log_manager.file_utils.read_contents",
+        torn_then_full)
+    assert mgr.get_log(0).state == States.ACTIVE
+    assert calls["n"] == 3
+
+
+def test_log_read_permanently_corrupt_raises(tmp_path):
+    log_dir = tmp_path / "idx" / "_hyperspace_log"
+    log_dir.mkdir(parents=True)
+    (log_dir / "0").write_text("{torn forever")
+    mgr = IndexLogManagerImpl(
+        str(tmp_path / "idx"),
+        conf=HyperspaceConf({"spark.hyperspace.io.retry.attempts": "2",
+                             "spark.hyperspace.io.retry.base.ms": "1"}))
+    with pytest.raises(HyperspaceException, match="Corrupt log entry"):
+        mgr.get_log(0)
+
+
+def test_atomic_publish_never_tears_target(tmp_path, fault_injector):
+    target = str(tmp_path / "latestStable")
+    file_utils.atomic_publish(target, '{"state": "OLD"}')
+    fault_injector(FaultRule("file.publish", kind="torn", times=-1))
+    with pytest.raises(TornWriteError):
+        file_utils.atomic_publish(target, '{"state": "NEW-LONGER"}')
+    # Reader sees the OLD contents in full — never a torn mix.
+    assert json.loads(file_utils.read_contents(target)) == {"state": "OLD"}
+    assert [f for f in os.listdir(tmp_path) if f.startswith("latestStable.")]\
+        == []  # no temp litter
+
+
+def test_latest_stable_copy_atomic_in_log_manager(tmp_path, fault_injector):
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"),
+                              conf=HyperspaceConf({
+                                  "spark.hyperspace.io.retry.attempts": "2",
+                                  "spark.hyperspace.io.retry.base.ms": "1"}))
+    assert mgr.write_log(0, make_entry(state=States.ACTIVE))
+    assert mgr.create_latest_stable_log(0)
+    assert mgr.write_log(1, make_entry(state=States.DELETED))
+    fault_injector(FaultRule("file.publish", kind="torn", times=-1))
+    with pytest.raises(TornWriteError):
+        mgr.create_latest_stable_log(1)
+    # latestStable still parses, serving the previous stable entry.
+    assert mgr.get_latest_stable_log().state == States.ACTIVE
+
+
+def test_action_report_write_failure_never_fails_action(tmp_path,
+                                                        fault_injector):
+    """fsspec backends raise library-specific (non-OSError) exceptions;
+    the sidecar guard must absorb ANY of them."""
+    mgr = IndexLogManagerImpl(str(tmp_path / "idx"))
+    fault_injector(FaultRule("file.create", kind="permanent",
+                             path="*report.json*", times=-1))
+
+    from test_actions import NoOpAction
+    NoOpAction(mgr).run()  # must not raise
+    assert mgr.get_latest_log().state == States.ACTIVE
+    assert mgr.get_action_report(1) is None
+
+
+# -- OCC under concurrency -------------------------------------------------
+
+
+def test_occ_exactly_one_winner_per_log_id_on_memory():
+    root = f"memory://occ-{uuid.uuid4().hex}"
+    mgr = IndexLogManagerImpl(root + "/idx")
+    workers = 8
+    try:
+        for log_id in range(3):
+            barrier = threading.Barrier(workers)
+            results = []
+
+            def attempt():
+                entry = make_entry(state=States.CREATING)
+                barrier.wait()
+                results.append(mgr.write_log(log_id, entry))
+
+            threads = [threading.Thread(target=attempt)
+                       for _ in range(workers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert sum(results) == 1, (log_id, results)
+            assert mgr.get_latest_id() == log_id
+    finally:
+        file_utils.delete(root)
+
+
+def test_occ_concurrent_actions_one_winner(tmp_path):
+    """Two racing NoOpActions on one filesystem log: exactly one wins
+    the begin slot; the loser raises the conflict error."""
+    from test_actions import NoOpAction
+
+    mgr_path = str(tmp_path / "idx")
+    outcomes = []
+    barrier = threading.Barrier(2)
+
+    def run_action():
+        action = NoOpAction(IndexLogManagerImpl(mgr_path))
+        _ = action.base_id  # resolve base BEFORE the race
+        barrier.wait()
+        try:
+            action.run()
+            outcomes.append("won")
+        except HyperspaceException:
+            outcomes.append("lost")
+
+    threads = [threading.Thread(target=run_action) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(outcomes) == ["lost", "won"]
+
+
+# -- crash-point matrix ----------------------------------------------------
+
+
+def _write_source(path, n=240, seed=3):
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    pq.write_table(
+        pa.table({"k": rng.integers(0, 40, n).astype(np.int64),
+                  "x": np.arange(n, dtype=np.int64)}),
+        os.path.join(path, f"part-{seed}.parquet"))
+
+
+def _fresh_env(tmp_path):
+    src = str(tmp_path / "src")
+    _write_source(src)
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": "4"}))
+    hs = Hyperspace(sess)
+    df = sess.read_parquet(src)
+    return hs, sess, df, src
+
+
+def _prepare(verb, hs, sess, df, src):
+    """Drive the index into the state the verb's validate() requires."""
+    cfg = IndexConfig("idx", ["k"], ["x"])
+    if verb == "create":
+        return
+    hs.create_index(df, cfg)
+    if verb in ("refresh", "optimize"):
+        return
+    if verb == "incremental":
+        _write_source(src, n=60, seed=9)  # append a source file
+        return
+    if verb in ("restore", "vacuum"):
+        hs.delete_index("idx")
+        return
+    if verb == "delete":
+        return
+    if verb == "cancel":
+        # Strand the index mid-refresh so cancel's validate passes.
+        faults.install(faults.FaultInjector(
+            [FaultRule("action.RefreshAction.end", kind="crash")]))
+        with pytest.raises(InjectedCrash):
+            hs.refresh_index("idx")
+        faults.uninstall()
+        return
+    raise AssertionError(verb)
+
+
+def _run_verb(verb, hs, sess, df, src):
+    cfg = IndexConfig("idx", ["k"], ["x"])
+    if verb == "create":
+        hs.create_index(df, cfg)
+    elif verb == "refresh":
+        hs.refresh_index("idx")
+    elif verb == "incremental":
+        hs.refresh_index("idx", mode="incremental")
+    elif verb == "optimize":
+        hs.optimize_index("idx")
+    elif verb == "delete":
+        hs.delete_index("idx")
+    elif verb == "restore":
+        hs.restore_index("idx")
+    elif verb == "vacuum":
+        hs.vacuum_index("idx")
+    elif verb == "cancel":
+        hs.cancel("idx")
+    else:
+        raise AssertionError(verb)
+
+
+_VERB_CLASS = {
+    "create": "CreateAction", "refresh": "RefreshAction",
+    "incremental": "RefreshIncrementalAction", "optimize": "OptimizeAction",
+    "delete": "DeleteAction", "restore": "RestoreAction",
+    "vacuum": "VacuumAction", "cancel": "CancelAction",
+}
+
+_FINAL_STATE = {
+    "create": States.ACTIVE, "refresh": States.ACTIVE,
+    "incremental": States.ACTIVE, "optimize": States.ACTIVE,
+    "delete": States.DELETED, "restore": States.ACTIVE,
+    "vacuum": States.DOESNOTEXIST,
+}
+
+
+@pytest.mark.parametrize("phase", ["validate", "begin", "op", "end"])
+@pytest.mark.parametrize("verb", sorted(_VERB_CLASS))
+def test_crash_point_matrix(tmp_path, fault_injector, verb, phase):
+    """Abort at every phase boundary of every Action subclass; the index
+    must always recover to a stable state via recover_index, and the
+    same maintenance op must then succeed with no manual surgery."""
+    hs, sess, df, src = _fresh_env(tmp_path)
+    _prepare(verb, hs, sess, df, src)
+
+    fault_injector(FaultRule(f"action.{_VERB_CLASS[verb]}.{phase}",
+                             kind="crash"))
+    with pytest.raises(InjectedCrash):
+        _run_verb(verb, hs, sess, df, src)
+    faults.uninstall()
+
+    log_mgr = IndexLogManagerImpl(str(tmp_path / "wh" / "indexes" / "idx"))
+    try:
+        hs.recover_index("idx")
+    except HyperspaceException:
+        # create crashed before its first log write: nothing to recover.
+        assert verb == "create" and phase in ("validate", "begin")
+    latest = log_mgr.get_latest_log()
+    if latest is not None:
+        assert latest.state in STABLE_STATES, (verb, phase, latest.state)
+
+    if verb == "cancel":
+        # Recovery IS the cancel; re-running cancel on a stable index is
+        # (correctly) invalid. The stranded refresh resolved to stable.
+        return
+    _run_verb(verb, hs, sess, df, src)  # next maintenance op succeeds
+    assert log_mgr.get_latest_log().state == _FINAL_STATE[verb], (verb,
+                                                                  phase)
+
+
+def test_crashed_create_then_query_and_rebuild(tmp_path, fault_injector):
+    """End-to-end recovery: a create that crashes mid-op leaves a partial
+    uncommitted `v__=0`; queries keep answering from source, recovery
+    unblocks the name, the rebuild lands in `v__=1`, and the new index
+    serves queries correctly."""
+    hs, sess, df, src = _fresh_env(tmp_path)
+    cfg = IndexConfig("idx", ["k"], ["x"])
+    fault_injector(FaultRule("parquet.write", kind="crash", nth=3))
+    with pytest.raises(InjectedCrash):
+        hs.create_index(df, cfg)
+    faults.uninstall()
+
+    idx_root = str(tmp_path / "wh" / "indexes" / "idx")
+    # Partial dir exists but carries no commit marker.
+    from hyperspace_tpu.index.data_manager import IndexDataManagerImpl
+    dm = IndexDataManagerImpl(idx_root)
+    assert dm.all_version_ids() == [0]
+    assert dm.get_latest_version_id() is None
+
+    sess.enable_hyperspace()
+    q = lambda: df.filter(col("k") == lit(5)).select("x")
+    want = q().collect().to_pandas()  # no ACTIVE index: source plan
+
+    assert hs.recover_index("idx") is True
+    hs.create_index(df, cfg)
+    assert dm.get_latest_version_id() == 1  # skipped the partial dir
+    got = q().collect().to_pandas()
+    assert sorted(got["x"]) == sorted(want["x"])
+    # Vacuuming hard-deletes the partial dir along with the real one.
+    hs.delete_index("idx")
+    hs.vacuum_index("idx")
+    assert dm.all_version_ids() == []
+
+
+def test_lease_gated_auto_recovery(tmp_path, fault_injector):
+    """Within the lease a stranded writer blocks (presumed alive); past
+    it, the next maintenance action recovers automatically."""
+    hs, sess, df, src = _fresh_env(tmp_path)
+    cfg = IndexConfig("idx", ["k"], ["x"])
+    fault_injector(FaultRule("action.CreateAction.op", kind="crash"))
+    with pytest.raises(InjectedCrash):
+        hs.create_index(df, cfg)
+    faults.uninstall()
+
+    sess.conf.set("spark.hyperspace.maintenance.lease.seconds", "3600")
+    with pytest.raises(HyperspaceException, match="already exists"):
+        hs.create_index(df, cfg)  # lease holds: writer presumed alive
+
+    sess.conf.set("spark.hyperspace.maintenance.lease.seconds", "0")
+    hs.create_index(df, cfg)  # auto-recovered, then built
+    log_mgr = IndexLogManagerImpl(str(tmp_path / "wh" / "indexes" / "idx"))
+    assert log_mgr.get_latest_log().state == States.ACTIVE
+    reg = sess.metrics_registry().counters_dict()
+    assert reg.get("resilience.recoveries", 0) >= 1
+
+
+# -- graceful query degradation --------------------------------------------
+
+
+def _indexed_env(tmp_path):
+    hs, sess, df, src = _fresh_env(tmp_path)
+    hs.create_index(df, IndexConfig("idx", ["k"], ["x"]))
+    sess.enable_hyperspace()
+    query = lambda: df.filter(col("k") == lit(5)).select("x")
+    # Sanity: the rule serves the query from index data.
+    roots = [p for leaf in query()._optimized_plan().collect_leaves()
+             for p in leaf.root_paths]
+    assert any("v__=" in p for p in roots)
+    return hs, sess, df, query, str(tmp_path / "wh" / "indexes" / "idx")
+
+
+def test_degrades_to_source_when_index_data_deleted(tmp_path):
+    hs, sess, df, query, idx_root = _indexed_env(tmp_path)
+    want = sorted(query().collect().to_pandas()["x"])
+    shutil.rmtree(os.path.join(idx_root, "v__=0"))
+
+    from hyperspace_tpu import telemetry
+    before = telemetry.get_registry().counters_dict() \
+        .get("resilience.fallbacks", 0)
+    table, metrics = query().collect(with_metrics=True)
+    assert sorted(table.to_pandas()["x"]) == want  # correct via source
+    assert metrics.counters.get("resilience.fallbacks") == 1
+    degraded = metrics.events_of("resilience", "degraded")
+    assert degraded and degraded[0]["index"] == "idx"
+    after = telemetry.get_registry().counters_dict() \
+        .get("resilience.fallbacks", 0)
+    assert after - before >= 1
+
+
+def test_degrades_to_source_when_index_file_corrupt(tmp_path):
+    hs, sess, df, query, idx_root = _indexed_env(tmp_path)
+    want = sorted(query().collect().to_pandas()["x"])
+    data_dir = os.path.join(idx_root, "v__=0")
+    for name in os.listdir(data_dir):
+        if name.endswith(".parquet"):
+            with open(os.path.join(data_dir, name), "wb") as f:
+                f.write(b"these are not the bytes you indexed")
+    table, metrics = query().collect(with_metrics=True)
+    assert sorted(table.to_pandas()["x"]) == want
+    assert metrics.counters.get("resilience.fallbacks") == 1
+
+
+def test_source_scan_errors_do_not_degrade(tmp_path):
+    """Degradation is for RULE-SELECTED index scans only: a broken
+    SOURCE relation has nothing to fall back to and must raise."""
+    hs, sess, df, query, idx_root = _indexed_env(tmp_path)
+    sess.disable_hyperspace()
+    shutil.rmtree(str(tmp_path / "src"))
+    with pytest.raises(Exception):
+        df.filter(col("k") == lit(5)).select("x").collect()
+
+
+def test_join_query_degrades_too(tmp_path):
+    """The JoinIndexRule path: both sides' indexes vanish; the join
+    answers from source."""
+    src_a = str(tmp_path / "a")
+    src_b = str(tmp_path / "b")
+    _write_source(src_a, n=120, seed=1)
+    _write_source(src_b, n=120, seed=2)
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": "4"}))
+    hs = Hyperspace(sess)
+    dfa = sess.read_parquet(src_a)
+    dfb = sess.read_parquet(src_b)
+    hs.create_index(dfa, IndexConfig("ia", ["k"], ["x"]))
+    hs.create_index(dfb, IndexConfig("ib", ["k"], ["x"]))
+    sess.enable_hyperspace()
+    q = lambda: dfa.join(dfb, on="k").select("k")
+    want = q().collect().num_rows
+    for name in ("ia", "ib"):
+        shutil.rmtree(str(tmp_path / "wh" / "indexes" / name / "v__=0"))
+    table, metrics = q().collect(with_metrics=True)
+    assert table.num_rows == want
+    assert metrics.counters.get("resilience.fallbacks") == 1
+
+
+# -- vacuum over sparse/partial layouts ------------------------------------
+
+
+def test_vacuum_handles_sparse_versions():
+    mgr = FakeLogManager()
+    mgr.write_log(0, make_entry(state=States.DELETED))
+    data = FakeDataManager(versions=[0, 3, 7])  # sparse: 1,2,4-6 missing
+    from hyperspace_tpu.actions.vacuum import VacuumAction
+    VacuumAction(mgr, data).run()
+    assert data.deleted == [7, 3, 0]
+    assert mgr.get_latest_log().state == States.DOESNOTEXIST
+
+
+def test_storage_transient_faults_ride_the_retry_seam(tmp_path,
+                                                      fault_injector):
+    """A transient storage failure mid-action is absorbed by the retry
+    policy — the action completes as if nothing happened, and the
+    io.retries counter shows the save."""
+    from hyperspace_tpu import telemetry
+    hs, sess, df, src = _fresh_env(tmp_path)
+    before = telemetry.get_registry().counters_dict().get("io.retries", 0)
+    fault_injector(FaultRule("parquet.write", kind="transient", nth=2,
+                             times=1),
+                   FaultRule("file.write_if_absent", kind="transient",
+                             times=1))
+    hs.create_index(df, IndexConfig("idx", ["k"], ["x"]))
+    log_mgr = IndexLogManagerImpl(str(tmp_path / "wh" / "indexes" / "idx"))
+    assert log_mgr.get_latest_log().state == States.ACTIVE
+    after = telemetry.get_registry().counters_dict().get("io.retries", 0)
+    assert after - before >= 2

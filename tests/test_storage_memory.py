@@ -49,10 +49,18 @@ def test_data_manager_versions_on_memory(mem_root):
     dm = IndexDataManagerImpl(mem_root + "/idx")
     assert dm.get_latest_version_id() is None
     for v in (0, 1, 5):
-        file_utils.create_file(dm.get_path(v) + "/marker.txt", "x")
+        file_utils.create_file(dm.get_path(v) + "/data.txt", "x")
+        dm.commit(v)
     assert dm.get_latest_version_id() == 5
+    assert dm.next_version_id() == 6
     dm.delete(5)
     assert dm.get_latest_version_id() == 1
+    # An uncommitted (partial) dir is skipped by readers but seen by the
+    # version allocator and vacuum's enumeration.
+    file_utils.create_file(dm.get_path(7) + "/data.txt", "x")
+    assert dm.get_latest_version_id() == 1
+    assert dm.next_version_id() == 8
+    assert dm.all_version_ids() == [0, 1, 7]
 
 
 def test_full_lifecycle_and_query_on_memory_warehouse(mem_root, tmp_path):

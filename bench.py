@@ -34,7 +34,7 @@ NUM_BUCKETS = int(os.environ.get("BENCH_BUCKETS", 64))
 WARM_RUNS = int(os.environ.get("BENCH_WARM_RUNS", 5))
 
 
-from bench_common import link_probe, log  # noqa: E402
+from bench_common import link_probe, log, transfer_summary  # noqa: E402
 
 # label -> median seconds over the warm runs; rides in the artifact next
 # to the best-of numbers so a lucky run can't carry a headline.
@@ -179,7 +179,15 @@ def rung1_build(table, work):
     def compute_and_fetch():
         # Fresh dispatch each run: jax caches an array's host copy, so
         # re-fetching the SAME chunks would time a no-op after run 0.
-        for c in compute():
+        # Mirror the product fetch (`_write_sorted_runs`): every
+        # chunk's async D2H is issued before the first blocking
+        # asarray, so the streams overlap exactly like the build's
+        # permutation fetch does.
+        chunks = compute()
+        for c in chunks:
+            if hasattr(c, "copy_to_host_async"):
+                c.copy_to_host_async()
+        for c in chunks:
             np.asarray(c)
 
     fetch_s = best_of(compute_and_fetch, label="rung1 compute+perm-d2h")
@@ -618,6 +626,12 @@ def main():
                                      full5 / inc5, 3)},
             },
             "phase_medians_s": dict(MEDIANS),
+            # Link-engine digest over the whole ladder: total bytes /
+            # chunk counts each direction and the measured
+            # decode<->link overlap (serial stage sum minus pipelined
+            # wall). bench_regress.py separately gates the rung-1 link
+            # SHARE of the build.
+            "transfer": transfer_summary(),
             # Process-lifetime aggregates over the WHOLE ladder: action
             # reports (create/refresh/optimize counts, rows/bytes),
             # fusion stage stats, link-transfer totals, mesh dispatches.
@@ -628,6 +642,12 @@ def main():
             # peak_hbm_bytes growing >15% between rounds.
             "memory": telemetry.memory.artifact_section(),
         }
+        xfer = result["transfer"]
+        log(f"transfer: h2d {xfer['h2d_bytes'] / 1e6:.1f} MB in "
+            f"{xfer['h2d_chunks']} chunks / {xfer['h2d_transfers']} "
+            f"transfers, d2h {xfer['d2h_bytes'] / 1e6:.1f} MB in "
+            f"{xfer['d2h_chunks']} chunks, overlap saved "
+            f"{xfer['overlap_saved_seconds']:.2f}s")
         trace_out = os.environ.get("BENCH_TRACE_OUT")
         if trace_out:
             result["trace"] = telemetry.export_trace(trace_out)

@@ -45,7 +45,8 @@ QUERY_FILTER = [q for q in os.environ.get(
     "BENCH_TPCDS_QUERIES", "").split(",") if q]
 
 
-from bench_common import link_probe, log, timed_runs  # noqa: E402
+from bench_common import (link_probe, log, timed_runs,  # noqa: E402
+                          transfer_summary)
 from hyperspace_tpu import telemetry  # noqa: E402
 
 
@@ -151,6 +152,7 @@ def main():
             "index_build_s": round(index_build_s, 2),
             "link_probe": probe,
             "queries": queries,
+            "transfer": transfer_summary(),
             "process_metrics": telemetry.get_registry().counters_dict(),
             "memory": telemetry.memory.artifact_section(),
         }))

@@ -164,6 +164,29 @@ class HyperspaceConf:
                               str(constants.IO_RETRY_MAX_MS_DEFAULT)))
 
     @property
+    def io_transfer_chunk_bytes(self) -> int:
+        """Chunk granularity of pipelined H2D stagings
+        (`io/transfer.py`); large arrays ship as row chunks of at most
+        this many bytes."""
+        return self.get_int(constants.IO_TRANSFER_CHUNK_BYTES,
+                            constants.IO_TRANSFER_CHUNK_BYTES_DEFAULT)
+
+    @property
+    def io_transfer_inflight_bytes(self) -> int:
+        """Bound on bytes in flight over the device link across all
+        outstanding puts (the transfer engine blocks the oldest put
+        before admitting more)."""
+        return self.get_int(constants.IO_TRANSFER_INFLIGHT_BYTES,
+                            constants.IO_TRANSFER_INFLIGHT_BYTES_DEFAULT)
+
+    @property
+    def io_transfer_threads(self) -> int:
+        """Staging-thread pool width: how many column decodes / chunk
+        conversions can run ahead of the link."""
+        return self.get_int(constants.IO_TRANSFER_THREADS,
+                            constants.IO_TRANSFER_THREADS_DEFAULT)
+
+    @property
     def maintenance_lease_seconds(self) -> int:
         """Age past which a transient op-log entry is treated as a crashed
         writer and auto-recovered (Cancel FSM) by the next maintenance

@@ -72,6 +72,19 @@ IO_RETRY_BASE_MS_DEFAULT = 20
 IO_RETRY_MAX_MS = "spark.hyperspace.io.retry.max.ms"
 IO_RETRY_MAX_MS_DEFAULT = 2000
 
+# Pipelined transfer engine (`io/transfer.py`, THE host<->device link
+# seam): chunk granularity of large H2D stagings, the bounded in-flight
+# byte window across all outstanding puts, and the staging-thread pool
+# width (decode/convert of chunk i+1 overlaps chunk i's transfer).
+# Tune chunk.bytes against the link: small enough that several chunks
+# pipeline, large enough that the per-put dispatch latency amortizes.
+IO_TRANSFER_CHUNK_BYTES = "spark.hyperspace.io.transfer.chunk.bytes"
+IO_TRANSFER_CHUNK_BYTES_DEFAULT = 4 * 1024 * 1024
+IO_TRANSFER_INFLIGHT_BYTES = "spark.hyperspace.io.transfer.inflight.bytes"
+IO_TRANSFER_INFLIGHT_BYTES_DEFAULT = 64 * 1024 * 1024
+IO_TRANSFER_THREADS = "spark.hyperspace.io.transfer.threads"
+IO_TRANSFER_THREADS_DEFAULT = 2
+
 # Crash recovery lease: a maintenance action that finds the op log's
 # latest entry in a TRANSIENT state (CREATING/REFRESHING/...) treats the
 # in-flight writer as crashed once the entry is older than this many

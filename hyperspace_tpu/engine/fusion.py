@@ -122,11 +122,15 @@ _bcast_budget = [_constants.FUSION_BCAST_CACHE_BYTES_DEFAULT]
 def _configure_cache_budgets(conf) -> None:
     """Refresh the effective byte budgets from the session conf (the
     caches are process-wide; sessions sharing a process should agree,
-    same caveat as the parquet cache budgets)."""
+    same caveat as the parquet cache budgets). The transfer engine's
+    io.transfer.* knobs refresh on the same cadence — one fused
+    execution picks up a session's link tuning."""
     if conf is None:
         return
     _promote_budget[0] = conf.fusion_promote_cache_bytes
     _bcast_budget[0] = conf.fusion_bcast_cache_bytes
+    from hyperspace_tpu.io import transfer
+    transfer.configure(conf)
 
 
 def _promote_nbytes(ent) -> int:
@@ -170,12 +174,13 @@ def _to_device(arr):
         telemetry.memory.cache_hit("fusion_promote")
         return ent[1]
     telemetry.memory.cache_miss("fusion_promote")
-    import jax
+    from hyperspace_tpu.io import transfer
     # Cache MISSES are exactly the executions that pay the link; the
-    # transfer record (registry histogram + optional span) makes the
-    # promotion cost attributable instead of folded into dispatch_s.
-    with telemetry.link_transfer("h2d", arr.nbytes):
-        out = jax.device_put(arr)
+    # engine's transfer record (registry histogram + optional span)
+    # makes the promotion cost attributable instead of folded into
+    # dispatch_s — and big dimension columns ship chunked/windowed like
+    # every other crossing.
+    out = transfer.get_engine().put(arr)
     try:
         ref = weakref.ref(arr)
     except TypeError:

@@ -27,6 +27,11 @@ class HyperspaceSession:
         self._hyperspace_enabled = False
         self._views: dict = {}
         self._last_query_metrics = None
+        # Session knobs -> the process-wide pipelined transfer engine
+        # (io.transfer.{chunk,inflight,threads}); refreshed again at
+        # each fused execution so late conf.set calls take effect.
+        from hyperspace_tpu.io import transfer
+        transfer.configure(self.conf)
 
     def last_query_metrics(self):
         """`telemetry.QueryMetrics` of the most recent query executed

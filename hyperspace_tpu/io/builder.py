@@ -21,6 +21,7 @@ TPU-native pipeline (single device; the mesh-sharded variant lives in
 from __future__ import annotations
 
 import os
+import threading
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -34,67 +35,102 @@ from hyperspace_tpu.plan.nodes import BucketSpec
 def _write_sorted_runs(table, perm_chunks, starts, ends, path: str,
                        file_suffix: Optional[str]) -> List[str]:
     """Apply the device-computed permutation chunk by chunk on the host and
-    stream bucket files out.
+    stream bucket files out — the tail of the pipelined build.
 
     `perm_chunks` are contiguous slices of the global (bucket, *keys) sort
     permutation, still device-resident: their D2H copies are issued
-    asynchronously up front, so chunk i+1 is in flight over the link while
-    chunk i is being gathered (Arrow `take`) and parquet-encoded. A bucket
-    whose rows span a chunk boundary is written as multiple run files
-    (`part-NNNNN-cKK.parquet`); runs are contiguous in sort order, so their
-    name-ordered concatenation stays fully sorted — the same multi-run
-    layout the incremental-refresh deltas already use.
+    asynchronously up front (transfer-engine prefetch; failures land in
+    `link.d2h.prefetch_errors` instead of silently degrading to the
+    serial path), so chunk i+1 is in flight over the link while chunk i
+    is being gathered (Arrow `take`) and parquet-encoded — and chunk i's
+    parquet ENCODE runs on the writer thread while chunk i+1's fetch +
+    gather proceed (one chunk of write depth, so fault/ordering
+    semantics stay deterministic). A bucket whose rows span a chunk
+    boundary is written as multiple run files (`part-NNNNN-cKK.parquet`);
+    runs are contiguous in sort order, so their name-ordered
+    concatenation stays fully sorted — the same multi-run layout the
+    incremental-refresh deltas already use.
     """
     import pyarrow as pa
 
+    from hyperspace_tpu.io import transfer
+
+    engine = transfer.get_engine()
     # Order matters: issue every chunk's DMA before the first blocking
-    # np.asarray (starts/ends below) so the transfers run during the
+    # fetch (starts/ends below) so the transfers run during the
     # device-sort sync instead of after it.
-    for chunk in perm_chunks:
-        if hasattr(chunk, "copy_to_host_async"):
-            try:
-                chunk.copy_to_host_async()
-            except Exception:
-                pass  # best-effort prefetch only
+    engine.prefetch(*perm_chunks)
     starts, ends = np.asarray(starts), np.asarray(ends)
     written: List[str] = []
-    from hyperspace_tpu import telemetry
     from hyperspace_tpu.utils import file_utils
     file_utils.create_directory(path)
     multi = len(perm_chunks) > 1
     offset = 0
-    for ci, chunk in enumerate(perm_chunks):
-        if not isinstance(chunk, np.ndarray):
-            # Device-resident permutation chunk: this np.asarray IS the
-            # D2H link crossing (the async prefetch above may have
-            # already landed it — the histogram then shows a near-zero
-            # wall for the same bytes, which is the overlap working).
-            with telemetry.link_transfer("d2h",
-                                         getattr(chunk, "nbytes", 0)):
-                perm_np = np.asarray(chunk)
-        else:
-            perm_np = chunk
-        m = len(perm_np)
-        if m == 0:
-            continue
-        chunk_table = table.take(pa.array(perm_np))
-        # Buckets intersecting sorted-row range [offset, offset + m).
-        b_lo = int(np.searchsorted(ends, offset, side="right"))
-        b_hi = int(np.searchsorted(starts, offset + m, side="left"))
-        for b in range(b_lo, b_hi):
-            s = max(int(starts[b]), offset)
-            e = min(int(ends[b]), offset + m)
-            if e <= s:
-                continue  # empty bucket -> no file, like Spark bucketed output
-            suffix = file_suffix
-            if multi and (int(starts[b]) < offset or int(ends[b]) > offset + m):
-                # Partial run of a chunk-spanning bucket: unique, ordered name.
-                suffix = f"{file_suffix or ''}c{ci:02d}"
-            out = os.path.join(path, parquet.bucket_file_name(b, suffix))
-            parquet.write_table(chunk_table.slice(s - offset, e - s), out)
-            written.append(out)
-        offset += m
+    pending: List = []  # last chunk's in-flight write futures
+
+    def drain():
+        for fut in pending:
+            fut.result()
+        pending.clear()
+
+    try:
+        for ci, chunk in enumerate(perm_chunks):
+            # Device-resident permutation chunk: engine.fetch IS the D2H
+            # link crossing (the async prefetch above may have already
+            # landed it — the histogram then shows a near-zero wall for
+            # the same bytes, which is the overlap working).
+            perm_np = engine.fetch(chunk)
+            m = len(perm_np)
+            if m == 0:
+                continue
+            chunk_table = table.take(pa.array(perm_np))
+            # Previous chunk's encodes must land before this chunk's are
+            # queued: single-writer FIFO keeps write (and injected
+            # fault) order identical to the serial path.
+            drain()
+            # Buckets intersecting sorted-row range [offset, offset + m).
+            b_lo = int(np.searchsorted(ends, offset, side="right"))
+            b_hi = int(np.searchsorted(starts, offset + m, side="left"))
+            for b in range(b_lo, b_hi):
+                s = max(int(starts[b]), offset)
+                e = min(int(ends[b]), offset + m)
+                if e <= s:
+                    continue  # empty bucket -> no file (Spark parity)
+                suffix = file_suffix
+                if multi and (int(starts[b]) < offset
+                              or int(ends[b]) > offset + m):
+                    # Partial run of a chunk-spanning bucket: unique,
+                    # ordered name.
+                    suffix = f"{file_suffix or ''}c{ci:02d}"
+                out = os.path.join(path, parquet.bucket_file_name(b, suffix))
+                pending.append(_writer_pool().submit(
+                    parquet.write_table,
+                    chunk_table.slice(s - offset, e - s), out))
+                written.append(out)
+            offset += m
+    finally:
+        drain()
     return written
+
+
+# Single-worker writer behind `_write_sorted_runs`: ONE lane keeps file
+# writes (and injected write faults) in deterministic submission order
+# while still overlapping chunk i's parquet encode with chunk i+1's
+# permutation fetch + Arrow gather. Lazy module-level pool — a
+# per-build executor would churn a thread per maintenance action.
+_writer = None
+_writer_lock = threading.Lock()
+
+
+def _writer_pool():
+    global _writer
+    if _writer is None:
+        with _writer_lock:
+            if _writer is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _writer = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="hs-bucket-writer")
+    return _writer
 
 
 # Below this row count the build permutation is computed on the host
@@ -166,10 +202,17 @@ def _stage_key_tree(table, names: Sequence[str]):
     for `ops.build.permutation_from_tree`, with narrow transport: a
     null-free int64 column whose values fit uint32 (host range check over
     data already in cache) ships HALF the bytes as a single `lo32` lane —
-    hash identity and sort order are unchanged (`ops/build.py`)."""
-    import jax.numpy as jnp
+    hash identity and sort order are unchanged (`ops/build.py`). All H2D
+    rides the pipelined transfer engine: the narrow lane ships as
+    byte-budgeted chunks (several concurrent streams beat one big
+    transfer on the tunneled link; the compiled program concatenates —
+    `_entry_assemble`), cast into reused staging buffers instead of a
+    fresh `astype` materialisation per column."""
     import pyarrow as pa
 
+    from hyperspace_tpu.io import transfer
+
+    engine = transfer.get_engine()
     tree = {}
     wide = []
     for name in names:
@@ -179,21 +222,12 @@ def _stage_key_tree(table, names: Sequence[str]):
         if pa.types.is_int64(chunk.type) and chunk.null_count == 0:
             vals = chunk.to_numpy(zero_copy_only=False)
             if len(vals) and vals.min() >= 0 and vals.max() < 1 << 32:
-                lo = vals.astype(np.uint32)
-                from hyperspace_tpu import telemetry
-                from hyperspace_tpu.ops.build import (LINK_CHUNK_ROWS,
-                                                      LINK_CHUNKS)
-                if len(lo) >= LINK_CHUNK_ROWS:
-                    # Several concurrent H2D streams beat one big transfer
-                    # on the tunneled link; the program concatenates.
-                    import jax
-                    parts = np.array_split(lo, LINK_CHUNKS)
-                    with telemetry.link_transfer("h2d", lo.nbytes):
-                        tree[name] = {"lo32_chunks": tuple(
-                            jax.device_put(p) for p in parts)}
+                parts = engine.put_chunks(
+                    transfer.HostCast(vals, np.uint32))
+                if len(parts) > 1:
+                    tree[name] = {"lo32_chunks": parts}
                 else:
-                    with telemetry.link_transfer("h2d", lo.nbytes):
-                        tree[name] = {"lo32": jnp.asarray(lo)}
+                    tree[name] = {"lo32": parts[0]}
                 continue
         wide.append(name)
     if wide:
@@ -251,15 +285,19 @@ def write_bucketed_from_files(files: Sequence[str],
                               file_suffix: Optional[str] = None
                               ) -> List[str]:
     """PIPELINED build straight from parquet files (the plain-scan create
-    path): decode only the KEY columns, dispatch the device permutation
-    (async — jax returns before the sort finishes), then decode the
-    payload columns WHILE the device sorts. On the decode-bound 1-core
-    host the sort and the key H2D ride along nearly free; the round-3
-    sequential pipeline (full decode -> sort -> write) paid them end to
-    end. Below the device-amortization row count this degrades to the
-    single-read host path."""
+    path): the payload-column decode is kicked off on a background
+    thread FIRST, then the key columns decode, stage over the link
+    (chunked H2D through the transfer engine), and the device
+    permutation dispatches (async — jax returns before the sort
+    finishes). Payload decode thus overlaps key decode + key H2D +
+    device sort, and `_write_sorted_runs` overlaps perm D2H, Arrow
+    gather, and parquet encode — every stage of
+    decode -> stage -> sort -> fetch -> take -> write has a partner to
+    hide behind. Below the device-amortization row count this degrades
+    to the single-read host path."""
     import pyarrow as pa
 
+    from hyperspace_tpu import telemetry
     from hyperspace_tpu.ops.build import permutation_from_tree
 
     n = sum(parquet.file_row_counts(files))  # footers only, no decode
@@ -269,14 +307,32 @@ def write_bucketed_from_files(files: Sequence[str],
             table = append_lineage_column(table, files, lineage_ids)
         return write_bucketed_table(table, list(key_names), num_buckets,
                                     path, file_suffix=file_suffix)
+    payload_names = [c for c in column_names if c not in key_names]
+    payload: dict = {}
+    payload_thread = None
+    if payload_names:
+        # Decoded while the keys decode/stage and the device sorts
+        # (pyarrow releases the GIL for the column decode).
+        def _decode_payload():
+            try:
+                payload["table"] = parquet.read_table(
+                    files, columns=payload_names)
+            except BaseException as exc:  # surfaces at join below
+                payload["error"] = exc
+
+        payload_thread = threading.Thread(
+            target=telemetry.propagating(_decode_payload),
+            name="hs-payload-decode", daemon=True)
+        payload_thread.start()
     key_table = parquet.read_table(files, columns=list(key_names))
     tree = _stage_key_tree(key_table, key_names)
     chunks, starts, ends = permutation_from_tree(tree, key_names, n,
                                                  num_buckets)
-    payload_names = [c for c in column_names if c not in key_names]
-    if payload_names:
-        # Decoded while the device sorts.
-        ptable = parquet.read_table(files, columns=payload_names)
+    if payload_thread is not None:
+        payload_thread.join()
+        if "error" in payload:
+            raise payload["error"]
+        ptable = payload["table"]
         table = pa.table({c: (key_table.column(c) if c in key_names
                               else ptable.column(c))
                           for c in column_names})
@@ -391,7 +447,10 @@ def write_index(df, indexed_columns: Sequence[str],
     lineage_ids[F]. Payload-only — bucket hash and sort keys are untouched.
     """
     from hyperspace_tpu.engine.executor import execute_plan
+    from hyperspace_tpu.io import transfer
     from hyperspace_tpu.parallel.context import should_distribute
+
+    transfer.configure(conf)  # session knobs -> process engine
 
     def build_distributed(mesh, batch):
         from hyperspace_tpu.parallel.build import distributed_build
@@ -420,8 +479,12 @@ def write_index(df, indexed_columns: Sequence[str],
             table = parquet.read_table(files, columns=names)
             if lineage_ids is not None:
                 table = append_lineage_column(table, files, lineage_ids)
-            written = build_distributed(mesh, columnar.from_arrow(table,
-                                                                  schema))
+            # Host batch: `distributed_build` places each device's shard
+            # straight from host memory (concurrent sharded puts through
+            # the transfer engine) instead of round-tripping the whole
+            # table through the default device first.
+            written = build_distributed(
+                mesh, columnar.from_arrow(table, schema, device=False))
         else:
             # Pipelined: key decode -> async device sort -> payload
             # decode overlapping the sort -> streamed bucket writes.

@@ -250,88 +250,120 @@ def _encode_strings_arrow(arr):
     return codes, dictionary, hashes, validity
 
 
+def _decode_numeric(arr, f: SchemaField):
+    """Decode one non-string Arrow column to its RAW host values + null
+    mask (no target-dtype cast yet — the cast is the step the transfer
+    engine performs into reused staging buffers). Returns
+    (np_vals, np_dtype, mask|None)."""
+    np_dtype = _NUMERIC_NP.get(f.dtype)
+    if np_dtype is None:
+        raise HyperspaceException(f"Unsupported dtype: {f.dtype}")
+    chunk = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
+    has_nulls = chunk.null_count > 0
+    if f.dtype == "timestamp":
+        np_vals = chunk.cast("int64").to_numpy(zero_copy_only=False)
+    elif f.dtype == "date32":
+        np_vals = chunk.cast("int32").to_numpy(zero_copy_only=False)
+    else:
+        np_vals = chunk.to_numpy(zero_copy_only=False)
+    mask = None
+    if has_nulls:
+        mask = ~np.asarray(chunk.is_null())
+        np_vals = np.where(mask, np.nan_to_num(np_vals), 0)
+    return np.asarray(np_vals), np_dtype, mask
+
+
+def _decode_device_column(arr, f: SchemaField) -> dict:
+    """Transfer-engine job body for one column (runs on the staging
+    pool): decode to host form and name what must be placed. ndarray /
+    HostCast values cross the link; Host(...) values stay host."""
+    from hyperspace_tpu.io import transfer
+
+    if f.dtype == "string":
+        codes, dictionary, hashes, validity = _encode_strings_arrow(arr)
+        hi, lo = _split_hashes(hashes, device=False)
+        return {"data": codes, "validity": validity,
+                "dictionary": transfer.Host(dictionary),
+                "hash_hi": hi, "hash_lo": lo}
+    np_vals, np_dtype, mask = _decode_numeric(arr, f)
+    data = (np.ascontiguousarray(np_vals)
+            if np_vals.dtype == np_dtype
+            else transfer.HostCast(np_vals, np_dtype))
+    return {"data": data, "validity": mask}
+
+
 def from_arrow(table, schema: Optional[Schema] = None,
                device: bool = True) -> ColumnBatch:
     """Arrow table -> ColumnBatch. Nulls become validity masks with
     sentinel-filled payloads (0 / empty string). `device=False` keeps the
     columns in host memory (numpy) for the adaptive host lane — small
-    batches where a device round-trip would dominate the work."""
-    if device:
-        import time as _time
+    batches where a device round-trip would dominate the work.
 
-        import jax.numpy as jnp
-
-        # THE scan-side H2D site: decoded host columns become device
-        # arrays here. Staged bytes/dispatch-wall accumulate across the
-        # batch's columns and land in the link histograms as one
-        # transfer record (`telemetry.record_link_transfer`).
-        _staged = {"bytes": 0, "s": 0.0}
-
-        def _asarray(arr):
-            arr = np.asarray(arr)
-            t0 = _time.perf_counter()
-            out = jnp.asarray(arr)
-            _staged["s"] += _time.perf_counter() - t0
-            _staged["bytes"] += arr.nbytes
-            return out
-    else:
-        _staged = None
-        _asarray = np.asarray
-
+    The device path is THE scan-side H2D site and runs STREAMED through
+    the pipelined transfer engine (`io/transfer.py`): column decodes run
+    on the staging pool while earlier columns' puts are in flight, large
+    columns ship as byte-budgeted chunks cast into reused staging
+    buffers, and the whole batch lands as one chunk-counted transfer
+    record in the link telemetry."""
     if schema is None:
         schema = Schema.from_arrow(table.schema)
-    columns: Dict[str, DeviceColumn] = {}
+    if device:
+        from functools import partial
+
+        from hyperspace_tpu.io import transfer
+
+        jobs = [partial(_decode_device_column, table.column(f.name), f)
+                for f in schema.fields]
+        placed = transfer.get_engine().put_group(jobs)
+        columns: Dict[str, DeviceColumn] = {}
+        for f, entry in zip(schema.fields, placed):
+            if f.dtype == "string":
+                columns[f.name] = DeviceColumn(
+                    data=entry["data"], dtype="string",
+                    validity=entry.get("validity"),
+                    dictionary=entry["dictionary"],
+                    dict_hashes=(entry["hash_hi"], entry["hash_lo"]))
+            else:
+                columns[f.name] = DeviceColumn(
+                    data=entry["data"], dtype=f.dtype,
+                    validity=entry.get("validity"))
+        return ColumnBatch(schema, columns)
+
+    columns = {}
     for f in schema.fields:
         arr = table.column(f.name)
         if f.dtype == "string":
             codes, dictionary, hashes, validity = _encode_strings_arrow(arr)
             columns[f.name] = DeviceColumn(
-                data=_asarray(codes), dtype="string",
-                validity=(_asarray(validity) if validity is not None else None),
+                data=np.asarray(codes), dtype="string",
+                validity=(np.asarray(validity)
+                          if validity is not None else None),
                 dictionary=dictionary,
-                dict_hashes=_split_hashes(hashes, device=device))
+                dict_hashes=_split_hashes(hashes, device=False))
         else:
-            np_dtype = _NUMERIC_NP.get(f.dtype)
-            if np_dtype is None:
-                raise HyperspaceException(f"Unsupported dtype: {f.dtype}")
-            chunk = arr.combine_chunks() if hasattr(arr, "combine_chunks") else arr
-            has_nulls = chunk.null_count > 0
-            if f.dtype == "timestamp":
-                np_vals = chunk.cast("int64").to_numpy(zero_copy_only=False)
-            elif f.dtype == "date32":
-                np_vals = chunk.cast("int32").to_numpy(zero_copy_only=False)
-            else:
-                np_vals = chunk.to_numpy(zero_copy_only=False)
-            if has_nulls:
-                mask = ~np.asarray(chunk.is_null())
-                np_vals = np.where(mask, np.nan_to_num(np_vals), 0)
-            np_vals = np.asarray(np_vals).astype(np_dtype)
+            np_vals, np_dtype, mask = _decode_numeric(arr, f)
             columns[f.name] = DeviceColumn(
-                data=_asarray(np_vals), dtype=f.dtype,
-                validity=(_asarray(mask) if has_nulls else None))
-    if _staged is not None and _staged["bytes"]:
-        from hyperspace_tpu import telemetry
-        telemetry.record_link_transfer("h2d", _staged["bytes"],
-                                       _staged["s"])
+                data=np_vals.astype(np_dtype), dtype=f.dtype,
+                validity=(np.asarray(mask) if mask is not None else None))
     return ColumnBatch(schema, columns)
 
 
 def to_arrow(batch: ColumnBatch):
     """Device ColumnBatch -> Arrow table (decodes dictionary codes).
 
-    All device->host copies are issued asynchronously first so the
-    per-column transfers overlap (d2h latency dominates on tunneled
+    All device->host copies are issued asynchronously first (transfer
+    engine prefetch — failures are counted, not silently swallowed) so
+    the per-column transfers overlap (d2h latency dominates on tunneled
     devices); the per-column np.asarray below then hits the ready copies.
     """
     import pyarrow as pa
 
+    from hyperspace_tpu.io import transfer
+
+    engine = transfer.get_engine()
     for col in batch.columns.values():
-        for arr in (col.data, col.validity):
-            if arr is not None and hasattr(arr, "copy_to_host_async"):
-                try:
-                    arr.copy_to_host_async()
-                except Exception:
-                    pass  # best-effort prefetch only
+        engine.prefetch(col.data, *((col.validity,)
+                                    if col.validity is not None else ()))
 
     import time as _time
 
@@ -339,6 +371,7 @@ def to_arrow(batch: ColumnBatch):
     names = []
     d2h_bytes = 0
     d2h_s = 0.0
+    d2h_chunks = 0
     for f in batch.schema.fields:
         col = batch.columns[f.name]
         # Result-side D2H: device arrays cross the link in these
@@ -351,6 +384,7 @@ def to_arrow(batch: ColumnBatch):
             d2h_s += _time.perf_counter() - t0
             d2h_bytes += data.nbytes + (validity.nbytes
                                         if validity is not None else 0)
+            d2h_chunks += 1 if validity is None else 2
         if col.is_string:
             values = col.dictionary[data]
             arr = pa.array(values, type=pa.string(),
@@ -372,7 +406,8 @@ def to_arrow(batch: ColumnBatch):
         names.append(f.name)
     if d2h_bytes:
         from hyperspace_tpu import telemetry
-        telemetry.record_link_transfer("d2h", d2h_bytes, d2h_s)
+        telemetry.record_link_transfer("d2h", d2h_bytes, d2h_s,
+                                       chunks=d2h_chunks)
     return pa.table(dict(zip(names, arrays)))
 
 

@@ -84,6 +84,25 @@ _read_cache: "_OrderedDict" = _OrderedDict()
 # run outside it.
 _read_cache_lock = threading.Lock()
 
+# ONE shared IO executor for concurrent per-file reads and footer
+# fetches (lazily created): the previous per-call
+# ThreadPoolExecutor(8) spun up and tore down 8 threads on EVERY
+# multi-file read — per-query thread churn on the hot scan path.
+# Tasks never submit sub-tasks, so sharing cannot deadlock.
+_io_pool = None
+_io_pool_lock = threading.Lock()
+
+
+def io_executor():
+    global _io_pool
+    if _io_pool is None:
+        with _io_pool_lock:
+            if _io_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _io_pool = ThreadPoolExecutor(max_workers=8,
+                                              thread_name_prefix="hs-io")
+    return _io_pool
+
 
 def _file_stamp(path: str):
     """(size, mtime) of a FILE, or None when the path is a directory or
@@ -153,9 +172,8 @@ def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
     if len(paths) == 1:
         table = _read_one(paths[0], cols)
     else:
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=8) as pool:
-            tables = list(pool.map(lambda p: _read_one(p, cols), paths))
+        tables = list(io_executor().map(lambda p: _read_one(p, cols),
+                                        paths))
         table = pa.concat_tables(tables, promote_options="default")
 
     if stamps is not None and READ_CACHE_BYTES > 0:
@@ -211,9 +229,7 @@ def file_row_counts(paths: Sequence[str]) -> List[int]:
 
     if len(paths) <= 1:
         return [meta_rows(p) for p in paths]
-    from concurrent.futures import ThreadPoolExecutor
-    with ThreadPoolExecutor(max_workers=8) as pool:
-        return list(pool.map(meta_rows, paths))
+    return list(io_executor().map(meta_rows, paths))
 
 
 # Decoded host-batch cache: the read cache (above) keeps Arrow bytes, but

@@ -121,11 +121,13 @@ def _build_core(tree, key_names: Tuple[str, ...], num_buckets: int,
     return sorted_tree, sorted_bucket, starts, ends
 
 
-# Transfer policy for the tunneled host<->device link: split transfers of
-# >= LINK_CHUNK_ROWS rows into LINK_CHUNKS concurrent streams (measured
-# ~1.7x faster than one stream; below the threshold the ~0.1s per-sync
-# latency dominates). Shared by the H2D staging (`io/builder.py`) and the
-# D2H permutation fetch (`permutation_from_tree`).
+# Legacy transfer policy for the tunneled host<->device link: split
+# transfers of >= LINK_CHUNK_ROWS rows into LINK_CHUNKS concurrent
+# streams (measured ~1.7x faster than one stream; below the threshold
+# the ~0.1s per-sync latency dominates). H2D staging and the build's
+# D2H permutation fetch now size their chunks from the transfer
+# engine's byte budget (`io/transfer.py`); these remain for the
+# compaction merge path (`ops/merge.py`).
 LINK_CHUNK_ROWS = 1 << 19
 LINK_CHUNKS = 4
 
@@ -186,8 +188,11 @@ def permutation_from_tree(key_tree, key_names: Sequence[str], n: int,
     """As `build_permutation` over an already-staged device key tree."""
     if n_chunks <= 0:
         # Chunked D2H only pays off once the transfer dwarfs the ~0.1s
-        # per-sync latency of the tunneled device link.
-        n_chunks = LINK_CHUNKS if n >= LINK_CHUNK_ROWS else 1
+        # per-sync latency of the tunneled device link; the chunk count
+        # follows the transfer engine's byte budget (int32 permutation),
+        # so H2D and D2H pipeline at the same granularity.
+        from hyperspace_tpu.io import transfer
+        n_chunks = transfer.get_engine().d2h_chunk_count(n * 4)
     n_chunks = max(1, min(n_chunks, n))
     return _perm_core(key_tree, tuple(key_names), num_buckets, n_chunks,
                       use_pallas=_pallas_enabled())

@@ -213,7 +213,7 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
     import jax.numpy as jnp
 
     from hyperspace_tpu import telemetry
-    from hyperspace_tpu.parallel.mesh import total_shards
+    from hyperspace_tpu.parallel.mesh import shard_rows, total_shards
 
     n_shards = total_shards(mesh)
     key_names = tuple(batch.schema.field(c).name for c in key_columns)
@@ -226,10 +226,19 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
     span_ts = tracer.now_us() if tracer is not None else 0.0
 
     tree, aux = batch_to_tree(batch)
+    # Host-resident sources build the padded tree in numpy and place
+    # every leaf with the row sharding DIRECTLY (pipelined transfer
+    # engine, all shards' puts issued before the first block) — each
+    # device receives only its slice, instead of the whole table
+    # round-tripping through the default device before the exchange.
+    host_input = all(isinstance(entry["data"], np.ndarray)
+                     for entry in tree.values())
+    xp = np if host_input else jnp
+
     # Pad rows to a multiple of the shard count; padding rows are invalid.
     def pad(arr):
         pad_width = [(0, padded - n)] + [(0, 0)] * (arr.ndim - 1)
-        return jnp.pad(arr, pad_width)
+        return xp.pad(arr, pad_width)
 
     in_tree: Dict = {}
     for name, entry in tree.items():
@@ -239,13 +248,21 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
             out["validity"] = pad(entry["validity"])
         # hash tables stay replicated: broadcast to per-shard copies
         if "hash_hi" in entry:
-            out["hash_hi"] = jnp.tile(entry["hash_hi"], (n_shards, 1)).reshape(
+            out["hash_hi"] = xp.tile(entry["hash_hi"], (n_shards, 1)).reshape(
                 n_shards * entry["hash_hi"].shape[0])
-            out["hash_lo"] = jnp.tile(entry["hash_lo"], (n_shards, 1)).reshape(
+            out["hash_lo"] = xp.tile(entry["hash_lo"], (n_shards, 1)).reshape(
                 n_shards * entry["hash_lo"].shape[0])
         in_tree[name] = out
-    in_tree["__valid__"] = jnp.concatenate(
-        [jnp.ones(n, dtype=bool), jnp.zeros(padded - n, dtype=bool)])
+    in_tree["__valid__"] = xp.concatenate(
+        [xp.ones(n, dtype=bool), xp.zeros(padded - n, dtype=bool)])
+    if host_input:
+        from hyperspace_tpu.io import transfer
+
+        engine = transfer.get_engine()
+        sharding = shard_rows(mesh)
+        in_tree = jax.tree_util.tree_map(
+            lambda a: (engine.put(a, device=sharding)
+                       if isinstance(a, np.ndarray) else a), in_tree)
 
     factor = capacity_factor
     while True:

@@ -211,10 +211,6 @@ def _sharded_inputs(left, right, l_lengths, r_lengths, left_keys,
     sharded spec — per-device bytes ~ T, not total rows. Also returns
     the per-shard assigned row counts (the load-balance attribution the
     mesh telemetry reports)."""
-    import jax
-
-    from hyperspace_tpu import telemetry
-
     n_shards = total_shards(mesh)
     l_lanes, r_lanes, l_ok, r_ok = _side_lanes(left, right, left_keys,
                                                right_keys)
@@ -229,17 +225,19 @@ def _sharded_inputs(left, right, l_lengths, r_lengths, left_keys,
     null = np.concatenate([l_valid & ~l_ok[l_idx],
                            r_valid & ~r_ok[r_idx]], axis=1)
 
-    # device_put STRAIGHT from numpy: jnp.asarray would materialize the
-    # full array on the default device first, defeating the per-device
-    # memory bound; device_put(host_array, sharding) transfers each
-    # device only its slice.
+    # Sharded puts STRAIGHT from numpy (transfer engine): jnp.asarray
+    # would materialize the full array on the default device first,
+    # defeating the per-device memory bound; a put under the row
+    # sharding transfers each device only its slice. The engine issues
+    # all five puts before anything blocks and records the one link
+    # crossing.
+    from hyperspace_tpu.io import transfer
+
     sharding = shard_rows(mesh)
-    put = partial(jax.device_put, device=sharding)
-    nbytes = (sum(x.nbytes for x in lanes2d) + pad.nbytes + null.nbytes
-              + l_idx.nbytes + r_idx.nbytes)
-    with telemetry.link_transfer("h2d", nbytes):
-        staged = (tuple(put(x) for x in lanes2d), put(pad), put(null),
-                  put(l_idx), put(r_idx))
+    engine = transfer.get_engine()
+    put = partial(engine.put, device=sharding)
+    staged = (tuple(put(x) for x in lanes2d), put(pad), put(null),
+              put(l_idx), put(r_idx))
     return staged + (Cl, Cr, shard_assigned)
 
 

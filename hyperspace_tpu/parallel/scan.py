@@ -23,30 +23,38 @@ from hyperspace_tpu.parallel.mesh import shard_rows, total_shards
 def shard_batch(batch: ColumnBatch, mesh):
     """Pad rows to a multiple of the mesh size and place every column
     row-sharded. Returns (sharded batch, row_valid mask) — padding rows are
-    marked invalid and must be excluded by the caller."""
-    import jax
+    marked invalid and must be excluded by the caller.
+
+    Host-resident columns pad in numpy and cross the link through the
+    transfer engine (each device pulls only its slice of the sharded
+    put; every column's put is issued before the first block); device
+    columns only re-lay out."""
     import jax.numpy as jnp
+
+    from hyperspace_tpu.io import transfer
 
     n = batch.num_rows
     n_shards = total_shards(mesh)
     padded = -(-n // n_shards) * n_shards
     pad = padded - n
     sharding = shard_rows(mesh)
+    engine = transfer.get_engine()
 
     def place(arr, fill):
+        if isinstance(arr, np.ndarray):
+            if pad:
+                arr = np.concatenate(
+                    [arr, np.full((pad,) + arr.shape[1:], fill,
+                                  arr.dtype)])
+            return engine.put(arr, device=sharding)
         if pad:
             arr = jnp.concatenate(
                 [arr, jnp.full((pad,) + arr.shape[1:], fill, arr.dtype)])
-        return jax.device_put(arr, sharding)
+        return engine.put(arr, device=sharding)
 
-    # Host-resident columns pay the device link on placement; device
-    # columns only re-lay out. Record the former so mesh staging shows
-    # up in the link histograms next to the fusion promotions.
-    host_bytes = sum(
-        col.data.nbytes for col in batch.columns.values()
-        if isinstance(col.data, np.ndarray))
-    with telemetry.link_transfer("h2d", host_bytes) \
-            if host_bytes else telemetry.span("mesh:place", "mesh"):
+    # The engine records each host column's link crossing; the span
+    # keeps the placement visible as one mesh stage in traces.
+    with telemetry.span("mesh:place", "mesh", rows=n, shards=n_shards):
         columns: Dict[str, DeviceColumn] = {}
         for name, col in batch.columns.items():
             columns[name] = DeviceColumn(
@@ -56,7 +64,7 @@ def shard_batch(batch: ColumnBatch, mesh):
                           if col.validity is not None else None),
                 dictionary=col.dictionary,
                 dict_hashes=col.dict_hashes)
-        row_valid = place(jnp.ones(n, dtype=bool), False)
+        row_valid = place(np.ones(n, dtype=bool), False)
     return ColumnBatch(batch.schema, columns), row_valid
 
 

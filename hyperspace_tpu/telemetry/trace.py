@@ -199,17 +199,21 @@ def span(name: str, cat: str = "engine", **args):
 
 
 def record_link_transfer(direction: str, nbytes: int, seconds: float,
-                         ts_us: Optional[float] = None) -> None:
+                         ts_us: Optional[float] = None,
+                         chunks: int = 1) -> None:
     """Record one device-link transfer (`direction` = "h2d" | "d2h"):
     registry counters + log-bucketed byte/seconds histograms ALWAYS, a
     per-query counter when a recorder is active, a span when tracing.
-    jax dispatch is asynchronous — the measured wall is dispatch-side
-    unless the measuring code synced; the byte counts are exact either
-    way."""
+    `chunks` is how many pipelined chunk puts the logical transfer
+    shipped as (`io/transfer.py`) — `link.<dir>.chunks` vs
+    `link.<dir>.transfers` is the chunking ratio. jax dispatch is
+    asynchronous — the measured wall is dispatch-side unless the
+    measuring code synced; the byte counts are exact either way."""
     reg = _registry.get_registry()
     reg.counter(f"link.{direction}.bytes").inc(nbytes)
     reg.counter(f"link.{direction}.seconds").inc(seconds)
     reg.counter(f"link.{direction}.transfers").inc()
+    reg.counter(f"link.{direction}.chunks").inc(max(int(chunks), 1))
     reg.histogram(f"link.{direction}.bytes_per_transfer").observe(nbytes)
     from hyperspace_tpu import telemetry
     telemetry.add_seconds(f"link.{direction}_s", seconds)
@@ -228,7 +232,7 @@ def record_link_transfer(direction: str, nbytes: int, seconds: float,
 
 
 @contextmanager
-def link_transfer(direction: str, nbytes: int):
+def link_transfer(direction: str, nbytes: int, chunks: int = 1):
     """Context-manager form of `record_link_transfer`: times the
     enclosed block as the transfer wall."""
     t = _tracer
@@ -238,7 +242,8 @@ def link_transfer(direction: str, nbytes: int):
         yield
     finally:
         record_link_transfer(direction, nbytes,
-                             time.perf_counter() - t0, ts_us=ts)
+                             time.perf_counter() - t0, ts_us=ts,
+                             chunks=chunks)
 
 
 def export_trace(path: str) -> dict:

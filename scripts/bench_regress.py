@@ -57,10 +57,28 @@ def pick_latest_two(pattern: str):
     return paths[-2], paths[-1]
 
 
+def _rung1_link_share(doc: dict):
+    """(key_stage_link_s + perm_d2h_link_s) / build_s of rung 1 — the
+    fraction of the build the device path spends on the link. The
+    pipelined transfer engine exists to drive this DOWN; a >threshold
+    rebound means the link seam regressed even if wall times still
+    pass. None when the artifact predates the device-path phases."""
+    r1 = (doc.get("rungs") or {}).get("1_build") or {}
+    phases = r1.get("device_path") or {}
+    stage = phases.get("key_stage_link_s")
+    d2h = phases.get("perm_d2h_link_s")
+    build = r1.get("build_s")
+    if not all(isinstance(v, (int, float)) for v in (stage, d2h, build)) \
+            or not build:
+        return None
+    return (stage + d2h) / build
+
+
 def compare(old: dict, new: dict, threshold: float):
     """[(name, old_ratio, new_ratio, change, gated)] for every
     comparable vs_baseline (higher is better), headline first, plus
-    the peak-HBM row (lower is better — it gates on GROWTH)."""
+    the peak-HBM row and the rung-1 link share (both lower is better —
+    they gate on GROWTH)."""
     rows = []
 
     def add(name, old_v, new_v, lower_is_better=False):
@@ -86,6 +104,8 @@ def compare(old: dict, new: dict, threshold: float):
         (old.get("memory") or {}).get("peak_hbm_bytes"),
         (new.get("memory") or {}).get("peak_hbm_bytes"),
         lower_is_better=True)
+    add("rung1_link_share", _rung1_link_share(old),
+        _rung1_link_share(new), lower_is_better=True)
     return rows
 
 

@@ -81,6 +81,40 @@ def check_jit_entry_points(package_dir: str):
     return failures
 
 
+# The ONE sanctioned link seam: every host->device placement routes
+# through the pipelined transfer engine (chunked staging, in-flight
+# byte window, fault injection, link.{h2d,d2h}.* counters). A raw
+# `jax.device_put` anywhere else in the package is a link crossing the
+# engine cannot pipeline, observe, or fault-inject. Tests and bench
+# drivers live outside the package tree and stay exempt (the raw-link
+# probe in bench_common.py MUST bypass the engine by design).
+_RAW_PUT_RE = re.compile(r"jax\.device_put\s*\(|partial\(\s*jax\.device_put\b")
+_PUT_ALLOWED = os.path.join("io", "transfer.py")
+
+
+def check_device_put_seam(package_dir: str):
+    """Source lint: no direct `jax.device_put` outside io/transfer.py."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _PUT_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_PUT_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: raw "
+                            "jax.device_put bypasses the transfer "
+                            "engine — route it through io/transfer.py")
+    return failures
+
+
 # The ONE sanctioned backoff point: every storage retry routes through
 # the policy in utils/retry.py (typed classification, conf-driven
 # backoff, io.retries/io.giveups counters, fault-injection coverage).
@@ -182,6 +216,8 @@ def main() -> int:
                 "without emitting an action report")
 
     failures.extend(check_jit_entry_points(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_device_put_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_retry_seams(
         os.path.dirname(hyperspace_tpu.__file__)))

@@ -34,7 +34,7 @@ NUM_BUCKETS = int(os.environ.get("BENCH_BUCKETS", 64))
 WARM_RUNS = int(os.environ.get("BENCH_WARM_RUNS", 5))
 
 
-from bench_common import link_probe, log, transfer_summary  # noqa: E402
+from bench_common import link_probe, log  # noqa: E402
 
 # label -> median seconds over the warm runs; rides in the artifact next
 # to the best-of numbers so a lucky run can't carry a headline.
@@ -278,8 +278,10 @@ def rung2_filter(sess, hs, ldf, left, work):
     q()  # warm compile
     dev_s = best_of(q, label="rung2 device")
     # Operator-level telemetry of the last timed run rides in the
-    # artifact (collect always records onto the session).
-    qm = sess.last_query_metrics().summary()
+    # artifact (collect always records onto the session); the full
+    # QueryMetrics goes back to main so the artifact can embed BOTH
+    # the summary digest and the diff-alignable operator tree.
+    qm = sess.last_query_metrics()
     sess.disable_hyperspace()
 
     src_files = sorted(
@@ -321,7 +323,7 @@ def rung3_join(sess, hs, ldf, rdf, work):
     assert all(s.bucket_spec is not None for s in scans), "rung3 not bucketed"
     q()
     dev_s = best_of(q, label="rung3 device")
-    qm = sess.last_query_metrics().summary()
+    qm = sess.last_query_metrics()
     sess.disable_hyperspace()
 
     lfiles = [os.path.join(work, "left", f)
@@ -391,7 +393,7 @@ def rung4_hybrid(sess, hs, left, work):
     assert found_union[0], "rung4 not hybrid-served (no Union in plan)"
     q()
     dev_s = best_of(q, label="rung4 device")
-    qm = sess.last_query_metrics().summary()
+    qm = sess.last_query_metrics()
     sess.disable_hyperspace()
 
     files = sorted(os.path.join(hdir, f) for f in os.listdir(hdir))
@@ -441,7 +443,7 @@ def rung4b_hybrid_join(sess, hs, rdf, work):
 
     q()
     dev_s = best_of(q, label="rung4b device")
-    qm = sess.last_query_metrics().summary()
+    qm = sess.last_query_metrics()
     sess.disable_hyperspace()
 
     lfiles = sorted(os.path.join(hdir, f) for f in os.listdir(hdir))
@@ -577,13 +579,7 @@ def main():
             f"full refresh {full5:.3f}s (optimize x{full5 / opt5:.2f}, "
             f"incremental x{full5 / inc5:.2f})")
 
-        result = {
-            "metric": "covering_index_build_rows_per_sec_chip",
-            "value": round(rate1, 1),
-            "unit": "rows/s",
-            "vs_baseline": round(cpu1 / dev1, 3),
-            "link_probe": probe,
-            "rungs": {
+        rungs = {
                 "1_build": {"build_s": round(dev1, 3),
                             "lane": lane1,
                             "sort_s": (round(sort1, 3)
@@ -605,43 +601,45 @@ def main():
                 "2_filter_query": {"device_s": round(dev2, 3),
                                    "cpu_s": round(cpu2, 3),
                                    "vs_baseline": round(cpu2 / dev2, 3),
-                                   "metrics": met2},
+                                   **telemetry.artifact
+                                   .query_metrics_block(met2)},
                 "3_bucketed_smj": {"device_s": round(dev3, 3),
                                    "cpu_s": round(cpu3, 3),
                                    "vs_baseline": round(cpu3 / dev3, 3),
-                                   "metrics": met3},
+                                   **telemetry.artifact
+                                   .query_metrics_block(met3)},
                 "4_hybrid_scan": {"device_s": round(dev4, 3),
                                   "cpu_s": round(cpu4, 3),
                                   "vs_baseline": round(cpu4 / dev4, 3),
-                                  "metrics": met4},
+                                  **telemetry.artifact
+                                  .query_metrics_block(met4)},
                 "4b_hybrid_join": {"device_s": round(dev4b, 3),
                                    "cpu_s": round(cpu4b, 3),
                                    "vs_baseline": round(cpu4b / dev4b, 3),
-                                   "metrics": met4b},
+                                   **telemetry.artifact
+                                   .query_metrics_block(met4b)},
                 "5_compaction": {"incremental_refresh_s": round(inc5, 3),
                                  "optimize_s": round(opt5, 3),
                                  "full_refresh_s": round(full5, 3),
                                  "vs_baseline": round(full5 / opt5, 3),
                                  "incremental_vs_full": round(
                                      full5 / inc5, 3)},
-            },
-            "phase_medians_s": dict(MEDIANS),
-            # Link-engine digest over the whole ladder: total bytes /
-            # chunk counts each direction and the measured
-            # decode<->link overlap (serial stage sum minus pipelined
-            # wall). bench_regress.py separately gates the rung-1 link
-            # SHARE of the build.
-            "transfer": transfer_summary(),
-            # Process-lifetime aggregates over the WHOLE ladder: action
-            # reports (create/refresh/optimize counts, rows/bytes),
-            # fusion stage stats, link-transfer totals, mesh dispatches.
-            "process_metrics": telemetry.get_registry().counters_dict(),
-            # The resource story next to the timings: per-device peak
-            # HBM, per-cache hit/miss/eviction/bytes-held series,
-            # compile trace/cache-hit counts. bench_regress.py gates on
-            # peak_hbm_bytes growing >15% between rounds.
-            "memory": telemetry.memory.artifact_section(),
         }
+        # Canonical, versioned artifact (telemetry/artifact.py): the
+        # emitter attaches the transfer digest, the process-lifetime
+        # counter aggregates, and the memory/cache/compile section —
+        # no committed round can miss the telemetry the regression
+        # differ attributes from. bench_regress.py gates rung ratios,
+        # peak HBM, and the rung-1 link share from this shape.
+        result = telemetry.artifact.make_artifact(
+            driver="bench.py",
+            metric="covering_index_build_rows_per_sec_chip",
+            value=round(rate1, 1),
+            unit="rows/s",
+            vs_baseline=round(cpu1 / dev1, 3),
+            rungs=rungs,
+            extra={"link_probe": probe,
+                   "phase_medians_s": dict(MEDIANS)})
         xfer = result["transfer"]
         log(f"transfer: h2d {xfer['h2d_bytes'] / 1e6:.1f} MB in "
             f"{xfer['h2d_chunks']} chunks / {xfer['h2d_transfers']} "

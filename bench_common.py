@@ -101,22 +101,13 @@ def link_probe(runs: int = PROBE_RUNS) -> dict:
 def transfer_summary() -> dict:
     """Ladder-lifetime digest of the pipelined transfer engine's link
     counters (process registry) — embedded by both bench drivers so the
-    overlap the engine claims is a committed number, not an assumption."""
-    from hyperspace_tpu import telemetry
+    overlap the engine claims is a committed number, not an assumption.
+    The schema authority is `telemetry.artifact.transfer_digest`; this
+    is the bench-side alias (kept for stderr logging before the final
+    artifact assembly)."""
+    from hyperspace_tpu.telemetry import artifact
 
-    c = telemetry.get_registry().counters_dict()
-    return {
-        "h2d_bytes": int(c.get("link.h2d.bytes", 0)),
-        "h2d_seconds": round(c.get("link.h2d.seconds", 0.0), 3),
-        "h2d_chunks": int(c.get("link.h2d.chunks", 0)),
-        "h2d_transfers": int(c.get("link.h2d.transfers", 0)),
-        "d2h_bytes": int(c.get("link.d2h.bytes", 0)),
-        "d2h_seconds": round(c.get("link.d2h.seconds", 0.0), 3),
-        "d2h_chunks": int(c.get("link.d2h.chunks", 0)),
-        "d2h_prefetch_errors": int(c.get("link.d2h.prefetch_errors", 0)),
-        "overlap_saved_seconds": round(
-            c.get("transfer.overlap_saved_seconds", 0.0), 3),
-    }
+    return artifact.transfer_digest()
 
 
 def timed_runs(fn, runs: int, label: str = ""):

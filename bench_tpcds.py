@@ -45,8 +45,7 @@ QUERY_FILTER = [q for q in os.environ.get(
     "BENCH_TPCDS_QUERIES", "").split(",") if q]
 
 
-from bench_common import (link_probe, log, timed_runs,  # noqa: E402
-                          transfer_summary)
+from bench_common import link_probe, log, timed_runs  # noqa: E402
 from hyperspace_tpu import telemetry  # noqa: E402
 
 
@@ -136,26 +135,32 @@ def main():
                              "vs_baseline": round(cpu_s / on_s, 3),
                              "vs_no_index": round(off_s / on_s, 3),
                              "rows": int(len(expected)),
-                             "metrics": qmetrics.summary()}
+                             # summary digest + full operator tree —
+                             # the node-level shape telemetry.diff
+                             # aligns round-over-round.
+                             **telemetry.artifact.query_metrics_block(
+                                 qmetrics)}
             tot_on += on_s
             tot_off += off_s
             tot_cpu += cpu_s
 
-        print(json.dumps({
-            "metric": ("tpcds_q17_q25_q64_wall_s"
-                       if set(selected) == {"q17", "q25", "q64"}
-                       else f"tpcds_{len(selected)}q_wall_s"),
-            "value": round(tot_on, 3),
-            "unit": "s",
-            "vs_baseline": round(tot_cpu / tot_on, 3),
-            "scale": SCALE,
-            "index_build_s": round(index_build_s, 2),
-            "link_probe": probe,
-            "queries": queries,
-            "transfer": transfer_summary(),
-            "process_metrics": telemetry.get_registry().counters_dict(),
-            "memory": telemetry.memory.artifact_section(),
-        }))
+        # Canonical, versioned artifact (telemetry/artifact.py): the
+        # ONE emitter both bench drivers share, so TPC-DS rounds and
+        # micro-ladder rounds diff with the same tooling
+        # (scripts/bench_diff.py) and gate with the same script
+        # (scripts/bench_regress.py).
+        print(json.dumps(telemetry.artifact.make_artifact(
+            driver="bench_tpcds.py",
+            metric=("tpcds_q17_q25_q64_wall_s"
+                    if set(selected) == {"q17", "q25", "q64"}
+                    else f"tpcds_{len(selected)}q_wall_s"),
+            value=round(tot_on, 3),
+            unit="s",
+            vs_baseline=round(tot_cpu / tot_on, 3),
+            queries=queries,
+            extra={"scale": SCALE,
+                   "index_build_s": round(index_build_s, 2),
+                   "link_probe": probe})))
     finally:
         shutil.rmtree(work, ignore_errors=True)
 

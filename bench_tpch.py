@@ -53,6 +53,7 @@ def main():
     import pandas as pd
     import pyarrow.parquet as pq
 
+    from hyperspace_tpu import telemetry
     from hyperspace_tpu import Hyperspace, HyperspaceConf, HyperspaceSession
     from hyperspace_tpu.tpch import QUERIES, generate
     from hyperspace_tpu.tpch.queries import create_indexes
@@ -87,6 +88,7 @@ def main():
             build(dfs).collect()  # warm (compiles, file listings)
             on_s, got_on = best_of(lambda: build(dfs).collect().to_pandas(),
                                    label=f"{name} rules-on")
+            qmetrics = sess.last_query_metrics()
             sess.disable_hyperspace()
             off_s, got_off = best_of(lambda: build(dfs).collect().to_pandas(),
                                      label=f"{name} rules-off")
@@ -102,20 +104,25 @@ def main():
                              "pandas_s": round(cpu_s, 4),
                              "vs_baseline": round(cpu_s / on_s, 3),
                              "vs_no_index": round(off_s / on_s, 3),
-                             "rows": int(len(expected))}
+                             "rows": int(len(expected)),
+                             **telemetry.artifact.query_metrics_block(
+                                 qmetrics)}
             tot_on += on_s
             tot_off += off_s
             tot_cpu += cpu_s
 
-        print(json.dumps({
-            "metric": (f"tpch_{len(selected)}q_wall_s"),
-            "value": round(tot_on, 3),
-            "unit": "s",
-            "vs_baseline": round(tot_cpu / tot_on, 3),
-            "scale": SCALE,
-            "index_build_s": round(index_build_s, 2),
-            "queries": queries,
-        }))
+        # Canonical, versioned artifact — same emitter as bench.py /
+        # bench_tpcds.py (telemetry/artifact.py), so TPC-H rounds diff
+        # and gate with the same tooling.
+        print(json.dumps(telemetry.artifact.make_artifact(
+            driver="bench_tpch.py",
+            metric=f"tpch_{len(selected)}q_wall_s",
+            value=round(tot_on, 3),
+            unit="s",
+            vs_baseline=round(tot_cpu / tot_on, 3),
+            queries=queries,
+            extra={"scale": SCALE,
+                   "index_build_s": round(index_build_s, 2)})))
     finally:
         shutil.rmtree(work, ignore_errors=True)
 

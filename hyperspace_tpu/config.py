@@ -187,6 +187,30 @@ class HyperspaceConf:
                             constants.IO_TRANSFER_THREADS_DEFAULT)
 
     @property
+    def slowlog_seconds(self) -> float:
+        """Slow-query dump threshold for the flight recorder
+        (`telemetry/flight.py`): any query whose wall exceeds this many
+        seconds persists its full metric tree, a registry snapshot,
+        and a trace slice to `slowlog_dir`. 0 (the default) disables
+        dumping; the in-memory ring of recent queries is always on."""
+        return float(self.get(constants.TELEMETRY_SLOWLOG_SECONDS,
+                              str(constants.TELEMETRY_SLOWLOG_SECONDS_DEFAULT)))
+
+    @property
+    def slowlog_dir(self) -> str:
+        """Slow-query dump directory; default `<warehouse>/slowlog`."""
+        configured = self.get(constants.TELEMETRY_SLOWLOG_DIR)
+        if configured:
+            return configured
+        return os.path.join(self.warehouse_dir, "slowlog")
+
+    @property
+    def slowlog_keep(self) -> int:
+        """How many slow-query dump files to retain (oldest pruned)."""
+        return self.get_int(constants.TELEMETRY_SLOWLOG_KEEP,
+                            constants.TELEMETRY_SLOWLOG_KEEP_DEFAULT)
+
+    @property
     def maintenance_lease_seconds(self) -> int:
         """Age past which a transient op-log entry is treated as a crashed
         writer and auto-recovered (Cancel FSM) by the next maintenance

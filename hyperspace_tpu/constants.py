@@ -127,6 +127,19 @@ DISTRIBUTION_DCN_SIZE_DEFAULT = 1
 # query), viewable in TensorBoard/XProf/Perfetto. Empty (default) = off.
 TRACE_DIR = "spark.hyperspace.trace.dir"
 
+# Query flight recorder (`telemetry/flight.py`): the bounded ring of
+# the last-K completed QueryMetrics is ALWAYS on (it costs one deque
+# append per query); the slow-query dump persists the full metric
+# tree + registry snapshot + trace slice of any query whose wall
+# exceeds `slowlog.seconds` (0, the default, disables dumping). Dumps
+# land under `slowlog.dir` (default `<warehouse>/slowlog`); only the
+# newest `slowlog.keep` dump files are retained.
+TELEMETRY_SLOWLOG_SECONDS = "spark.hyperspace.telemetry.slowlog.seconds"
+TELEMETRY_SLOWLOG_SECONDS_DEFAULT = 0.0
+TELEMETRY_SLOWLOG_DIR = "spark.hyperspace.telemetry.slowlog.dir"
+TELEMETRY_SLOWLOG_KEEP = "spark.hyperspace.telemetry.slowlog.keep"
+TELEMETRY_SLOWLOG_KEEP_DEFAULT = 20
+
 # Adaptive host/device execution lane: batches below this row count are
 # evaluated with host numpy, larger batches run on the accelerator. The
 # default is tuned for a high-latency (tunneled) device link where each

@@ -228,6 +228,11 @@ class DataFrame:
         reg.counter("queries.total").inc()
         reg.counter("queries.seconds").inc(metrics.wall_s)
         reg.histogram("query.wall_s").observe(metrics.wall_s)
+        # Flight recorder: the finished recorder joins the always-on
+        # ring of recent queries; a wall past the session's slowlog
+        # threshold also persists a self-contained dump (metric tree +
+        # registry snapshot + trace slice) for post-hoc diagnosis.
+        telemetry.flight.record(metrics, conf=self._conf())
         if self.session is not None:
             self.session._last_query_metrics = metrics
         table = to_arrow(batch)

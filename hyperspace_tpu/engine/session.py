@@ -42,6 +42,16 @@ class HyperspaceSession:
         last."""
         return self._last_query_metrics
 
+    def flight_recorder(self):
+        """The PROCESS-WIDE query flight recorder: the bounded ring of
+        the last-K completed `QueryMetrics` across every session
+        (always on), plus the slow-query dump policy driven by
+        `spark.hyperspace.telemetry.slowlog.{seconds,dir,keep}` on the
+        executing session's conf. `recorder.queries(5)` is the last
+        five finished queries, newest last."""
+        from hyperspace_tpu import telemetry
+        return telemetry.get_recorder()
+
     def metrics_registry(self):
         """The PROCESS-WIDE metrics registry: counters, gauges, and
         log-bucketed histograms aggregating across every query, session,

@@ -42,6 +42,15 @@ tracks — plus the byte-aware `cache.<name>.*` instrumentation every
 cache in the system reports through), and `compilation`
 (`instrumented_jit`: compile spans, trace/cache-hit counters, and
 retrace-cause decision events for every jit entry point).
+
+Regression attribution (PR 6) closes the loop: `artifact` (the ONE
+canonical, versioned bench-artifact schema both bench drivers emit),
+`diff` (align two artifacts or two QueryMetrics trees and decompose
+each wall delta into compute / link / compile / cache / fallback /
+residual buckets — the ranked attribution tree `scripts/bench_diff.py`
+prints and `scripts/bench_regress.py` auto-runs on gate failure), and
+`flight` (the always-on ring of the last-K completed QueryMetrics plus
+the slow-query dump, `spark.hyperspace.telemetry.slowlog.*`).
 """
 
 from __future__ import annotations
@@ -63,7 +72,12 @@ from hyperspace_tpu.telemetry.trace import (Tracer, disable_tracing,
                                             tracer, tracing_enabled)
 from hyperspace_tpu.telemetry import memory  # noqa: F401
 from hyperspace_tpu.telemetry import compilation  # noqa: F401
+from hyperspace_tpu.telemetry import artifact  # noqa: F401
+from hyperspace_tpu.telemetry import diff  # noqa: F401
+from hyperspace_tpu.telemetry import flight  # noqa: F401
 from hyperspace_tpu.telemetry.compilation import instrumented_jit
+from hyperspace_tpu.telemetry.flight import (FlightRecorder,
+                                             get_recorder)
 from hyperspace_tpu.telemetry.memory import (DeviceMemoryAccountant,
                                              get_accountant)
 
@@ -73,7 +87,8 @@ __all__ = [
     "MetricsRegistry", "get_registry", "Tracer", "enable_tracing",
     "disable_tracing", "tracing_enabled", "tracer", "span",
     "link_transfer", "record_link_transfer", "export_trace",
-    "memory", "compilation", "instrumented_jit",
+    "memory", "compilation", "instrumented_jit", "artifact", "diff",
+    "flight", "FlightRecorder", "get_recorder",
     "DeviceMemoryAccountant", "get_accountant",
 ]
 

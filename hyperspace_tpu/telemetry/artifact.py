@@ -1,0 +1,223 @@
+"""Canonical bench-artifact schema: ONE versioned shape for every
+committed benchmark round.
+
+Until PR 6 the committed artifacts were three ad-hoc shapes — bench.py
+printed a rung document, bench_tpcds.py a query document, and the
+driver sometimes wrapped either in a `{n, cmd, rc, tail, parsed}`
+command envelope — so two rounds could not be compared mechanically,
+and the regression differ (`telemetry/diff.py`) had nothing stable to
+stand on. This module is the schema authority:
+
+- `make_artifact(...)` — the ONE emitter both bench drivers route
+  their final JSON through. It stamps `schema_version`, the driver
+  name, and ALWAYS attaches the three process-wide digests
+  (`process_metrics`, `memory`, `transfer`), so no committed round can
+  miss the telemetry the differ attributes from.
+  `scripts/check_metrics_coverage.py` fails any bench driver that
+  prints an artifact without routing through this seam.
+- `query_metrics_block(qm)` — the per-query telemetry block: the
+  compact `summary()` digest next to the FULL `to_dict()` operator
+  tree (`"tree"`), which is what `diff.py` aligns node-by-node.
+- `load(path)` / `migrate(doc)` — read any committed artifact,
+  unwrapping the driver envelope; legacy (pre-schema) documents raise
+  `LegacyArtifactError` unless migration is requested. Migration is
+  lossless: every legacy field is preserved, `schema_version` is
+  stamped, and `"legacy": true` records that the telemetry sections
+  are absent-by-history rather than absent-by-bug.
+
+Run `python -m hyperspace_tpu.telemetry.artifact migrate FILE...` to
+migrate committed artifacts in place (the driver envelope, when
+present, is preserved and its `parsed` payload migrated).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+# A canonical artifact MUST carry these; `validate()` reports what is
+# missing and the regression gate refuses to gate without them.
+REQUIRED_FIELDS = ("schema_version", "metric", "value", "vs_baseline",
+                   "process_metrics")
+
+
+class LegacyArtifactError(Exception):
+    """Raised when a pre-schema artifact is loaded without asking for
+    migration — gating or diffing it silently would compare shapes
+    that do not mean the same thing."""
+
+    def __init__(self, path: str, missing: List[str]):
+        self.path = path
+        self.missing = missing
+        super().__init__(
+            f"{path}: legacy-schema bench artifact (missing "
+            f"{', '.join(missing)}). Re-run the bench driver (it now "
+            "emits the canonical schema), or migrate in place: "
+            "python -m hyperspace_tpu.telemetry.artifact migrate "
+            f"{path}")
+
+
+def transfer_digest() -> dict:
+    """Process-lifetime digest of the pipelined transfer engine's link
+    counters — embedded by every driver so the overlap the engine
+    claims is a committed number, not an assumption."""
+    from hyperspace_tpu.telemetry import registry as _registry
+
+    c = _registry.get_registry().counters_dict()
+    return {
+        "h2d_bytes": int(c.get("link.h2d.bytes", 0)),
+        "h2d_seconds": round(c.get("link.h2d.seconds", 0.0), 3),
+        "h2d_chunks": int(c.get("link.h2d.chunks", 0)),
+        "h2d_transfers": int(c.get("link.h2d.transfers", 0)),
+        "d2h_bytes": int(c.get("link.d2h.bytes", 0)),
+        "d2h_seconds": round(c.get("link.d2h.seconds", 0.0), 3),
+        "d2h_chunks": int(c.get("link.d2h.chunks", 0)),
+        "d2h_prefetch_errors": int(c.get("link.d2h.prefetch_errors", 0)),
+        "overlap_saved_seconds": round(
+            c.get("transfer.overlap_saved_seconds", 0.0), 3),
+    }
+
+
+def query_metrics_block(qm) -> dict:
+    """Per-query telemetry block: `summary()` (the compact rollup
+    earlier rounds embedded) plus the full `to_dict()` operator tree
+    the differ aligns node-by-node. `qm` may be None (e.g. a lane that
+    never executed under a recorder) — both keys are then None so the
+    artifact shape stays diffable."""
+    if qm is None:
+        return {"metrics": None, "tree": None}
+    return {"metrics": qm.summary(), "tree": qm.to_dict()}
+
+
+def make_artifact(*, driver: str, metric: str, value, unit: str,
+                  vs_baseline, queries: Optional[Dict[str, dict]] = None,
+                  rungs: Optional[Dict[str, dict]] = None,
+                  extra: Optional[dict] = None) -> dict:
+    """Assemble the canonical artifact document. The three process-wide
+    digests are attached HERE, unconditionally — a driver cannot emit a
+    canonical artifact that lacks them."""
+    from hyperspace_tpu import telemetry
+
+    doc: dict = {
+        "schema_version": SCHEMA_VERSION,
+        "driver": driver,
+        "generated_at": round(time.time(), 3),
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+    }
+    import sys
+    if "jax" in sys.modules:  # record the backend without forcing one
+        import jax
+        doc["platform"] = jax.devices()[0].platform
+    if extra:
+        doc.update(extra)
+    if queries is not None:
+        doc["queries"] = queries
+    if rungs is not None:
+        doc["rungs"] = rungs
+    doc["transfer"] = transfer_digest()
+    doc["process_metrics"] = telemetry.get_registry().counters_dict()
+    doc["memory"] = telemetry.memory.artifact_section()
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Loading / validation / migration
+# ---------------------------------------------------------------------------
+
+
+def unwrap(doc: dict) -> dict:
+    """Strip the external driver's `{n, cmd, rc, tail, parsed}` command
+    envelope, when present (the driver wraps whatever the bench process
+    printed; the payload is what the schema governs)."""
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict) \
+            and "cmd" in doc:
+        return doc["parsed"]
+    return doc
+
+
+def validate(doc: dict) -> List[str]:
+    """Missing required canonical fields (empty list = canonical)."""
+    doc = unwrap(doc)
+    return [f for f in REQUIRED_FIELDS if f not in doc]
+
+
+def is_canonical(doc: dict) -> bool:
+    return not validate(doc)
+
+
+def migrate(doc: dict, source: str = "") -> dict:
+    """Upgrade a legacy document to the canonical schema IN MEMORY,
+    losslessly: every field the legacy round committed is preserved,
+    `schema_version` is stamped, telemetry sections the round never
+    recorded are filled with empty dicts, and `"legacy": true` marks
+    that those sections are absent-by-history. Canonical input is
+    returned unchanged."""
+    doc = unwrap(doc)
+    if is_canonical(doc):
+        return doc
+    out = dict(doc)
+    out["schema_version"] = SCHEMA_VERSION
+    out["legacy"] = True
+    if source:
+        out["migrated_from"] = source
+    out.setdefault("process_metrics", {})
+    return out
+
+
+def load(path: str, migrate_legacy: bool = False) -> dict:
+    """Load a committed artifact (driver envelope unwrapped). Legacy
+    documents raise `LegacyArtifactError` unless `migrate_legacy`."""
+    with open(path) as f:
+        doc = json.load(f)
+    doc = unwrap(doc)
+    if not isinstance(doc, dict):
+        raise LegacyArtifactError(path, list(REQUIRED_FIELDS))
+    missing = validate(doc)
+    if missing:
+        if not migrate_legacy:
+            raise LegacyArtifactError(path, missing)
+        doc = migrate(doc, source=path)
+    return doc
+
+
+def migrate_file(path: str) -> bool:
+    """Migrate a committed artifact file in place, preserving the
+    driver envelope when present. Returns True if the file changed."""
+    with open(path) as f:
+        outer = json.load(f)
+    inner = unwrap(outer)
+    if is_canonical(inner):
+        return False
+    migrated = migrate(inner, source="legacy "
+                       + (inner.get("metric") or "artifact"))
+    if inner is not outer:
+        outer = dict(outer)
+        outer["parsed"] = migrated
+    else:
+        outer = migrated
+    with open(path, "w") as f:
+        json.dump(outer, f)
+        f.write("\n")
+    return True
+
+
+def _main(argv: List[str]) -> int:
+    if len(argv) >= 2 and argv[0] == "migrate":
+        for path in argv[1:]:
+            changed = migrate_file(path)
+            print(f"{path}: {'migrated' if changed else 'already canonical'}")
+        return 0
+    print("usage: python -m hyperspace_tpu.telemetry.artifact "
+          "migrate FILE...")
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main(sys.argv[1:]))

@@ -1,0 +1,190 @@
+"""Query flight recorder: the last-K completed queries, always on,
+plus a slow-query dump for post-hoc diagnosis.
+
+The per-query recorder (`telemetry/__init__.py`) already captures
+everything about one execution — but until now it evaporated with the
+Python object unless the caller thought to keep it. Production
+diagnosis works the other way round: the interesting query has ALREADY
+finished by the time anyone asks. So the engine keeps a bounded ring
+of the last `CAPACITY` completed `QueryMetrics` (every session-attached
+collect appends; one deque append + threshold check per query), and
+any query whose wall exceeds `spark.hyperspace.telemetry.slowlog.seconds`
+persists a self-contained dump — its full metric tree, a process
+registry snapshot, and the slice of the trace ring covering the query
+(when tracing is on) — to `spark.hyperspace.telemetry.slowlog.dir`.
+A dump can be reloaded (`load_dump`) and diffed against a live re-run
+(`telemetry.diff.diff_trees`) without ever re-running the original
+under instrumentation, because the instrumentation was never off.
+
+Dumping never fails a query: any dump error is swallowed, counted
+(`flight.dump_errors`) and logged. Only the newest
+`spark.hyperspace.telemetry.slowlog.keep` dumps are retained.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from hyperspace_tpu.telemetry import registry as _registry
+
+__all__ = ["FlightRecorder", "get_recorder", "record", "load_dump"]
+
+logger = logging.getLogger(__name__)
+
+# Ring depth: enough to cover a burst of concurrent sessions' recent
+# history while holding only finished recorders (operator node refs
+# are already released by QueryMetrics.finish()).
+CAPACITY = 64
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of completed `QueryMetrics` + the
+    slow-query dump policy. One per process (`get_recorder()`);
+    concurrent collects from any number of sessions append safely."""
+
+    def __init__(self, capacity: int = CAPACITY):
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = itertools.count()  # dump-name monotonicity
+
+    # -- recording ------------------------------------------------------
+
+    def record(self, metrics, conf=None) -> Optional[str]:
+        """Fold one FINISHED query recorder into the ring; dump it when
+        the session's slowlog threshold says so. Returns the dump path
+        when a dump was written (None otherwise)."""
+        with self._lock:
+            self._ring.append(metrics)
+        _registry.get_registry().counter("flight.queries").inc()
+        if conf is None:
+            return None
+        try:
+            threshold = conf.slowlog_seconds
+        except Exception:
+            return None
+        if threshold <= 0 or metrics.wall_s is None \
+                or metrics.wall_s < threshold:
+            return None
+        try:
+            return self._dump_slow(metrics, conf, threshold)
+        except Exception:
+            # A diagnosis feature must never fail the query it
+            # diagnoses: count, log, move on.
+            _registry.get_registry().counter("flight.dump_errors").inc()
+            logger.warning("slow-query dump failed", exc_info=True)
+            return None
+
+    # -- inspection -----------------------------------------------------
+
+    def queries(self, n: Optional[int] = None) -> List:
+        """The most recent completed `QueryMetrics`, oldest first
+        (last element = latest); `n` limits to the newest n."""
+        with self._lock:
+            out = list(self._ring)
+        return out if n is None else out[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- slow-query dump ------------------------------------------------
+
+    def _dump_slow(self, metrics, conf, threshold: float) -> str:
+        dump_dir = conf.slowlog_dir
+        os.makedirs(dump_dir, exist_ok=True)
+        doc = {
+            "kind": "hyperspace-slowlog",
+            "dumped_at": round(time.time(), 3),
+            "threshold_s": threshold,
+            "wall_s": metrics.wall_s,
+            "description": metrics.description,
+            "metrics": metrics.to_dict(),
+            "registry": _registry.get_registry().to_dict(),
+        }
+        trace_slice = self._trace_slice(metrics)
+        if trace_slice is not None:
+            doc["trace"] = trace_slice
+        # Name sorts in creation order WITHIN this process (wall-clock
+        # ms + a monotonic sequence); pruning still orders by mtime so
+        # multiple processes sharing a dump dir prune correctly.
+        fname = (f"slow-{int(doc['dumped_at'] * 1000)}-"
+                 f"{os.getpid()}-{next(self._seq):06d}.json")
+        path = os.path.join(dump_dir, fname)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=str)
+        os.replace(tmp, path)  # a reader never sees a torn dump
+        self._prune(dump_dir, conf.slowlog_keep)
+        _registry.get_registry().counter("flight.slow_dumps").inc()
+        logger.warning("slow query (%.3fs >= %.3fs): metrics dumped "
+                       "to %s", metrics.wall_s, threshold, path)
+        return path
+
+    @staticmethod
+    def _trace_slice(metrics) -> Optional[dict]:
+        """The tracer-ring events overlapping this query's execution
+        window (None when tracing is off). Timestamps stay on the
+        tracer's clock so the slice loads in Perfetto as-is."""
+        from hyperspace_tpu.telemetry import trace as _trace
+        t = _trace.tracer()
+        if t is None:
+            return None
+        start_us = (metrics._t0 - t.t0_s) * 1e6
+        with t._lock:
+            events = [e for e in t.events
+                      if e.get("ts", 0) + e.get("dur", 0) >= start_us]
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    @staticmethod
+    def _prune(dump_dir: str, keep: int) -> None:
+        def order(fname: str):
+            try:
+                return (os.path.getmtime(os.path.join(dump_dir, fname)),
+                        fname)
+            except OSError:
+                return (0.0, fname)  # already pruned: oldest
+
+        dumps = sorted((f for f in os.listdir(dump_dir)
+                        if f.startswith("slow-")
+                        and f.endswith(".json")), key=order)
+        for stale in dumps[:max(len(dumps) - max(keep, 1), 0)]:
+            try:
+                os.remove(os.path.join(dump_dir, stale))
+            except OSError:
+                pass  # concurrent pruner got it first
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    """THE process-wide flight recorder (sessions share it)."""
+    return _RECORDER
+
+
+def record(metrics, conf=None) -> Optional[str]:
+    """Module-level convenience the engine's collect path calls."""
+    return _RECORDER.record(metrics, conf=conf)
+
+
+def load_dump(path: str) -> dict:
+    """Reload a slow-query dump. `doc["metrics"]` is a full
+    `QueryMetrics.to_dict()` tree — `telemetry.diff.diff_trees(
+    doc["metrics"], live.to_dict())` diffs it against a fresh run."""
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("kind") != "hyperspace-slowlog":
+        raise ValueError(f"{path}: not a slow-query dump")
+    return doc

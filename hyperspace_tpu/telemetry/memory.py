@@ -203,15 +203,27 @@ def snapshot() -> dict:
 
 def cache_hit(name: str, n: int = 1) -> None:
     _registry.get_registry().counter(f"cache.{name}.hits").inc(n)
+    _query_cache_count(f"cache.{name}.hits", n)
 
 
 def cache_miss(name: str, n: int = 1) -> None:
     _registry.get_registry().counter(f"cache.{name}.misses").inc(n)
+    _query_cache_count(f"cache.{name}.misses", n)
 
 
 def cache_eviction(name: str, n: int = 1) -> None:
     if n:
         _registry.get_registry().counter(f"cache.{name}.evictions").inc(n)
+        _query_cache_count(f"cache.{name}.evictions", n)
+
+
+def _query_cache_count(counter: str, n: int) -> None:
+    """Mirror a cache event onto the active per-query recorder (no-op
+    without one) — the regression differ's `cache` bucket reads these
+    per-query `cache.<name>.*` deltas, so WHICH query thrashed a cache
+    is attributable round-over-round, not just that the process did."""
+    from hyperspace_tpu import telemetry
+    telemetry.add_count(counter, n)
 
 
 def cache_stats(name: str, bytes_held: Optional[int],

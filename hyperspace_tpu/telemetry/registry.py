@@ -131,8 +131,25 @@ class Histogram:
 
 
 def _prom_name(name: str) -> str:
+    """Map a dotted metric name onto the Prometheus name grammar
+    `[a-zA-Z_:][a-zA-Z0-9_:]*` (exposition format): every illegal
+    character becomes `_`, and the `hs_` prefix both namespaces the
+    export and guarantees a legal first character."""
     out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
     return "hs_" + out
+
+
+def _escape_help(text: str) -> str:
+    """Escape a `# HELP` line per the exposition format: backslash and
+    line feed only (double quotes are NOT escaped in HELP)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(value: str) -> str:
+    """Escape a label VALUE per the exposition format: backslash,
+    double quote, and line feed."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 class MetricsRegistry:
@@ -210,32 +227,49 @@ class MetricsRegistry:
 
     def to_text(self) -> str:
         """Prometheus text exposition format (the `/metrics` payload a
-        service deployment would scrape)."""
+        service deployment would scrape). Conformance contract (pinned
+        by `tests/test_artifact_diff.py::test_prometheus_conformance`):
+        every family gets `# HELP` then `# TYPE` before its samples,
+        names obey the Prometheus grammar (dotted names sanitized via
+        `_prom_name`; the HELP text carries the original dotted name
+        for the reverse mapping), label values are escaped per the
+        format, and histogram buckets are cumulative with a closing
+        `+Inf` bucket equal to `_count`. Dotted names that collide
+        after sanitization are disambiguated with a numeric suffix —
+        a repeated `# TYPE` for one family is a format violation."""
         with self._lock:
             metrics = dict(self._metrics)
         lines: List[str] = []
+        taken: Dict[str, str] = {}  # prom name -> dotted source name
         for name in sorted(metrics):
             m = metrics[name]
             pname = _prom_name(name)
-            if isinstance(m, Counter):
-                lines.append(f"# TYPE {pname} counter")
+            serial = 2
+            while pname in taken and taken[pname] != name:
+                pname = f"{_prom_name(name)}_{serial}"
+                serial += 1
+            taken[pname] = name
+            kind = ("counter" if isinstance(m, Counter)
+                    else "gauge" if isinstance(m, Gauge)
+                    else "histogram")
+            lines.append(f"# HELP {pname} "
+                         + _escape_help(f"hyperspace metric '{name}'"))
+            lines.append(f"# TYPE {pname} {kind}")
+            if kind in ("counter", "gauge"):
                 lines.append(f"{pname} {m.value:g}")
-            elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {pname} gauge")
-                lines.append(f"{pname} {m.value:g}")
-            else:
-                lines.append(f"# TYPE {pname} histogram")
-                cum = 0
-                for exp, n in sorted(
-                        m._buckets.items(),
-                        key=lambda kv: (-1e99 if kv[0] is None
-                                        else kv[0])):
-                    cum += n
-                    le = "0" if exp is None else f"{float(2 ** exp):g}"
-                    lines.append(f'{pname}_bucket{{le="{le}"}} {cum}')
-                lines.append(f'{pname}_bucket{{le="+Inf"}} {m.count}')
-                lines.append(f"{pname}_sum {m.sum:g}")
-                lines.append(f"{pname}_count {m.count}")
+                continue
+            cum = 0
+            for exp, n in sorted(
+                    m._buckets.items(),
+                    key=lambda kv: (-1e99 if kv[0] is None
+                                    else kv[0])):
+                cum += n
+                le = "0" if exp is None else f"{float(2 ** exp):g}"
+                le = _escape_label_value(le)
+                lines.append(f'{pname}_bucket{{le="{le}"}} {cum}')
+            lines.append(f'{pname}_bucket{{le="+Inf"}} {m.count}')
+            lines.append(f"{pname}_sum {m.sum:g}")
+            lines.append(f"{pname}_count {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:

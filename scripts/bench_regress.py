@@ -1,48 +1,72 @@
 #!/usr/bin/env python
-"""Pre-merge perf gate: diff the newest BENCH_*.json artifact against
-the previous one and exit nonzero on a >15% regression in any rung's
-`vs_baseline` ratio (or the headline ratio) — or a >15% GROWTH in
-peak HBM bytes (`memory.peak_hbm_bytes`, the per-device-peak total
-the memory accountant embeds): a query ladder that suddenly holds
-more device memory is a pre-OOM regression even when its wall times
-still pass. Artifacts predating the memory section simply don't gate.
+"""Pre-merge perf gate: diff the newest bench artifact against the
+previous one and exit nonzero on a regression — arriving WITH its own
+diagnosis: any gate failure auto-runs the regression differ
+(`telemetry/diff.py`) on the same pair and prints the ranked
+attribution tree, so the reviewer sees *why*, not just *that*.
+
+Two artifact families, one gate:
 
   python scripts/bench_regress.py                 # newest two BENCH_r*.json
+  python scripts/bench_regress.py --tpcds         # newest two BENCH_TPCDS_r*.json
   python scripts/bench_regress.py OLD.json NEW.json
   python scripts/bench_regress.py --threshold 0.10 --glob 'BENCH_r*.json'
 
-Artifacts are the driver-wrapped form ({"parsed": {...}}) or the raw
-bench.py output ({"rungs": {...}}); both load. Rungs present in only
-one artifact are reported but never gate (a new rung has no baseline;
-a removed rung is a review question, not a perf fact). The 15%
-default leaves headroom for the shared tunneled link's ~2x
-time-of-day wobble on sub-ratios that sit near 1 (see `link_probe` in
-bench_common.py) while still catching real order-of-magnitude cliffs;
-artifacts carry the probe so a borderline failure can be attributed
-to link vs code before overriding the gate.
+Rung artifacts (bench.py) gate per-rung `vs_baseline`, peak HBM growth,
+and the rung-1 link share as before. Query artifacts (bench_tpcds.py /
+bench_tpch.py) gate the aggregate `vs_baseline` AND every per-query
+`vs_baseline` — the r03->r04 TPC-DS regression (aggregate 3.14x ->
+0.81x, q64 at 0.45x) is exactly the failure this mode exists to stop
+at the door. The mode is detected from artifact content (`queries` vs
+`rungs`), so explicit paths need no flag.
+
+Artifacts must be in the canonical schema (`telemetry/artifact.py`,
+`schema_version` + `process_metrics`); a legacy-schema artifact is
+REFUSED with exit 2 — gating shapes that cannot be compared
+mechanically is how the r04 regression went unnoticed for two rounds.
+Migrate committed legacy rounds with
+`python -m hyperspace_tpu.telemetry.artifact migrate FILE`.
+
+Entries present in only one artifact are reported but never gate (a
+new rung/query has no baseline; a removed one is a review question,
+not a perf fact). The 15% default threshold leaves headroom for the
+shared tunneled link's ~2x time-of-day wobble on sub-ratios near 1
+(see `link_probe` in bench_common.py) while still catching real
+cliffs; artifacts carry the probe so a borderline failure can be
+attributed to link vs code before overriding the gate.
 """
 
 import argparse
 import glob
-import json
 import os
 import re
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def load_artifact(path: str) -> dict:
-    with open(path) as f:
-        doc = json.load(f)
-    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
-        doc = doc["parsed"]
-    if not isinstance(doc, dict):
-        raise SystemExit(f"{path}: not a bench artifact object")
-    return doc
+    """Canonical-schema load; legacy artifacts are refused LOUDLY
+    (exit 2) — the gate must never silently pass what it cannot
+    mechanically compare."""
+    from hyperspace_tpu.telemetry import artifact
+
+    try:
+        return artifact.load(path)
+    except artifact.LegacyArtifactError as exc:
+        print(f"bench_regress: REFUSING to gate a legacy-schema "
+              f"artifact:\n  {exc}", file=sys.stderr)
+        raise SystemExit(2)
+    except (OSError, ValueError) as exc:
+        raise SystemExit(f"{path}: not a bench artifact object ({exc})")
 
 
 def _round_key(path: str):
+    """Numeric round ordering: `_r9` sorts before `_r10` (a plain
+    lexicographic sort would interleave them); non-round files sort
+    last, then by name, so the newest ROUND is always picked."""
     m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
     return (m is None, int(m.group(1)) if m else 0, path)
 
@@ -76,8 +100,9 @@ def _rung1_link_share(doc: dict):
 
 def compare(old: dict, new: dict, threshold: float):
     """[(name, old_ratio, new_ratio, change, gated)] for every
-    comparable vs_baseline (higher is better), headline first, plus
-    the peak-HBM row and the rung-1 link share (both lower is better —
+    comparable vs_baseline (higher is better), headline first — rungs
+    for rung artifacts, per-query rows for query artifacts — plus the
+    peak-HBM row and the rung-1 link share (both lower is better —
     they gate on GROWTH)."""
     rows = []
 
@@ -91,15 +116,18 @@ def compare(old: dict, new: dict, threshold: float):
         rows.append((name, old_v, new_v, change, gated))
 
     add("headline", old.get("vs_baseline"), new.get("vs_baseline"))
-    old_rungs = old.get("rungs") or {}
-    new_rungs = new.get("rungs") or {}
-    for rung in sorted(set(old_rungs) | set(new_rungs)):
-        o, n = old_rungs.get(rung), new_rungs.get(rung)
-        if o is None or n is None:
-            rows.append((rung, (o or {}).get("vs_baseline"),
-                         (n or {}).get("vs_baseline"), None, False))
-            continue
-        add(rung, o.get("vs_baseline"), n.get("vs_baseline"))
+    for section, prefix in (("rungs", ""), ("queries", "")):
+        old_entries = old.get(section) or {}
+        new_entries = new.get(section) or {}
+        for entry in sorted(set(old_entries) | set(new_entries)):
+            o, n = old_entries.get(entry), new_entries.get(entry)
+            if o is None or n is None:
+                rows.append((prefix + entry,
+                             (o or {}).get("vs_baseline"),
+                             (n or {}).get("vs_baseline"), None, False))
+                continue
+            add(prefix + entry, o.get("vs_baseline"),
+                n.get("vs_baseline"))
     add("peak_hbm_bytes",
         (old.get("memory") or {}).get("peak_hbm_bytes"),
         (new.get("memory") or {}).get("peak_hbm_bytes"),
@@ -109,20 +137,42 @@ def compare(old: dict, new: dict, threshold: float):
     return rows
 
 
+def print_attribution(old: dict, new: dict, old_path: str,
+                      new_path: str) -> None:
+    """The failed gate's own diagnosis: run the differ on the gated
+    pair and print the ranked attribution tree."""
+    from hyperspace_tpu.telemetry import diff
+
+    d = diff.diff_artifacts(old, new,
+                            old_name=os.path.basename(old_path),
+                            new_name=os.path.basename(new_path))
+    print()
+    print(d.format_tree())
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("artifacts", nargs="*",
                     help="explicit OLD NEW artifact paths")
     ap.add_argument("--threshold", type=float, default=0.15,
                     help="max tolerated vs_baseline drop (default 0.15)")
-    ap.add_argument("--glob", default="BENCH_r*.json",
-                    help="artifact family when paths are not given")
+    ap.add_argument("--glob", default=None,
+                    help="artifact family when paths are not given "
+                         "(default BENCH_r*.json)")
+    ap.add_argument("--tpcds", action="store_true",
+                    help="gate the TPC-DS macro-bench family "
+                         "(BENCH_TPCDS_r*.json) instead of the "
+                         "micro-rung ladder")
+    ap.add_argument("--no-diff", action="store_true",
+                    help="skip the attribution tree on gate failure")
     args = ap.parse_args()
 
     if len(args.artifacts) == 2:
         old_path, new_path = args.artifacts
     elif not args.artifacts:
-        old_path, new_path = pick_latest_two(args.glob)
+        pattern = args.glob or ("BENCH_TPCDS_r*.json" if args.tpcds
+                                else "BENCH_r*.json")
+        old_path, new_path = pick_latest_two(pattern)
     else:
         ap.error("pass exactly two artifact paths, or none for auto")
 
@@ -145,7 +195,9 @@ def main() -> int:
         if gated:
             regressions.append(name)
     if regressions:
-        print(f"bench_regress: FAILED — {len(regressions)} rung(s) "
+        if not args.no_diff:
+            print_attribution(old, new, old_path, new_path)
+        print(f"bench_regress: FAILED — {len(regressions)} gate(s) "
               f"regressed >{args.threshold:.0%}: "
               + ", ".join(regressions), file=sys.stderr)
         return 1

@@ -115,6 +115,37 @@ def check_device_put_seam(package_dir: str):
     return failures
 
 
+# The ONE sanctioned artifact emitter: every bench driver's committed
+# JSON routes through telemetry.artifact.make_artifact, which stamps
+# `schema_version` and unconditionally attaches `process_metrics`,
+# `memory`, and `transfer`. A driver assembling its own top-level
+# artifact can silently drop the telemetry the regression differ
+# attributes from — exactly how the r03/r04 TPC-DS rounds became
+# mechanically incomparable.
+_BENCH_EXEMPT = ("bench_common.py",)  # helpers; prints no artifact
+
+
+def check_bench_artifact_seam(repo_root: str):
+    """Source lint: every `bench*.py` driver at the repo root must
+    route its artifact through `telemetry.artifact.make_artifact`."""
+    import glob as _glob
+
+    failures = []
+    for path in sorted(_glob.glob(os.path.join(repo_root, "bench*.py"))):
+        fname = os.path.basename(path)
+        if fname in _BENCH_EXEMPT:
+            continue
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        if "make_artifact(" not in src:
+            failures.append(
+                f"{fname}: bench driver emits an artifact without "
+                "routing through telemetry.artifact.make_artifact — "
+                "schema_version/process_metrics can silently go "
+                "missing from a committed round")
+    return failures
+
+
 # The ONE sanctioned backoff point: every storage retry routes through
 # the policy in utils/retry.py (typed classification, conf-driven
 # backoff, io.retries/io.giveups counters, fault-injection coverage).
@@ -221,6 +252,8 @@ def main() -> int:
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_retry_seams(
         os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_bench_artifact_seam(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
     if import_errors:
         print("check_metrics_coverage: module import failures "

@@ -1,0 +1,465 @@
+"""Regression attribution (PR 6): the canonical bench-artifact schema
+(`telemetry/artifact.py`), the telemetry differ (`telemetry/diff.py`),
+the `bench_diff` CLI, the TPC-DS gate + legacy refusal in
+`bench_regress.py`, and the Prometheus exposition-format conformance
+of `registry.to_text()`."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from hyperspace_tpu import telemetry
+from hyperspace_tpu.telemetry import artifact, diff
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+# ---------------------------------------------------------------------------
+# Canonical schema
+# ---------------------------------------------------------------------------
+
+
+def test_make_artifact_is_canonical():
+    doc = artifact.make_artifact(driver="test", metric="m", value=1.0,
+                                 unit="s", vs_baseline=2.0,
+                                 queries={"q1": {"rules_on_s": 0.5}})
+    assert artifact.is_canonical(doc)
+    assert doc["schema_version"] == artifact.SCHEMA_VERSION
+    # The emitter attaches the process digests UNCONDITIONALLY — a
+    # driver cannot produce a canonical artifact missing them.
+    assert "process_metrics" in doc
+    assert "memory" in doc
+    assert set(doc["transfer"]) >= {"h2d_bytes", "d2h_bytes",
+                                    "overlap_saved_seconds"}
+
+
+def test_validate_flags_legacy_shapes():
+    legacy = {"metric": "m", "value": 1, "vs_baseline": 2.0}
+    missing = artifact.validate(legacy)
+    assert "schema_version" in missing and "process_metrics" in missing
+    migrated = artifact.migrate(legacy)
+    assert artifact.is_canonical(migrated)
+    assert migrated["legacy"] is True
+    # lossless: every legacy field survives
+    assert migrated["metric"] == "m" and migrated["vs_baseline"] == 2.0
+    # canonical input passes through unchanged
+    assert artifact.migrate(migrated) is migrated
+
+
+def test_unwrap_driver_envelope():
+    inner = {"schema_version": 1, "metric": "m", "value": 1,
+             "vs_baseline": 1.0, "process_metrics": {}}
+    wrapped = {"n": 5, "cmd": "python bench.py", "rc": 0,
+               "tail": "...", "parsed": inner}
+    assert artifact.unwrap(wrapped) == inner
+    assert artifact.is_canonical(wrapped)
+
+
+def test_load_refuses_legacy_then_migrates(tmp_path):
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps({"metric": "m", "value": 1,
+                             "vs_baseline": 1.0}))
+    with pytest.raises(artifact.LegacyArtifactError) as exc:
+        artifact.load(str(p))
+    assert "migrate" in str(exc.value)
+    doc = artifact.load(str(p), migrate_legacy=True)
+    assert doc["legacy"] and artifact.is_canonical(doc)
+
+
+def test_migrate_file_preserves_envelope(tmp_path):
+    p = tmp_path / "BENCH_r05.json"
+    p.write_text(json.dumps({"n": 5, "cmd": "python bench.py", "rc": 0,
+                             "tail": "t",
+                             "parsed": {"metric": "m", "value": 1,
+                                        "vs_baseline": 1.0}}))
+    assert artifact.migrate_file(str(p))
+    outer = json.loads(p.read_text())
+    assert outer["cmd"] == "python bench.py"  # envelope survives
+    assert artifact.is_canonical(outer["parsed"])
+    assert not artifact.migrate_file(str(p))  # idempotent
+
+
+def test_committed_artifacts_are_canonical():
+    """Every committed bench round must load without legacy migration
+    — the repo's own artifacts obey the repo's own schema."""
+    import glob
+    for path in sorted(glob.glob(os.path.join(REPO_ROOT,
+                                              "BENCH_*r*.json"))):
+        doc = artifact.load(path)  # raises LegacyArtifactError if not
+        assert doc["schema_version"] == artifact.SCHEMA_VERSION, path
+
+
+# ---------------------------------------------------------------------------
+# The differ: telemetry-based attribution
+# ---------------------------------------------------------------------------
+
+
+def _tree(wall, op_walls, counters=None, events=None):
+    """A minimal QueryMetrics.to_dict()-shaped tree: a linear chain of
+    operators (parent -> child) with the given walls."""
+    ops = []
+    cum = list(op_walls)
+    # wall of node i includes its children: accumulate from the leaf.
+    for i, name_wall in enumerate(op_walls):
+        name, self_s = name_wall
+        total = sum(w for _, w in op_walls[i:])
+        ops.append({"op_id": i, "parent_id": i - 1 if i else None,
+                    "name": name, "label": name, "wall_s": total,
+                    "rows_out": 100})
+    del cum
+    return {"description": "t", "wall_s": wall, "operators": ops,
+            "events": events or [], "counters": counters or {},
+            "index_usage": [], "peak_hbm_bytes": 0,
+            "peak_hbm_per_device": {}, "compile": {}}
+
+
+def test_diff_trees_attributes_compile_regression():
+    """Synthetic retrace regression: same operator work, +2s of
+    compile — the compile bucket must dominate and carry the cause."""
+    old = _tree(1.0, [("Project", 0.2), ("Filter", 0.3), ("Scan", 0.4)],
+                counters={"compile.seconds": 0.0, "plan_s": 0.05})
+    new = _tree(3.1, [("Project", 0.2), ("Filter", 2.4), ("Scan", 0.4)],
+                counters={"compile.seconds": 2.0, "compile.traces": 3,
+                          "plan_s": 0.05},
+                events=[{"category": "compile", "name": "retrace",
+                         "target": "fusion.run_stage",
+                         "cause": "shape/dtype: f64[4000] -> f64[8000]"}])
+    qd = diff.diff_trees(old, new, name="q_retrace")
+    assert qd.dominant == "compile"
+    buckets = {b.name: b for b in qd.buckets}
+    assert buckets["compile"].seconds == pytest.approx(2.0)
+    assert buckets["compile"].detail["traces"] == 3
+    assert buckets["compile"].detail["retrace_causes"][0]["cause"] \
+        .startswith("shape/dtype")
+    # the +2.1s of operator movement nets out the compile seconds: the
+    # compute bucket holds only the genuine +0.1s
+    assert buckets["compute"].seconds == pytest.approx(0.1)
+    # decomposition sums exactly to the wall delta
+    total = sum(b.seconds for b in qd.buckets)
+    assert total == pytest.approx(qd.delta)
+
+
+def test_diff_trees_attributes_link_regression():
+    old = _tree(1.0, [("Join", 0.5), ("Scan", 0.4)],
+                counters={"link.h2d_s": 0.1, "link.h2d_bytes": 1000})
+    new = _tree(2.5, [("Join", 0.5), ("Scan", 1.9)],
+                counters={"link.h2d_s": 1.6, "link.h2d_bytes": 9000})
+    qd = diff.diff_trees(old, new, name="q_link")
+    assert qd.dominant == "link"
+    buckets = {b.name: b for b in qd.buckets}
+    assert buckets["link"].seconds == pytest.approx(1.5)
+    assert buckets["link"].detail["link.h2d_bytes"] == 8000
+
+
+def test_diff_trees_cache_and_fallback_evidence():
+    old = _tree(1.0, [("Scan", 0.9)],
+                counters={"cache.parquet_read.hits": 10})
+    new = _tree(1.1, [("Scan", 1.0)],
+                counters={"cache.parquet_read.hits": 2,
+                          "cache.parquet_read.misses": 8,
+                          "resilience.fallbacks": 1},
+                events=[{"category": "resilience", "name": "degraded",
+                         "index": "idx", "reason": "gone"}])
+    qd = diff.diff_trees(old, new, name="q_cache")
+    buckets = {b.name: b for b in qd.buckets}
+    assert buckets["cache"].detail["cache.parquet_read.misses"] == 8
+    assert buckets["cache"].detail["cache.parquet_read.hits"] == -8
+    assert buckets["fallback"].detail["fallbacks"] == 1
+    # evidence buckets never claim seconds (their cost is already in
+    # compute/link — no double counting)
+    assert buckets["cache"].seconds == 0.0
+    assert buckets["fallback"].seconds == 0.0
+
+
+def test_diff_live_query_metrics_round_trip(tmp_path):
+    """diff_trees accepts live QueryMetrics objects, not just dicts."""
+    qm_old = telemetry.QueryMetrics("a")
+    op = qm_old.start_operator("Scan")
+    qm_old.finish_operator(op, rows_out=10)
+    qm_old.add_seconds("plan_s", 0.01)
+    qm_old.finish()
+    qm_new = telemetry.QueryMetrics("a")
+    op = qm_new.start_operator("Scan")
+    qm_new.finish_operator(op, rows_out=10)
+    qm_new.add_seconds("plan_s", 0.02)
+    qm_new.finish()
+    qd = diff.diff_trees(qm_old, qm_new)
+    assert qd.old_wall is not None and qd.new_wall is not None
+    assert {b.name for b in qd.buckets} >= {"compute", "link",
+                                            "compile", "residual"}
+
+
+# ---------------------------------------------------------------------------
+# The differ: legacy per-lane attribution + the committed r03/r04 pair
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_lane_attribution_names_framework_common():
+    """When no telemetry exists (legacy rounds), the slowdown the
+    rules-OFF lane also paid is attributed as framework/environment-
+    common — only the remainder can be index-path work."""
+    old = artifact.migrate({"metric": "m", "value": 25.6,
+                            "vs_baseline": 3.3, "queries": {
+                                "q64": {"rules_on_s": 25.0,
+                                        "rules_off_s": 33.0,
+                                        "pandas_s": 84.0}}})
+    new = artifact.migrate({"metric": "m", "value": 137.8,
+                            "vs_baseline": 0.45, "queries": {
+                                "q64": {"rules_on_s": 138.0,
+                                        "rules_off_s": 142.0,
+                                        "pandas_s": 61.0}}})
+    d = diff.diff_artifacts(old, new)
+    (qd,) = d.queries
+    assert qd.dominant == "framework_common"
+    buckets = {b.name: b for b in qd.buckets}
+    # 25.0 * (142/33 - 1) ~ +82.6s of the +113s is lane-common
+    assert buckets["framework_common"].seconds == pytest.approx(
+        25.0 * (142.0 / 33.0 - 1.0))
+    assert buckets["framework_common"].seconds \
+        + buckets["residual"].seconds == pytest.approx(qd.delta)
+
+
+def test_committed_r03_r04_pair_attributes_q64():
+    """THE acceptance pair: the migrated r03/r04 TPC-DS artifacts must
+    diff mechanically, and q64's slowdown must name a dominant
+    bucket."""
+    old = artifact.load(os.path.join(REPO_ROOT, "BENCH_TPCDS_r03.json"))
+    new = artifact.load(os.path.join(REPO_ROOT, "BENCH_TPCDS_r04.json"))
+    d = diff.diff_artifacts(old, new, "r03", "r04")
+    q64 = next(q for q in d.queries if q.name == "q64")
+    assert q64.ratio > 2.0  # the regression is real in the artifacts
+    assert q64.dominant == "framework_common"
+    tree = d.format_tree()
+    assert "q64" in tree and "dominant: framework_common" in tree
+    # machine form round-trips
+    doc = json.loads(d.to_json())
+    assert doc["queries"][0]["query"] == "q64"  # ranked: biggest first
+
+
+def test_rung_artifacts_diff_via_device_walls():
+    old = artifact.migrate({"metric": "m", "value": 1, "vs_baseline": 2,
+                            "rungs": {"2_filter_query":
+                                      {"device_s": 0.1, "cpu_s": 0.3,
+                                       "vs_baseline": 3.0}}})
+    new = artifact.migrate({"metric": "m", "value": 1, "vs_baseline": 1,
+                            "rungs": {"2_filter_query":
+                                      {"device_s": 0.4, "cpu_s": 0.3,
+                                       "vs_baseline": 0.75}}})
+    d = diff.diff_artifacts(old, new)
+    (qd,) = d.queries
+    assert qd.name == "2_filter_query"
+    assert qd.delta == pytest.approx(0.3)
+
+
+# ---------------------------------------------------------------------------
+# bench_diff CLI
+# ---------------------------------------------------------------------------
+
+
+def test_bench_diff_cli_on_committed_pair():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "bench_diff.py"),
+         os.path.join(REPO_ROOT, "BENCH_TPCDS_r03.json"),
+         os.path.join(REPO_ROOT, "BENCH_TPCDS_r04.json")],
+        capture_output=True, text=True, env=_ENV)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "dominant: framework_common" in out.stdout
+    assert "q64" in out.stdout
+    js = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "bench_diff.py"),
+         os.path.join(REPO_ROOT, "BENCH_TPCDS_r03.json"),
+         os.path.join(REPO_ROOT, "BENCH_TPCDS_r04.json"),
+         "--json", "--query", "q64"],
+        capture_output=True, text=True, env=_ENV)
+    assert js.returncode == 0
+    doc = json.loads(js.stdout)
+    assert doc["queries"][0]["query"] == "q64"
+    assert doc["queries"][0]["dominant"] == "framework_common"
+
+
+# ---------------------------------------------------------------------------
+# bench_regress: TPC-DS gate, attribution on failure, legacy refusal
+# ---------------------------------------------------------------------------
+
+
+def _regress(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                      "bench_regress.py"), *args],
+        capture_output=True, text=True, env=_ENV)
+
+
+def test_bench_regress_replays_tpcds_regression_with_attribution():
+    """THE acceptance replay: gating the committed r03 -> r04 pair
+    exits nonzero AND prints the attribution tree."""
+    out = _regress(os.path.join(REPO_ROOT, "BENCH_TPCDS_r03.json"),
+                   os.path.join(REPO_ROOT, "BENCH_TPCDS_r04.json"))
+    assert out.returncode == 1
+    assert "q64" in out.stdout and "REGRESSION" in out.stdout
+    assert "Attribution:" in out.stdout
+    assert "dominant: framework_common" in out.stdout
+    assert "FAILED" in out.stderr
+
+
+def test_bench_regress_gates_per_query(tmp_path):
+    def write(path, agg, q_ratios):
+        doc = {"schema_version": 1, "metric": "tpcds", "value": 1.0,
+               "process_metrics": {}, "vs_baseline": agg,
+               "queries": {q: {"vs_baseline": r, "rules_on_s": 1.0,
+                               "rules_off_s": 1.0}
+                           for q, r in q_ratios.items()}}
+        path.write_text(json.dumps(doc))
+
+    old, new = tmp_path / "a.json", tmp_path / "b.json"
+    write(old, 3.0, {"q17": 3.0, "q64": 3.0})
+    # aggregate holds, ONE query tanks: the per-query gate must fire
+    write(new, 2.9, {"q17": 3.2, "q64": 1.0})
+    out = _regress(str(old), str(new))
+    assert out.returncode == 1
+    assert "q64" in out.stderr
+    write(new, 2.9, {"q17": 3.0, "q64": 2.8})
+    assert _regress(str(old), str(new)).returncode == 0
+
+
+def test_bench_regress_refuses_legacy_schema(tmp_path):
+    legacy = tmp_path / "BENCH_TPCDS_r01.json"
+    legacy.write_text(json.dumps({"metric": "m", "value": 1,
+                                  "vs_baseline": 3.0, "queries": {}}))
+    good = tmp_path / "BENCH_TPCDS_r02.json"
+    good.write_text(json.dumps({"schema_version": 1, "metric": "m",
+                                "value": 1, "vs_baseline": 3.0,
+                                "process_metrics": {}, "queries": {}}))
+    out = _regress(str(legacy), str(good))
+    assert out.returncode == 2
+    assert "legacy-schema" in out.stderr
+    assert "migrate" in out.stderr
+
+
+def test_pick_latest_two_numeric_round_ordering(tmp_path, monkeypatch):
+    """`_r9` vs `_r10`: lexicographic sort would pick r9 as newest."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import bench_regress
+    finally:
+        sys.path.pop(0)
+    for n in (1, 2, 9, 10):
+        (tmp_path / f"BENCH_r{n}.json").write_text("{}")
+    monkeypatch.setattr(bench_regress, "REPO_ROOT", str(tmp_path))
+    old, new = bench_regress.pick_latest_two("BENCH_r*.json")
+    assert os.path.basename(old) == "BENCH_r9.json"
+    assert os.path.basename(new) == "BENCH_r10.json"
+    # zero-padded and unpadded rounds interleave numerically too
+    (tmp_path / "BENCH_r04.json").write_text("{}")
+    old, new = bench_regress.pick_latest_two("BENCH_r*.json")
+    assert os.path.basename(new) == "BENCH_r10.json"
+
+
+def test_check_metrics_coverage_bench_artifact_seam(tmp_path):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import check_metrics_coverage as cmc
+    finally:
+        sys.path.pop(0)
+    # the real drivers all route through the emitter
+    assert cmc.check_bench_artifact_seam(REPO_ROOT) == []
+    # a rogue driver printing its own top-level JSON fails the lint
+    (tmp_path / "bench_rogue.py").write_text(
+        "import json\nprint(json.dumps({'metric': 'm'}))\n")
+    failures = cmc.check_bench_artifact_seam(str(tmp_path))
+    assert len(failures) == 1 and "bench_rogue.py" in failures[0]
+    (tmp_path / "bench_ok.py").write_text(
+        "from hyperspace_tpu.telemetry.artifact import make_artifact\n"
+        "print(make_artifact(driver='x', metric='m', value=1,\n"
+        "                    unit='s', vs_baseline=1))\n")
+    failures = cmc.check_bench_artifact_seam(str(tmp_path))
+    assert len(failures) == 1  # bench_ok passes, rogue still fails
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition-format conformance (registry.to_text)
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"            # metric name
+    r"(?:\{([a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\[\\\"n])*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\[\\\"n])*\")*)\})?"
+    r" (NaN|[+-]?(?:Inf|[0-9.eE+-]+))$")      # value
+
+
+def test_prometheus_conformance():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("fusion.stage_execs").inc(4)
+    reg.counter("link.h2d.bytes").inc(1 << 20)
+    reg.gauge("mesh.devices").set(8)
+    reg.gauge("cache.device_batch.bytes_held").set(12345)
+    h = reg.histogram("link.h2d.bytes_per_transfer")
+    h.observe(100)
+    h.observe(5000)
+    h.observe(0)  # the "0" bucket — a label value worth escaping rules
+    text = reg.to_text()
+    assert text.endswith("\n")
+
+    seen_type = {}
+    seen_help = set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert _NAME_RE.fullmatch(name), line
+            assert name not in seen_help, f"duplicate HELP: {line}"
+            seen_help.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            assert _NAME_RE.fullmatch(name), line
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in seen_type, f"duplicate TYPE: {line}"
+            # HELP precedes TYPE for every family
+            assert name in seen_help, f"TYPE before HELP: {line}"
+            seen_type[name] = kind
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        base = m.group(1)
+        family = re.sub(r"_(bucket|sum|count)$", "", base)
+        assert family in seen_type or base in seen_type, \
+            f"sample before its TYPE: {line!r}"
+
+    # dotted names map to legal names, deterministically
+    assert "# TYPE hs_fusion_stage_execs counter" in text
+    assert "# HELP hs_fusion_stage_execs" in text
+    assert "hyperspace metric 'fusion.stage_execs'" in text
+    # histogram invariants: cumulative buckets, +Inf == count
+    bucket_counts = [int(line.rsplit(" ", 1)[1])
+                     for line in text.splitlines()
+                     if line.startswith(
+                         "hs_link_h2d_bytes_per_transfer_bucket")]
+    assert bucket_counts == sorted(bucket_counts)
+    assert bucket_counts[-1] == 3
+    assert "hs_link_h2d_bytes_per_transfer_count 3" in text
+
+
+def test_prometheus_label_escaping():
+    from hyperspace_tpu.telemetry.registry import (_escape_help,
+                                                   _escape_label_value)
+    assert _escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    assert _escape_help("back\\slash\nline") == "back\\\\slash\\nline"
+
+
+def test_prometheus_name_collision_disambiguated():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("a.b").inc()
+    reg.counter("a_b").inc()  # same name after sanitization
+    text = reg.to_text()
+    types = [line for line in text.splitlines()
+             if line.startswith("# TYPE ")]
+    names = [line.split()[2] for line in types]
+    assert len(names) == len(set(names)), names
+    assert "hs_a_b" in names and "hs_a_b_2" in names

@@ -1,0 +1,241 @@
+"""Query flight recorder (telemetry/flight.py): ring bounds, the
+slow-query dump round trip (dump -> reload -> diff against a live
+tree), thread safety of concurrent collects, and the engine wiring
+(every session-attached collect lands in the ring)."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import telemetry
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.telemetry import diff, flight
+
+
+def _finished_metrics(tag, wall_op=0.0):
+    qm = telemetry.QueryMetrics(description=tag)
+    op = qm.start_operator("Scan")
+    qm.finish_operator(op, rows_out=5)
+    qm.finish()
+    return qm
+
+
+@pytest.fixture
+def sales_env(tmp_path):
+    rng = np.random.default_rng(3)
+    n = 2000
+    data_dir = tmp_path / "sales"
+    data_dir.mkdir()
+    pq.write_table(pa.table({
+        "key": rng.integers(0, 50, n).astype(np.int64),
+        "qty": rng.integers(1, 10, n).astype(np.int64),
+    }), str(data_dir / "part-0.parquet"))
+
+    def session(**extra):
+        conf = {"hyperspace.warehouse.dir": str(tmp_path / "wh")}
+        conf.update(extra)
+        return HyperspaceSession(HyperspaceConf(conf))
+
+    return session, str(data_dir)
+
+
+# ---------------------------------------------------------------------------
+# Ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_keeps_newest():
+    rec = flight.FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record(_finished_metrics(f"q{i}"))
+    assert len(rec) == 4
+    assert [m.description for m in rec.queries()] == \
+        ["q6", "q7", "q8", "q9"]
+    assert [m.description for m in rec.queries(2)] == ["q8", "q9"]
+    rec.clear()
+    assert len(rec) == 0
+
+
+def test_collect_feeds_the_process_ring(sales_env):
+    session, data_dir = sales_env
+    sess = session()
+    rec = sess.flight_recorder()
+    assert rec is telemetry.get_recorder()
+    before = len(rec.queries())
+    df = sess.read_parquet(data_dir).filter(col("qty") > lit(5)) \
+        .select("key")
+    df.collect()
+    df.collect()
+    queries = rec.queries()
+    assert len(queries) >= min(before + 2, rec.capacity)
+    # the ring holds the SAME recorder objects the session surfaced
+    assert queries[-1] is sess.last_query_metrics()
+    assert queries[-1].wall_s is not None  # only finished recorders
+
+
+# ---------------------------------------------------------------------------
+# Slow-query dump
+# ---------------------------------------------------------------------------
+
+
+def test_slow_dump_round_trip_and_diff(sales_env, tmp_path):
+    session, data_dir = sales_env
+    dump_dir = str(tmp_path / "slowlog")
+    sess = session(**{
+        "spark.hyperspace.telemetry.slowlog.seconds": "0.000001",
+        "spark.hyperspace.telemetry.slowlog.dir": dump_dir})
+    df = sess.read_parquet(data_dir).filter(col("qty") > lit(5)) \
+        .select("key")
+    df.collect()
+    dumps = [f for f in os.listdir(dump_dir) if f.endswith(".json")]
+    assert len(dumps) == 1
+    path = os.path.join(dump_dir, dumps[0])
+
+    doc = flight.load_dump(path)
+    assert doc["kind"] == "hyperspace-slowlog"
+    assert doc["wall_s"] == pytest.approx(
+        sess.last_query_metrics().wall_s)
+    assert doc["threshold_s"] == pytest.approx(1e-6)
+    # the dump carries the FULL metric tree + a registry snapshot
+    assert doc["metrics"]["operators"]
+    assert "counters" in doc["registry"]
+    live = sess.last_query_metrics().to_dict()
+    assert doc["metrics"]["operators"] == live["operators"]
+
+    # round trip: reload the dump and diff it against a live re-run of
+    # the same query — the post-hoc diagnosis workflow, no re-tracing
+    df.collect()
+    qd = diff.diff_trees(doc["metrics"],
+                         sess.last_query_metrics().to_dict(),
+                         name="slow-vs-rerun")
+    assert qd.old_wall is not None and qd.new_wall is not None
+    assert {b.name for b in qd.buckets} >= {"compute", "link",
+                                            "compile", "residual"}
+    total = sum(b.seconds for b in qd.buckets)
+    assert total == pytest.approx(qd.delta, abs=1e-6)
+
+
+def test_slow_dump_respects_threshold(sales_env, tmp_path):
+    session, data_dir = sales_env
+    dump_dir = str(tmp_path / "slowlog")
+    # a threshold no test query reaches: ring records, nothing dumps
+    sess = session(**{
+        "spark.hyperspace.telemetry.slowlog.seconds": "3600",
+        "spark.hyperspace.telemetry.slowlog.dir": dump_dir})
+    sess.read_parquet(data_dir).select("key").collect()
+    assert not os.path.exists(dump_dir)
+    # default (0) disables dumping entirely
+    sess2 = session()
+    assert sess2.conf.slowlog_seconds == 0.0
+    sess2.read_parquet(data_dir).select("key").collect()
+    assert not os.path.exists(sess2.conf.slowlog_dir)
+
+
+def test_slow_dump_prunes_to_keep(tmp_path):
+    conf = HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "spark.hyperspace.telemetry.slowlog.seconds": "0.000001",
+        "spark.hyperspace.telemetry.slowlog.keep": "2"})
+    rec = flight.FlightRecorder(capacity=8)
+    paths = [rec.record(_finished_metrics(f"q{i}"), conf=conf)
+             for i in range(5)]
+    assert all(paths)
+    dumps = sorted(f for f in os.listdir(conf.slowlog_dir)
+                   if f.endswith(".json"))
+    assert len(dumps) == 2
+    # the newest dumps survive the prune
+    assert os.path.basename(paths[-1]) in dumps
+
+
+def test_dump_failure_never_fails_the_query(sales_env, tmp_path):
+    session, data_dir = sales_env
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("a file where the dump dir must go")
+    sess = session(**{
+        "spark.hyperspace.telemetry.slowlog.seconds": "0.000001",
+        "spark.hyperspace.telemetry.slowlog.dir":
+            str(blocker / "slowlog")})
+    errors_before = telemetry.get_registry() \
+        .counter("flight.dump_errors").value
+    table = sess.read_parquet(data_dir).select("key").collect()
+    assert table.num_rows > 0  # the query succeeded regardless
+    assert telemetry.get_registry().counter("flight.dump_errors") \
+        .value == errors_before + 1
+
+
+def test_load_dump_rejects_non_dumps(tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text(json.dumps({"metric": "m"}))
+    with pytest.raises(ValueError):
+        flight.load_dump(str(p))
+
+
+# ---------------------------------------------------------------------------
+# Thread safety
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_record_is_safe():
+    rec = flight.FlightRecorder(capacity=32)
+    n_threads, per_thread = 8, 50
+    errors = []
+
+    def worker(t):
+        try:
+            for i in range(per_thread):
+                rec.record(_finished_metrics(f"t{t}-{i}"))
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    assert len(rec) == 32  # full, exactly at capacity
+    assert all(m.wall_s is not None for m in rec.queries())
+
+
+def test_concurrent_collects_append_to_ring(sales_env):
+    """Concurrent session-attached collects (each with its own
+    recorder — the contextvar scoping) all land in the shared ring
+    without corrupting it."""
+    session, data_dir = sales_env
+    rec = telemetry.get_recorder()
+    rec.clear()
+    n_threads = 6
+    errors = []
+    barrier = threading.Barrier(n_threads)
+
+    def worker(i):
+        try:
+            sess = session()
+            df = sess.read_parquet(data_dir) \
+                .filter(col("qty") > lit(i % 9)).select("key")
+            barrier.wait(timeout=30)
+            df.collect()
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    queries = rec.queries()
+    assert len(queries) >= n_threads
+    # every recorder in the ring is finished and distinct
+    tail = queries[-n_threads:]
+    assert len({id(m) for m in tail}) == n_threads
+    assert all(m.wall_s is not None for m in tail)

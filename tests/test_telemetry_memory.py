@@ -388,7 +388,11 @@ def test_artifact_section_shape(sales_env):
 
 
 def _write_artifact(path, headline, peak_hbm=None):
-    doc = {"vs_baseline": headline,
+    # Canonical-schema fixture; a round MAY predate the memory
+    # section (peak_hbm=None) and must then not gate on it.
+    doc = {"schema_version": 1, "metric": "fixture", "value": 1.0,
+           "process_metrics": {},
+           "vs_baseline": headline,
            "rungs": {"1_build": {"vs_baseline": headline}}}
     if peak_hbm is not None:
         doc["memory"] = {"peak_hbm_bytes": peak_hbm}

@@ -369,11 +369,16 @@ def test_mesh_join_query_attributes_to_recorder(sales_env, tracing):
 
 
 def _write_artifact(path, ratios, wrap_parsed=False):
-    doc = {"vs_baseline": ratios.get("headline", 1.0),
+    # Canonical-schema fixture (telemetry/artifact.py): bench_regress
+    # refuses legacy shapes outright, so gate fixtures carry the
+    # required stamp fields.
+    doc = {"schema_version": 1, "metric": "fixture", "value": 1.0,
+           "process_metrics": {},
+           "vs_baseline": ratios.get("headline", 1.0),
            "rungs": {k: {"vs_baseline": v} for k, v in ratios.items()
                      if k != "headline"}}
     if wrap_parsed:
-        doc = {"parsed": doc, "rc": 0}
+        doc = {"parsed": doc, "rc": 0, "cmd": "python bench.py"}
     with open(path, "w") as f:
         json.dump(doc, f)
 

@@ -1,0 +1,252 @@
+"""Closed-loop serving benchmark — the traffic the ROADMAP's serving
+item gates on.
+
+K client threads drive a mixed filter / join / aggregate workload
+through ONE session (every `collect` routes through the process-wide
+`QueryScheduler`), closed-loop: each client issues its next query the
+moment the previous one returns. Reported:
+
+  - p50 / p95 / p99 latency over successful queries,
+  - QPS (successes / loop wall),
+  - typed outcome counts (rejected / deadline-exceeded / cancelled),
+  - the scheduler's serve.* counter block and peak admitted bytes.
+
+`vs_baseline` is the concurrency scaling ratio: closed-loop QPS at K
+clients over single-client QPS on the same warm mix — the number the
+scheduler must not regress (admission overhead, queue convoying, lock
+contention all land here). Every successful query's result is compared
+against its serial-run table, so a correctness break under concurrency
+fails the bench before any number is reported.
+
+Prints exactly ONE JSON line (canonical schema via
+`telemetry.artifact.make_artifact`; `scripts/bench_regress.py --serve`
+gates p99, reject rate, and QPS from it).
+
+Env knobs: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_QUERIES (200 total),
+BENCH_SERVE_ROWS (50000), BENCH_SERVE_BUDGET_BYTES (serving HBM budget;
+0 = unlimited), BENCH_SERVE_TIMEOUT_S (per-query deadline; 0 = none),
+BENCH_SERVE_QUEUE_DEPTH (32).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+CLIENTS = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+TOTAL_QUERIES = int(os.environ.get("BENCH_SERVE_QUERIES", 200))
+ROWS = int(os.environ.get("BENCH_SERVE_ROWS", 50_000))
+BUDGET_BYTES = int(os.environ.get("BENCH_SERVE_BUDGET_BYTES", 0))
+TIMEOUT_S = float(os.environ.get("BENCH_SERVE_TIMEOUT_S", 0))
+QUEUE_DEPTH = int(os.environ.get("BENCH_SERVE_QUEUE_DEPTH", 32))
+
+from bench_common import link_probe, log  # noqa: E402
+from hyperspace_tpu import telemetry  # noqa: E402
+
+
+def _percentile(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def build_workload(session, data_dir: str):
+    """The mixed query set. Deterministic plans — each query's serial
+    result is the correctness oracle for its concurrent runs."""
+    from hyperspace_tpu.plan.expr import col, lit
+
+    facts = session.read_parquet(os.path.join(data_dir, "facts"))
+    dims = session.read_parquet(os.path.join(data_dir, "dims"))
+    return [
+        ("filter", facts.filter(col("v") > lit(0.9))
+         .select("k", "v")),
+        ("agg", facts.group_by("g").agg(("sum", "v", "total"),
+                                        cnt=("count", "*"))),
+        ("join", facts.join(dims, on="k")
+         .filter(col("w") > lit(0.5))
+         .group_by("g").agg(("avg", "v", "avg_v"))),
+        ("filter2", facts.filter((col("g") == lit(7)))
+         .select("k", "g", "v")),
+        ("join_agg", facts.join(dims, on="k")
+         .group_by("label").agg(("sum", "w", "tw"))),
+    ]
+
+
+def generate(data_dir: str) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(7)
+    os.makedirs(os.path.join(data_dir, "facts"))
+    os.makedirs(os.path.join(data_dir, "dims"))
+    n_dims = max(ROWS // 50, 16)
+    pq.write_table(pa.table({
+        "k": rng.integers(0, n_dims, ROWS).astype(np.int64),
+        "g": rng.integers(0, 32, ROWS).astype(np.int64),
+        "v": rng.random(ROWS).astype(np.float64),
+    }), os.path.join(data_dir, "facts", "part-0.parquet"))
+    pq.write_table(pa.table({
+        "k": np.arange(n_dims, dtype=np.int64),
+        "w": rng.random(n_dims).astype(np.float64),
+        "label": pa.array([f"d{i % 100}" for i in range(n_dims)]),
+    }), os.path.join(data_dir, "dims", "part-0.parquet"))
+
+
+def canonical(table):
+    names = table.schema.names
+    return table.sort_by([(n, "ascending") for n in names])
+
+
+def main():
+    from hyperspace_tpu import HyperspaceConf, HyperspaceSession
+    from hyperspace_tpu.exceptions import (QueryCancelledError,
+                                           QueryDeadlineExceededError,
+                                           QueryRejectedError)
+
+    work = tempfile.mkdtemp(prefix="hs_serve_")
+    try:
+        data_dir = os.path.join(work, "data")
+        generate(data_dir)
+        session = HyperspaceSession(HyperspaceConf({
+            "hyperspace.warehouse.dir": os.path.join(work, "wh"),
+            "spark.hyperspace.serve.hbm.budget.bytes": str(BUDGET_BYTES),
+            "spark.hyperspace.serve.queue.depth": str(QUEUE_DEPTH),
+        }))
+        workload = build_workload(session, data_dir)
+
+        # Warm + correctness oracles (serial run of every query).
+        expected = {}
+        for name, df in workload:
+            expected[name] = canonical(df.collect())
+
+        # Single-client baseline QPS on the warm mix.
+        t0 = time.perf_counter()
+        serial_runs = 0
+        while serial_runs < max(len(workload) * 4, 20):
+            _name, df = workload[serial_runs % len(workload)]
+            df.collect()
+            serial_runs += 1
+        serial_wall = time.perf_counter() - t0
+        serial_qps = serial_runs / serial_wall
+        log(f"serial baseline: {serial_runs} queries in "
+            f"{serial_wall:.2f}s = {serial_qps:.1f} QPS")
+
+        # Closed loop: K clients share one global query budget.
+        next_q = [0]
+        take_lock = threading.Lock()
+        latencies = []
+        outcomes = {"ok": 0, "rejected": 0, "deadline": 0,
+                    "cancelled": 0, "error": 0}
+        mismatches = []
+        res_lock = threading.Lock()
+
+        def client(cid: int):
+            while True:
+                with take_lock:
+                    if next_q[0] >= TOTAL_QUERIES:
+                        return
+                    qi = next_q[0]
+                    next_q[0] += 1
+                name, df = workload[qi % len(workload)]
+                t1 = time.perf_counter()
+                try:
+                    table = df.collect(
+                        timeout=TIMEOUT_S if TIMEOUT_S > 0 else None)
+                except QueryRejectedError:
+                    with res_lock:
+                        outcomes["rejected"] += 1
+                    continue
+                except QueryDeadlineExceededError:
+                    with res_lock:
+                        outcomes["deadline"] += 1
+                    continue
+                except QueryCancelledError:
+                    with res_lock:
+                        outcomes["cancelled"] += 1
+                    continue
+                except Exception as exc:  # pragma: no cover
+                    with res_lock:
+                        outcomes["error"] += 1
+                        mismatches.append(f"{name}: {exc!r}")
+                    continue
+                wall = time.perf_counter() - t1
+                ok = canonical(table).equals(expected[name])
+                with res_lock:
+                    latencies.append(wall)
+                    outcomes["ok"] += 1
+                    if not ok:
+                        mismatches.append(
+                            f"{name}: result differs from serial run")
+
+        threads = [threading.Thread(target=client, args=(c,),
+                                    name=f"serve-client-{c}")
+                   for c in range(CLIENTS)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        loop_wall = time.perf_counter() - t0
+
+        if mismatches:
+            log("CORRECTNESS FAILURES under concurrency:")
+            for m in mismatches[:10]:
+                log(f"  {m}")
+            raise SystemExit(1)
+
+        latencies.sort()
+        qps = outcomes["ok"] / loop_wall if loop_wall else 0.0
+        sched = session.scheduler()
+        counters = telemetry.get_registry().counters_dict()
+        serve_counters = {k: v for k, v in counters.items()
+                          if k.startswith(("serve.", "resilience."))}
+        attempted = TOTAL_QUERIES
+        serve = {
+            "clients": CLIENTS,
+            "queries": attempted,
+            "rows": ROWS,
+            "budget_bytes": BUDGET_BYTES,
+            "deadline_s": TIMEOUT_S,
+            "loop_wall_s": round(loop_wall, 3),
+            "qps": round(qps, 2),
+            "serial_qps": round(serial_qps, 2),
+            "p50_s": round(_percentile(latencies, 0.50) or 0, 5),
+            "p95_s": round(_percentile(latencies, 0.95) or 0, 5),
+            "p99_s": round(_percentile(latencies, 0.99) or 0, 5),
+            "max_s": round(latencies[-1], 5) if latencies else None,
+            "outcomes": outcomes,
+            "reject_rate": round(outcomes["rejected"] / attempted, 5),
+            "timeout_rate": round(outcomes["deadline"] / attempted, 5),
+            "peak_admitted_bytes": sched.peak_admitted_bytes,
+            "counters": serve_counters,
+        }
+        log(f"closed loop: {outcomes['ok']}/{attempted} ok in "
+            f"{loop_wall:.2f}s = {qps:.1f} QPS "
+            f"(x{qps / serial_qps:.2f} vs 1 client), "
+            f"p50 {serve['p50_s'] * 1e3:.1f} ms, "
+            f"p99 {serve['p99_s'] * 1e3:.1f} ms, "
+            f"rejected {outcomes['rejected']}, "
+            f"deadline {outcomes['deadline']}")
+
+        result = telemetry.artifact.make_artifact(
+            driver="bench_serve.py",
+            metric="serve_closed_loop_qps",
+            value=round(qps, 2),
+            unit="queries/s",
+            vs_baseline=round(qps / serial_qps, 3) if serial_qps else None,
+            extra={"serve": serve, "link_probe": link_probe()})
+        print(json.dumps(result))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

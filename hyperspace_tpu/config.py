@@ -187,6 +187,62 @@ class HyperspaceConf:
                             constants.IO_TRANSFER_THREADS_DEFAULT)
 
     @property
+    def io_transfer_acquire_timeout_ms(self) -> float:
+        """Bound on waiting for in-flight-window headroom before a put
+        raises a typed transient `TransferAcquireTimeoutError` instead
+        of hanging on bytes a dead transfer never released; <= 0
+        disables the bound."""
+        return float(self.get(
+            constants.IO_TRANSFER_ACQUIRE_TIMEOUT_MS,
+            str(constants.IO_TRANSFER_ACQUIRE_TIMEOUT_MS_DEFAULT)))
+
+    @property
+    def serve_hbm_budget_bytes(self) -> int:
+        """Serving-plane admission budget: the sum of concurrently
+        admitted queries' projected HBM footprints stays under this; 0
+        (the default) disables budgeting. Process-wide scheduler —
+        co-resident sessions should agree (same caveat as the transfer
+        knobs)."""
+        return self.get_int(constants.SERVE_HBM_BUDGET_BYTES,
+                            constants.SERVE_HBM_BUDGET_BYTES_DEFAULT)
+
+    @property
+    def serve_queue_depth(self) -> int:
+        """How many over-budget queries may WAIT for admission; a query
+        arriving at a full queue gets a typed QueryRejectedError
+        immediately (backpressure to the caller)."""
+        return self.get_int(constants.SERVE_QUEUE_DEPTH,
+                            constants.SERVE_QUEUE_DEPTH_DEFAULT)
+
+    @property
+    def serve_deadline_seconds(self) -> float:
+        """Default per-query deadline (queued time included); 0 = none.
+        `collect(timeout=...)` overrides per call."""
+        return float(self.get(constants.SERVE_DEADLINE_SECONDS,
+                              str(constants.SERVE_DEADLINE_SECONDS_DEFAULT)))
+
+    @property
+    def serve_breaker_failures(self) -> int:
+        """Degraded-fallback count within the window that OPENS a
+        per-index circuit breaker (known-bad index skips straight to
+        the source plan)."""
+        return self.get_int(constants.SERVE_BREAKER_FAILURES,
+                            constants.SERVE_BREAKER_FAILURES_DEFAULT)
+
+    @property
+    def serve_breaker_window_seconds(self) -> float:
+        return float(self.get(
+            constants.SERVE_BREAKER_WINDOW_SECONDS,
+            str(constants.SERVE_BREAKER_WINDOW_SECONDS_DEFAULT)))
+
+    @property
+    def serve_breaker_cooldown_seconds(self) -> float:
+        """Open-state dwell before one half-open probe is allowed."""
+        return float(self.get(
+            constants.SERVE_BREAKER_COOLDOWN_SECONDS,
+            str(constants.SERVE_BREAKER_COOLDOWN_SECONDS_DEFAULT)))
+
+    @property
     def slowlog_seconds(self) -> float:
         """Slow-query dump threshold for the flight recorder
         (`telemetry/flight.py`): any query whose wall exceeds this many

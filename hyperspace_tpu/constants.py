@@ -84,6 +84,47 @@ IO_TRANSFER_INFLIGHT_BYTES = "spark.hyperspace.io.transfer.inflight.bytes"
 IO_TRANSFER_INFLIGHT_BYTES_DEFAULT = 64 * 1024 * 1024
 IO_TRANSFER_THREADS = "spark.hyperspace.io.transfer.threads"
 IO_TRANSFER_THREADS_DEFAULT = 2
+# Bound on how long a put may wait for in-flight-window headroom. A put
+# that died without releasing its bytes (dead link, hung runtime) would
+# otherwise block every later caller forever; past the timeout the
+# waiter raises a TYPED transient error (`TransferAcquireTimeoutError`,
+# a TimeoutError — `utils/retry.py` classifies it retryable) and counts
+# `io.transfer.acquire_timeouts`. <= 0 disables the bound.
+IO_TRANSFER_ACQUIRE_TIMEOUT_MS = \
+    "spark.hyperspace.io.transfer.acquire.timeout.ms"
+IO_TRANSFER_ACQUIRE_TIMEOUT_MS_DEFAULT = 30_000
+
+# Serving plane (`engine/scheduler.py`): every DataFrame.collect routes
+# through the process-wide QueryScheduler. Admission control budgets
+# concurrent queries' projected HBM footprints against
+# `serve.hbm.budget.bytes` (0, the default, disables budgeting — every
+# query admits immediately); queries that do not fit wait in a bounded
+# FIFO queue of depth `serve.queue.depth`, and when the queue is full
+# the caller gets a typed QueryRejectedError at once — backpressure,
+# not silent pile-up. `serve.deadline.seconds` gives every query a
+# default deadline (0 = none; `collect(timeout=...)` overrides per
+# call), enforced cooperatively at operator / fusion-stage / transfer-
+# chunk / sorted-run-write boundaries.
+SERVE_HBM_BUDGET_BYTES = "spark.hyperspace.serve.hbm.budget.bytes"
+SERVE_HBM_BUDGET_BYTES_DEFAULT = 0
+SERVE_QUEUE_DEPTH = "spark.hyperspace.serve.queue.depth"
+SERVE_QUEUE_DEPTH_DEFAULT = 32
+SERVE_DEADLINE_SECONDS = "spark.hyperspace.serve.deadline.seconds"
+SERVE_DEADLINE_SECONDS_DEFAULT = 0.0
+
+# Degradation circuit breaker (per index): after `breaker.failures`
+# IndexDataUnavailableError fallbacks within `breaker.window.seconds`,
+# the breaker OPENS and queries selecting that index skip straight to
+# the source plan without re-paying the failed index scan. After
+# `breaker.cooldown.seconds` one probe query is allowed through
+# (half-open); success closes the breaker, failure re-opens it.
+SERVE_BREAKER_FAILURES = "spark.hyperspace.serve.breaker.failures"
+SERVE_BREAKER_FAILURES_DEFAULT = 3
+SERVE_BREAKER_WINDOW_SECONDS = "spark.hyperspace.serve.breaker.window.seconds"
+SERVE_BREAKER_WINDOW_SECONDS_DEFAULT = 60.0
+SERVE_BREAKER_COOLDOWN_SECONDS = \
+    "spark.hyperspace.serve.breaker.cooldown.seconds"
+SERVE_BREAKER_COOLDOWN_SECONDS_DEFAULT = 30.0
 
 # Crash recovery lease: a maintenance action that finds the op log's
 # latest entry in a TRANSIENT state (CREATING/REFRESHING/...) treats the

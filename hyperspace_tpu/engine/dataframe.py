@@ -172,7 +172,8 @@ class DataFrame:
     def _conf(self):
         return self.session.conf if self.session is not None else None
 
-    def collect(self, with_metrics: bool = False):
+    def collect(self, with_metrics: bool = False,
+                timeout: Optional[float] = None):
         """Execute and return an Arrow table. `with_metrics=True` returns
         `(table, telemetry.QueryMetrics)` instead — per-operator timings
         and row counts, optimizer-rule and fusion-lane decision events,
@@ -180,62 +181,19 @@ class DataFrame:
         every session-attached collect (the recorder is a handful of
         perf_counter reads per operator) and stashed as
         `session.last_query_metrics()`; the optimizer runs inside the
-        recording so rule fired/skipped events are captured too."""
-        from hyperspace_tpu import telemetry
-        from hyperspace_tpu.engine.executor import execute_plan
-        from hyperspace_tpu.exceptions import IndexDataUnavailableError
-        from hyperspace_tpu.io.columnar import to_arrow
+        recording so rule fired/skipped events are captured too.
 
-        description = ", ".join(self.schema.names[:6])
-        metrics = telemetry.QueryMetrics(description=description)
-        with telemetry.recording(metrics), \
-                telemetry.span("query", "query", description=description):
-            plan = self._optimized_plan()
-            try:
-                batch = execute_plan(plan, conf=self._conf())
-            except IndexDataUnavailableError as exc:
-                if plan is self.plan:
-                    raise  # no rewrite to fall back from
-                # Graceful degradation: a rule-selected index's data is
-                # missing/unreadable at scan time — answer from the
-                # SOURCE plan instead of failing the query, and make the
-                # silent downgrade visible to the telemetry stack.
-                import logging
-                logging.getLogger(__name__).warning(
-                    "Index data unavailable (%s); falling back to the "
-                    "source plan", exc)
-                telemetry.get_registry() \
-                    .counter("resilience.fallbacks").inc()
-                metrics.add_count("resilience.fallbacks")
-                metrics.event("resilience", "degraded",
-                              index=exc.index_name, reason=str(exc))
-                batch = execute_plan(self.plan, conf=self._conf())
-            if not batch.is_host:
-                # Query-end HBM watermark, FORCED (throttling may have
-                # swallowed every span-boundary sample of a fast query)
-                # and inside the recording so it attributes here.
-                telemetry.memory.sample()
-            else:
-                import sys as _sys
-                if "jax" in _sys.modules:
-                    # Host result, but intermediates may have ridden the
-                    # device; throttled sample — and never an import of
-                    # jax just to find zero bytes.
-                    telemetry.memory.maybe_sample()
-        metrics.finish()
-        # Process-lifetime aggregates next to the per-query recorder.
-        reg = telemetry.get_registry()
-        reg.counter("queries.total").inc()
-        reg.counter("queries.seconds").inc(metrics.wall_s)
-        reg.histogram("query.wall_s").observe(metrics.wall_s)
-        # Flight recorder: the finished recorder joins the always-on
-        # ring of recent queries; a wall past the session's slowlog
-        # threshold also persists a self-contained dump (metric tree +
-        # registry snapshot + trace slice) for post-hoc diagnosis.
-        telemetry.flight.record(metrics, conf=self._conf())
-        if self.session is not None:
-            self.session._last_query_metrics = metrics
-        table = to_arrow(batch)
+        Every collect routes through the process-wide serving plane
+        (`engine/scheduler.py`): admission control against the HBM
+        budget (typed `QueryRejectedError` backpressure when the wait
+        queue is full), a per-query deadline — `timeout` (seconds)
+        overrides `spark.hyperspace.serve.deadline.seconds`; expiry or
+        `session.cancel(query_id)` raises typed
+        `QueryDeadlineExceededError` / `QueryCancelledError` at the
+        next cooperative checkpoint — and the per-index degradation
+        circuit breaker around the index-fallback path."""
+        from hyperspace_tpu.engine.scheduler import get_scheduler
+        table, metrics = get_scheduler().collect(self, timeout=timeout)
         return (table, metrics) if with_metrics else table
 
     def to_pandas(self):

@@ -678,6 +678,13 @@ class FusedStageExec(PhysicalNode):
     def execute(self, bucket: Optional[int] = None) -> ColumnBatch:
         if bucket is not None:
             return self.root.execute(bucket)
+        # Stage-boundary seams: the fault point the chaos harness
+        # drives (`fusion.stage`) and the cooperative-cancellation
+        # checkpoint — both BEFORE source execution, so an injected
+        # fault or an expired deadline costs nothing downstream.
+        from hyperspace_tpu.utils import faults
+        faults.fire("fusion.stage")
+        telemetry.check_deadline("stage")
         _configure_cache_budgets(self.conf)
         for s in self.sources:
             s._batch = s.node.execute()
@@ -779,6 +786,10 @@ class FusedStageExec(PhysicalNode):
         telemetry.memory.cache_stats("fusion_trace", None, len(_OUT_META))
         telemetry.event("fusion", "trace-cache",
                         hit=cache_hit, ops=len(_region_nodes(self.root)))
+        # Last checkpoint before committing to the jitted dispatch (a
+        # cold stage pays an XLA trace here — don't start one a
+        # cancelled query will never consume).
+        telemetry.check_deadline("stage")
         t0 = _time.perf_counter()
         try:
             with telemetry.span("fusion:dispatch", "fusion",

@@ -49,10 +49,23 @@ def _instrument(fn, bucketed: bool):
 
     @functools.wraps(fn)
     def wrapper(self, arg=None):
+        # Cooperative-cancellation checkpoints bracket every operator
+        # (one contextvar read + None check each when no deadline is
+        # active — same always-off contract as the recorder hooks).
+        # BOTH ends matter in a pull-based executor: every operator
+        # STARTS during the initial tree descent (microseconds), so the
+        # entry check alone would see the whole plan before any real
+        # work ran; the finish check below — after the operator's
+        # actual compute, on the way up — is what stops a cancelled
+        # query between operators.
+        phase = "scan" if self.name == "Scan" else "operator"
+        telemetry.check_deadline(phase)
         rec = telemetry.current()
         tr = telemetry.tracer()
         if rec is None and tr is None:
-            return fn(self, arg)
+            out = fn(self, arg)
+            telemetry.check_deadline(phase)
+            return out
         op = None
         if rec is not None:
             op = rec.start_operator(self.name, self, bucketed=bucketed)
@@ -80,6 +93,10 @@ def _instrument(fn, bucketed: bool):
         # per-query HBM watermark (throttled; after the span close so
         # the accounting walk never inflates the operator's wall).
         telemetry.memory.maybe_sample()
+        # The mid-query cancellation point (see entry comment): the
+        # operator's record is already closed cleanly — the QUERY
+        # aborts before the parent consumes the result.
+        telemetry.check_deadline(phase)
         return out
 
     wrapper.__telemetry_instrumented__ = True

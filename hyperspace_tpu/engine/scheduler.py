@@ -1,0 +1,660 @@
+"""The serving plane: process-wide query scheduler + cancellation +
+degradation circuit breaker.
+
+ROADMAP's north star is "heavy traffic from millions of users"; until
+this module, any number of threads could call `DataFrame.collect`
+simultaneously with nothing budgeting device memory, no way to stop a
+running query, and a persistently broken index re-paying the expensive
+degraded fallback on every single query. Every `collect` now routes
+through ONE `QueryScheduler` (`get_scheduler()`), which gives the
+execution plane the same treatment PR 4 gave storage — typed failure
+modes, counters behind every one of them, and fault seams a chaos test
+can reach:
+
+- **admission control**: each query's projected HBM footprint
+  (`plan/footprint.py` — scan file sizes x a decode-expansion factor,
+  conservative default when unknowable) is admitted against
+  `spark.hyperspace.serve.hbm.budget.bytes`, derived against the
+  `DeviceMemoryAccountant` live gauges (device pressure beyond the
+  scheduler's own bookkeeping — resident caches, other tenants —
+  shrinks the headroom). Over-budget queries wait in a bounded FIFO
+  (`serve.queue.depth`); a query arriving at a full queue gets a typed
+  `QueryRejectedError` IMMEDIATELY — backpressure to the caller, not a
+  silent pile-up of blocked threads. Budget 0 (default) disables
+  budgeting but keeps the bookkeeping (gauges, query registry, cancel).
+
+- **deadlines & cooperative cancellation**: each query carries a
+  `Deadline` (per-call `collect(timeout=...)`, else
+  `serve.deadline.seconds`) in the same contextvar scope as its
+  `QueryMetrics` (`telemetry.deadline_scope`, carried across pool
+  threads by `telemetry.propagating`). `telemetry.check_deadline(phase)`
+  checkpoints at every layer's iteration boundaries — operator starts
+  (`engine/physical.py`), fusion stage entry (`engine/fusion.py`),
+  transfer-engine chunk loops (`io/transfer.py`), sorted-run writes
+  (`io/builder.py`) — raise `QueryDeadlineExceededError` /
+  `QueryCancelledError` tagged with the interrupted phase;
+  `session.cancel(query_id)` flips the same flag. Cancellation is
+  COOPERATIVE: in-flight device work runs to its next checkpoint, so
+  buffers unwind through the normal release paths (the leak-sentinel
+  tests in `tests/test_serving.py` pin this).
+
+- **degradation circuit breaker**: the PR-4 `IndexDataUnavailableError`
+  fallback is wrapped in a per-index breaker (closed -> open after N
+  failures in a window -> half-open probe; `serve.breaker.*` knobs).
+  While open, a query selecting the bad index skips STRAIGHT to the
+  source plan — no failed index scan to re-pay — with
+  `resilience.breaker.*` counters and flight-recorder events marking
+  every transition.
+
+Fault seams for the chaos harness (`tests/chaos.py`):
+`scheduler.admit` fires at admission entry, `scheduler.run` just
+before plan optimization; `fusion.stage` and `transfer.put` cover the
+execution layers below.
+
+Typed serving errors and their counters are a CLOSED set
+(`SERVING_ERROR_COUNTERS`): `scripts/check_metrics_coverage.py` fails
+any `QueryServingError` subclass missing from the table, so a new
+failure mode cannot ship without its scrape-able series.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from hyperspace_tpu import telemetry
+from hyperspace_tpu.exceptions import (HyperspaceException,
+                                       IndexDataUnavailableError,
+                                       QueryCancelledError,
+                                       QueryDeadlineExceededError,
+                                       QueryRejectedError,
+                                       QueryServingError)
+
+__all__ = ["Deadline", "QueryScheduler", "BreakerBoard", "get_scheduler",
+           "set_scheduler", "reset_scheduler", "SERVING_ERROR_COUNTERS"]
+
+logger = logging.getLogger(__name__)
+
+# Typed serving error -> the registry counter bumped when one is
+# raised. The metrics-coverage lint cross-checks this table against the
+# live QueryServingError subclass tree: every subclass must appear
+# here, and its entry must equal the class's own `counter` attribute.
+SERVING_ERROR_COUNTERS = {
+    "QueryRejectedError": "serve.rejected",
+    "QueryCancelledError": "serve.cancelled",
+    "QueryDeadlineExceededError": "serve.deadline_exceeded",
+}
+
+# Queue-wait poll quantum: waiters re-check admission at least this
+# often even without a notify (cheap safety against a lost wakeup
+# under chaos; the cv IS notified on every release).
+_WAIT_QUANTUM_S = 0.05
+
+
+class Deadline:
+    """Per-query cancellation token + optional wall-clock deadline.
+
+    `check(phase)` is the ONE cooperative checkpoint primitive: raises
+    the typed error tagged with the phase it would interrupt. The
+    cancelled flag is a plain bool (GIL-atomic store; checkpoints pay
+    an attribute read, not a lock). A Deadline with no timeout still
+    supports `cancel()` — every query gets one."""
+
+    __slots__ = ("query_id", "timeout_s", "_expires_t", "_cancelled")
+
+    def __init__(self, query_id: Optional[str] = None,
+                 timeout_s: Optional[float] = None):
+        self.query_id = query_id
+        self.timeout_s = timeout_s if timeout_s and timeout_s > 0 \
+            else None
+        self._expires_t = (time.monotonic() + self.timeout_s
+                           if self.timeout_s is not None else None)
+        self._cancelled = False
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def expired(self) -> bool:
+        return self._expires_t is not None \
+            and time.monotonic() >= self._expires_t
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left (None = no time limit; 0.0 = expired)."""
+        if self._expires_t is None:
+            return None
+        return max(0.0, self._expires_t - time.monotonic())
+
+    def check(self, phase: str = "unknown") -> None:
+        if self._cancelled:
+            raise QueryCancelledError(
+                f"query {self.query_id or '?'} cancelled (during "
+                f"{phase})", query_id=self.query_id, phase=phase)
+        if self.expired():
+            raise QueryDeadlineExceededError(
+                f"query {self.query_id or '?'} exceeded its "
+                f"{self.timeout_s:.3f}s deadline (during {phase})",
+                query_id=self.query_id, phase=phase)
+
+
+# ---------------------------------------------------------------------------
+# Degradation circuit breaker
+# ---------------------------------------------------------------------------
+
+_CLOSED, _OPEN, _HALF_OPEN = "closed", "open", "half_open"
+
+
+class _Breaker:
+    __slots__ = ("state", "failures", "opened_t", "probing")
+
+    def __init__(self):
+        self.state = _CLOSED
+        self.failures: deque = deque()  # monotonic timestamps
+        self.opened_t = 0.0
+        self.probing = False
+
+
+def _breaker_knobs(conf):
+    from hyperspace_tpu import constants
+    if conf is None:
+        return (constants.SERVE_BREAKER_FAILURES_DEFAULT,
+                constants.SERVE_BREAKER_WINDOW_SECONDS_DEFAULT,
+                constants.SERVE_BREAKER_COOLDOWN_SECONDS_DEFAULT)
+    return (conf.serve_breaker_failures,
+            conf.serve_breaker_window_seconds,
+            conf.serve_breaker_cooldown_seconds)
+
+
+class BreakerBoard:
+    """Per-index degradation circuit breakers.
+
+    closed --N failures in window--> open --cooldown--> half-open
+    (ONE probe query allowed through) --success--> closed / --failure-->
+    open again. A failure here is an `IndexDataUnavailableError`
+    fallback: the breaker's job is to stop re-paying the failed index
+    scan once the index is KNOWN bad, not to mask novel errors.
+    Transitions land in `resilience.breaker.{opened,half_open,closed}`
+    counters and, when a query recorder is active, as flight-recorder
+    visible `resilience: breaker` events."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, _Breaker] = {}
+
+    def state(self, index_name: str) -> str:
+        with self._lock:
+            b = self._breakers.get(index_name)
+            return b.state if b is not None else _CLOSED
+
+    def _transition(self, b: _Breaker, state: str, index_name: str) -> None:
+        # Called under the lock. Counter + decision event per move.
+        b.state = state
+        telemetry.get_registry().counter(
+            f"resilience.breaker.{state if state != _OPEN else 'opened'}"
+        ).inc()
+        telemetry.event("resilience", "breaker", index=index_name,
+                        state=state)
+
+    def allow(self, index_name: str, conf=None) -> str:
+        """Admission verdict for a query selecting `index_name`:
+        "closed" (serve from index), "probe" (half-open: THIS query is
+        the probe), or "open" (skip straight to the source plan)."""
+        with self._lock:
+            b = self._breakers.get(index_name)
+            if b is None or b.state == _CLOSED:
+                return _CLOSED
+            _n, _w, cooldown = _breaker_knobs(conf)
+            if b.state == _OPEN:
+                if time.monotonic() - b.opened_t < cooldown:
+                    return _OPEN
+                self._transition(b, _HALF_OPEN, index_name)
+                b.probing = True
+                return "probe"
+            # half-open: one probe at a time
+            if not b.probing:
+                b.probing = True
+                return "probe"
+            return _OPEN
+
+    def record_failure(self, index_name: str, conf=None) -> None:
+        now = time.monotonic()
+        with self._lock:
+            b = self._breakers.setdefault(index_name, _Breaker())
+            n, window, _cooldown = _breaker_knobs(conf)
+            if b.state == _HALF_OPEN:
+                # Probe failed: straight back to open, fresh cooldown.
+                b.probing = False
+                b.opened_t = now
+                self._transition(b, _OPEN, index_name)
+                return
+            if b.state == _OPEN:
+                return  # already open (a pre-open query finishing late)
+            b.failures.append(now)
+            while b.failures and b.failures[0] < now - window:
+                b.failures.popleft()
+            if len(b.failures) >= max(1, n):
+                b.opened_t = now
+                b.failures.clear()
+                self._transition(b, _OPEN, index_name)
+
+    def record_success(self, index_name: str) -> None:
+        with self._lock:
+            b = self._breakers.get(index_name)
+            if b is None:
+                return
+            if b.state == _HALF_OPEN:
+                b.probing = False
+                self._transition(b, _CLOSED, index_name)
+            elif b.state == _CLOSED:
+                b.failures.clear()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._breakers.clear()
+
+    def snapshot(self) -> Dict[str, str]:
+        with self._lock:
+            return {name: b.state for name, b in self._breakers.items()}
+
+
+# ---------------------------------------------------------------------------
+# The scheduler
+# ---------------------------------------------------------------------------
+
+
+class _QueryEntry:
+    __slots__ = ("query_id", "deadline", "footprint", "session_id",
+                 "admitted")
+
+    def __init__(self, query_id: str, deadline: Deadline, footprint: int,
+                 session_id: Optional[int]):
+        self.query_id = query_id
+        self.deadline = deadline
+        self.footprint = footprint
+        self.session_id = session_id
+        self.admitted = False
+
+
+class QueryScheduler:
+    """Process-wide serving-plane scheduler (module docstring). All
+    waiting happens on the CALLER's thread — the scheduler spawns no
+    threads of its own (and the metrics-coverage lint bans raw
+    `threading.Thread` elsewhere in `engine/`), so there is no
+    dispatcher to deadlock or leak."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._active: Dict[str, _QueryEntry] = {}
+        self._waiters: deque = deque()  # _QueryEntry FIFO
+        self._admitted_bytes = 0
+        self._inflight = 0
+        self._idle_baseline = 0  # accountant live bytes at idle
+        self._ids = itertools.count(1)
+        self.peak_admitted_bytes = 0
+        self._breakers = BreakerBoard()
+
+    # -- introspection ----------------------------------------------------
+
+    def active_queries(self) -> List[str]:
+        """Query ids currently admitted or queued (cancel targets)."""
+        with self._cv:
+            return sorted(self._active)
+
+    def admitted_bytes(self) -> int:
+        with self._cv:
+            return self._admitted_bytes
+
+    @property
+    def breakers(self) -> BreakerBoard:
+        return self._breakers
+
+    # -- cancellation -----------------------------------------------------
+
+    def cancel(self, query_id: str) -> bool:
+        """Cooperatively cancel a queued or running query. True iff the
+        id was live (the query raises `QueryCancelledError` at its next
+        checkpoint — cancellation is a request, not preemption)."""
+        with self._cv:
+            ent = self._active.get(query_id)
+            if ent is None:
+                return False
+            ent.deadline.cancel()
+            self._cv.notify_all()
+        return True
+
+    def cancel_session(self, session) -> int:
+        """Cancel every live query submitted through `session`
+        (`session.close()`'s drain). Returns how many were flagged."""
+        sid = id(session)
+        n = 0
+        with self._cv:
+            for ent in self._active.values():
+                if ent.session_id == sid:
+                    ent.deadline.cancel()
+                    n += 1
+            if n:
+                self._cv.notify_all()
+        return n
+
+    def drain_session(self, session, timeout_s: float = 10.0) -> bool:
+        """Block until no query of `session` is live (or timeout).
+        True iff drained."""
+        sid = id(session)
+        t_end = time.monotonic() + timeout_s
+        with self._cv:
+            while any(e.session_id == sid for e in self._active.values()):
+                left = t_end - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(timeout=min(left, _WAIT_QUANTUM_S))
+        return True
+
+    # -- admission --------------------------------------------------------
+
+    def _live_device_bytes(self) -> int:
+        """Last-sampled accountant live total (no walk forced — the
+        accountant samples at span boundaries and query ends already;
+        admission reads whatever is freshest)."""
+        try:
+            return sum(telemetry.get_accountant().live.values())
+        except Exception:
+            return 0
+
+    def _fits(self, footprint: int, budget: int) -> bool:
+        # Caller holds the cv lock. Progress guarantee: with nothing in
+        # flight a query larger than the whole budget still admits —
+        # the budget bounds CONCURRENCY, it must never wedge serving.
+        if self._inflight == 0:
+            return True
+        live = self._live_device_bytes()
+        used = max(self._admitted_bytes,
+                   live - self._idle_baseline if live else 0)
+        return used + footprint <= budget
+
+    def _admit(self, ent: _QueryEntry, conf) -> float:
+        """Admit `ent` (blocking in FIFO order when over budget).
+        Returns seconds spent queued. Raises QueryRejectedError when
+        the wait queue is full, or the entry's own deadline error when
+        it expires/cancels while queued."""
+        from hyperspace_tpu.utils import faults
+        faults.fire("scheduler.admit")
+        reg = telemetry.get_registry()
+        budget = conf.serve_hbm_budget_bytes if conf is not None else 0
+        with self._cv:
+            if budget <= 0 or (not self._waiters
+                               and self._fits(ent.footprint, budget)):
+                self._grant(ent, reg)
+                reg.histogram("serve.queue_wait_s").observe(0.0)
+                return 0.0
+            depth = conf.serve_queue_depth if conf is not None else 0
+            if len(self._waiters) >= max(0, depth):
+                raise QueryRejectedError(
+                    f"query {ent.query_id} rejected: projected "
+                    f"{ent.footprint} B does not fit the serving "
+                    f"budget ({budget} B, {self._admitted_bytes} B "
+                    f"admitted) and the wait queue is full "
+                    f"({len(self._waiters)}/{depth})",
+                    query_id=ent.query_id, phase="queue")
+            t0 = time.perf_counter()
+            self._waiters.append(ent)
+            reg.counter("serve.queued").inc()
+            reg.gauge("serve.queue_depth").set(len(self._waiters))
+            try:
+                while not (self._waiters[0] is ent
+                           and self._fits(ent.footprint, budget)):
+                    ent.deadline.check("queue")
+                    rem = ent.deadline.remaining()
+                    self._cv.wait(timeout=(_WAIT_QUANTUM_S if rem is None
+                                           else min(rem + 1e-3,
+                                                    _WAIT_QUANTUM_S)))
+                self._waiters.popleft()
+                self._grant(ent, reg)
+            finally:
+                try:
+                    self._waiters.remove(ent)
+                except ValueError:
+                    pass  # admitted (popleft) — the normal path
+                reg.gauge("serve.queue_depth").set(len(self._waiters))
+                self._cv.notify_all()
+            wait_s = time.perf_counter() - t0
+        reg.histogram("serve.queue_wait_s").observe(wait_s)
+        return wait_s
+
+    def _grant(self, ent: _QueryEntry, reg) -> None:
+        # Caller holds the cv lock.
+        self._admitted_bytes += ent.footprint
+        self._inflight += 1
+        ent.admitted = True
+        if self._admitted_bytes > self.peak_admitted_bytes:
+            self.peak_admitted_bytes = self._admitted_bytes
+        reg.counter("serve.admitted").inc()
+        reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
+        reg.gauge("serve.active").set(self._inflight)
+
+    def _release(self, ent: _QueryEntry) -> None:
+        reg = telemetry.get_registry()
+        with self._cv:
+            self._active.pop(ent.query_id, None)
+            if ent.admitted:
+                self._admitted_bytes -= ent.footprint
+                self._inflight -= 1
+                if self._inflight == 0:
+                    # Re-anchor: bookkeeping drift cannot accumulate,
+                    # and the idle baseline tracks resident caches so
+                    # `_fits` charges queries only for QUERY memory.
+                    self._admitted_bytes = 0
+                    self._idle_baseline = self._live_device_bytes()
+                reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
+                reg.gauge("serve.active").set(self._inflight)
+            self._cv.notify_all()
+
+    # -- serving-error bookkeeping ---------------------------------------
+
+    def _record_serving_error(self, exc: QueryServingError, metrics,
+                              conf) -> None:
+        """One place counts every typed serving error (exactly once):
+        the class-declared counter, a per-phase `serve.interrupted.*`
+        series, and — when the query had started executing — the event
+        + interrupted-phase counter on its recorder, which then joins
+        the flight ring so timeout clusters are diagnosable post-hoc."""
+        reg = telemetry.get_registry()
+        reg.counter(exc.counter).inc()
+        phase = exc.phase or "unknown"
+        reg.counter(f"serve.interrupted.{phase}").inc()
+        if metrics is None:
+            return
+        metrics.event("serve", exc.counter.split(".", 1)[1],
+                      query_id=exc.query_id, phase=phase)
+        metrics.add_count(f"serve.interrupted.{phase}")
+        metrics.finish()
+        telemetry.flight.record(metrics, conf=conf)
+        # Completed puts of the cancelled query release their window
+        # bytes + staging buffers now, not at the next caller's put.
+        try:
+            from hyperspace_tpu.io import transfer
+            transfer.get_engine().sweep()
+        except Exception:
+            pass
+
+    # -- resilient execution (breaker + degradation fallback) ------------
+
+    @staticmethod
+    def _index_scans(plan) -> List[tuple]:
+        """(index_name, breaker_key) of every rule-selected index scan.
+        The breaker keys on name AND data root: two warehouses (or two
+        test environments) reusing an index name are different indexes,
+        and one going bad must not short-circuit the other."""
+        from hyperspace_tpu.plan.nodes import Scan
+        out: List[tuple] = []
+
+        def visit(node):
+            if isinstance(node, Scan) and node.index_name:
+                root = node.root_paths[0] if node.root_paths else ""
+                out.append((node.index_name,
+                            f"{node.index_name}@{root}"))
+            for c in node.children:
+                visit(c)
+
+        visit(plan)
+        return out
+
+    def _degrade(self, df, metrics, conf, index_name, reason: str):
+        """Answer from the SOURCE plan (graceful degradation), keeping
+        the downgrade loud in telemetry."""
+        from hyperspace_tpu.engine.executor import execute_plan
+        telemetry.get_registry().counter("resilience.fallbacks").inc()
+        metrics.add_count("resilience.fallbacks")
+        metrics.event("resilience", "degraded", index=index_name,
+                      reason=reason)
+        return execute_plan(df.plan, conf=conf)
+
+    def _execute_resilient(self, df, plan, metrics, conf):
+        """Execute the optimized plan with the per-index circuit
+        breaker wrapped around the PR-4 degradation fallback."""
+        from hyperspace_tpu.engine.executor import execute_plan
+        index_scans = self._index_scans(plan) if plan is not df.plan \
+            else []
+        for name, key in index_scans:
+            verdict = self._breakers.allow(key, conf)
+            if verdict == _OPEN:
+                # Known-bad index: skip STRAIGHT to the source plan —
+                # no failed index scan to re-pay.
+                telemetry.get_registry().counter(
+                    "resilience.breaker.short_circuits").inc()
+                metrics.add_count("resilience.breaker.short_circuits")
+                return self._degrade(df, metrics, conf, name,
+                                     "breaker open")
+        try:
+            batch = execute_plan(plan, conf=conf)
+        except IndexDataUnavailableError as exc:
+            if plan is df.plan:
+                raise  # no rewrite to fall back from
+            logger.warning("Index data unavailable (%s); falling back "
+                           "to the source plan", exc)
+            for name, key in index_scans:
+                if name == exc.index_name:
+                    self._breakers.record_failure(key, conf)
+                    break
+            return self._degrade(df, metrics, conf, exc.index_name,
+                                 str(exc))
+        for _name, key in index_scans:
+            self._breakers.record_success(key)
+        return batch
+
+    # -- the collect pipeline ---------------------------------------------
+
+    def collect(self, df, timeout: Optional[float] = None):
+        """Execute a DataFrame end to end under serving control.
+        Returns `(arrow_table, QueryMetrics)` — `DataFrame.collect`
+        owns the user-facing return shape."""
+        from hyperspace_tpu.io.columnar import to_arrow
+        from hyperspace_tpu.plan import footprint as _footprint
+        from hyperspace_tpu.utils import faults
+
+        session = df.session
+        conf = session.conf if session is not None else None
+        if session is not None and getattr(session, "_closed", False):
+            raise HyperspaceException(
+                "Session is closed; create a new HyperspaceSession.")
+        query_id = f"q-{next(self._ids)}"
+        if timeout is None and conf is not None:
+            timeout = conf.serve_deadline_seconds or None
+        deadline = Deadline(query_id, timeout)
+        ent = _QueryEntry(query_id, deadline,
+                          _footprint.projected_bytes(df.plan),
+                          id(session) if session is not None else None)
+        description = ", ".join(df.schema.names[:6])
+        metrics = telemetry.QueryMetrics(description=description)
+        metrics.query_id = query_id  # cancel/log correlation handle
+        with self._cv:
+            self._active[query_id] = ent
+        reg = telemetry.get_registry()
+        try:
+            try:
+                wait_s = self._admit(ent, conf)
+            except QueryServingError as exc:
+                self._record_serving_error(exc, None, conf)
+                raise
+            try:
+                with telemetry.recording(metrics), \
+                        telemetry.deadline_scope(deadline), \
+                        telemetry.span("query", "query",
+                                       description=description):
+                    metrics.event("serve", "admitted",
+                                  query_id=query_id,
+                                  footprint_bytes=ent.footprint,
+                                  queue_wait_s=round(wait_s, 6))
+                    faults.fire("scheduler.run")
+                    deadline.check("plan")
+                    plan = (session.optimize(df.plan)
+                            if session is not None else df.plan)
+                    batch = self._execute_resilient(df, plan, metrics,
+                                                    conf)
+                    if not batch.is_host:
+                        # Query-end HBM watermark, FORCED (throttling
+                        # may have swallowed every span-boundary sample
+                        # of a fast query) and inside the recording so
+                        # it attributes here.
+                        telemetry.memory.sample()
+                    else:
+                        import sys as _sys
+                        if "jax" in _sys.modules:
+                            # Host result, but intermediates may have
+                            # ridden the device; throttled sample — and
+                            # never an import of jax to find zero bytes.
+                            telemetry.memory.maybe_sample()
+            except QueryServingError as exc:
+                self._record_serving_error(exc, metrics, conf)
+                raise
+        finally:
+            self._release(ent)
+        metrics.finish()
+        # Process-lifetime aggregates next to the per-query recorder.
+        reg.counter("queries.total").inc()
+        reg.counter("queries.seconds").inc(metrics.wall_s)
+        reg.histogram("query.wall_s").observe(metrics.wall_s)
+        # Flight recorder: the finished recorder joins the always-on
+        # ring of recent queries; a wall past the session's slowlog
+        # threshold also persists a self-contained dump (metric tree +
+        # registry snapshot + trace slice) for post-hoc diagnosis.
+        telemetry.flight.record(metrics, conf=conf)
+        if session is not None:
+            session._last_query_metrics = metrics
+        table = to_arrow(batch)
+        return table, metrics
+
+
+# ---------------------------------------------------------------------------
+# Process-wide scheduler
+# ---------------------------------------------------------------------------
+
+_scheduler: Optional[QueryScheduler] = None
+_scheduler_lock = threading.Lock()
+
+
+def get_scheduler() -> QueryScheduler:
+    global _scheduler
+    if _scheduler is None:
+        with _scheduler_lock:
+            if _scheduler is None:
+                _scheduler = QueryScheduler()
+    return _scheduler
+
+
+def set_scheduler(scheduler: QueryScheduler) -> QueryScheduler:
+    """Install a specific scheduler (tests: fresh budgets/breakers)."""
+    global _scheduler
+    _scheduler = scheduler
+    return scheduler
+
+
+def reset_scheduler() -> None:
+    global _scheduler
+    _scheduler = None

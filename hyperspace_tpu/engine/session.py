@@ -27,11 +27,55 @@ class HyperspaceSession:
         self._hyperspace_enabled = False
         self._views: dict = {}
         self._last_query_metrics = None
+        self._closed = False
         # Session knobs -> the process-wide pipelined transfer engine
         # (io.transfer.{chunk,inflight,threads}); refreshed again at
         # each fused execution so late conf.set calls take effect.
         from hyperspace_tpu.io import transfer
         transfer.configure(self.conf)
+
+    # -- serving plane ----------------------------------------------------
+
+    def scheduler(self):
+        """The PROCESS-WIDE query scheduler every `collect` routes
+        through (`engine/scheduler.py`): admission control against the
+        serving HBM budget, the bounded wait queue, per-query deadlines
+        + cancellation, and the per-index degradation circuit breakers.
+        Sessions share it, same caveat as the transfer engine."""
+        from hyperspace_tpu.engine.scheduler import get_scheduler
+        return get_scheduler()
+
+    def active_queries(self) -> List[str]:
+        """Ids of queries currently queued or running (process-wide) —
+        the targets `cancel` accepts. A query learns its own id as
+        `metrics.query_id` (`collect(with_metrics=True)`)."""
+        return self.scheduler().active_queries()
+
+    def cancel(self, query_id: str) -> bool:
+        """Cooperatively cancel a queued or running query: its
+        `collect` raises a typed `QueryCancelledError` at the next
+        checkpoint (operator / fusion-stage / transfer-chunk / write
+        boundary). True iff the id was live. Cancellation is a request,
+        not preemption — in-flight device work unwinds through the
+        normal release paths."""
+        return self.scheduler().cancel(query_id)
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Shut this session down, IDEMPOTENTLY: cancel its live
+        queries, wait (bounded) for them to drain from the scheduler,
+        and flush the flight recorder's pending slow-query dumps. The
+        process-wide executors (scheduler, transfer engine, IO pool)
+        stay up for co-resident sessions; interpreter teardown drains
+        them via their atexit hooks. A closed session refuses new
+        collects."""
+        if self._closed:
+            return
+        self._closed = True
+        sched = self.scheduler()
+        sched.cancel_session(self)
+        sched.drain_session(self, timeout_s=timeout_s)
+        from hyperspace_tpu import telemetry
+        telemetry.flight.get_recorder().drain()
 
     def last_query_metrics(self):
         """`telemetry.QueryMetrics` of the most recent query executed

@@ -73,8 +73,15 @@ def _write_sorted_runs(table, perm_chunks, starts, ends, path: str,
             fut.result()
         pending.clear()
 
+    from hyperspace_tpu import telemetry
     try:
         for ci, chunk in enumerate(perm_chunks):
+            # Chunk-boundary cancellation checkpoint: a cancelled query
+            # (or a deadline-capped maintenance caller) stops WITHOUT
+            # queueing further writes — the finally drain below leaves
+            # already-submitted files landed, same partial-dir story
+            # the `_committed` marker already makes crash-safe.
+            telemetry.check_deadline("write")
             # Device-resident permutation chunk: engine.fetch IS the D2H
             # link crossing (the async prefetch above may have already
             # landed it — the histogram then shows a near-zero wall for
@@ -131,6 +138,22 @@ def _writer_pool():
                 _writer = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="hs-bucket-writer")
     return _writer
+
+
+def shutdown_writer_pool(wait: bool = True) -> None:
+    """Drain + stop the single-lane bucket writer (idempotent, lazily
+    re-created; atexit hook — a queued parquet encode must land before
+    interpreter teardown, the build already returned its file list)."""
+    global _writer
+    with _writer_lock:
+        pool, _writer = _writer, None
+    if pool is not None:
+        pool.shutdown(wait=wait)
+
+
+import atexit as _atexit  # noqa: E402
+
+_atexit.register(shutdown_writer_pool)
 
 
 # Below this row count the build permutation is computed on the host

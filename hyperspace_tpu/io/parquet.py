@@ -104,6 +104,25 @@ def io_executor():
     return _io_pool
 
 
+def shutdown_io_executor(wait: bool = True) -> None:
+    """Tear the shared IO pool down (idempotent; lazily re-created by
+    the next `io_executor()` call, so tests survive a mid-run
+    shutdown). Registered atexit: before this, interpreter teardown
+    left 8 idle `hs-io` threads to be reaped by the futures module's
+    own exit hook with any queued work's ordering unobserved — now the
+    pool drains deterministically."""
+    global _io_pool
+    with _io_pool_lock:
+        pool, _io_pool = _io_pool, None
+    if pool is not None:
+        pool.shutdown(wait=wait)
+
+
+import atexit as _atexit  # noqa: E402
+
+_atexit.register(shutdown_io_executor)
+
+
 def _file_stamp(path: str):
     """(size, mtime) of a FILE, or None when the path is a directory or
     the backend exposes no modification time — both must disable caching

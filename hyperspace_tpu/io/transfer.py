@@ -53,7 +53,19 @@ import numpy as np
 from hyperspace_tpu import constants
 
 __all__ = ["TransferEngine", "HostCast", "Host", "get_engine",
-           "set_engine", "reset_engine", "configure", "device_put"]
+           "set_engine", "reset_engine", "configure", "device_put",
+           "TransferAcquireTimeoutError", "shutdown"]
+
+
+class TransferAcquireTimeoutError(TimeoutError):
+    """Waiting for in-flight-window headroom exceeded
+    `spark.hyperspace.io.transfer.acquire.timeout.ms`. A put that died
+    without releasing its bytes (hung runtime, dead link) would
+    otherwise block every later caller FOREVER on a window that can
+    never drain. TimeoutError parentage is deliberate: `utils/retry.py`
+    classifies it transient, so retry-wrapped callers back off and
+    re-try instead of treating a recoverable stall as fatal. Counted
+    as `io.transfer.acquire_timeouts`."""
 
 import logging
 
@@ -126,13 +138,18 @@ class TransferEngine:
     def __init__(self, chunk_bytes: Optional[int] = None,
                  inflight_bytes: Optional[int] = None,
                  threads: Optional[int] = None,
-                 put_fn: Optional[Callable] = None):
+                 put_fn: Optional[Callable] = None,
+                 acquire_timeout_s: Optional[float] = None):
         self.chunk_bytes = int(
             chunk_bytes or constants.IO_TRANSFER_CHUNK_BYTES_DEFAULT)
         self.inflight_bytes = int(
             inflight_bytes or constants.IO_TRANSFER_INFLIGHT_BYTES_DEFAULT)
         self.threads = int(
             threads or constants.IO_TRANSFER_THREADS_DEFAULT)
+        self.acquire_timeout_s = (
+            acquire_timeout_s if acquire_timeout_s is not None
+            else constants.IO_TRANSFER_ACQUIRE_TIMEOUT_MS_DEFAULT
+            / 1000.0)
         self._put_fn = put_fn
         self._lock = threading.RLock()
         self._pool = None
@@ -162,6 +179,11 @@ class TransferEngine:
         self.inflight_bytes = max(self.chunk_bytes,
                                   conf.io_transfer_inflight_bytes)
         self.threads = max(1, conf.io_transfer_threads)
+        try:
+            self.acquire_timeout_s = \
+                conf.io_transfer_acquire_timeout_ms / 1000.0
+        except Exception:
+            pass  # conf-shaped test fakes without the property
 
     def _staging_pool(self):
         if self._pool is None:
@@ -223,11 +245,43 @@ class TransferEngine:
         for buf in released:
             self._release_staging(buf, gate=None)
 
+    def _wait_entry_ready(self, ent: _WindowEntry,
+                          t_end: Optional[float]) -> None:
+        """Block until `ent`'s transfer lands, bounded by `t_end`
+        (monotonic). With an `is_ready` probe (every jax array; fakes
+        by contract) the wait polls so it CAN time out; without one it
+        falls back to the unbounded blocking sync. Timeout raises
+        `TransferAcquireTimeoutError` with the entry untouched — the
+        caller must re-queue it before propagating."""
+        probe = getattr(ent.dev, "is_ready", None)
+        if probe is None or t_end is None:
+            _block_ready(ent.dev)
+            return
+        while True:
+            try:
+                if probe():
+                    return
+            except Exception:
+                return  # a dead array is as released as it gets
+            if time.monotonic() >= t_end:
+                raise TransferAcquireTimeoutError(
+                    f"in-flight window acquisition timed out after "
+                    f"{self.acquire_timeout_s:.1f}s "
+                    f"({self._window_bytes} B held, "
+                    f"{self.inflight_bytes} B window)")
+            time.sleep(0.002)
+
     def _admit(self, nbytes: int) -> None:
         """Reserve `nbytes` of in-flight budget, blocking on the OLDEST
         outstanding transfers until the window fits (their completion
-        also releases their staging buffers)."""
+        also releases their staging buffers). The wait is BOUNDED by
+        the acquire timeout: a transfer that never completes raises a
+        typed transient error (`TransferAcquireTimeoutError`, counted
+        as `io.transfer.acquire_timeouts`) instead of hanging every
+        later caller on bytes that can never drain."""
         self._sweep()
+        t_end = (time.monotonic() + self.acquire_timeout_s
+                 if self.acquire_timeout_s > 0 else None)
         while True:
             with self._lock:
                 if (self._window_bytes + nbytes <= self.inflight_bytes
@@ -236,7 +290,17 @@ class TransferEngine:
                     return
                 ent = self._window.popleft()
                 self.stats["window_waits"] += 1
-            _block_ready(ent.dev)
+            try:
+                self._wait_entry_ready(ent, t_end)
+            except TransferAcquireTimeoutError:
+                with self._lock:
+                    # The entry's transfer is still outstanding: its
+                    # bytes stay accounted, back at the window head.
+                    self._window.appendleft(ent)
+                from hyperspace_tpu import telemetry
+                telemetry.get_registry().counter(
+                    "io.transfer.acquire_timeouts").inc()
+                raise
             with self._lock:
                 self._window_bytes -= ent.nbytes
             if ent.buf is not None:
@@ -249,7 +313,18 @@ class TransferEngine:
     def _windowed_put(self, view, device, buf=None):
         nbytes = int(getattr(view, "nbytes", 0))
         self._admit(nbytes)
-        dev = self._raw_put(view, device)
+        try:
+            dev = self._raw_put(view, device)
+        except BaseException:
+            # A put that dies must RELEASE its reservation (and its
+            # staging buffer) — leaked bytes would shrink the window
+            # for every later caller until nothing fits and the
+            # acquire timeout becomes the only way out.
+            with self._lock:
+                self._window_bytes -= nbytes
+            if buf is not None:
+                self._release_staging(buf, gate=None)
+            raise
         self._track(dev, nbytes, buf)
         with self._lock:
             self.stats["chunks"] += 1
@@ -380,12 +455,18 @@ class TransferEngine:
             timings["chunks"] += 1
             return [dev]
 
+        from hyperspace_tpu import telemetry
+
         parts = [None] * len(bounds)
         pending: deque = deque()
         lookahead = max(1, self.threads) + 1
         pool = self._staging_pool()
 
         def emit():
+            # Chunk-boundary cancellation checkpoint: a cancelled query
+            # stops shipping chunks here; already-issued puts complete
+            # and release through the window sweep.
+            telemetry.check_deadline("transfer")
             idx, fut, ready = pending.popleft()
             buf = None
             if fut is not None:
@@ -398,16 +479,34 @@ class TransferEngine:
             timings["put_s"] += time.perf_counter() - t0
             timings["chunks"] += 1
 
-        for idx, (s, e) in enumerate(bounds):
-            while len(pending) >= lookahead:
+        try:
+            for idx, (s, e) in enumerate(bounds):
+                while len(pending) >= lookahead:
+                    emit()
+                if cast:
+                    pending.append((idx, pool.submit(self._convert,
+                                                     entry, s, e), None))
+                else:
+                    pending.append((idx, None, arr[s:e]))
+            while pending:
                 emit()
-            if cast:
-                pending.append((idx, pool.submit(self._convert, entry,
-                                                 s, e), None))
-            else:
-                pending.append((idx, None, arr[s:e]))
-        while pending:
-            emit()
+        except BaseException:
+            # Guaranteed release of in-flight STAGING on the error path
+            # (cancellation included): conversions already submitted to
+            # the pool hold pooled buffers their put will now never
+            # consume — drain and return them, or the pool bleeds
+            # buffers one cancelled query at a time.
+            while pending:
+                _idx, fut, _ready = pending.popleft()
+                if fut is None:
+                    continue
+                try:
+                    _view, buf, _s = fut.result()
+                except Exception:
+                    continue
+                if buf is not None:
+                    self._release_staging(buf, gate=None)
+            raise
         return parts
 
     def _put_entry(self, entry, device, timings) -> object:
@@ -500,6 +599,10 @@ class TransferEngine:
         total_bytes = 0
         results: List[dict] = []
         for fut in futs:
+            # Per-column checkpoint: remaining decodes still run on the
+            # pool (futures are not revoked) but their results are
+            # plain host arrays — nothing device-side leaks.
+            telemetry.check_deadline("transfer")
             produced, job_s = fut.result()
             decode_s += job_s
             placed = {}
@@ -526,6 +629,48 @@ class TransferEngine:
                                                       1))
         self._sweep()
         return results
+
+    # -- lifecycle --------------------------------------------------------
+
+    def sweep(self) -> None:
+        """Public probe-and-release pass over the in-flight window:
+        completed transfers give back their bytes and staging buffers
+        NOW (the scheduler calls this after a cancellation so a dead
+        query's window share does not wait for the next caller's
+        put)."""
+        self._sweep()
+
+    def drain(self) -> None:
+        """Block (bounded by the acquire timeout per entry) until every
+        outstanding transfer lands and its resources are released."""
+        while True:
+            with self._lock:
+                if not self._window:
+                    return
+                ent = self._window.popleft()
+            t_end = (time.monotonic() + self.acquire_timeout_s
+                     if self.acquire_timeout_s > 0 else None)
+            try:
+                self._wait_entry_ready(ent, t_end)
+            except TransferAcquireTimeoutError:
+                logger.warning("drain: abandoning a transfer that "
+                               "never completed (%d B)", ent.nbytes)
+            with self._lock:
+                self._window_bytes -= ent.nbytes
+            if ent.buf is not None:
+                self._release_staging(ent.buf, gate=None)
+
+    def shutdown(self) -> None:
+        """Drain the window and stop the staging pool (idempotent;
+        registered atexit so interpreter teardown neither leaks the
+        staging threads nor abandons in-flight puts)."""
+        try:
+            self.drain()
+        except Exception:
+            pass
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
 
     # -- device -> host ---------------------------------------------------
 
@@ -593,3 +738,17 @@ def configure(conf) -> None:
 def device_put(arr, device=None, chunked: Optional[bool] = None):
     """Module-level convenience: `get_engine().put(...)`."""
     return get_engine().put(arr, device=device, chunked=chunked)
+
+
+def shutdown() -> None:
+    """Shut the process engine down (atexit hook; idempotent — a new
+    engine lazily re-creates on the next put, so tests that reset the
+    module keep working)."""
+    engine = _engine
+    if engine is not None:
+        engine.shutdown()
+
+
+import atexit  # noqa: E402
+
+atexit.register(shutdown)

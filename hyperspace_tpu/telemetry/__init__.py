@@ -84,6 +84,7 @@ from hyperspace_tpu.telemetry.memory import (DeviceMemoryAccountant,
 __all__ = [
     "QueryMetrics", "OperatorRecord", "current", "recording",
     "propagating", "event", "annotate", "add_seconds", "add_count",
+    "current_deadline", "deadline_scope", "check_deadline",
     "MetricsRegistry", "get_registry", "Tracer", "enable_tracing",
     "disable_tracing", "tracing_enabled", "tracer", "span",
     "link_transfer", "record_link_transfer", "export_trace",
@@ -96,10 +97,51 @@ __all__ = [
 _current: contextvars.ContextVar[Optional["QueryMetrics"]] = \
     contextvars.ContextVar("hyperspace_query_metrics", default=None)
 
+# The active query's Deadline (`engine/scheduler.Deadline`) rides the
+# SAME contextvar scoping as the recorder: set by the scheduler around
+# execution, carried across the engine's pool threads by
+# `propagating(...)`, read by the cooperative-cancellation checkpoints
+# (`check_deadline`) at operator / fusion-stage / transfer-chunk /
+# sorted-run-write boundaries. The var lives HERE (not in the
+# scheduler) because every checkpoint module already imports telemetry
+# — the hooks stay one ContextVar read + None check when serving
+# features are off, the same always-off contract as the recorder.
+_deadline: contextvars.ContextVar = \
+    contextvars.ContextVar("hyperspace_query_deadline", default=None)
+
 
 def current() -> Optional["QueryMetrics"]:
     """The recorder of the query executing on this thread, or None."""
     return _current.get()
+
+
+def current_deadline():
+    """The Deadline of the query executing on this thread, or None."""
+    return _deadline.get()
+
+
+@contextmanager
+def deadline_scope(deadline):
+    """Make `deadline` the active cancellation token for the calling
+    context (None is allowed and makes the scope a no-op carrier)."""
+    token = _deadline.set(deadline)
+    try:
+        yield deadline
+    finally:
+        _deadline.reset(token)
+
+
+def check_deadline(phase: str) -> None:
+    """Cooperative-cancellation checkpoint: raises the active
+    deadline's typed error (QueryCancelledError /
+    QueryDeadlineExceededError, tagged with `phase`) when the query
+    was cancelled or its deadline passed; no-op without an active
+    deadline. `phase` names what the raise would interrupt —
+    scan/operator/stage/transfer/write — so timeout clusters are
+    attributable to a bucket (`telemetry/diff.py`), not `residual`."""
+    d = _deadline.get()
+    if d is not None:
+        d.check(phase)
 
 
 @contextmanager
@@ -118,19 +160,26 @@ def propagating(fn):
     in the operator tree — contextvars do not cross thread boundaries on
     their own, and the worker's operator records must parent under the
     operator that forked the work (e.g. the bucketed join reading its
-    two sides concurrently)."""
+    two sides concurrently). The active Deadline rides along too: a
+    cancelled query's pool-side subtree hits the same cooperative
+    checkpoints its main thread does."""
     rec = _current.get()
-    if rec is None:
+    deadline = _deadline.get()
+    if rec is None and deadline is None:
         return fn
-    parent = rec._current_op_id()
+    parent = rec._current_op_id() if rec is not None else None
 
     def run(*args, **kwargs):
         token = _current.set(rec)
-        rec._adopt_parent(parent)
+        dtoken = _deadline.set(deadline)
+        if rec is not None:
+            rec._adopt_parent(parent)
         try:
             return fn(*args, **kwargs)
         finally:
-            rec._clear_adoption()
+            if rec is not None:
+                rec._clear_adoption()
+            _deadline.reset(dtoken)
             _current.reset(token)
 
     return run

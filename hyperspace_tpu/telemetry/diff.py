@@ -26,6 +26,11 @@ into:
                 too would double-count;
 - `fallback`  — resilience degradation events (`resilience.fallbacks`,
                 `degraded`); evidence, not seconds, same reason;
+- `cancellation` — serving-plane interruptions: deadline/cancel events
+                with the phase they interrupted
+                (`serve.interrupted.<phase>` counters), so timeout
+                clusters name their phase instead of landing in
+                residual;
 - `framework_common` — LEGACY-artifact coarse attribution: the part of
                 the rules-on slowdown matching the rules-OFF lane's
                 relative slowdown. Both lanes share everything except
@@ -53,7 +58,7 @@ __all__ = ["Bucket", "QueryDiff", "ArtifactDiff", "diff_artifacts",
 
 # Evidence-only buckets attribute counts, never seconds (their cost is
 # already inside compute/link); they rank below any timed bucket.
-_EVIDENCE_BUCKETS = ("cache", "fallback")
+_EVIDENCE_BUCKETS = ("cache", "fallback", "cancellation")
 
 
 class Bucket:
@@ -270,6 +275,31 @@ def _attribute_from_rollups(qd: QueryDiff, old: Optional[dict],
         "fallback", 0.0,
         {"fallbacks": fallbacks,
          "events": degraded[:3]} if (fallbacks or degraded) else {}))
+
+    # Serving-plane interruptions: a deadline/cancellation event is
+    # recorded WITH the phase it interrupted (scan/operator/stage/
+    # transfer/write — `serve.interrupted.<phase>` counters + `serve`
+    # events), so a cluster of timeouts attributes to its phase bucket
+    # here instead of polluting `residual` — "q64 times out in
+    # transfer" is actionable, "q64 got slower somehow" is not.
+    serve_detail: dict = {}
+    phases = {}
+    for roll, sign in ((old, -1), (new, +1)):
+        for k, v in ((roll or {}).get("counters") or {}).items():
+            if k.startswith("serve.interrupted."):
+                phase = k.split(".", 2)[2]
+                phases[phase] = phases.get(phase, 0) + sign * int(v)
+    phases = {p: d for p, d in phases.items() if d}
+    if phases:
+        serve_detail["interrupted_by_phase"] = phases
+    serve_events = [e for e in (new or {}).get("events", [])
+                    if e.get("category") == "serve"
+                    and e.get("name") in ("cancelled",
+                                          "deadline_exceeded",
+                                          "rejected")]
+    if serve_events:
+        serve_detail["events"] = serve_events[:3]
+    qd.buckets.append(Bucket("cancellation", 0.0, serve_detail))
 
 
 def _attribute_legacy(qd: QueryDiff, old_entry: dict,

@@ -54,13 +54,22 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._seq = itertools.count()  # dump-name monotonicity
+        # Slow-dump writer lane: dumps are QUEUED to one background
+        # thread instead of serializing + fsyncing on the serving
+        # thread (a slow query is exactly the one whose caller is
+        # already past its latency budget). `drain()` flushes pending
+        # writes; the module atexit hook drains the process recorder
+        # so interpreter teardown cannot lose a queued dump.
+        self._dump_pool = None
+        self._pending: set = set()
 
     # -- recording ------------------------------------------------------
 
     def record(self, metrics, conf=None) -> Optional[str]:
         """Fold one FINISHED query recorder into the ring; dump it when
         the session's slowlog threshold says so. Returns the dump path
-        when a dump was written (None otherwise)."""
+        when a dump was QUEUED (None otherwise) — the write itself
+        rides the background lane; `drain()` flushes it."""
         with self._lock:
             self._ring.append(metrics)
         _registry.get_registry().counter("flight.queries").inc()
@@ -99,11 +108,52 @@ class FlightRecorder:
         with self._lock:
             return len(self._ring)
 
+    # -- dump lane lifecycle --------------------------------------------
+
+    def _lane(self):
+        if self._dump_pool is None:
+            with self._lock:
+                if self._dump_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    self._dump_pool = ThreadPoolExecutor(
+                        max_workers=1,
+                        thread_name_prefix="hs-flight-dump")
+        return self._dump_pool
+
+    def drain(self) -> None:
+        """Block until every queued slow-query dump has landed (or
+        failed and been counted). Idempotent; `session.close()` and the
+        atexit hook call this."""
+        while True:
+            with self._lock:
+                futs = list(self._pending)
+            if not futs:
+                return
+            for fut in futs:
+                try:
+                    fut.result()
+                except Exception:
+                    pass  # counted + logged by the job itself
+            with self._lock:
+                self._pending.difference_update(futs)
+
+    def shutdown(self) -> None:
+        """Drain and stop the dump lane (idempotent; lazily re-created
+        by the next dump)."""
+        self.drain()
+        with self._lock:
+            pool, self._dump_pool = self._dump_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
     # -- slow-query dump ------------------------------------------------
 
     def _dump_slow(self, metrics, conf, threshold: float) -> str:
+        # The SNAPSHOT happens on the calling thread (the metric tree
+        # and registry state of the moment the query finished); only
+        # the serialization + disk IO ride the background lane.
         dump_dir = conf.slowlog_dir
-        os.makedirs(dump_dir, exist_ok=True)
+        keep = conf.slowlog_keep
         doc = {
             "kind": "hyperspace-slowlog",
             "dumped_at": round(time.time(), 3),
@@ -122,15 +172,32 @@ class FlightRecorder:
         fname = (f"slow-{int(doc['dumped_at'] * 1000)}-"
                  f"{os.getpid()}-{next(self._seq):06d}.json")
         path = os.path.join(dump_dir, fname)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, default=str)
-        os.replace(tmp, path)  # a reader never sees a torn dump
-        self._prune(dump_dir, conf.slowlog_keep)
-        _registry.get_registry().counter("flight.slow_dumps").inc()
-        logger.warning("slow query (%.3fs >= %.3fs): metrics dumped "
-                       "to %s", metrics.wall_s, threshold, path)
+        fut = self._lane().submit(self._write_dump, doc, dump_dir, path,
+                                  keep, metrics.wall_s, threshold)
+        with self._lock:
+            self._pending.add(fut)
+        fut.add_done_callback(
+            lambda f: self._pending.discard(f))
         return path
+
+    def _write_dump(self, doc: dict, dump_dir: str, path: str,
+                    keep: int, wall_s, threshold: float) -> None:
+        """The dump-lane job: atomic write + prune. Failures are
+        counted + logged here (the query is long gone — nothing to
+        fail), same contract as the old synchronous path."""
+        try:
+            os.makedirs(dump_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, path)  # a reader never sees a torn dump
+            self._prune(dump_dir, keep)
+            _registry.get_registry().counter("flight.slow_dumps").inc()
+            logger.warning("slow query (%.3fs >= %.3fs): metrics "
+                           "dumped to %s", wall_s, threshold, path)
+        except Exception:
+            _registry.get_registry().counter("flight.dump_errors").inc()
+            logger.warning("slow-query dump failed", exc_info=True)
 
     @staticmethod
     def _trace_slice(metrics) -> Optional[dict]:
@@ -172,6 +239,19 @@ _RECORDER = FlightRecorder()
 def get_recorder() -> FlightRecorder:
     """THE process-wide flight recorder (sessions share it)."""
     return _RECORDER
+
+
+def _atexit_drain() -> None:
+    # Interpreter teardown must not lose a queued slow-query dump.
+    try:
+        _RECORDER.shutdown()
+    except Exception:
+        pass
+
+
+import atexit  # noqa: E402
+
+atexit.register(_atexit_drain)
 
 
 def record(metrics, conf=None) -> Optional[str]:

@@ -5,10 +5,15 @@ The resilience layer (`utils/retry.py`, crash recovery, graceful query
 degradation) is only as good as the failure paths a test can actually
 reach — so the injector is wired into the SAME seams production traffic
 crosses: every `file_utils` primitive, `storage.exclusive_create`, the
-parquet read/write entry points, and each Action phase boundary
+parquet read/write entry points, each Action phase boundary
 (`action.<Class>.<phase>` fires just before validate/begin/op/end runs —
 a "crash" there is an abort BETWEEN phases, exactly the stranded-writer
-scenario CancelAction/lease recovery must unwind).
+scenario CancelAction/lease recovery must unwind), and the execution
+plane's serving seams: `transfer.put` (every host->device link
+crossing, `io/transfer.py`), `fusion.stage` (fused-stage entry,
+`engine/fusion.py`), and the scheduler boundaries `scheduler.admit` /
+`scheduler.run` (`engine/scheduler.py`) the chaos harness
+(`tests/chaos.py`) drives concurrent query traffic against.
 
 A `FaultPlan` is just a list of `FaultRule`s: fail the `nth` call whose
 operation matches an fnmatch pattern (optionally path-filtered), `times`

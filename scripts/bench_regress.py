@@ -12,13 +12,21 @@ Two artifact families, one gate:
   python scripts/bench_regress.py OLD.json NEW.json
   python scripts/bench_regress.py --threshold 0.10 --glob 'BENCH_r*.json'
 
+  python scripts/bench_regress.py --serve         # newest two BENCH_SERVE_r*.json
+
 Rung artifacts (bench.py) gate per-rung `vs_baseline`, peak HBM growth,
 and the rung-1 link share as before. Query artifacts (bench_tpcds.py /
 bench_tpch.py) gate the aggregate `vs_baseline` AND every per-query
 `vs_baseline` — the r03->r04 TPC-DS regression (aggregate 3.14x ->
 0.81x, q64 at 0.45x) is exactly the failure this mode exists to stop
 at the door. The mode is detected from artifact content (`queries` vs
-`rungs`), so explicit paths need no flag.
+`rungs` vs `serve`), so explicit paths need no flag.
+
+Serving artifacts (bench_serve.py, `--serve`) gate the closed-loop
+scaling ratio (`vs_baseline` = K-client QPS / 1-client QPS), p99 and
+p50 latency GROWTH, and the reject/timeout RATES — rates gate on
+absolute movement (> 2 points), because a 0 -> 0.3 reject-rate jump is
+exactly the regression a ratio gate on zero cannot see.
 
 Artifacts must be in the canonical schema (`telemetry/artifact.py`,
 `schema_version` + `process_metrics`); a legacy-schema artifact is
@@ -98,6 +106,40 @@ def _rung1_link_share(doc: dict):
     return (stage + d2h) / build
 
 
+# Reject/timeout RATES gate on absolute movement, not ratio: the
+# healthy value is 0, and nothing ratio-gates against zero.
+RATE_SLACK = 0.02
+
+
+def compare_serve(old: dict, new: dict, threshold: float):
+    """Serving-artifact gate rows (same row shape as `compare`):
+    scaling ratio + QPS drop >threshold, p50/p99 growth >threshold,
+    reject/timeout rate growth > RATE_SLACK absolute."""
+    o = old.get("serve") or {}
+    n = new.get("serve") or {}
+    rows = []
+
+    def add(name, old_v, new_v, lower_is_better=False):
+        if not (isinstance(old_v, (int, float))
+                and isinstance(new_v, (int, float)) and old_v > 0):
+            return
+        change = new_v / old_v - 1.0
+        gated = (change > threshold if lower_is_better
+                 else change < -threshold)
+        rows.append((name, old_v, new_v, change, gated))
+
+    add("scaling_ratio", old.get("vs_baseline"), new.get("vs_baseline"))
+    add("qps", o.get("qps"), n.get("qps"))
+    add("p50_s", o.get("p50_s"), n.get("p50_s"), lower_is_better=True)
+    add("p99_s", o.get("p99_s"), n.get("p99_s"), lower_is_better=True)
+    for rate in ("reject_rate", "timeout_rate"):
+        ov, nv = o.get(rate), n.get(rate)
+        if isinstance(ov, (int, float)) and isinstance(nv, (int, float)):
+            delta = nv - ov
+            rows.append((rate, ov, nv, delta, delta > RATE_SLACK))
+    return rows
+
+
 def compare(old: dict, new: dict, threshold: float):
     """[(name, old_ratio, new_ratio, change, gated)] for every
     comparable vs_baseline (higher is better), headline first — rungs
@@ -163,6 +205,11 @@ def main() -> int:
                     help="gate the TPC-DS macro-bench family "
                          "(BENCH_TPCDS_r*.json) instead of the "
                          "micro-rung ladder")
+    ap.add_argument("--serve", action="store_true",
+                    help="gate the serving-bench family "
+                         "(BENCH_SERVE_r*.json): scaling ratio, QPS, "
+                         "p50/p99 latency growth, reject/timeout "
+                         "rates")
     ap.add_argument("--no-diff", action="store_true",
                     help="skip the attribution tree on gate failure")
     args = ap.parse_args()
@@ -170,7 +217,8 @@ def main() -> int:
     if len(args.artifacts) == 2:
         old_path, new_path = args.artifacts
     elif not args.artifacts:
-        pattern = args.glob or ("BENCH_TPCDS_r*.json" if args.tpcds
+        pattern = args.glob or ("BENCH_SERVE_r*.json" if args.serve
+                                else "BENCH_TPCDS_r*.json" if args.tpcds
                                 else "BENCH_r*.json")
         old_path, new_path = pick_latest_two(pattern)
     else:
@@ -178,7 +226,11 @@ def main() -> int:
 
     old = load_artifact(old_path)
     new = load_artifact(new_path)
-    rows = compare(old, new, args.threshold)
+    # Serving artifacts are content-detected like the other families,
+    # so explicit paths gate correctly without the flag.
+    serve_mode = args.serve or ("serve" in old and "serve" in new)
+    rows = (compare_serve(old, new, args.threshold) if serve_mode
+            else compare(old, new, args.threshold))
 
     print(f"bench_regress: {os.path.basename(old_path)} -> "
           f"{os.path.basename(new_path)} "

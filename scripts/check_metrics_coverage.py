@@ -146,6 +146,83 @@ def check_bench_artifact_seam(repo_root: str):
     return failures
 
 
+# The ONE sanctioned serving-concurrency point: engine/ code runs on
+# the caller's thread or on the sanctioned pools
+# (`telemetry.propagating`-wrapped executors); a raw threading.Thread
+# in the engine is concurrency the scheduler cannot admit, cancel,
+# budget, or drain at shutdown. Only the scheduler module itself may
+# own threads (it currently owns none — waiting happens on caller
+# threads — but it is the one place that legitimately could).
+_RAW_THREAD_RE = re.compile(r"threading\.Thread\s*\(")
+_THREAD_ALLOWED = os.path.join("engine", "scheduler.py")
+
+
+def check_engine_thread_seam(package_dir: str):
+    """Source lint: no raw `threading.Thread(...)` under engine/
+    outside scheduler.py."""
+    failures = []
+    engine_dir = os.path.join(package_dir, "engine")
+    for root, _dirs, files in os.walk(engine_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _THREAD_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_THREAD_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: raw "
+                            "threading.Thread in engine/ — concurrency "
+                            "the query scheduler cannot admit, cancel, "
+                            "or drain; route it through "
+                            "engine/scheduler.py or a propagating-"
+                            "wrapped executor")
+    return failures
+
+
+def check_serving_error_counters():
+    """Every typed serving error must have a registry counter behind
+    it: each `QueryServingError` subclass declares `counter`, and
+    `scheduler.SERVING_ERROR_COUNTERS` (the table the scheduler's
+    raise-path bookkeeping reads) must list exactly that counter — a
+    new serving failure mode cannot ship without a scrape-able
+    series."""
+    from hyperspace_tpu.engine import scheduler
+    from hyperspace_tpu.exceptions import QueryServingError
+
+    failures = []
+    seen = set()
+    for cls in sorted(set(_all_subclasses(QueryServingError)),
+                      key=lambda c: c.__name__):
+        counter = getattr(cls, "counter", "")
+        if not counter:
+            failures.append(
+                f"{cls.__module__}.{cls.__name__}: typed serving error "
+                "lacks a registry counter (declare `counter = "
+                "'serve.<name>'`)")
+            continue
+        mapped = scheduler.SERVING_ERROR_COUNTERS.get(cls.__name__)
+        if mapped != counter:
+            failures.append(
+                f"{cls.__module__}.{cls.__name__}: counter "
+                f"{counter!r} not registered in "
+                "scheduler.SERVING_ERROR_COUNTERS "
+                f"(found {mapped!r}) — the scheduler cannot count what "
+                "it does not know about")
+        seen.add(cls.__name__)
+    for name in scheduler.SERVING_ERROR_COUNTERS:
+        if name not in seen:
+            failures.append(
+                f"scheduler.SERVING_ERROR_COUNTERS lists {name!r} but "
+                "no such QueryServingError subclass exists")
+    return failures
+
+
 # The ONE sanctioned backoff point: every storage retry routes through
 # the policy in utils/retry.py (typed classification, conf-driven
 # backoff, io.retries/io.giveups counters, fault-injection coverage).
@@ -250,6 +327,9 @@ def main() -> int:
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_device_put_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_engine_thread_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_serving_error_counters())
     failures.extend(check_retry_seams(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_bench_artifact_seam(

@@ -460,6 +460,83 @@ def rung4b_hybrid_join(sess, hs, rdf, work):
 
 
 # ---------------------------------------------------------------------------
+# Steady-state repeat-query phase — the segment-cache acceptance bar
+# ---------------------------------------------------------------------------
+
+
+def warm_repeat_phase(sess, left, ldf, rdf, work):
+    """Re-run rungs 2/3/4's queries cold (full cache clear first — the
+    fill cost) and then steady-state warm, with the DEVICE lane forced
+    (`min.device.rows=0`): this is the serving scenario the segment
+    cache exists for — index segments resident in HBM. The warm runs
+    must be LINK-FREE: every scanned segment hits the segment cache
+    (`io/segcache.py`), so `link.h2d.chunks` must not move — the
+    binary acceptance bar this phase commits per round, and what
+    `bench_regress.py`'s warm-rung gate enforces. (The rung 2/3/4
+    best-of numbers above keep the default adaptive lane and stay
+    comparable to earlier rounds.)"""
+    from hyperspace_tpu import telemetry
+    from hyperspace_tpu.io.parquet import clear_read_cache
+    from hyperspace_tpu.plan.expr import col, lit
+
+    key_hit = int(left.column("key")[0].as_py())
+    saved_min_rows = sess.conf.get(
+        "spark.hyperspace.execution.min.device.rows")
+    sess.conf.set("spark.hyperspace.execution.min.device.rows", "0")
+    hdf = sess.read_parquet(os.path.join(work, "hybrid"))
+    queries = {
+        "2_filter_query": lambda: (
+            ldf.filter((col("key") == lit(key_hit)) & (col("k2") < lit(50)))
+            .select("id", "score").collect()),
+        "3_bucketed_smj": lambda: (
+            ldf.select("key", "id").join(rdf.select("key", "val"),
+                                         on="key")
+            .select("id", "val").collect()),
+        "4_hybrid_scan": lambda: (
+            hdf.filter(col("key") == lit(key_hit))
+            .select("id", "score").collect()),
+    }
+    sess.enable_hyperspace()
+    reg = telemetry.get_registry()
+    out = {}
+    try:
+        for name, q in queries.items():
+            clear_read_cache()  # cold start: decode + stage from scratch
+            c0 = reg.counter("link.h2d.chunks").value
+            t0 = time.perf_counter()
+            q()
+            cold_s = time.perf_counter() - t0
+            cold_chunks = int(reg.counter("link.h2d.chunks").value - c0)
+            q()  # settle jit/fusion caches so the measured run is steady
+            h0 = reg.counter("link.h2d.chunks").value
+            hits0 = reg.counter("cache.segments.hits").value
+            t0 = time.perf_counter()
+            q()
+            warm_s = time.perf_counter() - t0
+            out[name] = {
+                "cold_s": round(cold_s, 4),
+                "warm_s": round(warm_s, 4),
+                "cold_h2d_chunks": cold_chunks,
+                "h2d_chunks": int(reg.counter("link.h2d.chunks").value
+                                  - h0),
+                "segment_hits": int(
+                    reg.counter("cache.segments.hits").value - hits0),
+            }
+            log(f"warm {name}: cold {cold_s:.3f}s ({cold_chunks} h2d "
+                f"chunks) -> warm {warm_s:.3f}s "
+                f"({out[name]['h2d_chunks']} h2d chunks, "
+                f"{out[name]['segment_hits']} segment hits)")
+    finally:
+        sess.disable_hyperspace()
+        if saved_min_rows is None:
+            sess.conf.unset("spark.hyperspace.execution.min.device.rows")
+        else:
+            sess.conf.set("spark.hyperspace.execution.min.device.rows",
+                          saved_min_rows)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Rung 5 — Optimize merge-compaction vs full refresh
 # ---------------------------------------------------------------------------
 
@@ -578,6 +655,7 @@ def main():
         log(f"rung5: incremental {inc5:.3f}s, optimize {opt5:.3f}s vs "
             f"full refresh {full5:.3f}s (optimize x{full5 / opt5:.2f}, "
             f"incremental x{full5 / inc5:.2f})")
+        warm = warm_repeat_phase(sess, left, ldf, rdf, work)
 
         rungs = {
                 "1_build": {"build_s": round(dev1, 3),
@@ -639,7 +717,9 @@ def main():
             vs_baseline=round(cpu1 / dev1, 3),
             rungs=rungs,
             extra={"link_probe": probe,
-                   "phase_medians_s": dict(MEDIANS)})
+                   "phase_medians_s": dict(MEDIANS),
+                   "segments": {**telemetry.artifact.segments_digest(),
+                                "warm": warm}})
         xfer = result["transfer"]
         log(f"transfer: h2d {xfer['h2d_bytes'] / 1e6:.1f} MB in "
             f"{xfer['h2d_chunks']} chunks / {xfer['h2d_transfers']} "

@@ -123,12 +123,30 @@ class HyperspaceConf:
 
     @property
     def device_cache_bytes(self):
-        """HBM-resident batch cache budget; None = env/process default.
-        Competes with join/sort working sets for device memory — lower it
-        (or 0) when large queries OOM; 0 releases already-resident
-        batches. Process-wide cache, same caveat as read_cache_bytes."""
+        """Legacy spelling of the HBM segment-cache budget (the old
+        device-batch LRU); kept as the fallback key for
+        `segment_cache_bytes`."""
         value = self.get(constants.DEVICE_CACHE_BYTES_KEY)
         return int(value) if value is not None else None
+
+    @property
+    def segment_cache_bytes(self):
+        """HBM segment-cache budget (`io/segcache.py`); None = the
+        legacy `cache.device.bytes` key, then the env/process default.
+        Competes with join/sort working sets for device memory — lower
+        it (or 0) when large queries OOM; 0 releases already-resident
+        segments. Process-wide cache, same caveat as
+        read_cache_bytes."""
+        value = self.get(constants.SEGMENT_CACHE_BYTES_KEY)
+        if value is not None:
+            return int(value)
+        return self.device_cache_bytes
+
+    @property
+    def segment_cache_pin_indexes(self) -> str:
+        """Comma-separated index names whose cached segments are never
+        evicted by byte pressure (invalidation still drops them)."""
+        return self.get(constants.SEGMENT_CACHE_PIN_INDEXES, "") or ""
 
     @property
     def fusion_promote_cache_bytes(self) -> int:

@@ -31,6 +31,18 @@ INDEX_CACHE_EXPIRY_DURATION_SECONDS_DEFAULT = 300
 READ_CACHE_BYTES_KEY = "spark.hyperspace.cache.read.bytes"
 DEVICE_CACHE_BYTES_KEY = "spark.hyperspace.cache.device.bytes"
 
+# HBM segment cache (`io/segcache.py`): byte budget for device-resident
+# index segments (falls back to the legacy `cache.device.bytes` key,
+# then the HYPERSPACE_SEGMENT_CACHE_BYTES / HYPERSPACE_DEVICE_CACHE_BYTES
+# env defaults), and a comma-separated list of index names whose
+# segments are PINNED — never evicted by byte pressure (invalidation on
+# refresh/optimize/vacuum still drops them). When a serving budget
+# (`serve.hbm.budget.bytes`) is set, the cache's effective budget is
+# additionally capped by what that budget leaves after non-cache device
+# residency (one truth with the admission controller).
+SEGMENT_CACHE_BYTES_KEY = "spark.hyperspace.cache.segments.bytes"
+SEGMENT_CACHE_PIN_INDEXES = "spark.hyperspace.cache.segments.pin.indexes"
+
 # Fusion cache byte budgets: the device-promotion cache (host source
 # columns promoted to device-resident jit arguments, keyed by host-array
 # identity) and the broadcast-table cache (direct-address join tables,

@@ -18,7 +18,7 @@ from typing import List, Optional, Sequence, Set, Tuple
 
 from hyperspace_tpu import telemetry
 from hyperspace_tpu.exceptions import HyperspaceException
-from hyperspace_tpu.io import columnar, parquet
+from hyperspace_tpu.io import columnar, parquet, segcache
 from hyperspace_tpu.plan import expr as E
 from hyperspace_tpu.plan.nodes import (Aggregate, BucketSpec, Except, Filter,
                                        Join, Limit, LogicalPlan, Project,
@@ -177,11 +177,27 @@ class ScanExec(PhysicalNode):
 
     def _budget(self, device: bool):
         """Session-conf cache budget for this scan's lane (None = the
-        process-wide env default)."""
+        process-wide env default). The device lane is the HBM segment
+        cache (`spark.hyperspace.cache.segments.bytes`)."""
         if self.conf is None:
             return None
-        return (self.conf.device_cache_bytes if device
+        return (self.conf.segment_cache_bytes if device
                 else self.conf.read_cache_bytes)
+
+    def _read_device(self, files: List[str], bucket=None,
+                     bucketed: bool = False) -> columnar.ColumnBatch:
+        """Device-lane read THROUGH the HBM segment cache: a warm hit
+        is link-free (no parquet decode, no H2D). Rule-selected index
+        scans key by (index root, committed version, bucket selector);
+        unversioned scans fall back to stamp validation inside the
+        cache."""
+        ref = segcache.segment_ref_for_scan(
+            self.scan, bucket=bucket,
+            allowed_buckets=self.allowed_buckets, bucketed=bucketed)
+        return segcache.read_segment(files, self.columns,
+                                     self.out_schema, ref=ref,
+                                     conf=self.conf,
+                                     budget=self._budget(device=True))
 
     def _annotate_read(self, files: List[str], host: bool,
                        files_total: Optional[int] = None) -> None:
@@ -289,9 +305,7 @@ class ScanExec(PhysicalNode):
                                             self.out_schema,
                                             budget=self._budget(device=False))
         else:
-            batch = parquet.read_device_batch(files, self.columns,
-                                              self.out_schema,
-                                              budget=self._budget(device=True))
+            batch = self._read_device(files, bucket=bucket)
         if bucket is not None and len(files) > 1:
             # Multiple sorted runs in one bucket (incremental deltas): the
             # concat is not globally sorted — restore order on device.
@@ -345,9 +359,7 @@ class ScanExec(PhysicalNode):
             return parquet.read_host_batch(
                 files, self.columns, self.out_schema,
                 budget=self._budget(device=False)), lengths
-        return parquet.read_device_batch(
-            files, self.columns, self.out_schema,
-            budget=self._budget(device=True)), lengths
+        return self._read_device(files, bucketed=True), lengths
 
 
 class FilterExec(PhysicalNode):

@@ -438,6 +438,32 @@ class QueryScheduler:
         reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
         reg.gauge("serve.active").set(self._inflight)
 
+    def _credit(self, ent: _QueryEntry, nbytes: int) -> int:
+        """Footprint credit for already-HBM-resident bytes: once the
+        optimized plan is known, the bytes its index scans will serve
+        from the segment cache (`io/segcache.py`) are NOT bytes this
+        query will stage — shrink its admitted charge so queued queries
+        over the same hot index stop serially occupying budget as if
+        each re-staged the data (the admission-side half of shared-scan
+        coalescing; the cache's single-flight fill is the other half).
+        Returns the bytes actually credited (clamped so a query never
+        charges below the footprint floor)."""
+        from hyperspace_tpu.plan.footprint import MIN_FOOTPRINT_BYTES
+        with self._cv:
+            if not ent.admitted or nbytes <= 0:
+                return 0
+            delta = min(int(nbytes),
+                        max(0, ent.footprint - MIN_FOOTPRINT_BYTES))
+            if delta <= 0:
+                return 0
+            ent.footprint -= delta
+            self._admitted_bytes -= delta
+            reg = telemetry.get_registry()
+            reg.counter("serve.footprint_credit_bytes").inc(delta)
+            reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
+            self._cv.notify_all()
+        return delta
+
     def _release(self, ent: _QueryEntry) -> None:
         reg = telemetry.get_registry()
         with self._cv:
@@ -595,6 +621,21 @@ class QueryScheduler:
                     deadline.check("plan")
                     plan = (session.optimize(df.plan)
                             if session is not None else df.plan)
+                    if plan is not df.plan:
+                        # Already-resident index segments are bytes this
+                        # query will never stage: credit them back so
+                        # queued queries coalesce onto the warm cache.
+                        try:
+                            from hyperspace_tpu.io import segcache
+                            resident = (segcache.get_cache()
+                                        .resident_bytes_for_plan(plan))
+                        except Exception:
+                            resident = 0
+                        credited = self._credit(ent, resident)
+                        if credited:
+                            metrics.event("serve", "footprint_credit",
+                                          query_id=query_id,
+                                          credited_bytes=credited)
                     batch = self._execute_resilient(df, plan, metrics,
                                                     conf)
                     if not batch.is_host:

@@ -101,13 +101,24 @@ class IndexDataManagerImpl(IndexDataManager):
 
     def commit(self, version_id: int) -> None:
         """Write the `_committed` marker — the LAST write of a build; the
-        version is served only after this lands."""
+        version is served only after this lands. Committing is also THE
+        cache-invalidation event for the version bump: every
+        data-writing action (create/refresh/incremental/optimize)
+        funnels through here, so the HBM segment cache and the stamped
+        host caches learn about new bytes at exactly the boundary where
+        they become servable — not via per-action ad-hoc clears."""
         file_utils.create_file(
             self._marker_path(version_id),
             json.dumps({"committedAtMs": int(time.time() * 1000)}))
+        from hyperspace_tpu.io import segcache
+        segcache.on_version_committed(self.index_path, version_id)
 
     def is_committed(self, version_id: int) -> bool:
         return file_utils.exists(self._marker_path(version_id))
 
     def delete(self, version_id: int) -> None:
         file_utils.delete(self.get_path(version_id))
+        # Vacuum's hard delete: the version's bytes are gone from disk,
+        # so its segments must leave HBM (and the host caches) too.
+        from hyperspace_tpu.io import segcache
+        segcache.on_version_deleted(self.index_path, version_id)

@@ -293,7 +293,8 @@ def _decode_device_column(arr, f: SchemaField) -> dict:
 
 
 def from_arrow(table, schema: Optional[Schema] = None,
-               device: bool = True) -> ColumnBatch:
+               device: bool = True,
+               transfer_tag: Optional[str] = None) -> ColumnBatch:
     """Arrow table -> ColumnBatch. Nulls become validity masks with
     sentinel-filled payloads (0 / empty string). `device=False` keeps the
     columns in host memory (numpy) for the adaptive host lane — small
@@ -314,7 +315,7 @@ def from_arrow(table, schema: Optional[Schema] = None,
 
         jobs = [partial(_decode_device_column, table.column(f.name), f)
                 for f in schema.fields]
-        placed = transfer.get_engine().put_group(jobs)
+        placed = transfer.get_engine().put_group(jobs, tag=transfer_tag)
         columns: Dict[str, DeviceColumn] = {}
         for f, entry in zip(schema.fields, placed):
             if f.dtype == "string":

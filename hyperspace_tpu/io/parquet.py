@@ -164,6 +164,31 @@ def clear_read_cache() -> None:
     clear_device_cache()
 
 
+def invalidate_paths(prefix: str) -> None:
+    """Drop every host-cache entry (read / decoded-batch / footer-count)
+    whose key touches a path under `prefix` — the index-FSM
+    invalidation hook (`io/segcache.py`). Stamp validation alone cannot
+    close the mid-commit window: a racing query can stat, validate, and
+    serve bytes the committing action is replacing; an explicit sweep
+    at the commit boundary can."""
+    prefix = prefix.rstrip("/\\")
+
+    def under(path: str) -> bool:
+        return path == prefix or path.startswith(prefix + "/") \
+            or path.startswith(prefix + os.sep)
+
+    with _read_cache_lock:
+        for key in [k for k in _read_cache if any(under(p)
+                                                  for p in k[0])]:
+            del _read_cache[key]
+    with _batch_cache_lock:
+        for key in [k for k in _batch_cache if any(under(p)
+                                                   for p in k[0])]:
+            del _batch_cache[key]
+    for path in [p for p in _count_cache if under(p)]:
+        _count_cache.pop(path, None)
+
+
 def read_table(paths: Sequence[str], columns: Optional[Sequence[str]] = None):
     """Read one or more parquet files/dirs into a single Arrow table, in
     path order. Files are read concurrently (pyarrow releases the GIL);
@@ -268,19 +293,18 @@ def clear_batch_cache() -> None:
 
 def _stamped_batch_read(paths: Sequence[str],
                         columns: Optional[Sequence[str]], schema,
-                        cache: "_OrderedDict", lock, budget: int,
-                        device: bool):
-    """ONE stamped-LRU read for both decoded-batch caches (host and
-    device): get with stamp validation, decode on miss, insert with
-    re-stat (a file rewritten during the read must not cache under the
-    old stamp), evict LRU entries until within budget. Hit/miss/
-    eviction/bytes-held series land as `cache.device_batch.*` /
-    `cache.host_batch.*` — on device backends the device-batch bytes
-    ARE resident HBM, the first number to read in an OOM."""
+                        cache: "_OrderedDict", lock, budget: int):
+    """Stamped-LRU read for the HOST decoded-batch cache: get with
+    stamp validation, decode on miss, insert with re-stat (a file
+    rewritten during the read must not cache under the old stamp),
+    evict LRU entries until within budget. Hit/miss/eviction/bytes-held
+    series land as `cache.host_batch.*`. (The DEVICE lane lives in
+    `io/segcache.py` — version-keyed HBM residency, single-flight
+    fills, index-FSM invalidation.)"""
     from hyperspace_tpu.io import columnar
     from hyperspace_tpu.telemetry import memory as _mem
 
-    name = "device_batch" if device else "host_batch"
+    name = "host_batch"
     key = (tuple(paths), tuple(columns) if columns is not None else None,
            schema.to_json() if schema is not None else None)
     # Enforce the effective budget on ENTRY, not only on insert: a budget
@@ -315,7 +339,7 @@ def _stamped_batch_read(paths: Sequence[str],
                 del cache[key]
     _mem.cache_miss(name)
     table = read_table(paths, columns=columns)
-    batch = columnar.from_arrow(table, schema, device=device)
+    batch = columnar.from_arrow(table, schema, device=False)
     if stamps is not None and budget > 0:
         if _stamps(paths) != stamps:
             return batch
@@ -343,44 +367,15 @@ def read_host_batch(paths: Sequence[str],
     cache bound."""
     return _stamped_batch_read(paths, columns, schema, _batch_cache,
                                _batch_cache_lock,
-                               READ_CACHE_BYTES if budget is None else budget,
-                               device=False)
-
-
-# Device-resident batch cache: the host caches above still leave a warm
-# DEVICE-lane query paying the host->device transfer of every scanned
-# column on every run — on a tunneled link that transfer IS the warm
-# cost (hundreds of MB per query at TPC-DS scale). Index data files are
-# immutable (`v__=N` versioning), batches are immutable downstream, and
-# accelerator HBM is exactly where hot index columns should live, so
-# repeat scans of unchanged files reuse the HBM-resident batch. Same
-# stamp validation as the host caches; budget via the session conf
-# `spark.hyperspace.cache.device.bytes` (preferred — it must be sized
-# against the join/sort working set sharing HBM) with the
-# HYPERSPACE_DEVICE_CACHE_BYTES env var as the process-wide default
-# (0 disables).
-DEVICE_CACHE_BYTES = int(os.environ.get(
-    "HYPERSPACE_DEVICE_CACHE_BYTES", 4 * 1024 ** 3))
-_device_cache: "_OrderedDict" = _OrderedDict()
-_device_cache_lock = threading.Lock()
+                               READ_CACHE_BYTES if budget is None else budget)
 
 
 def clear_device_cache() -> None:
-    with _device_cache_lock:
-        _device_cache.clear()
-
-
-def read_device_batch(paths: Sequence[str],
-                      columns: Optional[Sequence[str]], schema,
-                      budget: Optional[int] = None):
-    """Read parquet files into a DEVICE-resident ColumnBatch through the
-    stamped device cache — a warm hit skips the parquet decode AND the
-    host->device copy. `budget` (session conf) overrides the env-default
-    cache bound."""
-    return _stamped_batch_read(paths, columns, schema, _device_cache,
-                               _device_cache_lock,
-                               DEVICE_CACHE_BYTES if budget is None else budget,
-                               device=True)
+    """Empty the HBM segment cache (`io/segcache.py` owns the device
+    lane now; this name survives for the cold-cache callers —
+    `clear_read_cache`, bench drivers, tests)."""
+    from hyperspace_tpu.io import segcache
+    segcache.clear()
 
 
 def _batch_nbytes(batch) -> int:

@@ -1,0 +1,604 @@
+"""HBM-resident index segment cache — THE device-residency seam.
+
+BENCH_r05 put the device path's problem in one line: ~0.11s of device
+compute against ~1.95s of H2D/D2H. Under serving traffic every query
+re-paid parquet decode + H2D for the same hot index shards; the paper's
+premise is that a covering index is a *reusable* derived dataset
+(PAPER.md §3 — read many times per build), and on a TPU the analog of
+Spark's distributed page cache is HBM residency. This module promotes
+the stamped device-batch LRU that used to live inside `io/parquet.py`
+into a first-class, process-wide, byte-budgeted segment cache that owns
+device residency end to end (`scripts/check_metrics_coverage.py` bans
+the old `_device_cache`/`read_device_batch` access anywhere else):
+
+- **keying**: a committed index segment is keyed by
+  `(index root, v__=N, bucket selector, columns, schema)` — content
+  identity, NO per-read stat/stamp validation. Index version dirs are
+  immutable once their `_committed` marker lands (PR 4), and the rules
+  only ever select committed versions, so a key can never alias two
+  byte-states. Version keying is also what gives reads pinned-version
+  stability: a refresh committing `v__=N+1` mid-query cannot perturb a
+  scan already reading (and caching under) `v__=N`. Non-index device
+  scans (source data, hybrid-scan appended files) have no version to
+  key on and fall back to the PR-3 `(paths, size+mtime stamp)`
+  validation.
+- **fills**: misses decode through the stamped host read cache and
+  cross the link through the PR-5 `TransferEngine` (chunked, staged,
+  budget-shared with live queries' transfers) tagged as the `fill`
+  lane, with per-key SINGLE-FLIGHT: N concurrent queries over the same
+  cold bucket trigger exactly one decode+H2D — the PR-7 scheduler
+  queue is the coalescing point; queued queries whose footprint
+  overlaps an in-flight fill wait on the fill (deadline-checkpointed),
+  not the link. A fill's projected bytes are RESERVED against the
+  budget before the transfer starts (concurrent fills cannot
+  collectively blow past it) and released on every exit path —
+  cancellation mid-fill included.
+- **eviction**: byte-budgeted LRU (the PR-3 machinery), with the PR-3
+  accountant's live HBM gauges as a CEILING: when a serving budget
+  (`spark.hyperspace.serve.hbm.budget.bytes`) is set, the cache's
+  effective budget shrinks by non-cache device residency so the cache
+  and the admission controller share one truth about device memory.
+  Indexes listed in `spark.hyperspace.cache.segments.pin.indexes` are
+  pinned: their segments survive byte pressure (but not invalidation).
+- **invalidation**: hooks off the index log FSM, not ad-hoc clears —
+  `IndexDataManagerImpl.commit/delete` and the log manager's stable-log
+  publish call `on_version_committed` / `on_version_deleted` /
+  `on_index_dropped`, which also drop the stamped host caches and the
+  footprint size cache for the affected paths (the old mid-commit
+  stamp-validation race).
+
+Telemetry: `cache.segments.{hits,misses,fills,evictions,bytes_held,
+entries,pins}` through the PR-3 helpers (per-query mirrors feed the
+regression differ's `cache` bucket), `segcache.fill` spans, and
+`transfer.fill.*` counters on the fill lane. Budget knob:
+`spark.hyperspace.cache.segments.bytes` (falls back to the legacy
+`cache.device.bytes` key, then the HYPERSPACE_SEGMENT_CACHE_BYTES /
+HYPERSPACE_DEVICE_CACHE_BYTES env defaults).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from hyperspace_tpu import constants
+
+__all__ = ["SegmentCache", "SegmentRef", "get_cache", "set_cache",
+           "reset_cache", "clear", "segment_ref_for_scan",
+           "on_version_committed", "on_version_deleted",
+           "on_index_dropped", "read_segment", "stats_snapshot"]
+
+# Process-wide default budget (bytes); session conf overrides. The new
+# env var wins; the legacy device-cache env keeps old deployments'
+# sizing working.
+SEGMENT_CACHE_BYTES = int(os.environ.get(
+    "HYPERSPACE_SEGMENT_CACHE_BYTES",
+    os.environ.get("HYPERSPACE_DEVICE_CACHE_BYTES", 4 * 1024 ** 3)))
+
+# Wait quantum for single-flight waiters: short enough that a
+# cancelled waiter notices its deadline promptly, long enough not to
+# spin (same discipline as the scheduler's queue wait).
+_FILL_WAIT_QUANTUM_S = 0.05
+
+_VERSION_DIR_RE = re.compile(
+    re.escape(constants.INDEX_VERSION_DIRECTORY_PREFIX) + r"=(\d+)$")
+
+
+@dataclass(frozen=True)
+class SegmentRef:
+    """Identity of one cacheable index segment: WHICH committed bytes a
+    read covers, independent of how the filesystem is asked for them.
+    `bucket` is the bucket selector the read applied — a single bucket
+    id, a sorted tuple of pruned bucket ids, or "all"."""
+
+    index_name: str
+    index_root: str   # parent of the v__=N dir (warehouse-unique)
+    version: int
+    bucket: object
+
+    @property
+    def key(self) -> tuple:
+        return ("seg", self.index_root, self.version, self.bucket)
+
+
+def segment_ref_for_scan(scan, bucket=None, allowed_buckets=None,
+                         bucketed: bool = False) -> Optional[SegmentRef]:
+    """SegmentRef for a rule-selected index scan, or None when the read
+    is not version-addressable (source-data scans, multi-root scans, a
+    root that is not a `v__=N` dir). Only the rules put `index_name` on
+    a Scan, and they only ever resolve COMMITTED versions
+    (`IndexDataManager.get_latest_version_id`), so a parseable version
+    here is a committed one by construction."""
+    if not getattr(scan, "index_name", None):
+        return None
+    roots = list(scan.root_paths)
+    if len(roots) != 1:
+        return None
+    root = roots[0].rstrip("/\\")
+    m = _VERSION_DIR_RE.search(os.path.basename(root))
+    if m is None:
+        return None
+    if bucket is not None:
+        selector: object = int(bucket)
+    elif allowed_buckets is not None:
+        selector = ("pruned", tuple(sorted(allowed_buckets)))
+    else:
+        selector = "all"
+    if bucketed:
+        # The bucket-ordered whole-index read (`execute_bucketed`) and
+        # the plain read can concatenate the same files in different
+        # orders — distinct layouts, distinct keys.
+        selector = ("bucketed", selector)
+    return SegmentRef(index_name=scan.index_name,
+                      index_root=os.path.dirname(root),
+                      version=int(m.group(1)),
+                      bucket=selector)
+
+
+class _Entry:
+    __slots__ = ("batch", "nbytes", "ref", "pinned", "stamps")
+
+    def __init__(self, batch, nbytes: int, ref: Optional[SegmentRef],
+                 pinned: bool, stamps=None):
+        self.batch = batch
+        self.nbytes = nbytes
+        self.ref = ref
+        self.pinned = pinned
+        # Per-file (size, mtime) stamps for UNVERSIONED entries; hits
+        # revalidate against the live stamps (version-keyed entries are
+        # immutable by construction and carry None).
+        self.stamps = stamps
+
+
+class _Fill:
+    """One in-flight single-flight fill. `event` flips when the filler
+    finishes (success or not); waiters read `batch`/`error` after it.
+    `doomed` marks a fill whose index was invalidated mid-flight — its
+    result is still returned to its waiters (their query pinned that
+    version) but never inserted."""
+
+    __slots__ = ("event", "batch", "error", "doomed", "reserved",
+                 "index_root")
+
+    def __init__(self, index_root: Optional[str]):
+        self.event = threading.Event()
+        self.batch = None
+        self.error: Optional[BaseException] = None
+        self.doomed = False
+        self.reserved = 0
+        self.index_root = index_root
+
+
+def _batch_nbytes(batch) -> int:
+    """Resident bytes of a ColumnBatch (payload + validity + the device
+    halves of string dictionary hashes)."""
+    total = 0
+    for col in batch.columns.values():
+        total += int(getattr(col.data, "nbytes", 0))
+        if col.validity is not None:
+            total += int(getattr(col.validity, "nbytes", 0))
+        if col.dict_hashes is not None:
+            for h in col.dict_hashes:
+                total += int(getattr(h, "nbytes", 0))
+    return total
+
+
+def _pinned_indexes(conf) -> frozenset:
+    if conf is None:
+        return frozenset()
+    raw = conf.get(constants.SEGMENT_CACHE_PIN_INDEXES) or ""
+    return frozenset(n.strip() for n in raw.split(",") if n.strip())
+
+
+class SegmentCache:
+    """Process-wide HBM segment cache (module docstring). All blocking
+    happens on caller threads; the cache spawns none of its own."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self._cv = threading.Condition()
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._fills: Dict[tuple, _Fill] = {}
+        self._bytes_held = 0
+        self._reserved = 0
+        self._default_budget = (SEGMENT_CACHE_BYTES if budget_bytes is None
+                                else int(budget_bytes))
+
+    # -- budget math ------------------------------------------------------
+
+    def _configured_budget(self, conf, override: Optional[int]) -> int:
+        if override is not None:
+            return int(override)
+        if conf is not None:
+            value = conf.segment_cache_bytes
+            if value is not None:
+                return int(value)
+        return self._default_budget
+
+    def _effective_budget(self, conf, override: Optional[int]) -> int:
+        """The configured budget, CAPPED by what the serving budget
+        leaves after non-cache device residency — the accountant's live
+        gauges are the shared truth between this cache and the
+        admission controller (`engine/scheduler.py` derives headroom
+        from the same numbers)."""
+        budget = self._configured_budget(conf, override)
+        serve = conf.serve_hbm_budget_bytes if conf is not None else 0
+        if serve and serve > 0:
+            try:
+                from hyperspace_tpu import telemetry
+                live = sum(telemetry.get_accountant().live.values())
+            except Exception:
+                live = 0
+            non_cache = max(0, live - self._bytes_held - self._reserved)
+            budget = min(budget, max(0, serve - non_cache))
+        return budget
+
+    # -- residency accounting --------------------------------------------
+
+    def _publish_stats(self) -> None:
+        # Caller holds the cv lock.
+        from hyperspace_tpu.telemetry import memory as _mem
+        _mem.cache_stats("segments", self._bytes_held, len(self._entries))
+        from hyperspace_tpu import telemetry
+        telemetry.get_registry().gauge("cache.segments.pins").set(
+            sum(1 for e in self._entries.values() if e.pinned))
+
+    def _evict_until(self, need: int, budget: int) -> int:
+        """Evict unpinned LRU entries until `need` extra bytes fit under
+        `budget`. Caller holds the cv lock. Returns evictions."""
+        evictions = 0
+        while self._bytes_held + self._reserved + need > budget:
+            victim_key = None
+            for key, ent in self._entries.items():  # LRU order
+                if not ent.pinned:
+                    victim_key = key
+                    break
+            if victim_key is None:
+                break  # only pinned residency left
+            ent = self._entries.pop(victim_key)
+            self._bytes_held -= ent.nbytes
+            evictions += 1
+        return evictions
+
+    def bytes_held(self) -> int:
+        with self._cv:
+            return self._bytes_held
+
+    def resident_bytes_for_plan(self, plan) -> int:
+        """Bytes already HBM-resident for `plan`'s index scans — the
+        admission-control footprint credit (`QueryScheduler` shrinks an
+        admitted query's charged bytes by this, so K queries over the
+        same hot index do not serially occupy budget as if each
+        re-staged the data)."""
+        from hyperspace_tpu.plan.nodes import Scan
+
+        roots: set = set()
+
+        def visit(node):
+            if isinstance(node, Scan) and getattr(node, "index_name",
+                                                  None):
+                for r in node.root_paths:
+                    root = r.rstrip("/\\")
+                    if _VERSION_DIR_RE.search(os.path.basename(root)):
+                        roots.add(os.path.dirname(root))
+            for c in node.children:
+                visit(c)
+
+        try:
+            visit(plan)
+        except Exception:
+            return 0
+        if not roots:
+            return 0
+        with self._cv:
+            return sum(e.nbytes for e in self._entries.values()
+                       if e.ref is not None and e.ref.index_root in roots)
+
+    # -- the read path ----------------------------------------------------
+
+    def read(self, paths: Sequence[str],
+             columns: Optional[Sequence[str]], schema,
+             ref: Optional[SegmentRef] = None,
+             conf=None, budget: Optional[int] = None):
+        """Read parquet `paths` into a DEVICE-resident ColumnBatch
+        through the segment cache: a hit skips the parquet decode AND
+        the host->device transfer; a miss fills once per key no matter
+        how many threads ask (single-flight)."""
+        from hyperspace_tpu import telemetry
+        from hyperspace_tpu.telemetry import memory as _mem
+
+        cols = tuple(columns) if columns is not None else None
+        schema_json = schema.to_json() if schema is not None else None
+        stamps = None
+        if ref is not None:
+            key = ref.key + (cols, schema_json)
+        else:
+            # Unversioned read: PR-3 stamp validation (size+mtime per
+            # file). Unstampable paths are uncacheable.
+            from hyperspace_tpu.io import parquet
+            stamps = parquet._stamps(paths)
+            if stamps is None:
+                _mem.cache_miss("segments")
+                return self._decode(paths, cols, schema)
+            key = ("path", tuple(paths), cols, schema_json)
+
+        while True:
+            fill = None
+            with self._cv:
+                ent = self._entries.get(key)
+                if ent is not None:
+                    if ent.stamps is not None and ent.stamps != stamps:
+                        # Rewritten since caching: stale — drop and
+                        # fall through to a fresh fill.
+                        self._bytes_held -= ent.nbytes
+                        del self._entries[key]
+                        self._publish_stats()
+                    else:
+                        self._entries.move_to_end(key)
+                        _mem.cache_hit("segments")
+                        return ent.batch
+                fill = self._fills.get(key)
+                if fill is None:
+                    fill = _Fill(ref.index_root if ref is not None
+                                 else None)
+                    self._fills[key] = fill
+                    break
+            # Another thread owns the fill: wait on IT, not the link —
+            # deadline-checkpointed so a cancelled waiter leaves the
+            # queue promptly (the filler keeps going for its own query).
+            while not fill.event.is_set():
+                telemetry.check_deadline("cache.fill")
+                fill.event.wait(_FILL_WAIT_QUANTUM_S)
+            if fill.error is None and fill.batch is not None:
+                # Coalesced: one decode+H2D served K waiters the SAME
+                # batch object (bit-identical by construction).
+                _mem.cache_hit("segments")
+                telemetry.add_count("cache.segments.coalesced")
+                return fill.batch
+            # The filler died (fault, cancellation): retry with our own
+            # fill — its failure was its query's, not necessarily ours.
+
+        # This thread is the filler.
+        _mem.cache_miss("segments")
+        reg = telemetry.get_registry()
+        try:
+            with telemetry.span("segcache.fill", "cache",
+                                index=(ref.index_name if ref else None),
+                                files=len(paths)):
+                reg.counter("cache.segments.fills").inc()
+                batch, nbytes = self._fill(key, fill, paths, cols,
+                                           schema, stamps, ref, conf,
+                                           budget)
+            fill.batch = batch
+            return batch
+        except BaseException as exc:
+            fill.error = exc
+            raise
+        finally:
+            with self._cv:
+                if self._fills.get(key) is fill:
+                    del self._fills[key]
+                if fill.reserved:
+                    self._reserved -= fill.reserved
+                    fill.reserved = 0
+                self._cv.notify_all()
+            fill.event.set()
+
+    def _decode(self, paths, cols, schema):
+        """Uncached decode+transfer (fill lane, no insert)."""
+        from hyperspace_tpu.io import columnar, parquet
+        table = parquet.read_table(paths, columns=list(cols) if cols
+                                   else None)
+        return columnar.from_arrow(table, schema, device=True,
+                                   transfer_tag="fill")
+
+    def _fill(self, key, fill: _Fill, paths, cols, schema, stamps, ref,
+              conf, budget_override) -> Tuple[object, int]:
+        """One fill: host decode, byte reservation (evicting LRU for
+        headroom), H2D through the transfer engine's fill lane, insert.
+        Runs OUTSIDE the cache lock except for the bookkeeping."""
+        from hyperspace_tpu.io import columnar, parquet
+        from hyperspace_tpu.telemetry import memory as _mem
+
+        table = parquet.read_table(paths, columns=list(cols) if cols
+                                   else None)
+        budget = self._effective_budget(conf, budget_override)
+        # Reserve the projected device bytes BEFORE the transfer: the
+        # Arrow nbytes is a close proxy for the decoded device batch
+        # (validated against the real size after placement). Without a
+        # reservation, K concurrent fills each individually under
+        # budget could collectively blow past it.
+        projected = int(table.nbytes)
+        cacheable = budget > 0 and projected <= budget
+        if cacheable:
+            with self._cv:
+                evictions = self._evict_until(projected, budget)
+                self._reserved += projected
+                fill.reserved = projected
+                self._publish_stats()
+            _mem.cache_eviction("segments", evictions)
+        # The transfer itself: chunked + staged + deadline-checkpointed
+        # by the engine; a cancellation raising out of here releases
+        # the reservation in read()'s finally.
+        batch = columnar.from_arrow(table, schema, device=True,
+                                    transfer_tag="fill")
+        nbytes = _batch_nbytes(batch)
+        if not cacheable:
+            return batch, nbytes
+        if stamps is not None and parquet._stamps(paths) != stamps:
+            # Unversioned read raced a rewrite: serve, never cache.
+            return batch, nbytes
+        with self._cv:
+            self._reserved -= fill.reserved
+            fill.reserved = 0
+            budget = self._effective_budget(conf, budget_override)
+            if fill.doomed or nbytes > budget:
+                self._publish_stats()
+                self._cv.notify_all()
+                return batch, nbytes
+            evictions = self._evict_until(nbytes, budget)
+            self._entries[key] = _Entry(
+                batch, nbytes, ref,
+                pinned=(ref is not None
+                        and ref.index_name in _pinned_indexes(conf)),
+                stamps=stamps)
+            self._bytes_held += nbytes
+            self._publish_stats()
+            self._cv.notify_all()
+        _mem.cache_eviction("segments", evictions)
+        from hyperspace_tpu import telemetry
+        telemetry.memory.maybe_sample()
+        return batch, nbytes
+
+    # -- invalidation (the index log FSM hooks) ---------------------------
+
+    def _drop(self, predicate) -> int:
+        from hyperspace_tpu.telemetry import memory as _mem
+        with self._cv:
+            victims = [k for k, e in self._entries.items()
+                       if e.ref is not None and predicate(e.ref)]
+            for k in victims:
+                self._bytes_held -= self._entries.pop(k).nbytes
+            for f in self._fills.values():
+                if f.index_root is not None and predicate(
+                        SegmentRef("", f.index_root, -1, "all")):
+                    f.doomed = True
+            self._publish_stats()
+            self._cv.notify_all()
+        _mem.cache_eviction("segments", len(victims))
+        return len(victims)
+
+    def invalidate_index(self, index_root: str,
+                         keep_version: Optional[int] = None) -> int:
+        """Drop every cached segment of the index rooted at
+        `index_root` (optionally sparing one version). Returns how many
+        entries were dropped. In-flight fills for the index are doomed:
+        their waiters still get their batch (pinned-version stability)
+        but nothing stale is inserted."""
+        root = index_root.rstrip("/\\")
+        return self._drop(lambda ref: ref.index_root == root
+                          and ref.version != keep_version)
+
+    def invalidate_version(self, index_root: str, version: int) -> int:
+        root = index_root.rstrip("/\\")
+        return self._drop(lambda ref: ref.index_root == root
+                          and (ref.version == version or version < 0))
+
+    def clear(self) -> None:
+        from hyperspace_tpu.telemetry import memory as _mem
+        with self._cv:
+            n = len(self._entries)
+            self._entries.clear()
+            self._bytes_held = 0
+            for f in self._fills.values():
+                f.doomed = True
+            self._publish_stats()
+            self._cv.notify_all()
+        _mem.cache_eviction("segments", n)
+
+    # -- introspection ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {
+                "entries": len(self._entries),
+                "bytes_held": self._bytes_held,
+                "reserved_bytes": self._reserved,
+                "fills_in_flight": len(self._fills),
+                "pinned_entries": sum(1 for e in self._entries.values()
+                                      if e.pinned),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Process-wide cache + the index-FSM invalidation hooks
+# ---------------------------------------------------------------------------
+
+_cache: Optional[SegmentCache] = None
+_cache_lock = threading.Lock()
+
+
+def get_cache() -> SegmentCache:
+    global _cache
+    if _cache is None:
+        with _cache_lock:
+            if _cache is None:
+                _cache = SegmentCache()
+    return _cache
+
+
+def set_cache(cache: SegmentCache) -> SegmentCache:
+    """Install a specific cache (tests: tiny budgets, fresh state)."""
+    global _cache
+    _cache = cache
+    return cache
+
+
+def reset_cache() -> None:
+    global _cache
+    _cache = None
+
+
+def clear() -> None:
+    """Empty the process cache (bench cold phases, test isolation)."""
+    cache = _cache
+    if cache is not None:
+        cache.clear()
+
+
+def read_segment(paths, columns, schema, ref=None, conf=None,
+                 budget=None):
+    """Module-level convenience: `get_cache().read(...)`."""
+    return get_cache().read(paths, columns, schema, ref=ref, conf=conf,
+                            budget=budget)
+
+
+def stats_snapshot() -> dict:
+    return get_cache().snapshot()
+
+
+def _invalidate_host_caches(prefix: str) -> None:
+    """Stale-entry sweep of the HOST-side stamped caches + the
+    footprint size cache for paths under `prefix` — the other half of
+    the invalidation contract (stamp validation alone races a
+    mid-commit rewrite: a query can stat, validate, and serve bytes
+    the action is replacing)."""
+    from hyperspace_tpu.io import parquet
+    from hyperspace_tpu.plan import footprint
+    parquet.invalidate_paths(prefix)
+    footprint.invalidate_sizes(prefix)
+
+
+def on_version_committed(index_root: str, version: int) -> None:
+    """A data-writing action committed `v__=<version>` under
+    `index_root` (refresh/optimize/create/incremental). Older versions'
+    segments are dropped — in-flight readers of those versions refill
+    from disk if they come back (the dirs survive until vacuum); new
+    queries resolve the new version and fill fresh keys."""
+    cache = _cache
+    if cache is not None:
+        cache.invalidate_index(index_root, keep_version=version)
+    _invalidate_host_caches(index_root)
+
+
+def on_version_deleted(index_root: str, version: int) -> None:
+    """Vacuum hard-deleted `v__=<version>`: its bytes no longer exist
+    on disk, so its segments must not survive in HBM either."""
+    cache = _cache
+    if cache is not None:
+        cache.invalidate_version(index_root, version)
+    _invalidate_host_caches(index_root)
+
+
+def on_index_dropped(index_root: str) -> None:
+    """The index log published a terminal state (DELETED/DOESNOTEXIST):
+    release every segment of the index — the rules will not select it
+    again, and pinned HBM for a dropped index is a leak."""
+    cache = _cache
+    if cache is not None:
+        cache.invalidate_index(index_root)
+    _invalidate_host_caches(index_root)

@@ -571,19 +571,30 @@ class TransferEngine:
         self._sweep()
         return parts
 
-    def put_group(self, jobs: Sequence[Callable[[], dict]], device=None
-                  ) -> List[dict]:
+    def put_group(self, jobs: Sequence[Callable[[], dict]], device=None,
+                  tag: Optional[str] = None) -> List[dict]:
         """Pipelined multi-column placement. Each job runs on the
         staging pool and returns {name: value} where ndarray / HostCast
         values get placed (chunked + windowed), `Host(v)` unwraps to v,
         and anything else passes through. Decode of column i+1 overlaps
         column i's puts; one h2d telemetry record covers the group, and
         the measured overlap (serial stage sum minus pipelined wall)
-        accumulates in `transfer.overlap_saved_seconds`."""
+        accumulates in `transfer.overlap_saved_seconds`.
+
+        `tag` names the LANE for attribution: segment-cache fills pass
+        `tag="fill"`, which lands the group in `transfer.fill.{bytes,
+        seconds,chunks}` counters alongside the shared `link.h2d.*`
+        series (fills share the link, the in-flight window, and the
+        staging pool with live queries' transfers — the budget is one;
+        only the accounting is split) and stamps the cancellation
+        checkpoints with the `transfer.fill` phase so an interrupted
+        fill is distinguishable from an interrupted query transfer in
+        `serve.interrupted.*`."""
         if not jobs:
             return []
         from hyperspace_tpu import telemetry
         pool = self._staging_pool()
+        phase = f"transfer.{tag}" if tag else "transfer"
         t = telemetry.tracer()
         ts = t.now_us() if t is not None else None
         t0 = time.perf_counter()
@@ -602,7 +613,7 @@ class TransferEngine:
             # Per-column checkpoint: remaining decodes still run on the
             # pool (futures are not revoked) but their results are
             # plain host arrays — nothing device-side leaks.
-            telemetry.check_deadline("transfer")
+            telemetry.check_deadline(phase)
             produced, job_s = fut.result()
             decode_s += job_s
             placed = {}
@@ -623,6 +634,11 @@ class TransferEngine:
         if total_bytes:
             reg = telemetry.get_registry()
             reg.counter("transfer.overlap_saved_seconds").inc(saved)
+            if tag:
+                reg.counter(f"transfer.{tag}.bytes").inc(total_bytes)
+                reg.counter(f"transfer.{tag}.seconds").inc(wall)
+                reg.counter(f"transfer.{tag}.chunks").inc(
+                    max(timings["chunks"], 1))
             telemetry.record_link_transfer("h2d", total_bytes, wall,
                                            ts_us=ts,
                                            chunks=max(timings["chunks"],

@@ -26,12 +26,13 @@ the scheduler reads only `projected_bytes`.
 
 from __future__ import annotations
 
-from typing import Dict
+import os
+from typing import Dict, Tuple
 
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 
-__all__ = ["projected_bytes", "DECODE_EXPANSION", "DEFAULT_SCAN_BYTES",
-           "MIN_FOOTPRINT_BYTES"]
+__all__ = ["projected_bytes", "invalidate_sizes", "DECODE_EXPANSION",
+           "DEFAULT_SCAN_BYTES", "MIN_FOOTPRINT_BYTES"]
 
 # Decoded + staged + device-resident expansion over on-disk parquet.
 DECODE_EXPANSION = 3.0
@@ -44,31 +45,49 @@ DEFAULT_SCAN_BYTES = 32 * 1024 * 1024
 # Whole-plan floor.
 MIN_FOOTPRINT_BYTES = 1 * 1024 * 1024
 
-# Per-file size cache: footprint estimation runs on EVERY collect, and
-# serving traffic re-scans the same hot index files; one stat per file
-# per process is plenty (a refreshed index writes NEW v__=N paths, so
-# stale sizes age out with their files).
-_size_cache: Dict[str, int] = {}
+# Per-file size cache, STAMP-VALIDATED: footprint estimation runs on
+# EVERY collect, and serving traffic re-scans the same hot index files
+# — but a file rewritten in place (source data appends, a hybrid-scan
+# dir, an object-store overwrite) must not keep serving its old size
+# to admission control forever. Entries validate against the same
+# (size, mtime) stamp the parquet caches use (`io/parquet._file_stamp`)
+# — and since the stamp CARRIES the size, a validated hit and a
+# revalidation cost the same single stat. The index-FSM invalidation
+# hook (`io/segcache.py`) additionally sweeps entries under a
+# committed index root (`invalidate_sizes`).
+_size_cache: Dict[str, Tuple[object, int]] = {}
 
 
 def _file_size(path: str) -> int:
-    cached = _size_cache.get(path)
-    if cached is not None:
-        return cached
-    from hyperspace_tpu.utils import storage
+    from hyperspace_tpu.io.parquet import _file_stamp
     try:
-        if storage.is_url(path):
-            fs, real = storage.get_fs(path)
-            size = int(fs.info(real).get("size") or 0)
-        else:
-            import os
-            size = os.path.getsize(path)
+        stamp = _file_stamp(path)
     except Exception:
-        size = -1  # unknowable: caller substitutes the default
+        stamp = None
+    if stamp is None:
+        # Unstampable (directory, no mtime, stat failure): unknowable —
+        # never cached, caller substitutes the default.
+        _size_cache.pop(path, None)
+        return -1
+    cached = _size_cache.get(path)
+    if cached is not None and cached[0] == stamp:
+        return cached[1]
+    size = int(stamp[0])
     if len(_size_cache) > 65536:  # bound the cache, arbitrary-large safe
         _size_cache.clear()
-    _size_cache[path] = size
+    _size_cache[path] = (stamp, size)
     return size
+
+
+def invalidate_sizes(prefix: str) -> None:
+    """Drop cached sizes for every file under `prefix` (the index-FSM
+    invalidation hook — a refresh/optimize/vacuum boundary must not
+    leave admission control reading pre-commit sizes)."""
+    prefix = prefix.rstrip("/\\")
+    for path in [p for p in _size_cache
+                 if p == prefix or p.startswith(prefix + "/")
+                 or p.startswith(prefix + os.sep)]:
+        _size_cache.pop(path, None)
 
 
 def _scan_bytes(scan: Scan) -> int:

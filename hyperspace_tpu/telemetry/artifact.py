@@ -81,6 +81,29 @@ def transfer_digest() -> dict:
     }
 
 
+def segments_digest() -> dict:
+    """Process-lifetime digest of the HBM segment cache
+    (`io/segcache.py`) — hit/miss/fill/eviction counts and current
+    residency. Bench drivers embed it (with per-rung warm deltas) so
+    "repeat queries are link-free" is a committed, gateable number:
+    `scripts/bench_regress.py`'s warm-rung gate reads this block."""
+    from hyperspace_tpu.telemetry import registry as _registry
+
+    reg = _registry.get_registry()
+    c = reg.counters_dict()
+    return {
+        "hits": int(c.get("cache.segments.hits", 0)),
+        "misses": int(c.get("cache.segments.misses", 0)),
+        "fills": int(c.get("cache.segments.fills", 0)),
+        "evictions": int(c.get("cache.segments.evictions", 0)),
+        "fill_bytes": int(c.get("transfer.fill.bytes", 0)),
+        "fill_chunks": int(c.get("transfer.fill.chunks", 0)),
+        "bytes_held": int(reg.gauge("cache.segments.bytes_held").value),
+        "entries": int(reg.gauge("cache.segments.entries").value),
+        "pins": int(reg.gauge("cache.segments.pins").value),
+    }
+
+
 def query_metrics_block(qm) -> dict:
     """Per-query telemetry block: `summary()` (the compact rollup
     earlier rounds embedded) plus the full `to_dict()` operator tree

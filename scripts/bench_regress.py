@@ -15,7 +15,10 @@ Two artifact families, one gate:
   python scripts/bench_regress.py --serve         # newest two BENCH_SERVE_r*.json
 
 Rung artifacts (bench.py) gate per-rung `vs_baseline`, peak HBM growth,
-and the rung-1 link share as before. Query artifacts (bench_tpcds.py /
+the rung-1 link share, AND the warm-rung segment-cache bar: the
+steady-state repeat run of each query rung must show ZERO
+`link.h2d.chunks` (absolute gate — the healthy value is 0), and the
+segment-cache hit rate must not drop >threshold. Query artifacts (bench_tpcds.py /
 bench_tpch.py) gate the aggregate `vs_baseline` AND every per-query
 `vs_baseline` — the r03->r04 TPC-DS regression (aggregate 3.14x ->
 0.81x, q64 at 0.45x) is exactly the failure this mode exists to stop
@@ -111,6 +114,46 @@ def _rung1_link_share(doc: dict):
 RATE_SLACK = 0.02
 
 
+def _segment_rows(old: dict, new: dict, threshold: float):
+    """Warm-rung gate rows from the `segments` block bench.py embeds:
+
+    - `warm_h2d.<rung>` — the steady-state repeat run of each query
+      rung must cross the link ZERO times (`link.h2d.chunks` delta).
+      This gates on the NEW artifact alone and absolutely: the healthy
+      value is 0, and nothing ratio-gates against zero (same logic as
+      the serve rates).
+    - `segment_hit_rate` — hits/(hits+misses) of the HBM segment cache
+      over the whole ladder; a >threshold drop means repeat queries
+      started re-paying decode+H2D even if walls still pass.
+    """
+    rows = []
+    oseg = old.get("segments") or {}
+    nseg = new.get("segments") or {}
+    for rung, w in sorted((nseg.get("warm") or {}).items()):
+        chunks = w.get("h2d_chunks")
+        if not isinstance(chunks, (int, float)):
+            continue
+        ow = ((oseg.get("warm") or {}).get(rung) or {}).get("h2d_chunks")
+        rows.append((f"warm_h2d.{rung}",
+                     float(ow) if isinstance(ow, (int, float)) else 0.0,
+                     float(chunks), float(chunks), chunks > 0))
+
+    def rate(seg):
+        hits, misses = seg.get("hits"), seg.get("misses")
+        if not (isinstance(hits, (int, float))
+                and isinstance(misses, (int, float))) \
+                or hits + misses <= 0:
+            return None
+        return hits / (hits + misses)
+
+    old_rate, new_rate = rate(oseg), rate(nseg)
+    if old_rate and new_rate is not None:
+        change = new_rate / old_rate - 1.0
+        rows.append(("segment_hit_rate", old_rate, new_rate, change,
+                     change < -threshold))
+    return rows
+
+
 def compare_serve(old: dict, new: dict, threshold: float):
     """Serving-artifact gate rows (same row shape as `compare`):
     scaling ratio + QPS drop >threshold, p50/p99 growth >threshold,
@@ -176,6 +219,7 @@ def compare(old: dict, new: dict, threshold: float):
         lower_is_better=True)
     add("rung1_link_share", _rung1_link_share(old),
         _rung1_link_share(new), lower_is_better=True)
+    rows.extend(_segment_rows(old, new, threshold))
     return rows
 
 

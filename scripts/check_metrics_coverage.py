@@ -115,6 +115,41 @@ def check_device_put_seam(package_dir: str):
     return failures
 
 
+# The ONE sanctioned device-residency seam: HBM-resident batches live
+# in the segment cache (io/segcache.py — version-keyed, byte-budgeted,
+# single-flight fills, index-FSM invalidation). The legacy device-batch
+# LRU's entry points are BANNED outside that module: a raw
+# `_device_cache` map or `read_device_batch(...)` call anywhere else is
+# device residency the cache cannot budget, invalidate, or coalesce.
+_RAW_DEVCACHE_RE = re.compile(r"\b_device_cache\b|\bread_device_batch\b")
+_DEVCACHE_ALLOWED = os.path.join("io", "segcache.py")
+
+
+def check_segment_cache_seam(package_dir: str):
+    """Source lint: no direct `_device_cache`/`read_device_batch`
+    access outside io/segcache.py."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _DEVCACHE_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_DEVCACHE_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: direct "
+                            "device-batch cache access bypasses the "
+                            "HBM segment cache — route it through "
+                            "io/segcache.py")
+    return failures
+
+
 # The ONE sanctioned artifact emitter: every bench driver's committed
 # JSON routes through telemetry.artifact.make_artifact, which stamps
 # `schema_version` and unconditionally attaches `process_metrics`,
@@ -326,6 +361,8 @@ def main() -> int:
     failures.extend(check_jit_entry_points(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_device_put_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_segment_cache_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_engine_thread_seam(
         os.path.dirname(hyperspace_tpu.__file__)))

@@ -1,0 +1,516 @@
+"""HBM segment cache correctness suite (ISSUE 8).
+
+The acceptance bar: a warm repeat of an index-served query is
+LINK-FREE (`link.h2d.chunks` does not move); version invalidation
+tracks the index log FSM (refresh/optimize/vacuum); K concurrent
+queries over one cold segment trigger exactly ONE decode+H2D
+(single-flight, bit-identical results); a cancellation mid-fill
+releases its byte reservation; eviction is LRU under the byte budget
+and leaks nothing; and the chaos harness stays deadlock-free with
+concurrent fills, cancels, and refreshes.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (Hyperspace, HyperspaceConf, HyperspaceSession,
+                            IndexConfig, telemetry)
+from hyperspace_tpu.exceptions import QueryCancelledError
+from hyperspace_tpu.io import parquet, segcache
+from hyperspace_tpu.io.segcache import SegmentCache, SegmentRef
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.schema import Schema
+
+from chaos import canonical, run_chaos
+
+
+def _counter(name):
+    return telemetry.get_registry().counters_dict().get(name, 0)
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    """A fresh process segment cache per test (and after)."""
+    segcache.set_cache(SegmentCache())
+    yield
+    segcache.set_cache(SegmentCache())
+
+
+@pytest.fixture
+def indexed_env(tmp_path):
+    """A source dir + session/hs over it with an index created, device
+    lane forced."""
+    rng = np.random.default_rng(3)
+    n = 20_000
+    src = tmp_path / "src"
+    src.mkdir()
+    pq.write_table(pa.table({
+        "key": rng.integers(0, 200, n).astype(np.int64),
+        "val": rng.random(n).astype(np.float64),
+    }), str(src / "part-0.parquet"))
+
+    def session(**extra):
+        conf = {"hyperspace.warehouse.dir": str(tmp_path / "wh"),
+                "spark.hyperspace.execution.min.device.rows": "0",
+                "spark.hyperspace.distribution.enabled": "false"}
+        conf.update({k: str(v) for k, v in extra.items()})
+        return HyperspaceSession(HyperspaceConf(conf))
+
+    sess = session()
+    hs = Hyperspace(sess)
+    df = sess.read_parquet(str(src))
+    hs.create_index(df, IndexConfig("seg_idx", ["key"], ["val"]))
+    sess.enable_hyperspace()
+    return sess, hs, df, str(src), session
+
+
+@pytest.fixture
+def plain_parquet(tmp_path):
+    """One parquet file + its Schema, for direct SegmentCache units."""
+    rng = np.random.default_rng(9)
+    path = tmp_path / "plain.parquet"
+    table = pa.table({
+        "a": rng.integers(0, 1000, 5000).astype(np.int64),
+        "b": rng.random(5000).astype(np.float64),
+    })
+    pq.write_table(table, str(path))
+    return str(path), Schema.from_arrow(table.schema), table
+
+
+def _ref(version=0, bucket="all", name="u", root="/idx/u"):
+    return SegmentRef(index_name=name, index_root=root, version=version,
+                      bucket=bucket)
+
+
+# ---------------------------------------------------------------------------
+# The acceptance bar: warm repeat queries are link-free
+# ---------------------------------------------------------------------------
+
+
+def test_warm_repeat_query_is_link_free(indexed_env):
+    sess, hs, df, src, _session = indexed_env
+    q = lambda: df.filter(col("key") == lit(7)).select("val")  # noqa: E731
+    plan = q()._optimized_plan()
+    roots = [p for s in plan.collect_leaves() for p in s.root_paths]
+    assert any("v__=" in p for p in roots), "not index-served"
+    first = q().collect()
+    q().collect()  # settle jit/fusion caches
+    h0 = _counter("link.h2d.chunks")
+    hits0 = _counter("cache.segments.hits")
+    warm = q().collect()
+    assert _counter("link.h2d.chunks") == h0, \
+        "steady-state repeat query crossed the link"
+    assert _counter("cache.segments.hits") > hits0
+    assert canonical(warm).equals(canonical(first))
+
+
+def test_segment_ref_keys_on_committed_version(indexed_env):
+    sess, hs, df, src, _session = indexed_env
+    plan = df.filter(col("key") == lit(7)).select("val")._optimized_plan()
+    scan = next(s for s in plan.collect_leaves() if s.index_name)
+    ref = segcache.segment_ref_for_scan(scan)
+    assert ref is not None
+    assert ref.index_name == "seg_idx"
+    assert ref.version == 0
+    assert os.path.basename(ref.index_root) == "seg_idx"
+    # Source scans (no index_name) are not version-addressable.
+    src_scan = next(s for s in df.plan.collect_leaves())
+    assert segcache.segment_ref_for_scan(src_scan) is None
+
+
+# ---------------------------------------------------------------------------
+# Version invalidation: refresh + optimize + vacuum (the index log FSM)
+# ---------------------------------------------------------------------------
+
+
+def _index_root(sess, name):
+    from hyperspace_tpu.index.path_resolver import PathResolver
+    return PathResolver(sess.conf).get_index_path(name)
+
+
+def _append(src, n=2000, seed=99):
+    rng = np.random.default_rng(seed)
+    pq.write_table(pa.table({
+        "key": rng.integers(0, 200, n).astype(np.int64),
+        "val": rng.random(n).astype(np.float64),
+    }), os.path.join(src, f"part-extra{seed}.parquet"))
+
+
+def test_refresh_invalidates_and_serves_new_version(indexed_env):
+    sess, hs, df, src, _session = indexed_env
+    before = df.filter(col("key") == lit(7)).select("key",
+                                                    "val").collect()
+    assert segcache.get_cache().bytes_held() > 0
+    _append(src, seed=99)
+    hs.refresh_index("seg_idx")
+    # The commit hook dropped the old version's segments.
+    snap = segcache.get_cache().snapshot()
+    assert snap["entries"] == 0, snap
+    df2 = sess.read_parquet(src)  # re-list: appended file included
+    q2 = lambda: df2.filter(col("key") == lit(7)).select("key", "val")  # noqa: E731
+    plan = q2()._optimized_plan()
+    roots = [p for s in plan.collect_leaves() for p in s.root_paths]
+    assert any("v__=1" in p for p in roots), f"not v1-served: {roots}"
+    after = q2().collect()
+    assert after.num_rows > before.num_rows
+    # And the new version's segments are resident + warm-hit now.
+    hits0 = _counter("cache.segments.hits")
+    q2().collect()
+    assert _counter("cache.segments.hits") > hits0
+
+
+def _index_entries(cache):
+    """Count of version-keyed (index) entries resident — path-keyed
+    source-scan entries are invalidated by stamps, not the FSM."""
+    with cache._cv:
+        return sum(1 for e in cache._entries.values()
+                   if e.ref is not None)
+
+
+def test_optimize_and_vacuum_invalidate(indexed_env):
+    sess, hs, df, src, _session = indexed_env
+    cache = segcache.get_cache()
+    df.filter(col("key") == lit(7)).select("val").collect()
+    assert _index_entries(cache) > 0  # v__=0 resident
+    _append(src, seed=7)
+    hs.refresh_index("seg_idx", mode="incremental")
+    assert _index_entries(cache) == 0  # commit of v__=1 dropped v0
+    df2 = sess.read_parquet(src)
+    q2 = lambda: df2.filter(col("key") == lit(7)).select("val")  # noqa: E731
+    q2().collect()
+    assert _index_entries(cache) > 0  # v__=1 resident
+    hs.optimize_index("seg_idx")
+    assert _index_entries(cache) == 0  # commit of v__=2 dropped v1
+    q2().collect()
+    assert _index_entries(cache) > 0  # v__=2 resident
+    # delete + vacuum: every segment of the index leaves HBM.
+    hs.delete_index("seg_idx")
+    assert _index_entries(cache) == 0  # DELETED stable log drops all
+    hs.vacuum_index("seg_idx")
+    assert _index_entries(cache) == 0
+
+
+def test_footprint_size_cache_stamp_invalidation(tmp_path):
+    from hyperspace_tpu.plan import footprint
+
+    path = tmp_path / "f.parquet"
+    t = pa.table({"a": np.arange(100, dtype=np.int64)})
+    pq.write_table(t, str(path))
+    size1 = footprint._file_size(str(path))
+    assert size1 == os.path.getsize(str(path))
+    # Rewrite in place with different content: the stamp changes, so
+    # admission control must see the NEW size, not the cached one.
+    t2 = pa.table({"a": np.arange(50_000, dtype=np.int64)})
+    time.sleep(0.01)  # ensure mtime tick on coarse filesystems
+    pq.write_table(t2, str(path))
+    size2 = footprint._file_size(str(path))
+    assert size2 == os.path.getsize(str(path))
+    assert size2 != size1
+    footprint.invalidate_sizes(str(tmp_path))
+    assert str(path) not in footprint._size_cache
+
+
+def test_invalidate_paths_sweeps_host_caches(tmp_path):
+    path = tmp_path / "h.parquet"
+    pq.write_table(pa.table({"a": np.arange(64, dtype=np.int64)}),
+                   str(path))
+    parquet.read_table([str(path)])
+    assert any(str(path) in k[0] for k in parquet._read_cache)
+    parquet.file_row_counts([str(path)])
+    assert str(path) in parquet._count_cache
+    parquet.invalidate_paths(str(tmp_path))
+    assert not any(str(path) in k[0] for k in parquet._read_cache)
+    assert str(path) not in parquet._count_cache
+
+
+# ---------------------------------------------------------------------------
+# Single-flight: one fill for K waiters, bit-identical results
+# ---------------------------------------------------------------------------
+
+
+def test_single_flight_one_fill_for_k_waiters(plain_parquet, monkeypatch):
+    path, schema, _table = plain_parquet
+    cache = segcache.set_cache(SegmentCache())
+    reads = [0]
+    real_read = parquet.read_table
+
+    def slow_read(paths, columns=None):
+        reads[0] += 1
+        time.sleep(0.05)  # hold the fill open so waiters pile up
+        return real_read(paths, columns=columns)
+
+    monkeypatch.setattr(parquet, "read_table", slow_read)
+    ref = _ref()
+    results = [None] * 6
+    errors = []
+
+    def worker(i):
+        try:
+            results[i] = cache.read([path], ["a", "b"], schema, ref=ref)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    assert reads[0] == 1, f"{reads[0]} fills for 6 concurrent readers"
+    # Bit-identical by construction: every waiter got THE batch.
+    assert all(r is results[0] for r in results)
+    assert cache.snapshot()["fills_in_flight"] == 0
+
+
+def test_failed_fill_does_not_wedge_waiters(plain_parquet, monkeypatch):
+    path, schema, _table = plain_parquet
+    cache = segcache.set_cache(SegmentCache())
+    real_read = parquet.read_table
+    calls = [0]
+
+    def flaky_read(paths, columns=None):
+        calls[0] += 1
+        if calls[0] == 1:
+            time.sleep(0.03)
+            raise OSError("injected fill failure")
+        return real_read(paths, columns=columns)
+
+    monkeypatch.setattr(parquet, "read_table", flaky_read)
+    ref = _ref()
+    outcomes = []
+
+    def worker():
+        try:
+            outcomes.append(cache.read([path], ["a", "b"], schema,
+                                       ref=ref))
+        except OSError as exc:
+            outcomes.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    # The filler got the error; the waiters retried with their own fill
+    # and succeeded — nobody hung, and the cache is healthy.
+    assert any(isinstance(o, OSError) for o in outcomes)
+    assert any(not isinstance(o, OSError) for o in outcomes)
+    assert cache.snapshot()["fills_in_flight"] == 0
+    assert cache.read([path], ["a", "b"], schema, ref=ref) is not None
+
+
+# ---------------------------------------------------------------------------
+# Byte budget: eviction order, reservations, cancellation, leaks
+# ---------------------------------------------------------------------------
+
+
+def _write_sized(tmp_path, name, rows):
+    path = tmp_path / f"{name}.parquet"
+    t = pa.table({"a": np.arange(rows, dtype=np.int64)})
+    pq.write_table(t, str(path))
+    return str(path), Schema.from_arrow(t.schema)
+
+
+def test_byte_budget_eviction_order_under_concurrent_fills(tmp_path):
+    # Each entry is ~8 KB of int64; budget fits two.
+    paths = {}
+    for name in "abcd":
+        paths[name] = _write_sized(tmp_path, name, 1000)
+    budget = 20_000
+    cache = segcache.set_cache(SegmentCache(budget_bytes=budget))
+
+    def fill(name, version):
+        p, schema = paths[name]
+        return cache.read([p], ["a"], schema,
+                          ref=_ref(version=version, name=name,
+                                   root=f"/idx/{name}"))
+
+    threads = [threading.Thread(target=fill, args=(n, i))
+               for i, n in enumerate("abc")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    snap = cache.snapshot()
+    assert snap["bytes_held"] <= budget
+    assert snap["reserved_bytes"] == 0
+    assert _counter("cache.segments.evictions") >= 1
+    # LRU order: touch the survivors deterministically, then overflow —
+    # the LEAST recently used entry must be the victim.
+    fill("a", 0)  # a resident (fill or hit), now MRU among residents
+    hits_a0 = _counter("cache.segments.hits")
+    fill("a", 0)
+    assert _counter("cache.segments.hits") > hits_a0  # a is resident
+    fill("d", 3)  # evicts the LRU entry, which is NOT a
+    hits_a1 = _counter("cache.segments.hits")
+    fill("a", 0)
+    assert _counter("cache.segments.hits") > hits_a1, \
+        "eviction removed the most-recently-used entry"
+
+
+def test_cancellation_mid_fill_releases_reservation(plain_parquet):
+    from hyperspace_tpu.engine.scheduler import Deadline
+
+    path, schema, _table = plain_parquet
+    cache = segcache.set_cache(SegmentCache())
+    deadline = Deadline("q-cancel")
+    deadline.cancel()
+    with telemetry.deadline_scope(deadline):
+        with pytest.raises(QueryCancelledError):
+            cache.read([path], ["a", "b"], schema, ref=_ref())
+    snap = cache.snapshot()
+    assert snap["reserved_bytes"] == 0, "cancelled fill leaked its " \
+        "byte reservation"
+    assert snap["fills_in_flight"] == 0
+    assert snap["entries"] == 0
+    # The key is not wedged: a clean retry fills normally.
+    batch = cache.read([path], ["a", "b"], schema, ref=_ref())
+    assert batch.num_rows == 5000
+
+
+def test_leak_sentinel_on_eviction(tmp_path, leak_sentinel):
+    pa_, schema_a = _write_sized(tmp_path, "x", 2000)
+    pb_, schema_b = _write_sized(tmp_path, "y", 2000)
+    budget = 18_000  # fits ONE ~16 KB entry: every fill evicts the other
+    cache = segcache.set_cache(SegmentCache(budget_bytes=budget))
+    cache.read([pa_], ["a"], schema_a, ref=_ref(name="x", root="/i/x"))
+    cache.read([pb_], ["a"], schema_b, ref=_ref(name="y", root="/i/y"))
+    with leak_sentinel(tolerance=2):
+        for _ in range(4):
+            cache.read([pa_], ["a"], schema_a,
+                       ref=_ref(name="x", root="/i/x"))
+            cache.read([pb_], ["a"], schema_b,
+                       ref=_ref(name="y", root="/i/y"))
+    assert cache.snapshot()["bytes_held"] <= budget
+
+
+def test_pinned_index_survives_byte_pressure(tmp_path):
+    pa_, schema_a = _write_sized(tmp_path, "pinned", 1000)
+    pb_, schema_b = _write_sized(tmp_path, "bulk", 1000)
+    conf = HyperspaceConf({
+        "spark.hyperspace.cache.segments.pin.indexes": "hot_idx",
+    })
+    cache = segcache.set_cache(SegmentCache(budget_bytes=12_000))
+    cache.read([pa_], ["a"], schema_a, conf=conf,
+               ref=_ref(name="hot_idx", root="/i/hot"))
+    assert telemetry.get_registry().gauge("cache.segments.pins").value \
+        == 1
+    for v in range(3):  # pressure: each fill wants the whole budget
+        cache.read([pb_], ["a"], schema_b, conf=conf,
+                   ref=_ref(version=v, name="bulk", root="/i/bulk"))
+    hits0 = _counter("cache.segments.hits")
+    cache.read([pa_], ["a"], schema_a, conf=conf,
+               ref=_ref(name="hot_idx", root="/i/hot"))
+    assert _counter("cache.segments.hits") > hits0, \
+        "pinned segment was evicted by byte pressure"
+    # Invalidation still drops pinned segments (refresh correctness
+    # beats pinning).
+    cache.invalidate_index("/i/hot")
+    assert cache.snapshot()["pinned_entries"] == 0
+
+
+def test_unversioned_scan_stamp_validation(tmp_path):
+    path, schema = _write_sized(tmp_path, "plainsrc", 1000)
+    cache = segcache.set_cache(SegmentCache())
+    b1 = cache.read([path], ["a"], schema)  # no ref: stamp-keyed
+    misses0 = _counter("cache.segments.misses")
+    b2 = cache.read([path], ["a"], schema)
+    assert b2 is b1  # stamped hit
+    time.sleep(0.01)
+    t = pa.table({"a": np.arange(500, dtype=np.int64) * 2})
+    pq.write_table(t, path)
+    b3 = cache.read([path], ["a"], schema)
+    assert b3 is not b1
+    assert b3.num_rows == 500
+    assert _counter("cache.segments.misses") > misses0
+
+
+def test_budget_zero_disables_caching(plain_parquet):
+    path, schema, _table = plain_parquet
+    cache = segcache.set_cache(SegmentCache(budget_bytes=0))
+    b1 = cache.read([path], ["a", "b"], schema, ref=_ref())
+    b2 = cache.read([path], ["a", "b"], schema, ref=_ref())
+    assert b1 is not b2
+    assert cache.snapshot()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Admission-aware coalescing: footprint credit for resident bytes
+# ---------------------------------------------------------------------------
+
+
+def test_footprint_credit_for_resident_segments(indexed_env, monkeypatch):
+    from hyperspace_tpu.engine import scheduler as sched_mod
+    from hyperspace_tpu.engine.scheduler import QueryScheduler
+    from hyperspace_tpu.plan import footprint
+
+    sess, hs, df, src, session = indexed_env
+    # Test-scale data sits under the production footprint floor; lower
+    # it so the credit clamp has headroom to act on.
+    monkeypatch.setattr(footprint, "MIN_FOOTPRINT_BYTES", 1024)
+    sched_mod.set_scheduler(QueryScheduler())
+    try:
+        sess.conf.set("spark.hyperspace.serve.hbm.budget.bytes",
+                      str(512 * 1024 * 1024))
+        q = lambda: df.filter(col("key") == lit(7)).select("val")  # noqa: E731
+        q().collect()  # fills the cache
+        assert segcache.get_cache().bytes_held() > 0
+        credit0 = _counter("serve.footprint_credit_bytes")
+        _, metrics = q().collect(with_metrics=True)
+        assert _counter("serve.footprint_credit_bytes") > credit0
+        assert metrics.events_of("serve", "footprint_credit")
+    finally:
+        sched_mod.set_scheduler(QueryScheduler())
+
+
+# ---------------------------------------------------------------------------
+# Chaos: concurrent fills + cancels + refreshes, segment cache enabled
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_with_concurrent_refresh(indexed_env):
+    sess, hs, df, src, _session = indexed_env
+    filt = df.filter(col("key") == lit(7)).select("key", "val")
+    join_like = df.filter(col("key") < lit(20)).select("key", "val")
+    workload = [("filt", filt), ("range", join_like)]
+    expected = {name: canonical(d.collect())
+                for name, d in workload}
+
+    stop = threading.Event()
+    refresh_errors = []
+
+    def refresher():
+        # Full refreshes of unchanged source data: every commit
+        # invalidates + bumps the served version, but the correct
+        # ANSWER never changes — the oracle stays valid while the
+        # cache churns underneath the queries.
+        while not stop.is_set():
+            try:
+                hs.refresh_index("seg_idx")
+            except Exception as exc:  # OCC conflicts are fine
+                refresh_errors.append(repr(exc))
+            time.sleep(0.01)
+
+    th = threading.Thread(target=refresher, daemon=True)
+    th.start()
+    try:
+        report = run_chaos(
+            workload, expected, clients=6, total_queries=90,
+            timeout_for=lambda i: 0.002 if i % 9 == 4 else None)
+    finally:
+        stop.set()
+        th.join(timeout=30)
+    assert not report.stuck_threads, report.summary()
+    assert not report.mismatches, report.mismatches[:3]
+    assert report.outcomes["ok"] >= 1
+    assert report.outcomes["error"] == 0, report.errors[:3]
+    snap = segcache.get_cache().snapshot()
+    assert snap["reserved_bytes"] == 0
+    assert snap["fills_in_flight"] == 0

@@ -204,18 +204,21 @@ def test_promote_cache_hit_miss_series(promote_cache):
 
 
 def test_parquet_device_cache_series(sales_env):
+    """The device read lane is the HBM segment cache (`io/segcache.py`)
+    — repeat device scans hit it and report the `cache.segments.*`
+    series."""
     session, fact_dir = sales_env
     sess = session()
     reg = telemetry.get_registry()
-    miss0 = reg.counter("cache.device_batch.misses").value
-    hits0 = reg.counter("cache.device_batch.hits").value
+    miss0 = reg.counter("cache.segments.misses").value
+    hits0 = reg.counter("cache.segments.hits").value
     q = lambda: sess.read_parquet(fact_dir).select("key")  # noqa: E731
     q().collect()
     q().collect()
-    assert reg.counter("cache.device_batch.misses").value > miss0
-    assert reg.counter("cache.device_batch.hits").value > hits0
-    assert reg.gauge("cache.device_batch.bytes_held").value > 0
-    assert reg.gauge("cache.device_batch.entries").value >= 1
+    assert reg.counter("cache.segments.misses").value > miss0
+    assert reg.counter("cache.segments.hits").value > hits0
+    assert reg.gauge("cache.segments.bytes_held").value > 0
+    assert reg.gauge("cache.segments.entries").value >= 1
 
 
 # ---------------------------------------------------------------------------
@@ -379,8 +382,8 @@ def test_artifact_section_shape(sales_env):
     section = telemetry.memory.artifact_section()
     assert section["peak_hbm_bytes"] > 0
     assert section["devices"]
-    assert "device_batch" in section["caches"]
-    series = section["caches"]["device_batch"]
+    assert "segments" in section["caches"]
+    series = section["caches"]["segments"]
     assert {"hits", "misses", "evictions", "bytes_held",
             "entries"} <= set(series)
     assert section["compile"].get("traces", 0) >= 1

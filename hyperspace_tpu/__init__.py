@@ -14,7 +14,8 @@ __version__ = "0.1.0"
 from hyperspace_tpu.exceptions import (HyperspaceException,
                                        IndexDataUnavailableError)
 from hyperspace_tpu.config import HyperspaceConf
-from hyperspace_tpu.index.index_config import IndexConfig
+from hyperspace_tpu.index.index_config import (DataSkippingIndexConfig,
+                                               IndexConfig)
 
 _LAZY = {
     "Hyperspace": ("hyperspace_tpu.facade", "Hyperspace"),
@@ -40,6 +41,6 @@ def __getattr__(name):
 
 
 __all__ = ["HyperspaceException", "IndexDataUnavailableError",
-           "HyperspaceConf", "IndexConfig",
+           "HyperspaceConf", "IndexConfig", "DataSkippingIndexConfig",
            "Hyperspace", "HyperspaceSession", "DataFrame", "col", "lit",
            "telemetry", "__version__"]

@@ -45,6 +45,12 @@ class OptimizeAction(CreateActionBase):
 
     def validate(self) -> None:
         self._recover_stale_writer()
+        from hyperspace_tpu.index.log_entry import DataSkippingIndex
+        if isinstance(self.previous_entry.derived_dataset,
+                      DataSkippingIndex):
+            raise HyperspaceException(
+                "Optimize does not apply to data-skipping indexes: "
+                "there are no incremental delta runs to compact.")
         if self.previous_entry.state != States.ACTIVE:
             raise HyperspaceException(
                 f"Optimize is only supported in {States.ACTIVE} state; "

@@ -52,9 +52,19 @@ class RefreshAction(CreateActionBase):
         return self._df
 
     @property
-    def index_config(self) -> IndexConfig:
-        """Reuse the stored config (reference `RefreshAction.scala:52-55`)."""
+    def index_config(self):
+        """Reuse the stored config (reference `RefreshAction.scala:52-55`).
+        The config TYPE follows the previous entry's kind — refreshing a
+        DataSkippingIndex re-runs the sketch build through this same
+        FSM action (per-file sketches make a full re-sketch cheap)."""
         prev = self.previous_entry
+        from hyperspace_tpu.index.log_entry import DataSkippingIndex
+        if isinstance(prev.derived_dataset, DataSkippingIndex):
+            from hyperspace_tpu.index.index_config import (
+                DataSkippingIndexConfig)
+            dd = prev.derived_dataset
+            return DataSkippingIndexConfig(prev.name, dd.skipped_columns,
+                                           dd.sketch_types, dd.zorder_by)
         return IndexConfig(prev.name, prev.indexed_columns, prev.included_columns)
 
     def num_buckets(self) -> int:
@@ -77,15 +87,36 @@ class RefreshAction(CreateActionBase):
                 f"Refresh is only supported in {States.ACTIVE} state; "
                 f"current state is {self.previous_entry.state}.")
 
+    def _is_skipping(self) -> bool:
+        from hyperspace_tpu.index.index_config import DataSkippingIndexConfig
+        return isinstance(self.index_config, DataSkippingIndexConfig)
+
     def log_entry(self) -> IndexLogEntry:
         if self._entry is None:
-            self._entry = self.get_index_log_entry(
-                self.df, self.index_config, self.index_data_path)
+            if self._is_skipping():
+                from hyperspace_tpu.actions.skipping import skipping_log_entry
+                self._entry = skipping_log_entry(
+                    self.df, self.index_config, self.index_data_path,
+                    self._signature_provider())
+            else:
+                self._entry = self.get_index_log_entry(
+                    self.df, self.index_config, self.index_data_path)
         return IndexLogEntry.from_dict(self._entry.to_dict())
 
     def op(self) -> None:
         """Reference `RefreshAction.scala:72-77` — rebuild into the next
         version dir; the old dir is retained for in-flight readers."""
+        if self._is_skipping():
+            from hyperspace_tpu.actions.skipping import (
+                build_skipping_data, sweep_source_caches)
+            detail = build_skipping_data(self.df, self.index_config,
+                                         self.index_data_path, self.conf)
+            self.annotate_report(**detail)
+            self.commit_data_version()
+            self.annotate_report(
+                source_roots_swept=sweep_source_caches(self.df))
+            self.stamp_stats()
+            return
         self.write(self.df, self.index_config, self.index_data_path)
         self.commit_data_version()
         self.stamp_stats()

@@ -127,6 +127,11 @@ class RefreshIncrementalAction(RefreshAction):
 
     def validate(self) -> None:
         super().validate()
+        if self._is_skipping():
+            raise HyperspaceException(
+                "Incremental refresh does not apply to data-skipping "
+                "indexes; use mode='full' — per-file sketches make a "
+                "full re-sketch cheap.")
         self.source_delta()  # raises on un-servable deltas
         if self.lineage_enabled():
             return  # classify_current verified every survivor per file

@@ -266,22 +266,35 @@ class ScanExec(PhysicalNode):
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         return self._guard_index_read(lambda: self._execute(bucket))
 
+    def _per_bucket_files(self) -> dict:
+        """{bucket id: files} for this scan. A plan-time-PINNED scan
+        (snapshot isolation: `Rule.index_scan` resolved the committed
+        version's listing once) and an explicit-file-list scan derive
+        the map from that frozen listing — execution performs NO
+        directory re-listing, so a writer racing the query between plan
+        and scan cannot change what is read. Unpinned scans keep the
+        live per-root listing."""
+        if self.scan.pinned_version is not None \
+                or self.scan._explicit_files:
+            return parquet.bucket_map(self.scan.files())
+        out: dict = {}
+        for root in self.scan.root_paths:
+            for b, fs in parquet.bucket_files(root).items():
+                out.setdefault(b, []).extend(fs)
+        return out
+
     def _execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         files_total: Optional[int] = None
         if bucket is not None:
             if self.scan.bucket_spec is None:
                 raise HyperspaceException("Bucket read on unbucketed scan.")
-            files: List[str] = []
-            for root in self.scan.root_paths:
-                files.extend(parquet.bucket_files(root).get(bucket, []))
+            files: List[str] = self._per_bucket_files().get(bucket, [])
         elif self.allowed_buckets is not None and self.scan.bucket_spec:
             files = []
-            files_total = 0
-            for root in self.scan.root_paths:
-                per_bucket = parquet.bucket_files(root)
-                files_total += sum(len(v) for v in per_bucket.values())
-                for b in sorted(self.allowed_buckets):
-                    files.extend(per_bucket.get(b, []))
+            per_bucket = self._per_bucket_files()
+            files_total = sum(len(v) for v in per_bucket.values())
+            for b in sorted(self.allowed_buckets):
+                files.extend(per_bucket.get(b, []))
         else:
             files = self.scan.files()
             files_total = len(files)
@@ -330,15 +343,14 @@ class ScanExec(PhysicalNode):
             raise HyperspaceException("Bucketed read on unbucketed scan.")
         per_bucket = {}
         files_total = 0
-        for root in self.scan.root_paths:
-            for b, files in parquet.bucket_files(root).items():
-                files_total += len(files)
-                if (self.allowed_buckets is not None
-                        and b not in self.allowed_buckets):
-                    # Pruned by the filter above: no row in this bucket can
-                    # survive it, so an empty bucket is equivalent.
-                    continue
-                per_bucket.setdefault(b, []).extend(files)
+        for b, files in self._per_bucket_files().items():
+            files_total += len(files)
+            if (self.allowed_buckets is not None
+                    and b not in self.allowed_buckets):
+                # Pruned by the filter above: no row in this bucket can
+                # survive it, so an empty bucket is equivalent.
+                continue
+            per_bucket.setdefault(b, []).extend(files)
         # ONE ordered concurrent read of all bucket files; per-bucket
         # lengths come from parquet footers (no data read).
         ordered = [(b, f) for b in range(num_buckets)

@@ -622,6 +622,22 @@ class QueryScheduler:
                     plan = (session.optimize(df.plan)
                             if session is not None else df.plan)
                     if plan is not df.plan:
+                        # Admission charged the UNOPTIMIZED plan. The
+                        # rewritten plan may read strictly fewer bytes —
+                        # a covering index's narrower data, or a
+                        # sketch-pruned scan's surviving files — so
+                        # re-project and credit the difference:
+                        # admission control charges only what the plan
+                        # will actually stage.
+                        opt_fp = _footprint.projected_bytes(plan)
+                        if opt_fp < ent.footprint:
+                            reproj = self._credit(ent,
+                                                  ent.footprint - opt_fp)
+                            if reproj:
+                                metrics.event("serve",
+                                              "footprint_reprojected",
+                                              query_id=query_id,
+                                              credited_bytes=reproj)
                         # Already-resident index segments are bytes this
                         # query will never stage: credit them back so
                         # queued queries coalesce onto the warm cache.

@@ -142,6 +142,94 @@ class CoveringIndex:
             num_buckets=int(p["numBuckets"]),
             kind=d.get("kind", "CoveringIndex"))
 
+    @classmethod
+    def _serde_sample(cls) -> "CoveringIndex":
+        """A representative instance for the serde round-trip lint
+        (`scripts/check_metrics_coverage.py::check_index_kind_serde`)."""
+        return cls(["a"], ["b", "c"], "[]", 8)
+
+
+@dataclass
+class DataSkippingIndex:
+    """Derived-dataset spec of a DATA-SKIPPING index (extension; the
+    covering index's lightweight sibling — SURVEY §1's "hybrid scan +
+    incremental refresh" ecosystem). The index data is a compact
+    per-source-file sketch blob (min/max zone maps + blocked bloom
+    filters, `index/sketch.py`), not a copy of the rows; `zorder_by`
+    non-empty means the build ALSO wrote a Z-order-clustered rewrite of
+    the source under the index root, which the filter rule can serve
+    pruned reads from (`schema_json` then carries the full source
+    schema; otherwise just the sketched columns)."""
+
+    skipped_columns: List[str]
+    sketch_types: List[str]
+    schema_json: str
+    zorder_by: List[str] = field(default_factory=list)
+
+    kind: str = "DataSkippingIndex"
+
+    # Catalog/summary surface shared with CoveringIndex (the manager's
+    # IndexSummary rows read these off any derived dataset).
+    @property
+    def indexed_columns(self) -> List[str]:
+        return list(self.skipped_columns)
+
+    @property
+    def included_columns(self) -> List[str]:
+        return []
+
+    @property
+    def num_buckets(self) -> int:
+        return 0  # sketch blobs are not bucketed
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "properties": {
+                "columns": {"skipped": list(self.skipped_columns)},
+                "sketchTypes": list(self.sketch_types),
+                "zOrderBy": list(self.zorder_by),
+                "schemaString": self.schema_json,
+            },
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "DataSkippingIndex":
+        p = d["properties"]
+        return DataSkippingIndex(
+            skipped_columns=list(p["columns"]["skipped"]),
+            sketch_types=list(p.get("sketchTypes", [])),
+            schema_json=p["schemaString"],
+            zorder_by=list(p.get("zOrderBy", [])),
+            kind=d.get("kind", "DataSkippingIndex"))
+
+    @classmethod
+    def _serde_sample(cls) -> "DataSkippingIndex":
+        """A representative instance for the serde round-trip lint
+        (`scripts/check_metrics_coverage.py::check_index_kind_serde`)."""
+        return cls(["a", "b"], ["zonemap", "bloom"], "[]", ["a", "b"])
+
+
+# THE index-kind serde registry: `IndexLogEntry.from_dict` dispatches the
+# `derivedDataset.kind` field through it, so a second index kind flows
+# through the same log/action FSM as the covering index. Every class here
+# must round-trip `from_dict(x.to_dict()) == x` and provide a
+# `_serde_sample()` — `scripts/check_metrics_coverage.py` fails any
+# index-kind class in this module that is missing from the registry or
+# whose round-trip breaks.
+DERIVED_DATASET_KINDS: Dict[str, Any] = {
+    "CoveringIndex": CoveringIndex,
+    "DataSkippingIndex": DataSkippingIndex,
+}
+
+
+def derived_dataset_from_dict(d: dict):
+    kind = d.get("kind", "CoveringIndex")
+    cls = DERIVED_DATASET_KINDS.get(kind)
+    if cls is None:
+        raise HyperspaceException(f"Unknown derived-dataset kind: {kind}")
+    return cls.from_dict(d)
+
 
 @dataclass
 class Signature:
@@ -279,17 +367,25 @@ class LogEntry:
 class IndexLogEntry(LogEntry):
     """The on-disk index spec (reference `index/IndexLogEntry.scala:80-125`)."""
 
-    def __init__(self, name: str, derived_dataset: CoveringIndex,
+    def __init__(self, name: str, derived_dataset,
                  content: Content, source: Source,
                  extra: Optional[Dict[str, Any]] = None):
         super().__init__()
         self.name = name
+        # Any registered index kind (DERIVED_DATASET_KINDS): CoveringIndex
+        # or DataSkippingIndex.
         self.derived_dataset = derived_dataset
         self.content = content
         self.source = source
         self.extra: Dict[str, Any] = dict(extra or {})
 
     # Helpers (reference `IndexLogEntry.scala:96-124`).
+
+    @property
+    def kind(self) -> str:
+        """The derived dataset's kind string — what the rewrite rules
+        discriminate on ("CoveringIndex" / "DataSkippingIndex")."""
+        return self.derived_dataset.kind
 
     @property
     def schema_json(self) -> str:
@@ -380,7 +476,7 @@ class IndexLogEntry(LogEntry):
     def from_dict(d: dict) -> "IndexLogEntry":
         entry = IndexLogEntry(
             name=d["name"],
-            derived_dataset=CoveringIndex.from_dict(d["derivedDataset"]),
+            derived_dataset=derived_dataset_from_dict(d["derivedDataset"]),
             content=Content.from_dict(d["content"]),
             source=Source.from_dict(d["source"]),
             extra=d.get("extra", {}))

@@ -49,6 +49,7 @@ class IndexSummary:
     index_location: str
     query_plan: str
     state: str
+    kind: str = "CoveringIndex"
 
     def to_dict(self) -> dict:
         return {
@@ -60,6 +61,7 @@ class IndexSummary:
             "indexLocation": self.index_location,
             "queryPlan": self.query_plan,
             "state": self.state,
+            "kind": self.kind,
         }
 
 
@@ -148,8 +150,18 @@ class IndexCollectionManager(IndexManager):
         return (self.log_manager_factory.create(path, conf=self.conf),
                 self.data_manager_factory.create(path))
 
-    def create(self, df, index_config: IndexConfig) -> None:
+    def create(self, df, index_config) -> None:
+        """`index_config` selects the index KIND: an `IndexConfig`
+        builds a covering index, a `DataSkippingIndexConfig` builds the
+        sketch-blob skipping kind — both through the same FSM."""
         log_manager, data_manager = self._managers(index_config.index_name)
+        from hyperspace_tpu.index.index_config import DataSkippingIndexConfig
+        if isinstance(index_config, DataSkippingIndexConfig):
+            from hyperspace_tpu.actions.skipping import (
+                CreateSkippingIndexAction)
+            CreateSkippingIndexAction(df, index_config, log_manager,
+                                      data_manager, self.conf).run()
+            return
         CreateAction(df, index_config, log_manager, data_manager, self.conf).run()
 
     def delete(self, index_name: str) -> None:
@@ -222,7 +234,8 @@ class IndexCollectionManager(IndexManager):
                 schema_json=entry.schema_json,
                 index_location=entry.content.root,
                 query_plan=_pretty_plan(entry),
-                state=entry.state))
+                state=entry.state,
+                kind=entry.kind))
         return out
 
     def indexes_df(self):

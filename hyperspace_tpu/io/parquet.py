@@ -445,6 +445,20 @@ def read_bucket_spec(directory: str) -> Optional[BucketSpec]:
     return BucketSpec.from_dict(payload["bucketSpec"])
 
 
+def bucket_map(files: Sequence[str]) -> Dict[int, List[str]]:
+    """Group an EXPLICIT file listing by bucket id (files not carrying
+    the bucket naming pattern are dropped). The snapshot-pinned scan
+    path (`engine/physical.ScanExec._per_bucket_files`) derives bucket
+    maps from its plan-time-frozen listing through this instead of
+    re-listing the directory at execution."""
+    out: Dict[int, List[str]] = {}
+    for path in sorted(files, key=os.path.basename):
+        bucket = bucket_of_file(path)
+        if bucket is not None:
+            out.setdefault(bucket, []).append(path)
+    return out
+
+
 def bucket_files(directory: str) -> Dict[int, List[str]]:
     """Map bucket id -> parquet files in a bucketed data dir (empty buckets
     have no files)."""

@@ -71,7 +71,8 @@ from hyperspace_tpu import constants
 __all__ = ["SegmentCache", "SegmentRef", "get_cache", "set_cache",
            "reset_cache", "clear", "segment_ref_for_scan",
            "on_version_committed", "on_version_deleted",
-           "on_index_dropped", "read_segment", "stats_snapshot"]
+           "on_index_dropped", "invalidate_source_paths", "read_segment",
+           "stats_snapshot"]
 
 # Process-wide default budget (bytes); session conf overrides. The new
 # env var wins; the legacy device-cache env keeps old deployments'
@@ -129,6 +130,12 @@ def segment_ref_for_scan(scan, bucket=None, allowed_buckets=None,
         selector = ("pruned", tuple(sorted(allowed_buckets)))
     else:
         selector = "all"
+    if getattr(scan, "_explicit_files", False):
+        # An explicit file list (sketch-pruned reads) restricts WHICH of
+        # the version's bytes the read covers — two different survivor
+        # sets under one version must not alias one cache entry.
+        selector = ("files", selector,
+                    tuple(os.path.basename(f) for f in scan.files()))
     if bucketed:
         # The bucket-ordered whole-index read (`execute_bucketed`) and
         # the plain read can concatenate the same files in different
@@ -571,6 +578,16 @@ def _invalidate_host_caches(prefix: str) -> None:
     from hyperspace_tpu.plan import footprint
     parquet.invalidate_paths(prefix)
     footprint.invalidate_sizes(prefix)
+
+
+def invalidate_source_paths(prefix: str) -> None:
+    """Sweep the stamped HOST caches + the footprint size cache under a
+    SOURCE data root (not an index root). The skipping-index commit
+    calls this for each source root it sketched
+    (`actions/skipping.sweep_source_caches`): freshly built sketches
+    must be judged against fresh source stamps by the next admission
+    decision and plan-time prune, with no stale-stamp window."""
+    _invalidate_host_caches(prefix)
 
 
 def on_version_committed(index_root: str, version: int) -> None:

@@ -88,9 +88,30 @@ def invalidate_sizes(prefix: str) -> None:
                  if p == prefix or p.startswith(prefix + "/")
                  or p.startswith(prefix + os.sep)]:
         _size_cache.pop(path, None)
+    for key in [k for k in _pinned_bytes_cache
+                if k[0] == prefix or k[0].startswith(prefix + "/")
+                or k[0].startswith(prefix + os.sep)]:
+        _pinned_bytes_cache.pop(key, None)
+
+
+# Per-(root, pinned version) total-bytes memo for VERSION-PINNED index
+# scans: a committed `v__=N` dir is immutable, so its total on-disk
+# size never changes — the footprint re-projection that runs on every
+# optimized plan (scheduler credit) must not re-stat 200 bucket files
+# per collect. Swept by `invalidate_sizes` with everything else;
+# bounded like the per-file cache.
+_pinned_bytes_cache: Dict[Tuple[str, int], int] = {}
 
 
 def _scan_bytes(scan: Scan) -> int:
+    pinned = getattr(scan, "pinned_version", None)
+    pin_key = None
+    if pinned is not None and not getattr(scan, "_explicit_files", False) \
+            and len(scan.root_paths) == 1:
+        pin_key = (scan.root_paths[0], int(pinned))
+        hit = _pinned_bytes_cache.get(pin_key)
+        if hit is not None:
+            return hit
     try:
         files = scan.files()
     except Exception:
@@ -111,6 +132,10 @@ def _scan_bytes(scan: Scan) -> int:
         known = len(files) - unknown
         per = (total // known) if known else DEFAULT_SCAN_BYTES
         total += unknown * per
+    elif pin_key is not None:
+        if len(_pinned_bytes_cache) > 4096:
+            _pinned_bytes_cache.clear()
+        _pinned_bytes_cache[pin_key] = total
     return total
 
 

@@ -120,12 +120,21 @@ class Scan(LogicalPlan):
                  file_format: str = "parquet",
                  bucket_spec: Optional[BucketSpec] = None,
                  files: Optional[Sequence[str]] = None,
-                 index_name: Optional[str] = None):
+                 index_name: Optional[str] = None,
+                 pinned_version: Optional[int] = None):
         from hyperspace_tpu.utils.storage import canonical
         self.root_paths = [canonical(p) for p in root_paths]
         self._schema = schema
         self.file_format = file_format
         self.bucket_spec = bucket_spec
+        # Snapshot pin (set by `Rule.index_scan`): the committed `v__=N`
+        # this plan resolved AT PLAN TIME. A pinned scan's file listing
+        # is resolved once when the pin is taken and never re-listed at
+        # execution, so a maintenance writer racing the query between
+        # plan and scan can neither add files to nor swap the version
+        # this plan reads (the segment cache keys on the same version).
+        # In-process only, like index_name: excluded from to_dict().
+        self.pinned_version = pinned_version
         # Set iff a rewrite rule swapped this scan in over INDEX data
         # (`Rule.index_scan`): the execution-time marker the graceful-
         # degradation path keys on — an index scan whose data is missing

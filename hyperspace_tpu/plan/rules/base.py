@@ -17,6 +17,22 @@ logger = logging.getLogger(__name__)
 _layout_hash_memo: dict = {}
 
 
+def _version_of_root(root: str):
+    """Committed `v__=N` parsed from an index data root, or None for a
+    root that is not a version dir (fabricated/test entries). Entries
+    only reach ACTIVE after their version committed (the `_committed`
+    marker is the build's last data write), so a parseable version here
+    is a committed one by construction — same invariant
+    `io/segcache.segment_ref_for_scan` rides."""
+    import os
+    import re
+
+    from hyperspace_tpu import constants
+    m = re.search(re.escape(constants.INDEX_VERSION_DIRECTORY_PREFIX)
+                  + r"=(\d+)$", os.path.basename(root.rstrip("/\\")))
+    return int(m.group(1)) if m else None
+
+
 def _layout_hash_current(root: str) -> bool:
     """True when the bucketed layout at `root` was written under the
     CURRENT bucket-hash identity (`io/parquet.BUCKET_HASH_VERSION`).
@@ -67,6 +83,24 @@ class Rule:
         manager = Hyperspace.get_context(self.session).index_collection_manager
         return manager.get_indexes([States.ACTIVE])
 
+    def _covering_indexes(self) -> List[IndexLogEntry]:
+        """ACTIVE COVERING entries — what the scan-replacement candidate
+        loops iterate. With a second index kind in the catalog
+        (DataSkippingIndex), a kind filter here keeps covering-specific
+        surface (first-indexed-column coverage, bucket specs) off
+        entries that have neither."""
+        return [e for e in self._active_indexes()
+                if e.kind == "CoveringIndex"]
+
+    def _skipping_indexes(self) -> List[IndexLogEntry]:
+        """ACTIVE data-skipping entries, Z-order builds first (they can
+        both serve AND prune), then by name for determinism."""
+        entries = [e for e in self._active_indexes()
+                   if e.kind == "DataSkippingIndex"]
+        return sorted(entries,
+                      key=lambda e: (not e.derived_dataset.zorder_by,
+                                     e.name))
+
     def signature_matches(self, entry: IndexLogEntry, plan: LogicalPlan) -> bool:
         """Recompute the plan's signature with the provider recorded in the
         index metadata and compare (reference `FilterIndexRule.scala:155-168`).
@@ -115,8 +149,38 @@ class Rule:
         # data is missing/unreadable at execution time the scan raises
         # IndexDataUnavailableError and the query degrades to the source
         # plan instead of failing (graceful degradation).
-        return Scan([entry.content.root], schema, bucket_spec=bucket_spec,
-                    index_name=entry.name)
+        scan = Scan([entry.content.root], schema, bucket_spec=bucket_spec,
+                    index_name=entry.name,
+                    pinned_version=_version_of_root(entry.content.root))
+        if scan.pinned_version is not None:
+            # Snapshot pin: resolve the committed version's file listing
+            # ONCE, at plan time. Execution (including the bucketed read
+            # paths) consumes this listing instead of re-listing the
+            # directory, so a refresh committing v__=N+1 — or any writer
+            # touching the dir — between plan and scan cannot change
+            # what this plan reads; the segment cache pins the same
+            # version by keying on it. Version dirs are FLAT by
+            # construction (every writer emits part files at the top
+            # level), so the pin takes one listdir, not the generic
+            # recursive glob — this runs on every optimize of every
+            # index-served query.
+            from hyperspace_tpu.utils import storage
+            root = entry.content.root
+            try:
+                if storage.is_url(root):
+                    names = storage.listdir_names(root)
+                    join = storage.join
+                else:
+                    import os as _os
+                    names = _os.listdir(root) if _os.path.isdir(root) \
+                        else []
+                    join = _os.path.join
+                suffix = "." + scan.file_format
+                scan._files = sorted(join(root, n) for n in names
+                                     if n.endswith(suffix))
+            except Exception:
+                scan.files()  # odd backend: pay the generic listing
+        return scan
 
     @staticmethod
     def lineage_exclusion(deleted_ids):

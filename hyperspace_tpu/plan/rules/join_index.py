@@ -237,7 +237,7 @@ class JoinIndexRule(Rule):
         join_set = {c.lower() for c in join_cols}
         scan = self._base_scan(plan)
         out = []
-        for entry in self._active_indexes():
+        for entry in self._covering_indexes():
             indexed = [c.lower() for c in entry.indexed_columns]
             if set(indexed) != join_set:
                 continue

@@ -154,6 +154,24 @@ def _segment_rows(old: dict, new: dict, threshold: float):
     return rows
 
 
+def _skipping_rows(old: dict, new: dict):
+    """Data-skipping gate row: the `5_data_skipping` rung's
+    `files_pruned` must be > 0 in the NEW artifact (absolute gate, like
+    the warm-H2D rows — the healthy value is never zero: a selective
+    predicate over the clustered bench source must read strictly fewer
+    files than the unindexed plan). Artifacts predating the rung are
+    not gated."""
+    r = (new.get("rungs") or {}).get("5_data_skipping") or {}
+    fp = r.get("files_pruned")
+    if not isinstance(fp, (int, float)):
+        return []
+    old_fp = ((old.get("rungs") or {}).get("5_data_skipping")
+              or {}).get("files_pruned")
+    return [("skipping_files_pruned",
+             float(old_fp) if isinstance(old_fp, (int, float)) else 0.0,
+             float(fp), float(fp), fp <= 0)]
+
+
 def compare_serve(old: dict, new: dict, threshold: float):
     """Serving-artifact gate rows (same row shape as `compare`):
     scaling ratio + QPS drop >threshold, p50/p99 growth >threshold,
@@ -220,6 +238,7 @@ def compare(old: dict, new: dict, threshold: float):
     add("rung1_link_share", _rung1_link_share(old),
         _rung1_link_share(new), lower_is_better=True)
     rows.extend(_segment_rows(old, new, threshold))
+    rows.extend(_skipping_rows(old, new))
     return rows
 
 

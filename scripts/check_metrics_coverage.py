@@ -258,6 +258,94 @@ def check_serving_error_counters():
     return failures
 
 
+# Index-kind serde registry: every derived-dataset index kind must be
+# registered in `log_entry.DERIVED_DATASET_KINDS` (so IndexLogEntry
+# serde can dispatch it through the log FSM) and must round-trip
+# `from_dict(x.to_dict()) == x` on its declared `_serde_sample()`. A
+# new index-kind class that ships without registration would serialize
+# through `begin()` and then be UNREADABLE by every later action and
+# rule — this lint makes that a build failure, not a corrupt catalog.
+def check_index_kind_serde():
+    from hyperspace_tpu.index import log_entry
+
+    failures = []
+    registry = log_entry.DERIVED_DATASET_KINDS
+    registered = {cls for cls in registry.values()}
+    for name, obj in sorted(vars(log_entry).items()):
+        if not isinstance(obj, type):
+            continue
+        kind = getattr(obj, "kind", None)
+        if not isinstance(kind, str) or not kind.endswith("Index"):
+            continue
+        if obj not in registered:
+            failures.append(
+                f"index.log_entry.{name}: index-kind class (kind="
+                f"{kind!r}) missing from DERIVED_DATASET_KINDS — "
+                "IndexLogEntry serde cannot dispatch it")
+            continue
+        if registry.get(kind) is not obj:
+            failures.append(
+                f"index.log_entry.{name}: registered under a kind "
+                f"string that is not its own ({kind!r})")
+    for kind, cls in sorted(registry.items()):
+        sample_fn = getattr(cls, "_serde_sample", None)
+        if sample_fn is None:
+            failures.append(
+                f"{cls.__name__}: registered index kind lacks "
+                "_serde_sample() — the serde round-trip cannot be "
+                "proven")
+            continue
+        try:
+            sample = sample_fn()
+            d = sample.to_dict()
+            back = log_entry.derived_dataset_from_dict(d)
+            if back.to_dict() != d:
+                failures.append(
+                    f"{cls.__name__}: serde round-trip is lossy "
+                    "(from_dict(to_dict(x)).to_dict() != to_dict(x))")
+        except Exception as exc:
+            failures.append(
+                f"{cls.__name__}: serde round-trip raised {exc!r}")
+    return failures
+
+
+# The ONE sanctioned sketch-consultation point: data-skipping pruning
+# decisions live in the rules module (`plan/rules/skipping.py` calls
+# into the blob loader `index/sketch.py`). A `load_sketches(...)` or
+# `prune_files(...)` call anywhere else is a pruning decision the
+# optimizer cannot see, the telemetry cannot attribute, and the
+# no-false-negative property test does not cover.
+_RAW_SKETCH_RE = re.compile(r"\bload_sketches\s*\(|\bprune_files\s*\(")
+_SKETCH_ALLOWED = (os.path.join("index", "sketch.py"),)
+_SKETCH_ALLOWED_DIR = os.path.join("plan", "rules")
+
+
+def check_sketch_seam(package_dir: str):
+    """Source lint: no sketch-consulting calls outside plan/rules/ and
+    the blob-IO module."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel in _SKETCH_ALLOWED \
+                    or rel.startswith(_SKETCH_ALLOWED_DIR + os.sep):
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_SKETCH_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: sketch-"
+                            "consulting call outside the rules module — "
+                            "pruning decisions belong in "
+                            "plan/rules/skipping.py")
+    return failures
+
+
 # The ONE sanctioned backoff point: every storage retry routes through
 # the policy in utils/retry.py (typed classification, conf-driven
 # backoff, io.retries/io.giveups counters, fault-injection coverage).
@@ -367,6 +455,9 @@ def main() -> int:
     failures.extend(check_engine_thread_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_serving_error_counters())
+    failures.extend(check_index_kind_serde())
+    failures.extend(check_sketch_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_retry_seams(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_bench_artifact_seam(

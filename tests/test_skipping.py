@@ -1,0 +1,754 @@
+"""Data-skipping index subsystem: config, serde through the log FSM,
+plan-time pruning (zones + blooms, conjunction-aware), rule interplay
+with the covering index, degradation on corrupt/missing sketch blobs,
+the Z-order build option, snapshot-pinned reads, the commit-time
+source-cache sweep, and the no-false-negative property."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import telemetry
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.facade import Hyperspace
+from hyperspace_tpu.index.index_config import (DataSkippingIndexConfig,
+                                               IndexConfig)
+from hyperspace_tpu.index.log_entry import (DataSkippingIndex,
+                                            IndexLogEntry, LogEntry)
+from hyperspace_tpu.index.sketch import (SKETCH_BLOB, clear_sketch_cache,
+                                         load_sketches)
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.nodes import Scan
+
+
+def _reg(name):
+    return telemetry.get_registry().counter(name).value
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sketch_cache():
+    clear_sketch_cache()
+    yield
+    clear_sketch_cache()
+
+
+@pytest.fixture
+def env(tmp_path):
+    """(session, hs, df, src_dir): an 8-file source whose files hold
+    disjoint key ranges — zones are tight, so selective predicates can
+    refute whole files."""
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(7)
+    for i in range(8):
+        t = pa.table({
+            "key": np.arange(i * 100, (i + 1) * 100, dtype=np.int64),
+            "val": rng.random(100),
+            "s": pa.array([f"s{i}_{j % 10}" for j in range(100)]),
+        })
+        pq.write_table(t, str(src / f"f{i}.parquet"))
+    sess = HyperspaceSession(HyperspaceConf(
+        {"hyperspace.warehouse.dir": str(tmp_path / "wh")}))
+    hs = Hyperspace(sess)
+    return sess, hs, sess.read_parquet(str(src)), str(src)
+
+
+def _sorted(table):
+    return table.sort_by([(n, "ascending") for n in table.column_names])
+
+
+def _collect_both(sess, q_df):
+    """(rules-on table, rules-off table, on-run metrics)."""
+    sess.enable_hyperspace()
+    try:
+        on, metrics = q_df.collect(with_metrics=True)
+    finally:
+        sess.disable_hyperspace()
+    off = q_df.collect()
+    return on, off, metrics
+
+
+# -- config + serde --------------------------------------------------------
+
+
+def test_config_validation():
+    with pytest.raises(HyperspaceException):
+        DataSkippingIndexConfig("", ["a"])
+    with pytest.raises(HyperspaceException):
+        DataSkippingIndexConfig("x", [])
+    with pytest.raises(HyperspaceException):
+        DataSkippingIndexConfig("x", ["a", "A"])
+    with pytest.raises(HyperspaceException):
+        DataSkippingIndexConfig("x", ["a"], sketch_types=["zonemap", "hll"])
+    with pytest.raises(HyperspaceException):
+        DataSkippingIndexConfig("x", ["a"], zorder_by=["b", "B"])
+    cfg = (DataSkippingIndexConfig.builder().index_name("x")
+           .skip_by("a", "b").sketches("zonemap").zorder_by("a").create())
+    assert cfg == DataSkippingIndexConfig("X", ["a", "b"], ["zonemap"],
+                                          ["a"])
+    assert cfg != DataSkippingIndexConfig("X", ["a", "b"])
+
+
+def test_log_entry_serde_round_trip(env):
+    """A DataSkippingIndex entry written through the real log manager
+    reads back equal — the second index kind flows through the SAME
+    LogEntry serde as the covering index."""
+    sess, hs, df, _src = env
+    hs.create_index(df, DataSkippingIndexConfig("skA", ["key", "s"],
+                                                zorder_by=["key"]))
+    manager = Hyperspace.get_context(sess).index_collection_manager
+    (entry,) = manager.get_indexes(["ACTIVE"])
+    assert entry.kind == "DataSkippingIndex"
+    back = LogEntry.from_json(entry.to_json())
+    assert isinstance(back, IndexLogEntry)
+    assert isinstance(back.derived_dataset, DataSkippingIndex)
+    assert back == entry
+    assert back.derived_dataset.skipped_columns == ["key", "s"]
+    assert back.derived_dataset.zorder_by == ["key"]
+    # Catalog surface shared with the covering kind.
+    cat = hs.indexes()
+    assert list(cat["kind"]) == ["DataSkippingIndex"]
+    assert list(cat["state"]) == ["ACTIVE"]
+
+
+# -- pruning end to end ----------------------------------------------------
+
+
+def test_prune_eq_bit_identical_with_counters(env):
+    sess, hs, df, src = env
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key", "s"]))
+    q = df.filter(col("key") == lit(250)).select("key", "val")
+    pruned0 = _reg("skipping.files_pruned")
+    on, off, metrics = _collect_both(sess, q)
+    assert _sorted(on).equals(_sorted(off))
+    assert on.num_rows == 1
+    # 7 of 8 files refuted; the per-query counters and the process
+    # counters agree; the usage record carries the prune detail.
+    assert metrics.counters.get("skipping.files_pruned") == 7
+    assert metrics.counters.get("skipping.bytes_pruned", 0) > 0
+    assert _reg("skipping.files_pruned") - pruned0 >= 7
+    (use,) = [u for u in metrics.index_usage()
+              if u.get("side") == "skipping"]
+    assert use["name"] == "sk" and use["files_pruned"] == 7
+    assert use["files_considered"] == 8 and use["served"] == "source"
+    assert use["files_scanned"] == 1
+
+
+def test_prune_range_in_null_and_string(env):
+    sess, hs, df, _src = env
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key", "s"]))
+    cases = [
+        (col("key") > lit(699)) & (col("key") <= lit(750)),
+        col("key").isin(5, 105, 710),
+        col("s") == lit("s3_4"),          # bloom + string zones
+        col("key").between(199, 202),
+        col("s").is_null(),               # no nulls anywhere: all refuted
+    ]
+    for cond in cases:
+        q = df.filter(cond).select("key", "val", "s")
+        on, off, metrics = _collect_both(sess, q)
+        assert _sorted(on).equals(_sorted(off)), repr(cond)
+        assert metrics.counters.get("skipping.files_pruned", 0) > 0, \
+            repr(cond)
+
+
+def test_conjunction_prunes_more_than_either(env):
+    sess, hs, df, _src = env
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key", "s"]))
+    sess.enable_hyperspace()
+    try:
+        _, m_and = df.filter((col("key") < lit(100))
+                             & (col("s") == lit("s3_0"))) \
+            .select("key").collect(with_metrics=True)
+    finally:
+        sess.disable_hyperspace()
+    # key<100 alone refutes 7; s=='s3_0' alone refutes 7 (other files'
+    # dictionaries miss it); together every file is refuted.
+    assert m_and.counters.get("skipping.files_pruned") == 8
+
+
+def test_covering_index_wins_when_both_apply(env):
+    sess, hs, df, _src = env
+    hs.create_index(df, IndexConfig("cov", ["key"], ["val"]))
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    sess.enable_hyperspace()
+    try:
+        plan = df.filter(col("key") == lit(250)).select("key", "val") \
+            ._optimized_plan()
+    finally:
+        sess.disable_hyperspace()
+    (leaf,) = plan.collect_leaves()
+    assert leaf.index_name == "cov"
+    assert "cov" in leaf.root_paths[0] and "v__=" in leaf.root_paths[0]
+
+
+def test_no_prune_no_rewrite(env):
+    """A predicate the sketches cannot refute anywhere leaves the plan
+    untouched (no churn rewrite to an identical explicit listing)."""
+    sess, hs, df, src = env
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    sess.enable_hyperspace()
+    try:
+        plan = df.filter(col("val") < lit(2.0)).select("key") \
+            ._optimized_plan()  # val is unsketched; nothing refutable
+    finally:
+        sess.disable_hyperspace()
+    (leaf,) = plan.collect_leaves()
+    assert not leaf._explicit_files
+    assert leaf.root_paths == [src]
+
+
+def test_skipping_disabled_conf(env):
+    sess, hs, df, _src = env
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    sess.conf.set("spark.hyperspace.index.skipping.enabled", "false")
+    sess.enable_hyperspace()
+    try:
+        _, metrics = df.filter(col("key") == lit(3)).select("key") \
+            .collect(with_metrics=True)
+    finally:
+        sess.disable_hyperspace()
+    assert "skipping.files_pruned" not in metrics.counters
+
+
+def test_corrupt_and_missing_blob_degrade_unpruned(env):
+    sess, hs, df, _src = env
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    manager = Hyperspace.get_context(sess).index_collection_manager
+    (entry,) = manager.get_indexes(["ACTIVE"])
+    blob = os.path.join(entry.content.root, SKETCH_BLOB)
+    q = df.filter(col("key") == lit(250)).select("key", "val")
+    baseline = _sorted(q.collect())
+
+    with open(blob, "wb") as f:
+        f.write(b"not parquet at all")
+    clear_sketch_cache()
+    on, off, metrics = _collect_both(sess, q)
+    assert _sorted(on).equals(baseline) and _sorted(off).equals(baseline)
+    assert "skipping.files_pruned" not in metrics.counters
+
+    os.remove(blob)
+    clear_sketch_cache()
+    on, _off, metrics = _collect_both(sess, q)
+    assert _sorted(on).equals(baseline)
+    assert "skipping.files_pruned" not in metrics.counters
+
+
+def test_rewritten_source_file_not_pruned(env):
+    """Stamp revalidation: a file rewritten after sketching is UNKNOWN
+    — kept — so stale sketches can never drop fresh matching rows."""
+    sess, hs, df, src = env
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    # Rewrite f0 (keys 0..99) to now hold key 777 — its OLD sketch says
+    # max=99 and would refute key==777.
+    t = pa.table({"key": np.array([777], dtype=np.int64),
+                  "val": np.array([0.5]),
+                  "s": pa.array(["zz"])})
+    pq.write_table(t, os.path.join(src, "f0.parquet"))
+    from hyperspace_tpu.io.parquet import clear_read_cache
+    clear_read_cache()
+    df2 = sess.read_parquet(src)
+    q = df2.filter(col("key") == lit(777)).select("key", "val")
+    on, off, _m = _collect_both(sess, q)
+    assert on.num_rows == off.num_rows == 2  # rewritten f0 + original f7
+    assert _sorted(on).equals(_sorted(off))
+
+
+def test_hybrid_remainder_pruned_by_sketches(env):
+    """The covering index's SOURCE-FILE REMAINDER: with hybrid scan on,
+    appended files ride the union — unless a skipping index's sketches
+    refute the predicate for them, in which case the appended branch
+    thins (here: to nothing — no Union in the plan at all)."""
+    from hyperspace_tpu.plan.nodes import Union as UnionNode
+
+    sess, hs, df, src = env
+    hs.create_index(df, IndexConfig("cov", ["key"], ["val"]))
+    # Append a file with a DISJOINT key range, then sketch the grown
+    # source: the appended file has a sketch row that refutes key==250.
+    pq.write_table(pa.table({
+        "key": np.arange(5000, 5100, dtype=np.int64),
+        "val": np.zeros(100), "s": pa.array(["a"] * 100)}),
+        os.path.join(src, "f_app.parquet"))
+    df2 = sess.read_parquet(src)
+    hs.create_index(df2, DataSkippingIndexConfig("sk", ["key"]))
+    sess.conf.set("hyperspace.index.hybridscan.enabled", "true")
+    q = df2.filter(col("key") == lit(250)).select("key", "val")
+    sess.enable_hyperspace()
+    try:
+        plan = q._optimized_plan()
+        on, metrics = q.collect(with_metrics=True)
+    finally:
+        sess.disable_hyperspace()
+    off = q.collect()
+    assert _sorted(on).equals(_sorted(off)) and on.num_rows == 1
+    unions = []
+    plan.transform_up(lambda n: (unions.append(n), n)[1]
+                      if isinstance(n, UnionNode) else n)
+    assert not unions  # appended branch fully pruned away
+    assert any(u.get("served") == "hybrid-remainder"
+               for e in metrics.events_of("rule", "FilterIndexRule")
+               if e.get("action") == "applied"
+               for u in e.get("indexes", []))
+    # The index scan itself still serves the query.
+    assert any(leaf.index_name == "cov"
+               for leaf in plan.collect_leaves())
+
+
+# -- refresh / lifecycle ---------------------------------------------------
+
+
+def test_refresh_resketches_appended_files(env):
+    sess, hs, df, src = env
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    pq.write_table(pa.table({
+        "key": np.arange(800, 900, dtype=np.int64),
+        "val": np.zeros(100), "s": pa.array(["n"] * 100)}),
+        os.path.join(src, "f8.parquet"))
+    df2 = sess.read_parquet(src)
+    q = df2.filter(col("key") == lit(850)).select("key")
+    sess.enable_hyperspace()
+    try:
+        _, m_before = q.collect(with_metrics=True)
+    finally:
+        sess.disable_hyperspace()
+    # The appended file has no sketch row yet: kept, old files pruned.
+    assert m_before.counters.get("skipping.files_pruned") == 8
+    hs.refresh_index("sk")
+    manager = Hyperspace.get_context(sess).index_collection_manager
+    (entry,) = manager.get_indexes(["ACTIVE"])
+    assert entry.content.root.endswith("v__=1")
+    on, off, m_after = _collect_both(sess, q)
+    assert m_after.counters.get("skipping.files_pruned") == 8
+    assert _sorted(on).equals(_sorted(off)) and on.num_rows == 1
+
+
+def test_incremental_refresh_and_optimize_decline(env):
+    sess, hs, df, _src = env
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    with pytest.raises(HyperspaceException, match="full"):
+        hs.refresh_index("sk", mode="incremental")
+    with pytest.raises(HyperspaceException, match="skipping"):
+        hs.optimize_index("sk")
+    assert list(hs.indexes()["state"]) == ["ACTIVE"]
+
+
+def test_lifecycle_round_trip_with_crash_recovery(env, fault_injector):
+    """create -> (injected crash mid-create; recover) -> create ->
+    refresh -> delete -> vacuum through the shared FSM."""
+    from hyperspace_tpu.utils.faults import FaultRule, InjectedCrash
+
+    sess, hs, df, _src = env
+    inj = fault_injector(
+        FaultRule("action.CreateSkippingIndexAction.op", kind="crash"))
+    with pytest.raises(InjectedCrash):
+        hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    assert inj.fired("action.*") == 1
+    from hyperspace_tpu.utils import faults
+    faults.uninstall()
+    assert hs.recover_index("sk") is True  # stranded CREATING unwound
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    # Crash a refresh BETWEEN op and end (data committed, final log
+    # entry never written): recovery unwinds to ACTIVE-at-v0 and the
+    # next refresh skips the orphaned version number.
+    inj2 = fault_injector(FaultRule("action.RefreshAction.end",
+                                    kind="crash"))
+    with pytest.raises(InjectedCrash):
+        hs.refresh_index("sk")
+    assert inj2.fired("action.*") == 1
+    faults.uninstall()
+    assert hs.recover_index("sk") is True
+    hs.refresh_index("sk")
+    q = df.filter(col("key") == lit(5)).select("key")
+    on, off, m = _collect_both(sess, q)
+    assert _sorted(on).equals(_sorted(off))
+    assert m.counters.get("skipping.files_pruned", 0) > 0
+    hs.delete_index("sk")
+    hs.vacuum_index("sk")
+    assert len(hs.indexes()) == 0
+    manager = Hyperspace.get_context(sess).index_collection_manager
+    index_path = manager.path_resolver.get_index_path("sk")
+    assert not any(n.startswith("v__=") for n in os.listdir(index_path))
+
+
+# -- Z-order ---------------------------------------------------------------
+
+
+def _zorder_env(tmp_path, n=4000, files=4):
+    """Source with SHUFFLED keys: per-file zones are full-width, so
+    only the Z-order rewrite can prune."""
+    src = tmp_path / "zsrc"
+    src.mkdir()
+    rng = np.random.default_rng(3)
+    keys = rng.permutation(n).astype(np.int64)
+    k2 = rng.integers(0, 50, n).astype(np.int64)
+    per = n // files
+    for i in range(files):
+        sl = slice(i * per, (i + 1) * per)
+        pq.write_table(pa.table({"key": keys[sl], "k2": k2[sl],
+                                 "val": rng.random(per)}),
+                       str(src / f"f{i}.parquet"))
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "zwh"),
+        "spark.hyperspace.index.skipping.zorder.files": "8"}))
+    return sess, Hyperspace(sess), sess.read_parquet(str(src))
+
+
+def test_zorder_serves_pruned_copy(tmp_path):
+    sess, hs, df = _zorder_env(tmp_path)
+    hs.create_index(df, DataSkippingIndexConfig(
+        "z", ["key", "k2"], zorder_by=["key", "k2"]))
+    q = df.filter((col("key") < lit(400)) & (col("k2") < lit(8))) \
+        .select("key", "k2", "val")
+    sess.enable_hyperspace()
+    try:
+        plan = q._optimized_plan()
+        on, metrics = q.collect(with_metrics=True)
+    finally:
+        sess.disable_hyperspace()
+    off = q.collect()
+    (leaf,) = plan.collect_leaves()
+    assert leaf.index_name == "z" and "v__=0" in leaf.root_paths[0]
+    assert leaf.pinned_version == 0
+    assert leaf._explicit_files and 0 < len(leaf.files()) < 8
+    assert _sorted(on).equals(_sorted(off))
+    (use,) = [u for u in metrics.index_usage()
+              if u.get("side") == "skipping"]
+    assert use["served"] == "zorder-copy" and use["files_pruned"] > 0
+
+
+def test_zorder_requires_signature_match(tmp_path):
+    """Source changed after the Z-order build: the copy no longer
+    represents it — the entry must NOT serve."""
+    sess, hs, df = _zorder_env(tmp_path)
+    hs.create_index(df, DataSkippingIndexConfig(
+        "z", ["key"], zorder_by=["key"]))
+    src = df.plan.root_paths[0]
+    pq.write_table(pa.table({"key": np.array([9999], dtype=np.int64),
+                             "k2": np.array([1], dtype=np.int64),
+                             "val": np.array([0.5])}),
+                   os.path.join(src, "extra.parquet"))
+    df2 = sess.read_parquet(src)
+    q = df2.filter(col("key") == lit(9999)).select("key", "val")
+    on, off, _m = _collect_both(sess, q)
+    assert on.num_rows == 1
+    assert _sorted(on).equals(_sorted(off))
+
+
+def test_zorder_missing_data_degrades_and_trips_breaker(tmp_path):
+    """Copy data deleted out-of-band: execution raises the typed
+    IndexDataUnavailableError, the query falls back to the source plan
+    bit-identically, and repeated failures open the per-index breaker
+    (the PR-4/PR-7 degradation path)."""
+    from hyperspace_tpu.engine import scheduler as sched_mod
+    from hyperspace_tpu.engine.scheduler import QueryScheduler
+
+    sess, hs, df = _zorder_env(tmp_path)
+    sess.conf.set("spark.hyperspace.serve.breaker.failures", "1")
+    hs.create_index(df, DataSkippingIndexConfig(
+        "z", ["key"], zorder_by=["key"]))
+    q = df.filter(col("key") < lit(50)).select("key", "val")
+    baseline = _sorted(q.collect())
+    manager = Hyperspace.get_context(sess).index_collection_manager
+    (entry,) = manager.get_indexes(["ACTIVE"])
+    # Corrupt the copy's row files PRESERVING (size, mtime) — the
+    # stamps still validate, so the rule keeps serving the copy, and
+    # the failure surfaces at SCAN time as the typed error (deleting
+    # the files instead would flunk stamp revalidation and degrade at
+    # plan time — also correct, but not the path under test).
+    from hyperspace_tpu.io.parquet import clear_read_cache
+    sess.enable_hyperspace()
+    try:
+        q._optimized_plan()
+        for name in os.listdir(entry.content.root):
+            if name.endswith(".parquet"):
+                p = os.path.join(entry.content.root, name)
+                st = os.stat(p)
+                with open(p, "wb") as f:
+                    f.write(b"\x00" * st.st_size)
+                os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns))
+        clear_read_cache()
+        sched_mod.set_scheduler(QueryScheduler())
+        try:
+            fb0 = _reg("resilience.fallbacks")
+            t1 = q.collect()
+            assert _reg("resilience.fallbacks") == fb0 + 1
+            sc0 = _reg("resilience.breaker.short_circuits")
+            t2 = q.collect()  # breaker open: straight to source
+            assert _reg("resilience.breaker.short_circuits") == sc0 + 1
+        finally:
+            sched_mod.set_scheduler(QueryScheduler())
+    finally:
+        sess.disable_hyperspace()
+    assert _sorted(t1).equals(baseline) and _sorted(t2).equals(baseline)
+
+
+# -- snapshot-pinned reads -------------------------------------------------
+
+
+def test_snapshot_pin_freezes_listing_against_racing_writer(env):
+    """ROADMAP serving item 1: the committed v__=N is resolved ONCE at
+    plan time and the listing frozen — a file landing in the version
+    dir between plan and execution (a racing/stale writer) is invisible
+    to the already-planned query, and a refresh committing v__=N+1
+    cannot redirect it."""
+    from hyperspace_tpu.engine.executor import execute_plan
+    from hyperspace_tpu.io.columnar import to_arrow
+
+    sess, hs, df, src = env
+    hs.create_index(df, IndexConfig("cov", ["key"], ["val"]))
+    q = df.filter(col("key") > lit(750)).select("key", "val")
+    sess.enable_hyperspace()
+    try:
+        plan = q._optimized_plan()
+    finally:
+        sess.disable_hyperspace()
+    (leaf,) = plan.collect_leaves()
+    assert leaf.index_name == "cov" and leaf.pinned_version == 0
+    before = _sorted(to_arrow(execute_plan(plan, conf=sess.conf)))
+
+    # Concurrent refresher: source grows, refresh commits v__=1 ...
+    pq.write_table(pa.table({
+        "key": np.arange(900, 950, dtype=np.int64),
+        "val": np.zeros(50), "s": pa.array(["r"] * 50)}),
+        os.path.join(src, "f9.parquet"))
+    hs.refresh_index("cov")
+    # ... and a stale/racing writer drops a matching-keyed bucket file
+    # INTO the pinned v__=0 dir (what an unpinned execution-time
+    # re-listing would pick up).
+    foreign = pa.table({"key": np.array([800] * 5, dtype=np.int64),
+                        "val": np.zeros(5)})
+    pq.write_table(foreign, os.path.join(
+        os.path.dirname(leaf.root_paths[0]), "v__=0",
+        "part-99999.parquet"))
+
+    after = _sorted(to_arrow(execute_plan(plan, conf=sess.conf)))
+    assert after.equals(before)  # neither v__=1 nor the foreign file
+
+    # A FRESH plan resolves (and pins) the new committed version.
+    sess.enable_hyperspace()
+    try:
+        plan2 = sess.read_parquet(src).filter(col("key") > lit(750)) \
+            .select("key", "val")._optimized_plan()
+    finally:
+        sess.disable_hyperspace()
+    (leaf2,) = plan2.collect_leaves()
+    assert leaf2.pinned_version == 1
+
+
+# -- admission interplay ---------------------------------------------------
+
+
+def test_commit_sweeps_source_root_caches(env):
+    from hyperspace_tpu.plan import footprint
+
+    sess, hs, df, src = env
+    footprint.projected_bytes(df.plan)  # populate the size cache
+    assert any(p.startswith(src) for p in footprint._size_cache)
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    # Skipping-index commit sweeps SOURCE roots, not just index roots.
+    assert not any(p.startswith(src) for p in footprint._size_cache)
+
+
+def test_footprint_reprojection_credit(env, monkeypatch):
+    from hyperspace_tpu.engine import scheduler as sched_mod
+    from hyperspace_tpu.engine.scheduler import QueryScheduler
+    from hyperspace_tpu.plan import footprint
+
+    sess, hs, df, _src = env
+    monkeypatch.setattr(footprint, "MIN_FOOTPRINT_BYTES", 1024)
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
+    sched_mod.set_scheduler(QueryScheduler())
+    try:
+        sess.enable_hyperspace()
+        try:
+            c0 = _reg("serve.footprint_credit_bytes")
+            _, metrics = df.filter(col("key") == lit(250)).select("key") \
+                .collect(with_metrics=True)
+        finally:
+            sess.disable_hyperspace()
+        assert _reg("serve.footprint_credit_bytes") > c0
+        assert metrics.events_of("serve", "footprint_reprojected")
+    finally:
+        sched_mod.set_scheduler(QueryScheduler())
+
+
+# -- kernels ---------------------------------------------------------------
+
+
+def test_host_device_sketch_identity():
+    """Host and device lanes must produce bit-identical blooms and
+    equal zones — the blob a query probes must not depend on which lane
+    built it."""
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops import sketch as ops_sketch
+    from hyperspace_tpu.plan.schema import Schema
+
+    t = pa.table({
+        "a": pa.array([1, 5, None, 7, 5, -3], type=pa.int64()),
+        "s": pa.array(["x", "y", None, "zz", "x", ""]),
+        "f": pa.array([1.5, float("nan"), None, -0.0, 2.5, -9.75],
+                      type=pa.float64()),
+        "g": pa.array(np.arange(6, dtype=np.float32)),
+        "b": pa.array([True, False, None, True, True, False]),
+    })
+    schema = Schema.from_arrow(t.schema)
+    bh = columnar.from_arrow(t, schema, device=False)
+    bd = columnar.from_arrow(t, schema, device=True)
+    for name in t.column_names:
+        zh = ops_sketch.zones(bh.column(name))
+        zd = ops_sketch.zones(bd.column(name))
+        assert zh == zd, (name, zh, zd)
+        wh = ops_sketch.bloom_build(bh.column(name), 512)
+        wd = ops_sketch.bloom_build(bd.column(name), 512)
+        assert np.array_equal(wh, wd), name
+
+
+def test_bloom_membership_and_sizing():
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops.sketch import (bloom_build, bloom_maybe_contains,
+                                           bloom_num_bits, probe_hash_pair)
+    from hyperspace_tpu.plan.schema import Schema
+
+    assert bloom_num_bits(1000, 0.01, 64 * 1024) % 256 == 0
+    assert bloom_num_bits(10 ** 9, 0.01, 64 * 1024) == 64 * 1024 * 8
+    values = np.arange(0, 5000, 7, dtype=np.int64)
+    t = pa.table({"k": values})
+    batch = columnar.from_arrow(t, Schema.from_arrow(t.schema),
+                                device=False)
+    words = bloom_build(batch.column("k"),
+                        bloom_num_bits(len(values), 0.01, 64 * 1024))
+    for v in values[::50]:  # members: NEVER a false negative
+        assert bloom_maybe_contains(words, *probe_hash_pair(int(v),
+                                                            "int64"))
+    misses = sum(
+        bloom_maybe_contains(words, *probe_hash_pair(int(v), "int64"))
+        for v in range(1, 5000, 7))  # all non-members
+    assert misses / (5000 // 7) < 0.05  # ~fpp with headroom
+
+
+def test_zorder_permutation_clusters():
+    from hyperspace_tpu.io import columnar
+    from hyperspace_tpu.ops.sketch import zorder_permutation
+    from hyperspace_tpu.plan.schema import Schema
+
+    rng = np.random.default_rng(0)
+    n = 4096
+    t = pa.table({"x": rng.permutation(n).astype(np.int64),
+                  "y": rng.permutation(n).astype(np.int64)})
+    batch = columnar.from_arrow(t, Schema.from_arrow(t.schema),
+                                device=False)
+    perm = zorder_permutation(batch, ["x", "y"])
+    assert sorted(perm) == list(range(n))  # a permutation
+    x = t.column("x").to_numpy()[perm]
+    y = t.column("y").to_numpy()[perm]
+    # Z-order clustering: each quarter of the output spans far less
+    # than the full range in BOTH dimensions on average.
+    spans = []
+    for i in range(4):
+        sl = slice(i * n // 4, (i + 1) * n // 4)
+        spans.append((x[sl].max() - x[sl].min())
+                     * (y[sl].max() - y[sl].min()))
+    assert np.mean(spans) < 0.5 * (n - 1) ** 2
+
+
+# -- the property: pruning never drops a matching row ----------------------
+
+
+def test_property_no_false_negatives(tmp_path):
+    """Randomized predicates over files with nulls, NaNs, negatives,
+    strings, and int32 — every PRUNED file must contain ZERO rows the
+    ENGINE's own predicate compiler marks true."""
+    from hyperspace_tpu.engine.compiler import compile_predicate
+    from hyperspace_tpu.io import columnar, parquet as pio
+    from hyperspace_tpu.plan import expr as E
+    from hyperspace_tpu.plan.rules.skipping import prune_files
+    from hyperspace_tpu.plan.schema import Schema
+
+    rng = np.random.default_rng(42)
+    src = tmp_path / "prop"
+    src.mkdir()
+    n_files, per = 6, 60
+
+    def maybe_null(arr, p=0.15):
+        mask = rng.random(len(arr)) < p
+        return pa.array([None if m else v
+                         for v, m in zip(arr.tolist(), mask)])
+
+    files = []
+    for i in range(n_files):
+        base = rng.integers(-50, 400)
+        i64 = rng.integers(base, base + rng.integers(5, 120),
+                           per).astype(np.int64)
+        f64 = np.where(rng.random(per) < 0.1, np.nan,
+                       rng.normal(base, 30, per))
+        s = [f"v{int(v)}" for v in rng.integers(base, base + 40, per)]
+        i32 = rng.integers(-5, 5, per).astype(np.int32)
+        t = pa.table({
+            "i64": maybe_null(i64),
+            "f64": pa.array(f64, type=pa.float64()),  # NaN, no nulls
+            "s": maybe_null(np.asarray(s, dtype=object), p=0.1),
+            "i32": pa.array(i32, type=pa.int32()),
+        }).cast(pa.schema([("i64", pa.int64()), ("f64", pa.float64()),
+                           ("s", pa.string()), ("i32", pa.int32())]))
+        path = str(src / f"f{i}.parquet")
+        pq.write_table(t, path)
+        files.append(path)
+
+    sess = HyperspaceSession(HyperspaceConf(
+        {"hyperspace.warehouse.dir": str(tmp_path / "pwh")}))
+    hs = Hyperspace(sess)
+    df = sess.read_parquet(str(src))
+    hs.create_index(df, DataSkippingIndexConfig(
+        "prop", ["i64", "f64", "s", "i32"]))
+    manager = Hyperspace.get_context(sess).index_collection_manager
+    (entry,) = manager.get_indexes(["ACTIVE"])
+    sketches = load_sketches(entry.content.root)
+    schema = df.schema
+
+    def leaf():
+        name = rng.choice(["i64", "f64", "s", "i32"])
+        c = E.col(name)
+        kind = rng.choice(["eq", "ne", "lt", "le", "gt", "ge", "in",
+                           "null", "notnull"])
+        if name == "s":
+            vals = [f"v{int(v)}" for v in rng.integers(-60, 460, 3)]
+        elif name == "f64":
+            vals = [float(v) for v in rng.normal(150, 120, 3)]
+        elif name == "i32":
+            vals = [int(v) for v in rng.integers(-6, 6, 3)]
+        else:
+            vals = [int(v) for v in rng.integers(-60, 520, 3)]
+        v = vals[0]
+        return {"eq": c == E.lit(v), "ne": c != E.lit(v),
+                "lt": c < E.lit(v), "le": c <= E.lit(v),
+                "gt": c > E.lit(v), "ge": c >= E.lit(v),
+                "in": c.isin(*vals), "null": c.is_null(),
+                "notnull": c.is_not_null()}[kind]
+
+    def predicate(depth=2):
+        if depth == 0 or rng.random() < 0.4:
+            return leaf()
+        a, b = predicate(depth - 1), predicate(depth - 1)
+        return (a & b) if rng.random() < 0.5 else (a | b)
+
+    batches = {f: columnar.from_arrow(pio.read_table([f]), schema,
+                                      device=False) for f in files}
+    checked = 0
+    for _trial in range(120):
+        cond = predicate()
+        survivors, pruned, _bytes = prune_files(cond, files, sketches)
+        assert sorted(survivors + pruned) == sorted(files)
+        for f in pruned:
+            mask = np.asarray(compile_predicate(cond, batches[f]))
+            assert not mask.any(), (
+                f"false negative: {cond!r} pruned {os.path.basename(f)} "
+                f"which holds {int(mask.sum())} matching row(s)")
+            checked += 1
+    assert checked > 50  # the trials actually pruned files

@@ -105,6 +105,42 @@ def canonical(table):
     return table.sort_by([(n, "ascending") for n in names])
 
 
+def slow_decile_attribution():
+    """The p99 diagnosis the flight recorder exists for (ROADMAP item):
+    pull the slowest DECILE of the ring's completed queries and diff
+    each against the ring's median-wall query with the regression
+    differ, so the committed artifact carries *why* the tail is slow
+    (compute vs link vs compile vs cache vs cancellation), not just
+    that it is. The ring holds the most recent completed queries of the
+    closed loop — the exact population the p99 is computed over."""
+    from hyperspace_tpu.telemetry import diff, flight
+
+    ring = [q for q in flight.get_recorder().queries()
+            if q.wall_s is not None]
+    if len(ring) < 10:
+        return None
+    ring.sort(key=lambda q: q.wall_s)
+    median = ring[len(ring) // 2]
+    median_tree = median.to_dict()
+    out = {
+        "ring_queries": len(ring),
+        "median_wall_s": round(median.wall_s, 5),
+        "queries": [],
+    }
+    for qm in ring[-max(1, len(ring) // 10):]:
+        d = diff.diff_trees(median_tree, qm.to_dict(),
+                            name=qm.description or "query")
+        out["queries"].append({
+            "description": qm.description,
+            "wall_s": round(qm.wall_s, 5),
+            "vs_median": (round(qm.wall_s / median.wall_s, 2)
+                          if median.wall_s else None),
+            "dominant_bucket": d.dominant,
+            "attribution": d.to_dict(),
+        })
+    return out
+
+
 def main():
     from hyperspace_tpu import HyperspaceConf, HyperspaceSession
     from hyperspace_tpu.exceptions import (QueryCancelledError,
@@ -204,6 +240,7 @@ def main():
 
         latencies.sort()
         qps = outcomes["ok"] / loop_wall if loop_wall else 0.0
+        slow_decile = slow_decile_attribution()
         sched = session.scheduler()
         counters = telemetry.get_registry().counters_dict()
         serve_counters = {k: v for k, v in counters.items()
@@ -227,6 +264,7 @@ def main():
             "timeout_rate": round(outcomes["deadline"] / attempted, 5),
             "peak_admitted_bytes": sched.peak_admitted_bytes,
             "counters": serve_counters,
+            "slow_decile": slow_decile,
         }
         log(f"closed loop: {outcomes['ok']}/{attempted} ok in "
             f"{loop_wall:.2f}s = {qps:.1f} QPS "

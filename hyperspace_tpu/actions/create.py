@@ -230,6 +230,18 @@ class CreateActionBase(Action):
             return
         stats = index_data_stats(self._entry.content.root)
         self._entry.extra["stats"] = stats
+        # Born-sharded builds leave a `_shard_layout.json` record next to
+        # the bucket spec (io/builder.write_bucket_ordered); lift it into
+        # the log entry so readers know each device's contiguous bucket
+        # range without touching the data dir (the ISSUE's "recorded in
+        # the index log entry" contract). Single-device builds carry no
+        # layout and the key stays absent.
+        from hyperspace_tpu.io.builder import read_shard_layout
+        layout = read_shard_layout(self._entry.content.root)
+        if layout is not None:
+            self._entry.extra["shardLayout"] = layout
+        else:
+            self._entry.extra.pop("shardLayout", None)
         # The SAME numbers land in the action report: rows/bytes the
         # operation left on disk, measured once.
         self.annotate_report(rows=stats["rowCount"],

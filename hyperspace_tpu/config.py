@@ -105,6 +105,20 @@ class HyperspaceConf:
                             constants.DISTRIBUTION_MIN_ROWS_DEFAULT)
 
     @property
+    def distribution_spmd(self) -> bool:
+        """Born-sharded SPMD execution lane (`parallel/spmd.py`) on/off;
+        off = the legacy per-query-placement mesh path."""
+        return (self.get(constants.DISTRIBUTION_SPMD,
+                         constants.DISTRIBUTION_SPMD_DEFAULT)
+                or "true").lower() == "true"
+
+    @property
+    def distribution_capacity_factor(self) -> float:
+        value = self.get(constants.DISTRIBUTION_CAPACITY_FACTOR)
+        return (float(value) if value is not None
+                else constants.DISTRIBUTION_CAPACITY_FACTOR_DEFAULT)
+
+    @property
     def broadcast_threshold(self) -> int:
         """Join sides estimated under this many bytes broadcast as a
         direct-address table instead of riding Exchange+Sort; <= 0

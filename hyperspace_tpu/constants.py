@@ -196,6 +196,20 @@ DISTRIBUTION_MIN_ROWS_DEFAULT = 4096
 # cross-slice hop over DCN (SURVEY §2.12 "DCN only across slices").
 DISTRIBUTION_DCN_SIZE = "spark.hyperspace.distribution.dcn.size"
 DISTRIBUTION_DCN_SIZE_DEFAULT = 1
+# Born-sharded SPMD execution (`parallel/spmd.py`): bucketed SMJ /
+# scan / aggregate over device-resident bucket-range shards as single
+# jitted programs. "true" (default) uses it whenever the shape
+# qualifies; "false" forces the legacy per-query placement mesh path
+# (the escape hatch if a workload hits an SPMD-lane defect).
+DISTRIBUTION_SPMD = "spark.hyperspace.distribution.spmd.enabled"
+DISTRIBUTION_SPMD_DEFAULT = "true"
+# First-attempt static per-shard output capacity factor of the SPMD
+# join expansion (and the in-program repartition's per-peer slabs):
+# capacity = factor x per-shard input rows, doubled on exact on-device
+# overflow detection. Larger = fewer retries, more HBM per attempt.
+DISTRIBUTION_CAPACITY_FACTOR = \
+    "spark.hyperspace.distribution.capacity.factor"
+DISTRIBUTION_CAPACITY_FACTOR_DEFAULT = 2.0
 
 # XLA profiler integration: when set to a directory, every executed
 # query is captured as a profiler trace under it (one subdirectory per

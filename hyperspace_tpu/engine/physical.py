@@ -126,6 +126,15 @@ class PhysicalNode:
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         raise NotImplementedError
 
+    def execute_sharded(self, num_buckets: int, mesh):
+        """Born-sharded execution (`parallel/spmd.py`): produce this
+        node's output as a device-resident `ShardedBatch` whose shard s
+        holds bucket range s, or None when the shape does not qualify
+        (unbucketed source, string columns, host-lane row counts, hot
+        skew). None is a ROUTING answer, not an error — callers fall
+        back to the general paths. Default: not shardable."""
+        return None
+
     def execute_bucketed(self, num_buckets: int):
         """Produce (batch concat'd in bucket order, per-bucket lengths) for
         the batched bucketed join. Only meaningful on chains over a
@@ -333,6 +342,75 @@ class ScanExec(PhysicalNode):
         return self._guard_index_read(
             lambda: self._execute_bucketed(num_buckets))
 
+    def execute_sharded(self, num_buckets: int, mesh):
+        return self._guard_index_read(
+            lambda: self._execute_sharded(num_buckets, mesh))
+
+    def _execute_sharded(self, num_buckets: int, mesh):
+        """Born-sharded bucket-range read: shard s's bucket range decodes
+        and places onto DEVICE s through the per-device segment cache
+        (per-bucket fill granularity), so each device's HBM holds only
+        its range and a warm read is link-free per device. Returns a
+        ShardedBatch, or None when the read belongs on another lane."""
+        import numpy as np
+
+        from hyperspace_tpu.parallel import spmd
+        from hyperspace_tpu.parallel.mesh import (bucket_ranges,
+                                                  total_shards)
+
+        if self.scan.bucket_spec is None:
+            return None
+        if not spmd.supports_sharded(self.out_schema):
+            return None  # string columns: legacy path (module docstring)
+        per_bucket: dict = {}
+        files_total = 0
+        for b, files in self._per_bucket_files().items():
+            files_total += len(files)
+            if (self.allowed_buckets is not None
+                    and b not in self.allowed_buckets):
+                continue
+            per_bucket.setdefault(b, []).extend(files)
+        ordered = [(b, f) for b in range(num_buckets)
+                   for f in per_bucket.get(b, [])]
+        lengths = np.zeros(num_buckets, dtype=np.int64)
+        counts = parquet.file_row_counts([f for _, f in ordered])
+        for (b, _), c in zip(ordered, counts):
+            lengths[b] += c
+        total = int(lengths.sum())
+        if total == 0:
+            return None
+        mode = self.conf.distribution if self.conf is not None else "auto"
+        if mode == "auto":
+            from hyperspace_tpu.constants import (
+                DISTRIBUTION_MIN_ROWS_DEFAULT, MIN_DEVICE_ROWS_DEFAULT)
+            min_dev = (self.conf.min_device_rows if self.conf is not None
+                       else MIN_DEVICE_ROWS_DEFAULT)
+            min_dist = (self.conf.distribution_min_rows
+                        if self.conf is not None
+                        else DISTRIBUTION_MIN_ROWS_DEFAULT)
+            if total < max(min_dev, min_dist):
+                return None  # host / single-chip lane territory
+        n_shards = total_shards(mesh)
+        if spmd.pad_blowup(lengths, n_shards):
+            # Hot-bucket skew: range padding would blow the [S*C]
+            # layout; the legacy path splits hot buckets instead.
+            telemetry.event("mesh", "sharded-read-declined",
+                            reason="bucket-range skew")
+            return None
+        per_shard_files = [[f for b in range(lo, hi)
+                            for f in per_bucket.get(b, [])]
+                           for lo, hi in bucket_ranges(num_buckets,
+                                                       n_shards)]
+        ref = segcache.segment_ref_for_scan(
+            self.scan, allowed_buckets=self.allowed_buckets,
+            bucketed=True)
+        self._annotate_read([f for _, f in ordered], host=False,
+                            files_total=files_total)
+        return spmd.read_sharded(per_shard_files, lengths, self.columns,
+                                 self.scan.schema, mesh, base_ref=ref,
+                                 conf=self.conf,
+                                 budget=self._budget(device=True))
+
     def _execute_bucketed(self, num_buckets: int):
         """Read all bucket files in bucket order; lengths come from parquet
         metadata — no device work. (The batched join sorts per-bucket ids
@@ -437,6 +515,23 @@ class FilterExec(PhysicalNode):
         (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
         return batch.take(indices), new_lengths
 
+    def execute_sharded(self, num_buckets: int, mesh):
+        """Filter preserves the sharded layout: rows never move, the
+        predicate mask just narrows `row_valid` — each device evaluates
+        its shard, nothing crosses the link, and the downstream join /
+        aggregate skips masked rows exactly as it skips padding. The
+        per-bucket histogram is stale after filtering, so it is dropped
+        (capacity heuristics fall back to the overflow-retry loop)."""
+        sh = self.child.execute_sharded(num_buckets, mesh)
+        if sh is None:
+            return None
+        from hyperspace_tpu.engine.compiler import compile_predicate
+        from hyperspace_tpu.parallel.spmd import ShardedBatch
+        mask = compile_predicate(self.condition, sh.batch)
+        return ShardedBatch(sh.batch, sh.row_valid & mask, sh.mesh,
+                            sh.rows_per_shard, sh.num_buckets,
+                            lengths=None)
+
 
 class ProjectExec(PhysicalNode):
     """Projection over (out_name, source) entries, where source is a plain
@@ -493,6 +588,20 @@ class ProjectExec(PhysicalNode):
     def execute_bucketed(self, num_buckets: int):
         batch, lengths = self.child.execute_bucketed(num_buckets)
         return self._project(batch), lengths
+
+    def execute_sharded(self, num_buckets: int, mesh):
+        """Pure column selection/renaming preserves the sharded layout
+        (same rows, same residency); computed entries evaluate
+        element-wise over the sharded columns, which XLA keeps
+        shard-local."""
+        sh = self.child.execute_sharded(num_buckets, mesh)
+        if sh is None:
+            return None
+        from hyperspace_tpu.parallel.spmd import ShardedBatch
+        projected = self._project(sh.batch)
+        return ShardedBatch(projected, sh.row_valid, sh.mesh,
+                            sh.rows_per_shard, sh.num_buckets,
+                            lengths=sh.lengths)
 
 
 class ExchangeExec(PhysicalNode):
@@ -973,6 +1082,15 @@ class SortMergeJoinExec(PhysicalNode):
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         from hyperspace_tpu.ops.join import sort_merge_join
+        if self.bucketed and bucket is None:
+            # Born-sharded SPMD fast path: both sides resident per
+            # device by bucket range, ONE jitted program for the match +
+            # expansion, no host re-placement and no mid-join sizing
+            # sync. None = some precondition failed; the general paths
+            # below remain fully capable.
+            out = self._try_spmd()
+            if out is not None:
+                return out
         if self.how in ("left_semi", "left_anti"):
             # Membership joins: no expansion, no output from the right —
             # one encode + counting-match membership flags, then a
@@ -1048,6 +1166,53 @@ class SortMergeJoinExec(PhysicalNode):
         return sort_merge_join(lbatch, rbatch, self.left_keys,
                                self.right_keys, how=self.how,
                                columns=self.out_columns)
+
+    def _try_spmd(self) -> Optional[columnar.ColumnBatch]:
+        """The born-sharded SPMD join (`parallel/spmd.py`), or None when
+        any precondition fails: no mesh / bucket count not divisible /
+        either side not shardable (strings, host-lane sizing, skew).
+        Covers every equi-join type the sharded counting match handles;
+        right_outer swaps sides like the legacy path."""
+        from hyperspace_tpu.parallel import spmd
+        from hyperspace_tpu.parallel.context import (distribution_mesh,
+                                                     mesh_size)
+
+        if self.how not in ("inner", "left_outer", "right_outer",
+                            "full_outer", "left_semi", "left_anti"):
+            return None
+        if self.num_buckets <= 0:
+            return None
+        if self.conf is not None and not self.conf.distribution_spmd:
+            return None  # the escape hatch: legacy mesh path only
+        mesh = distribution_mesh(self.conf)
+        if mesh is None or self.num_buckets % mesh_size(mesh) != 0:
+            return None
+        lsh = self.left.execute_sharded(self.num_buckets, mesh)
+        if lsh is None:
+            return None
+        rsh = self.right.execute_sharded(self.num_buckets, mesh)
+        if rsh is None:
+            return None
+        telemetry.annotate(lane="spmd")
+        if self.how in ("left_semi", "left_anti"):
+            idx = spmd.sharded_semi_anti_indices(
+                lsh, rsh, self.left_keys, self.right_keys,
+                anti=self.how == "left_anti")
+            return lsh.batch.take(idx)
+        from hyperspace_tpu.ops.bucketed_join import assemble_join_output
+        factor = (self.conf.distribution_capacity_factor
+                  if self.conf is not None else None)
+        if self.how == "right_outer":
+            ri, li = spmd.sharded_join_indices(
+                rsh, lsh, self.right_keys, self.left_keys,
+                how="left_outer", capacity_factor=factor)
+        else:
+            li, ri = spmd.sharded_join_indices(
+                lsh, rsh, self.left_keys, self.right_keys, how=self.how,
+                capacity_factor=factor)
+        return assemble_join_output(lsh.batch, rsh.batch, li, ri,
+                                    how=self.how,
+                                    columns=self.out_columns)
 
     def _bucketed_inputs(self):
         """Read both sides in bucket order (overlapped IO) and decide the

@@ -409,6 +409,19 @@ class IndexLogEntry(LogEntry):
         return self.derived_dataset.num_buckets
 
     @property
+    def shard_layout(self) -> Optional[Dict[str, Any]]:
+        """The born-sharded layout record of this version's data
+        (extension; `io/builder.write_shard_layout`): `numShards` and
+        the per-shard contiguous `bucketRanges` the build wrote its
+        per-device parquet shards under. None for single-device builds.
+        The SPMD read path re-derives ownership from the SAME map
+        (`parallel/mesh.bucket_ranges`), so this record is provenance —
+        a reader on ANY mesh size can consume the data; a reader on the
+        RECORDED size refills each device exactly its own files."""
+        layout = self.extra.get("shardLayout")
+        return dict(layout) if isinstance(layout, dict) else None
+
+    @property
     def raw_plan(self) -> str:
         return self.source.plan.raw_plan
 

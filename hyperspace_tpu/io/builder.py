@@ -407,23 +407,92 @@ def _plain_scan_source(plan) -> Optional[tuple]:
     return None
 
 
+SHARD_LAYOUT_FILE = "_shard_layout.json"
+
+
+def write_shard_layout(path: str, num_buckets: int, n_shards: int) -> dict:
+    """Persist the born-sharded layout record next to the bucket spec:
+    which contiguous bucket range each device shard owns (THE map,
+    `parallel/mesh.bucket_ranges`). `stamp_stats` lifts it into the
+    index log entry so a reader knows the build's shard shape without
+    walking the data dir."""
+    import json
+
+    from hyperspace_tpu.parallel.mesh import bucket_ranges
+    from hyperspace_tpu.utils import file_utils, storage
+
+    layout = {
+        "version": 1,
+        "numBuckets": num_buckets,
+        "numShards": n_shards,
+        "bucketRanges": [[lo, hi]
+                         for lo, hi in bucket_ranges(num_buckets,
+                                                     n_shards)],
+    }
+    file_utils.create_file(storage.join(path, SHARD_LAYOUT_FILE),
+                           json.dumps(layout, indent=2))
+    return layout
+
+
+def read_shard_layout(path: str) -> Optional[dict]:
+    """The layout record of a born-sharded version dir, or None for a
+    single-device build."""
+    import json
+
+    from hyperspace_tpu.utils import file_utils, storage
+
+    p = storage.join(path, SHARD_LAYOUT_FILE)
+    if not file_utils.exists(p):
+        return None
+    try:
+        return json.loads(file_utils.read_contents(p))
+    except (ValueError, OSError):
+        return None
+
+
 def write_bucket_ordered(batch: columnar.ColumnBatch, lengths,
                          num_buckets: int, path: str,
-                         file_suffix: Optional[str] = None) -> List[str]:
+                         file_suffix: Optional[str] = None,
+                         mesh=None) -> List[str]:
     """Write a batch already concatenated in bucket order (the distributed
-    build's output shape) as bucketed parquet files."""
+    build's output shape) as bucketed parquet files.
+
+    With `mesh`, the index is BORN SHARDED: each flat shard's contiguous
+    bucket range writes as that device's parquet shard — files carry the
+    owning shard in their suffix (`part-00003-s01.parquet`), the
+    `_shard_layout.json` record pins the range map, and because
+    ownership is contiguous, shard s's files are exactly the rows its
+    device held after the build exchange (and exactly what its device
+    re-fills on a born-sharded read)."""
     table = columnar.to_arrow(batch)
     written: List[str] = []
     from hyperspace_tpu.utils import file_utils
     file_utils.create_directory(path)
+
+    def write_range(bucket_lo: int, bucket_hi: int, offset: int,
+                    suffix: Optional[str]) -> int:
+        for b in range(bucket_lo, bucket_hi):
+            count = int(lengths[b])
+            if count > 0:
+                out = os.path.join(path, parquet.bucket_file_name(b,
+                                                                  suffix))
+                parquet.write_table(table.slice(offset, count), out)
+                written.append(out)
+            offset += count
+        return offset
+
+    if mesh is None:
+        write_range(0, num_buckets, 0, file_suffix)
+        return written
+
+    from hyperspace_tpu.parallel.mesh import bucket_ranges, total_shards
+
+    n_shards = total_shards(mesh)
     offset = 0
-    for b in range(num_buckets):
-        count = int(lengths[b])
-        if count > 0:
-            out = os.path.join(path, parquet.bucket_file_name(b, file_suffix))
-            parquet.write_table(table.slice(offset, count), out)
-            written.append(out)
-        offset += count
+    for s, (lo, hi) in enumerate(bucket_ranges(num_buckets, n_shards)):
+        suffix = f"{file_suffix or ''}s{s:02d}"
+        offset = write_range(lo, hi, offset, suffix)
+    write_shard_layout(path, num_buckets, n_shards)
     return written
 
 
@@ -480,7 +549,11 @@ def write_index(df, indexed_columns: Sequence[str],
 
         built, lengths = distributed_build(batch, indexed_columns,
                                            num_buckets, mesh)
-        return write_bucket_ordered(built, lengths, num_buckets, path)
+        # Born sharded: per-device parquet shards over the contiguous
+        # bucket-range map, with the layout record next to the bucket
+        # spec (lifted into the log entry by `stamp_stats`).
+        return write_bucket_ordered(built, lengths, num_buckets, path,
+                                    mesh=mesh)
 
     columns = list(indexed_columns) + list(included_columns)
     source = _plain_scan_source(df.plan)

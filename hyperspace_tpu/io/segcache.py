@@ -395,6 +395,79 @@ class SegmentCache:
                 self._cv.notify_all()
             fill.event.set()
 
+    def get_or_fill(self, key: tuple, fill_fn, ref: Optional[SegmentRef]
+                    = None, conf=None, budget: Optional[int] = None):
+        """Generic cached fill under the cache's single-flight + byte-
+        budget + LRU + index-FSM-invalidation machinery, for payloads
+        the cache does not itself know how to decode — the per-device
+        BUCKET-RANGE fills of the born-sharded read path
+        (`parallel/spmd.read_sharded`): one committed index version on
+        an n-device mesh caches n entries, each holding exactly one
+        device's padded bucket-range shard, so each device's HBM holds
+        only its range and warm multi-chip reads are link-free per
+        device. `fill_fn` runs outside the lock and returns
+        (payload, resident_bytes); `ref` ties the entry to the index
+        log FSM's invalidation hooks."""
+        from hyperspace_tpu import telemetry
+        from hyperspace_tpu.telemetry import memory as _mem
+
+        while True:
+            fill = None
+            with self._cv:
+                ent = self._entries.get(key)
+                if ent is not None:
+                    self._entries.move_to_end(key)
+                    _mem.cache_hit("segments")
+                    return ent.batch
+                fill = self._fills.get(key)
+                if fill is None:
+                    fill = _Fill(ref.index_root if ref is not None
+                                 else None)
+                    self._fills[key] = fill
+                    break
+            while not fill.event.is_set():
+                telemetry.check_deadline("cache.fill")
+                fill.event.wait(_FILL_WAIT_QUANTUM_S)
+            if fill.error is None and fill.batch is not None:
+                _mem.cache_hit("segments")
+                telemetry.add_count("cache.segments.coalesced")
+                return fill.batch
+            # The filler died; retry with our own fill.
+
+        _mem.cache_miss("segments")
+        reg = telemetry.get_registry()
+        try:
+            with telemetry.span("segcache.fill", "cache",
+                                index=(ref.index_name if ref else None)):
+                reg.counter("cache.segments.fills").inc()
+                payload, nbytes = fill_fn()
+                budget_eff = self._effective_budget(conf, budget)
+                if budget_eff > 0 and nbytes <= budget_eff:
+                    with self._cv:
+                        if not fill.doomed:
+                            evictions = self._evict_until(nbytes,
+                                                          budget_eff)
+                            self._entries[key] = _Entry(
+                                payload, nbytes, ref,
+                                pinned=(ref is not None
+                                        and ref.index_name
+                                        in _pinned_indexes(conf)))
+                            self._bytes_held += nbytes
+                            self._publish_stats()
+                            self._cv.notify_all()
+                            _mem.cache_eviction("segments", evictions)
+            fill.batch = payload
+            return payload
+        except BaseException as exc:
+            fill.error = exc
+            raise
+        finally:
+            with self._cv:
+                if self._fills.get(key) is fill:
+                    del self._fills[key]
+                self._cv.notify_all()
+            fill.event.set()
+
     def _decode(self, paths, cols, schema):
         """Uncached decode+transfer (fill lane, no insert)."""
         from hyperspace_tpu.io import columnar, parquet

@@ -113,7 +113,6 @@ def _shard_partials(tree, num_lanes: int, specs_meta: Tuple[Tuple[str, bool],
 
 def make_partial_step(mesh, num_lanes: int, specs_meta, capacity: int):
     import jax
-    from jax.sharding import PartitionSpec as P
 
     from hyperspace_tpu.parallel.mesh import compat_shard_map, row_spec
     rows_spec = row_spec(mesh)
@@ -133,9 +132,15 @@ def make_partial_step(mesh, num_lanes: int, specs_meta, capacity: int):
 def distributed_group_aggregate(batch: ColumnBatch,
                                 group_columns: Sequence[str],
                                 aggregates: Sequence[AggSpec],
-                                out_schema: Schema, mesh) -> ColumnBatch:
+                                out_schema: Schema, mesh,
+                                pre_sharded=None) -> ColumnBatch:
     """SPMD partial aggregation over the mesh + host combine. Requires at
-    least one group column (global aggregates are cheap single-chip)."""
+    least one group column (global aggregates are cheap single-chip).
+
+    `pre_sharded` = (flat sharded batch, row_valid) skips the placement
+    step entirely for BORN-SHARDED inputs (`parallel/spmd.py`): the
+    partial program consumes the already-resident [S*C] layout, so a
+    join -> aggregate pipeline stays device-resident stage to stage."""
     if not group_columns:
         raise HyperspaceException(
             "distributed aggregation requires group columns")
@@ -149,18 +154,28 @@ def distributed_group_aggregate(batch: ColumnBatch,
                         shards=n_shards):
         return _distributed_group_aggregate(
             batch, group_columns, aggregates, out_schema, mesh, n_shards,
-            reg)
+            reg, pre_sharded=pre_sharded)
 
 
 def _distributed_group_aggregate(batch, group_columns, aggregates,
-                                 out_schema, mesh, n_shards, reg):
+                                 out_schema, mesh, n_shards, reg,
+                                 pre_sharded=None):
     import jax.numpy as jnp
     import time as _time
 
     from hyperspace_tpu import telemetry
     from hyperspace_tpu.ops.keys import column_sort_lanes
 
-    sharded, row_valid = shard_batch(batch, mesh)
+    if pre_sharded is not None:
+        # Born-sharded input: already resident under the canonical row
+        # sharding with its own validity mask — zero placement work, and
+        # the representative-row gather below indexes the SAME padded
+        # layout (first_perm's shard-local positions are global
+        # s*C + i here too).
+        sharded, row_valid = pre_sharded
+        batch = sharded
+    else:
+        sharded, row_valid = shard_batch(batch, mesh)
 
     tree = {"valid": row_valid}
     lane_cols: List = []

@@ -10,7 +10,7 @@ overflow is detected exactly and retried with a larger capacity factor):
 
 per shard (local rows [Ls]):
 1. bucket id = murmur-mix(keys) % num_buckets       (32-bit lanes)
-2. dest shard = bucket % n_shards                   (bucket<->shard map)
+2. dest shard = bucket * n_shards // num_buckets    (contiguous-range map)
 3. one local stable sort by dest groups rows per peer
 4. rows scatter into a [n_shards, capacity] send buffer; overflow beyond
    capacity is counted (never silently dropped: the host retries)
@@ -117,7 +117,13 @@ def _shard_step(tree, key_names: Tuple[str, ...], num_buckets: int,
     bucket = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
 
     n_total = n_ici * n_dcn
-    owner = bucket % n_total
+    # Contiguous-range ownership (mesh.bucket_owner): shard s receives the
+    # bucket range [ceil(s*B/n), ceil((s+1)*B/n)) — the same map the
+    # born-sharded parquet writer and the per-device cache fills use. The
+    # int64 intermediate keeps bucket * n_total exact for large bucket
+    # counts before the narrowing divide.
+    owner = ((bucket.astype(jnp.int64) * n_total)
+             // num_buckets).astype(jnp.int32)
     overflow = jnp.zeros((), dtype=jnp.int32)
 
     # Stage 1 (ICI): to the owner's position within THIS slice.
@@ -129,8 +135,11 @@ def _shard_step(tree, key_names: Tuple[str, ...], num_buckets: int,
 
     if n_dcn > 1:
         # Stage 2 (DCN): to the owner slice, ICI position already final.
+        # Ownership re-derives from the ROUTED bucket ids (the data moved
+        # in stage 1) through the same contiguous-range map.
         from hyperspace_tpu.parallel.mesh import DCN_AXIS
-        owner2 = (bucket % n_total) // n_ici
+        owner2 = ((bucket.astype(jnp.int64) * n_total)
+                  // num_buckets).astype(jnp.int32) // n_ici
         dest2 = jnp.where(row_valid, owner2, jnp.int32(n_dcn))
         cap2 = _stage_capacity(dest2.shape[0], n_dcn, capacity_factor)
         data_tree, row_valid, bucket, ov2 = _route_stage(
@@ -297,11 +306,14 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
     full = tree_to_batch(result_tree, batch.schema, aux)
 
     # Compact + globally order ON DEVICE: invalid rows carry bucket id
-    # num_buckets, and every bucket lives on exactly one shard
-    # (bucket % n_shards), so ONE stable argsort by bucket yields global
-    # (bucket, keys) order with invalid rows at the tail — the per-shard
-    # key order within each bucket is preserved. The only host traffic is
-    # the [num_buckets] length vector, which also sizes the final slice.
+    # num_buckets, and every bucket lives on exactly one shard (the
+    # contiguous-range map — shard s's buckets all precede shard s+1's),
+    # so ONE stable argsort by bucket yields global (bucket, keys) order
+    # with invalid rows at the tail — the per-shard key order within each
+    # bucket is preserved, and under range ownership the sort is nearly
+    # shard-local (rows only compact within their shard's run). The only
+    # host traffic is the [num_buckets] length vector, which also sizes
+    # the final slice.
     buckets_dev = out["__bucket__"]["data"]
     valid_dev = out["__valid__"]["data"]
     order = jnp.argsort(buckets_dev, stable=True)
@@ -310,11 +322,13 @@ def distributed_build(batch: ColumnBatch, key_columns: Sequence[str],
         num_segments=num_buckets + 1))[:num_buckets].astype(np.int64)
     total = int(lengths.sum())
     final = full.take(order[:total])
-    # Per-device attribution: flat shard s owns every bucket with
-    # b % n_shards == s, so the length vector yields each chip's row
-    # load exactly — the histogram + device-track spans are where
+    # Per-device attribution: flat shard s owns the contiguous bucket
+    # range (mesh.bucket_ranges), so the length vector yields each chip's
+    # row load exactly — the histogram + device-track spans are where
     # multi-chip skew becomes visible.
-    shard_rows = [int(lengths[s::n_shards].sum()) for s in range(n_shards)]
+    from hyperspace_tpu.parallel.mesh import bucket_ranges
+    shard_rows = [int(lengths[lo:hi].sum())
+                  for lo, hi in bucket_ranges(num_buckets, n_shards)]
     for rows in shard_rows:
         reg.histogram("mesh.build.shard_rows").observe(rows)
     reg.counter("mesh.build.execs").inc()

@@ -62,8 +62,11 @@ def _side_lanes(left: ColumnBatch, right: ColumnBatch,
                 left_keys: Sequence[str], right_keys: Sequence[str]):
     """Per-key 32-bit lane pairs plus per-row key validity for both sides
     (the shared decomposition, `ops/keys.py` — no cross-side encode).
-    Returned as HOST numpy arrays: the shard layout is gathered on the
-    host so each device receives only its slice."""
+    Lanes keep their residency: HOST columns yield numpy lanes (the shard
+    layout gathers them on the host so each device receives only its
+    slice), DEVICE-resident columns yield device lanes that never detour
+    through host numpy — `_sharded_inputs` gathers those on device and
+    reshards, so a device-resident join pays no D2H for its own keys."""
     import jax.numpy as jnp
 
     if len(left_keys) != len(right_keys) or not left_keys:
@@ -80,18 +83,27 @@ def _side_lanes(left: ColumnBatch, right: ColumnBatch,
         if lcol.is_string:
             lcol, rcol = unify_string_columns(lcol, rcol)
         if lcol.validity is not None:
-            l_ok &= np.asarray(lcol.validity)
+            l_ok = l_ok & _host_or_device_mask(lcol.validity)
         if rcol.validity is not None:
-            r_ok &= np.asarray(rcol.validity)
+            r_ok = r_ok & _host_or_device_mask(rcol.validity)
         ldata, rdata = lcol.data, rcol.data
         if ldata.dtype != rdata.dtype:
             common = jnp.promote_types(ldata.dtype, rdata.dtype)
             ldata = ldata.astype(common)
             rdata = rdata.astype(common)
         for ll, rl in zip(keymod.key_lanes(ldata), keymod.key_lanes(rdata)):
-            l_lanes.append(np.asarray(ll))
-            r_lanes.append(np.asarray(rl))
+            l_lanes.append(ll if not isinstance(ll, np.ndarray)
+                           else np.asarray(ll))
+            r_lanes.append(rl if not isinstance(rl, np.ndarray)
+                           else np.asarray(rl))
     return l_lanes, r_lanes, l_ok, r_ok
+
+
+def _host_or_device_mask(validity):
+    """Leave device validity masks on device (combining with a host bool
+    array broadcasts device-side); only genuinely host masks stay numpy."""
+    return validity if not isinstance(validity, np.ndarray) \
+        else np.asarray(validity)
 
 
 def shard_plan(l_lengths, r_lengths, n_shards: int, split: str):
@@ -190,10 +202,12 @@ def shard_skew(l_lengths, r_lengths, n_shards: int) -> bool:
     the true row count. Only FULL OUTER still routes single-chip on this
     (whole buckets are atomic there); every other join type splits hot
     buckets across shards instead (`shard_plan`)."""
+    from hyperspace_tpu.parallel.mesh import bucket_ranges
+
     l_lengths = np.asarray(l_lengths, dtype=np.int64)
     r_lengths = np.asarray(r_lengths, dtype=np.int64)
     B = len(l_lengths)
-    owned = [np.arange(s, B, n_shards) for s in range(n_shards)]
+    owned = [np.arange(lo, hi) for lo, hi in bucket_ranges(B, n_shards)]
     cl = max(1, max(int(l_lengths[o].sum()) for o in owned))
     cr = max(1, max(int(r_lengths[o].sum()) for o in owned))
     cells = n_shards * (cl + cr)
@@ -211,6 +225,8 @@ def _sharded_inputs(left, right, l_lengths, r_lengths, left_keys,
     sharded spec — per-device bytes ~ T, not total rows. Also returns
     the per-shard assigned row counts (the load-balance attribution the
     mesh telemetry reports)."""
+    import jax.numpy as jnp
+
     n_shards = total_shards(mesh)
     l_lanes, r_lanes, l_ok, r_ok = _side_lanes(left, right, left_keys,
                                                right_keys)
@@ -219,25 +235,50 @@ def _sharded_inputs(left, right, l_lengths, r_lengths, left_keys,
     l_idx, l_valid, Cl = _rows_to_layout(l_rows)
     r_idx, r_valid, Cr = _rows_to_layout(r_rows)
 
-    lanes2d = tuple(np.concatenate([ll[l_idx], rl[r_idx]], axis=1)
-                    for ll, rl in zip(l_lanes, r_lanes))
-    pad = np.concatenate([~l_valid, ~r_valid], axis=1)
-    null = np.concatenate([l_valid & ~l_ok[l_idx],
-                           r_valid & ~r_ok[r_idx]], axis=1)
-
     # Sharded puts STRAIGHT from numpy (transfer engine): jnp.asarray
     # would materialize the full array on the default device first,
     # defeating the per-device memory bound; a put under the row
     # sharding transfers each device only its slice. The engine issues
-    # all five puts before anything blocks and records the one link
-    # crossing.
+    # all puts before anything blocks and records the one link crossing.
     from hyperspace_tpu.io import transfer
 
     sharding = shard_rows(mesh)
     engine = transfer.get_engine()
     put = partial(engine.put, device=sharding)
-    staged = (tuple(put(x) for x in lanes2d), put(pad), put(null),
-              put(l_idx), put(r_idx))
+
+    def host(x):
+        return isinstance(x, np.ndarray)
+
+    def gather2d(llane, rlane):
+        """One combined [S, T] key lane. Host lanes gather on the host
+        and ride the sharded put; DEVICE-resident lanes gather on device
+        (jnp.take by the host layout index) and reshard — their bytes
+        never cross back to the host (the round-8 review item: the join
+        re-paid D2H for keys the scan had already placed)."""
+        if host(llane) and host(rlane):
+            return put(np.concatenate([llane[l_idx], rlane[r_idx]],
+                                      axis=1))
+        lg = (llane[l_idx] if host(llane)
+              else jnp.take(llane, jnp.asarray(l_idx), axis=0))
+        rg = (rlane[r_idx] if host(rlane)
+              else jnp.take(rlane, jnp.asarray(r_idx), axis=0))
+        return put(jnp.concatenate([jnp.asarray(lg), jnp.asarray(rg)],
+                                   axis=1))
+
+    lanes2d = tuple(gather2d(ll, rl)
+                    for ll, rl in zip(l_lanes, r_lanes))
+    pad = put(np.concatenate([~l_valid, ~r_valid], axis=1))
+    if host(l_ok) and host(r_ok):
+        null = put(np.concatenate([l_valid & ~l_ok[l_idx],
+                                   r_valid & ~r_ok[r_idx]], axis=1))
+    else:
+        null = put(jnp.concatenate(
+            [jnp.asarray(l_valid) & ~jnp.take(jnp.asarray(l_ok),
+                                              jnp.asarray(l_idx), axis=0),
+             jnp.asarray(r_valid) & ~jnp.take(jnp.asarray(r_ok),
+                                              jnp.asarray(r_idx), axis=0)],
+            axis=1))
+    staged = (lanes2d, pad, null, put(l_idx), put(r_idx))
     return staged + (Cl, Cr, shard_assigned)
 
 

@@ -14,12 +14,25 @@ build's heavy within-slice re-bucket rides ICI and only the cross-slice
 stage touches DCN (SURVEY §2.12: "DCN only across slices").
 
 Bucket <-> shard ownership: flat shard `s` of an `n`-total-shard mesh owns
-every bucket `b` with `b % n == s`; on a 2-axis mesh flat order is
-row-major (dcn, shard), i.e. `s = d * n_ici + i`. Both the build
-(all_to_all routing) and the co-sharded join rely on this one mapping,
-which is also why equal bucket counts join with ZERO inter-chip traffic
-(the ranker's preference, reference
-`index/rankers/JoinIndexRanker.scala:40-55`).
+the CONTIGUOUS bucket range `[ceil(s*B/n), ceil((s+1)*B/n))` —
+`bucket_owner(b) = b*n // B`; on a 2-axis mesh flat order is row-major
+(dcn, shard), i.e. `s = d * n_ici + i`. The build (all_to_all routing),
+the born-sharded parquet shard layout recorded in the index log entry,
+the per-device segment-cache fills, and the SPMD co-sharded join all rely
+on this ONE mapping (`bucket_ranges` / `bucket_owner` below), which is
+also why equal bucket counts join with ZERO inter-chip traffic (the
+ranker's preference, reference `index/rankers/JoinIndexRanker.scala:40-55`).
+Contiguous ranges — rather than the former `b % n` stripes — are what let
+a bucket-ordered on-disk layout slice straight into per-device shards: a
+device's bucket range is one contiguous run of rows/files, so a born-
+sharded read fills each device's HBM from its own files with no
+interleaving gather.
+
+This module is also THE layout-spec seam: every `NamedSharding` /
+`PartitionSpec` / `shard_map` the package constructs comes from the
+helpers here (`row_spec`, `shard_rows`, `replicated`, `device_of_shard`,
+`compat_shard_map`), so layouts cannot drift between operators —
+`scripts/check_metrics_coverage.py` bans raw construction elsewhere.
 """
 
 from __future__ import annotations
@@ -107,3 +120,68 @@ def shard_rows(mesh):
 def replicated(mesh):
     from jax.sharding import NamedSharding, PartitionSpec
     return NamedSharding(mesh, PartitionSpec())
+
+
+# -- contiguous bucket-range ownership --------------------------------------
+#
+# THE bucket <-> shard map (module docstring). Every consumer — the build's
+# all_to_all routing, the born-sharded parquet writer, the per-device
+# segment-cache fills, and the SPMD join/aggregate — derives ownership from
+# these two functions so the on-disk shard layout, the HBM residency, and
+# the collective routing can never disagree.
+
+
+def bucket_ranges(num_buckets: int, n_shards: int):
+    """[(lo, hi)) bucket range per flat shard: shard s owns
+    `[ceil(s*B/n), ceil((s+1)*B/n))` — contiguous, balanced to within one
+    bucket, exact `B/n`-sized when `n_shards` divides `num_buckets`."""
+    return [((s * num_buckets + n_shards - 1) // n_shards,
+             ((s + 1) * num_buckets + n_shards - 1) // n_shards)
+            for s in range(n_shards)]
+
+
+def bucket_owner(bucket, num_buckets: int, n_shards: int):
+    """Owning flat shard of `bucket` (scalar, numpy, or traced jax array)
+    under the contiguous-range map — the exact inverse of
+    `bucket_ranges`."""
+    return bucket * n_shards // num_buckets
+
+
+def shard_row_segments(lengths, n_shards: int):
+    """Per-shard (row_start, row_end) into a bucket-ordered row space:
+    shard s's rows are exactly its bucket range's rows — the property
+    that makes a bucket-ordered table sliceable into per-device shards
+    with no gather. `lengths` is the [num_buckets] per-bucket row-count
+    vector."""
+    import numpy as np
+    lengths = np.asarray(lengths, dtype=np.int64)
+    cum = np.concatenate([[0], np.cumsum(lengths)])
+    return [(int(cum[lo]), int(cum[hi]))
+            for lo, hi in bucket_ranges(len(lengths), n_shards)]
+
+
+def mesh_device_list(mesh):
+    """The mesh's devices in FLAT shard order (row-major over the axes) —
+    the order `shard_rows` places shard s of a [S*C] row-sharded array on
+    device s. Per-device segment-cache fills target these."""
+    import numpy as np
+    return list(np.asarray(mesh.devices).reshape(-1))
+
+
+def device_of_shard(mesh, shard: int):
+    """The device owning flat shard `shard` (per-device cache fills and
+    born-sharded placements target it)."""
+    return mesh_device_list(mesh)[shard]
+
+
+def assemble_sharded_rows(mesh, per_device_arrays):
+    """Build ONE globally row-sharded array from per-device single-shard
+    arrays (equal first-dim length, array i resident on flat-shard device
+    i) with ZERO data movement — the warm-path assembly of born-sharded
+    reads: each device's segment-cache entry becomes its shard of the
+    global array, and no byte crosses a link."""
+    import jax
+    total = sum(int(a.shape[0]) for a in per_device_arrays)
+    shape = (total,) + tuple(per_device_arrays[0].shape[1:])
+    return jax.make_array_from_single_device_arrays(
+        shape, shard_rows(mesh), list(per_device_arrays))

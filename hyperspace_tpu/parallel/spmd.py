@@ -1,0 +1,1177 @@
+"""Born-sharded SPMD query execution: device-resident, bucket-range-
+sharded inputs flowing stage to stage as single jitted programs.
+
+The legacy `parallel/` path (join.py / scan.py) parallelizes the BATCH:
+every query re-gathers key lanes on the host, re-places a fresh [S, C]
+layout onto the mesh, and syncs to the host between stages to size
+outputs. This module parallelizes the INDEX, the way the paper's bucketed
+layout intends: a committed covering index is *born sharded* — the build
+writes per-device parquet shards over the contiguous bucket-range map
+(`parallel/mesh.bucket_ranges`), the per-device segment cache holds each
+device's bucket range (warm reads assemble the global arrays from HBM
+with ZERO link traffic, `mesh.assemble_sharded_rows`), and the
+shuffle-free sort-merge join, predicate scan, and group-by aggregate
+execute as single jitted SPMD programs under the canonical row sharding:
+
+- **one program per join**: key-lane decomposition, the counting match,
+  and the static-capacity pair expansion trace into ONE `instrumented_jit`
+  dispatch. The legacy path's host-side sizing sync between match and
+  expansion (`parallel/join.py` reads `sum(counts)` to shape the
+  expansion) is replaced by a STATIC per-shard output capacity with
+  on-device overflow detection — the expansion never waits on the host,
+  and the one scalar readback per join carries (total, extra, overflow)
+  together *after* everything has dispatched. Overflow triggers an exact
+  retry at doubled capacity (the build's all_to_all discipline), and the
+  capacity is CLIPPED by the exact per-shard upper bound derived from the
+  two sides' bucket histograms, so the retry loop terminates.
+- **ICI repartition in-program**: when the two sides' bucket counts
+  mismatch (the ranker's fallback), the smaller-bucket side's key lanes
+  re-bucket to the larger count through a `shard_map` all_to_all *inside
+  the same jitted program* — row payload never routes (the expansion
+  carries routed original-row ids and the output gather reaches across
+  shards), and nothing crosses through the host.
+- **stage-to-stage residency**: join output stays a device-resident
+  ColumnBatch; `repartition_sharded` re-buckets it over ICI into a new
+  born-sharded layout for the next join, and `sharded_group_aggregate` /
+  `sharded_filter` consume the sharded layout directly — a warm
+  multi-stage plan records zero D2H link crossings between stages
+  (`link.d2h.*` stays flat until result materialization).
+
+Layout contract (`ShardedBatch`): every column is a flat `[S*C]` jax
+array under `mesh.shard_rows` — shard s's slice holds the rows of its
+bucket range, padded to the common per-shard capacity C with
+`row_valid=False` tail rows. Because ownership is a CONTIGUOUS bucket
+range, same-key rows co-locate on one shard by construction and the
+counting match needs no bucket lane: equal keys hash to one bucket, one
+bucket lives on one shard.
+
+String columns are not yet supported in this layout (per-range dictionary
+unification would re-ship remap tables on warm reads, breaking the
+link-free contract); callers fall back to the legacy mesh path, which
+remains fully general.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import hyperspace_tpu._jax_config  # noqa: F401
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.io.columnar import ColumnBatch, DeviceColumn
+from hyperspace_tpu.ops import keys as keymod
+from hyperspace_tpu.parallel.mesh import (SHARD_AXIS, assemble_sharded_rows,
+                                          bucket_owner, bucket_ranges,
+                                          compat_shard_map, dcn_size,
+                                          mesh_device_list, row_spec,
+                                          shard_row_segments, shard_rows,
+                                          total_shards)
+
+# Static-capacity discipline: first attempt sizes the per-shard output at
+# CAPACITY_FACTOR x the per-shard input rows; on-device overflow
+# detection doubles it until the expansion fits (exact — nothing is ever
+# silently dropped).
+CAPACITY_FACTOR = 2.0
+
+# Born-sharded skew guard: when the padded [S, C] layout would out-size
+# the true rows by more than this, the caller should fall back to the
+# load-balanced legacy path (which splits hot buckets across shards).
+PAD_BLOWUP_FACTOR = 4
+
+
+@dataclass
+class ShardedBatch:
+    """A born-sharded, device-resident batch: flat [S*C] columns under
+    the canonical row sharding, shard s holding its contiguous bucket
+    range's rows with invalid padding rows at each shard's tail.
+    `lengths` (per-bucket row counts) is layout metadata — None for
+    repartitioned intermediates whose per-bucket histogram never
+    touched the host."""
+
+    batch: ColumnBatch          # flat [S*C] device columns
+    row_valid: object           # [S*C] bool, sharded
+    mesh: object
+    rows_per_shard: int         # C
+    num_buckets: int
+    lengths: Optional[np.ndarray] = None
+
+    @property
+    def n_shards(self) -> int:
+        return total_shards(self.mesh)
+
+    @property
+    def num_rows(self) -> int:
+        """TRUE row count (padding excluded) when lengths are known."""
+        if self.lengths is not None:
+            return int(self.lengths.sum())
+        import jax.numpy as jnp
+        return int(jnp.sum(self.row_valid))
+
+
+def supports_sharded(schema, key_columns: Sequence[str] = ()) -> bool:
+    """Whether a schema fits the born-sharded layout (no string columns
+    — module docstring)."""
+    try:
+        for f in schema.fields:
+            if f.dtype == "string":
+                return False
+        for c in key_columns:
+            if schema.field(c).dtype == "string":
+                return False
+    except Exception:
+        return False
+    return True
+
+
+def pad_blowup(lengths, n_shards: int) -> bool:
+    """True when per-shard padding to the hottest shard's row count
+    would blow the [S*C] layout far past the true rows (the caller
+    falls back to the hot-bucket-splitting legacy path)."""
+    segs = shard_row_segments(lengths, n_shards)
+    C = max(1, max(e - s for s, e in segs))
+    rows = int(np.asarray(lengths).sum())
+    return C * n_shards > max(PAD_BLOWUP_FACTOR * rows, 1 << 16)
+
+
+# ---------------------------------------------------------------------------
+# Layout construction
+# ---------------------------------------------------------------------------
+
+
+def shard_bucket_ordered(batch: ColumnBatch, lengths, mesh) -> ShardedBatch:
+    """Place a bucket-ordered batch into the born-sharded layout. HOST
+    batches pad per shard in numpy and cross the link ONCE through the
+    transfer engine's sharded put (each device receives only its range's
+    rows); DEVICE batches re-lay out with an on-device gather (the
+    per-shard segment boundaries are host metadata, the rows never leave
+    the device)."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.io import transfer
+
+    lengths = np.asarray(lengths, dtype=np.int64)
+    n_shards = total_shards(mesh)
+    segs = shard_row_segments(lengths, n_shards)
+    C = max(1, max(e - s for s, e in segs))
+    n = batch.num_rows
+    sharding = shard_rows(mesh)
+    engine = transfer.get_engine()
+
+    # [S*C] gather index + validity, from the host-side segment map.
+    idx = np.zeros(n_shards * C, dtype=np.int64)
+    valid = np.zeros(n_shards * C, dtype=bool)
+    for s, (lo, hi) in enumerate(segs):
+        rows = hi - lo
+        idx[s * C:s * C + rows] = np.arange(lo, hi)
+        valid[s * C:s * C + rows] = True
+
+    columns = {}
+    if batch.is_host:
+        for name, col in batch.columns.items():
+            data = np.zeros((n_shards * C,) + col.data.shape[1:],
+                            dtype=col.data.dtype)
+            data[valid] = col.data
+            v = None
+            if col.validity is not None:
+                v = np.zeros(n_shards * C, dtype=bool)
+                v[valid] = col.validity
+                v = engine.put(v, device=sharding)
+            columns[name] = DeviceColumn(
+                data=engine.put(data, device=sharding), dtype=col.dtype,
+                validity=v, dictionary=col.dictionary,
+                dict_hashes=col.dict_hashes)
+        row_valid = engine.put(valid, device=sharding)
+    else:
+        idx_dev = engine.put(np.minimum(idx, max(n - 1, 0)),
+                             device=sharding)
+        row_valid = engine.put(valid, device=sharding)
+        for name, col in batch.columns.items():
+            data = jnp.where(
+                _expand_mask(row_valid, col.data.ndim),
+                jnp.take(jnp.asarray(col.data), idx_dev, axis=0), 0)
+            v = None
+            if col.validity is not None:
+                v = jnp.take(jnp.asarray(col.validity), idx_dev) & row_valid
+            columns[name] = DeviceColumn(
+                data=engine.put(data, device=sharding), dtype=col.dtype,
+                validity=(engine.put(v, device=sharding)
+                          if v is not None else None),
+                dictionary=col.dictionary, dict_hashes=col.dict_hashes)
+    flat = ColumnBatch(batch.schema, columns)
+    return ShardedBatch(flat, row_valid, mesh, C, len(lengths),
+                        lengths=lengths)
+
+
+def _expand_mask(mask, ndim: int):
+    import jax.numpy as jnp
+    out = jnp.asarray(mask)
+    for _ in range(ndim - 1):
+        out = out[..., None]
+    return out
+
+
+def read_sharded(per_shard_files: List[List[str]], lengths,
+                 columns: Sequence[str], schema, mesh,
+                 base_ref=None, conf=None, budget=None) -> ShardedBatch:
+    """Born-sharded read: each flat shard s's bucket-range files decode
+    and place onto DEVICE s through the per-device segment cache
+    (per-bucket-range fill granularity — the PR-8 "remaining on this
+    axis" item). A warm read touches neither parquet nor the link: the
+    cached per-device padded shards assemble into the global sharded
+    arrays with zero data movement."""
+    from hyperspace_tpu import telemetry
+    from hyperspace_tpu.io import segcache
+
+    lengths = np.asarray(lengths, dtype=np.int64)
+    n_shards = total_shards(mesh)
+    segs = shard_row_segments(lengths, n_shards)
+    C = max(1, max(e - s for s, e in segs))
+    devices = mesh_device_list(mesh)
+    cols = tuple(columns)
+    schema_json = schema.to_json()
+    ranges = bucket_ranges(len(lengths), n_shards)
+    cache = segcache.get_cache()
+
+    def fill_one(s: int):
+        rows = segs[s][1] - segs[s][0]
+
+        def fill():
+            return _fill_device_shard(per_shard_files[s], cols, schema,
+                                      rows, C, devices[s])
+
+        if base_ref is None:
+            return fill()[0]
+        key = base_ref.key + (
+            ("spmd", ranges[s][0], ranges[s][1], n_shards, C),
+            cols, schema_json)
+        return cache.get_or_fill(key, fill, ref=base_ref, conf=conf,
+                                 budget=budget)
+
+    # Concurrent per-shard fills: parquet decode of shard s+1 overlaps
+    # shard s's H2D (each fill itself pipelines through put_group). The
+    # fan-out rides a DEDICATED lane, not `parquet.io_executor()` — the
+    # fills call read_table, which submits to that shared pool and
+    # blocks; fanning out on the same pool would deadlock it against
+    # itself.
+    shards = list(_read_pool().map(
+        telemetry.propagating(fill_one), range(n_shards)))
+
+    out_schema = schema.select(cols)
+    columns_out = {}
+    for f in out_schema.fields:
+        data = assemble_sharded_rows(
+            mesh, [sh["columns"][f.name]["data"] for sh in shards])
+        validity = None
+        if any(sh["columns"][f.name].get("validity") is not None
+               for sh in shards):
+            validity = assemble_sharded_rows(
+                mesh, [_shard_validity(sh, f.name, C, devices[s])
+                       for s, sh in enumerate(shards)])
+        columns_out[f.name] = DeviceColumn(data=data, dtype=f.dtype,
+                                           validity=validity)
+    row_valid = assemble_sharded_rows(
+        mesh, [_on_device(devices[s],
+                          partial(_valid_mask, segs[s][1] - segs[s][0], C))
+               for s in range(n_shards)])
+    flat = ColumnBatch(out_schema, columns_out)
+    return ShardedBatch(flat, row_valid, mesh, C, len(lengths),
+                        lengths=lengths)
+
+
+_pool = None
+_pool_lock = None
+
+
+def _read_pool():
+    """Lazy shared fan-out lane for per-shard fills (one per process,
+    atexit-drained). DISTINCT from `parquet.io_executor()` on purpose:
+    the fills block on that pool internally."""
+    global _pool, _pool_lock
+    import threading
+    if _pool_lock is None:
+        _pool_lock = threading.Lock()
+    if _pool is None:
+        with _pool_lock:
+            if _pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _pool = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="hs-spmd-read")
+                import atexit
+                atexit.register(shutdown_read_pool)
+    return _pool
+
+
+def shutdown_read_pool(wait: bool = True) -> None:
+    """Drain + stop the fill fan-out lane (idempotent; lazily
+    re-created on the next born-sharded read)."""
+    global _pool
+    pool, _pool = _pool, None
+    if pool is not None:
+        pool.shutdown(wait=wait)
+
+
+def _valid_mask(rows: int, C: int):
+    import jax.numpy as jnp
+    return jnp.arange(C) < rows
+
+
+def _on_device(device, fn):
+    """Run an eager constant-producing computation ON `device` — device-
+    local array creation, no link traffic (XLA materializes the fill on
+    the target device)."""
+    import jax
+    with jax.default_device(device):
+        return fn()
+
+
+def _shard_validity(shard: dict, name: str, C: int, device):
+    v = shard["columns"][name].get("validity")
+    if v is not None:
+        return v
+    return _on_device(device, partial(_valid_mask, C, C))
+
+
+def _fill_device_shard(files: List[str], cols, schema, rows: int, C: int,
+                       device) -> Tuple[dict, int]:
+    """Cold fill of one device's bucket range: parquet decode, pad to
+    the common per-shard capacity on the host, place every column onto
+    THIS device through the transfer engine's fill lane. Returns
+    (payload, resident bytes)."""
+    from hyperspace_tpu.io import parquet, transfer
+
+    out_schema = schema.select(cols)
+    if not files or rows == 0:
+        # Empty range: all-padding shard, created device-locally.
+        import jax.numpy as jnp
+
+        from hyperspace_tpu.io.columnar import HOST_NP_DTYPES
+        cols_out = {}
+        for f in out_schema.fields:
+            dt = HOST_NP_DTYPES[f.dtype]
+            cols_out[f.name] = {
+                "data": _on_device(device, partial(jnp.zeros, C, dt)),
+                "validity": None}
+        payload = {"columns": cols_out, "rows": 0}
+        return payload, _payload_nbytes(payload)
+
+    table = parquet.read_table(files, columns=list(cols))
+    if table.num_rows != rows:
+        raise HyperspaceException(
+            f"Born-sharded read expected {rows} rows, decoded "
+            f"{table.num_rows} — footer metadata and data disagree.")
+    from hyperspace_tpu.io import columnar
+    host = columnar.from_arrow(table, out_schema, device=False)
+    jobs = []
+    for f in out_schema.fields:
+        col = host.columns[f.name]
+        data = np.zeros((C,) + col.data.shape[1:], dtype=col.data.dtype)
+        data[:rows] = col.data
+        entry = {"data": data}
+        if col.validity is not None:
+            v = np.zeros(C, dtype=bool)
+            v[:rows] = col.validity
+            entry["validity"] = v
+        jobs.append((f.name, entry))
+    engine = transfer.get_engine()
+    placed = engine.put_group([partial(lambda e: e, entry)
+                               for _name, entry in jobs],
+                              device=device, tag="fill")
+    cols_out = {name: {"data": entry["data"],
+                       "validity": entry.get("validity")}
+                for (name, _), entry in zip(jobs, placed)}
+    payload = {"columns": cols_out, "rows": rows}
+    return payload, _payload_nbytes(payload)
+
+
+def _payload_nbytes(payload: dict) -> int:
+    total = 0
+    for entry in payload["columns"].values():
+        total += int(getattr(entry["data"], "nbytes", 0))
+        if entry.get("validity") is not None:
+            total += int(getattr(entry["validity"], "nbytes", 0))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# The single-program SPMD join
+# ---------------------------------------------------------------------------
+
+
+def _key_arrays(batch: ColumnBatch, names: Sequence[str]):
+    """(data arrays, combined key validity | None) for the key columns."""
+    import jax.numpy as jnp
+
+    datas = []
+    ok = None
+    for name in names:
+        col = batch.column(name)
+        if col.is_string:
+            raise HyperspaceException(
+                "string keys are not supported in the born-sharded path")
+        datas.append(jnp.asarray(col.data))
+        if col.validity is not None:
+            v = jnp.asarray(col.validity)
+            ok = v if ok is None else (ok & v)
+    return datas, ok
+
+
+def _promote_pairs(l_datas, r_datas):
+    import jax.numpy as jnp
+    lp, rp = [], []
+    for ld, rd in zip(l_datas, r_datas):
+        if ld.dtype != rd.dtype:
+            common = jnp.promote_types(ld.dtype, rd.dtype)
+            ld, rd = ld.astype(common), rd.astype(common)
+        lp.append(ld)
+        rp.append(rd)
+    return lp, rp
+
+
+def _side_lane_chain(datas):
+    lanes = []
+    for d in datas:
+        lanes.extend(keymod.key_lanes(d))
+    return lanes
+
+
+def _route_local(arrs, dest, n_peers: int, capacity: int):
+    """Route local rows to their destination peers through ONE
+    all_to_all over the shard axis (shard_map-local shapes): stable sort
+    by dest, scatter into the [n_peers, capacity] send buffer, swap.
+    Returns (routed arrays [n_peers*capacity, ...], overflow count).
+    Mirrors `parallel/build._route_stage` for flat (1-axis) meshes."""
+    import jax
+    import jax.numpy as jnp
+
+    n_local = dest.shape[0]
+    iota = jnp.arange(n_local, dtype=jnp.int32)
+    dest_sorted, perm = jax.lax.sort([dest, iota], num_keys=1,
+                                     is_stable=True)
+    seg_start = jnp.searchsorted(
+        dest_sorted, jnp.arange(n_peers + 1, dtype=jnp.int32), side="left")
+    offset = jnp.arange(n_local, dtype=jnp.int32) - jnp.take(
+        seg_start, jnp.clip(dest_sorted, 0, n_peers))
+    keep = (offset < capacity) & (dest_sorted < n_peers)
+    overflow = jnp.sum((offset >= capacity) & (dest_sorted < n_peers))
+    slot = jnp.where(keep, dest_sorted * capacity + offset,
+                     n_peers * capacity)
+
+    def route(arr):
+        src = jnp.take(arr, perm, axis=0)
+        buf = jnp.zeros((n_peers * capacity + 1,) + src.shape[1:],
+                        dtype=src.dtype)
+        buf = buf.at[slot].set(src, mode="drop")
+        send = buf[:n_peers * capacity].reshape(
+            (n_peers, capacity) + src.shape[1:])
+        recv = jax.lax.all_to_all(send, SHARD_AXIS, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        return recv.reshape((n_peers * capacity,) + src.shape[1:])
+
+    return [route(a) for a in arrs], overflow
+
+
+def _repartition_lanes(lanes, null, valid, gid, num_buckets_to: int,
+                       mesh, route_capacity: int):
+    """In-program ICI re-bucket of one side's KEY LANES (+ null/valid
+    masks and original-row ids): each row moves to the shard owning its
+    bucket under the TARGET bucket count. Runs as a shard_map stage
+    inside the caller's jitted program — payload never routes, nothing
+    touches the host. Returns ([S*C'] lanes..., null, valid, gid,
+    route_overflow)."""
+    import jax.numpy as jnp
+
+    n_shards = total_shards(mesh)
+    rows_spec = row_spec(mesh)
+
+    def body(*flat):
+        lanes_l = list(flat[:-3])
+        null_l, valid_l, gid_l = flat[-3], flat[-2], flat[-1]
+        from hyperspace_tpu.ops.hash_partition import flat_hash32
+        hash_lanes = [jnp.where(null_l | ~valid_l, jnp.uint32(0),
+                                lane.astype(jnp.uint32))
+                      for lane in lanes_l]
+        h = flat_hash32(hash_lanes)
+        bucket = (h % jnp.uint32(num_buckets_to)).astype(jnp.int64)
+        owner = bucket_owner(bucket, num_buckets_to,
+                             n_shards).astype(jnp.int32)
+        dest = jnp.where(valid_l, owner, jnp.int32(n_shards))
+        routed, overflow = _route_local(
+            lanes_l + [null_l, valid_l, gid_l], dest, n_shards,
+            route_capacity)
+        return tuple(routed) + (overflow.reshape(1),)
+
+    flat_in = tuple(lanes) + (null, valid, gid)
+    out = compat_shard_map(
+        body, mesh=mesh,
+        in_specs=tuple(rows_spec for _ in flat_in),
+        out_specs=tuple([rows_spec] * (len(flat_in) + 1)),
+        check_vma=False)(*flat_in)
+    routed = out[:-1]
+    overflow = jnp.sum(out[-1])
+    k = len(lanes)
+    return (list(routed[:k]), routed[k], routed[k + 1], routed[k + 2],
+            overflow)
+
+
+def _match_expand(l_lanes2d, r_lanes2d, l_null, r_null, l_pad, r_pad,
+                  r_gid, cap: int, left_outer: bool, need_right: bool):
+    """The counting match + static-capacity expansion over the combined
+    [S, T] layout (T = Cl + Cr). Per shard: ONE stable sort by
+    (pad, null, *lanes, side, slot), run grouping from adjacent lane
+    differences, right-run brackets by cumulative counting, then the
+    expansion into the [S, cap] output slots — all traced into the ONE
+    enclosing jit, no host sizing sync between match and expansion.
+
+    `r_gid` maps a right slot to its ORIGINAL global row id (identity
+    for co-bucketed sides; the routed ids after an in-program
+    repartition). Returns (li, ri, out_valid [S, cap], shard_total [S],
+    expand_overflow, right_unmatched_gid [S, T] | None, matchable,
+    rights, pos_s)."""
+    import jax
+    import jax.numpy as jnp
+
+    S, Cl = l_pad.shape
+    Cr = r_pad.shape[1]
+    T = Cl + Cr
+    lanes2d = [jnp.concatenate([ll, rl], axis=1)
+               for ll, rl in zip(l_lanes2d, r_lanes2d)]
+    pad = jnp.concatenate([l_pad, r_pad], axis=1).astype(jnp.int32)
+    null = jnp.concatenate([l_null, r_null], axis=1).astype(jnp.int32)
+    side = jnp.broadcast_to(
+        jnp.concatenate([jnp.zeros(Cl, jnp.int32),
+                         jnp.ones(Cr, jnp.int32)]), (S, T))
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (S, T))
+    results = jax.lax.sort([pad, null, *lanes2d, side, pos],
+                           num_keys=3 + len(lanes2d), is_stable=True,
+                           dimension=1)
+    pad_s, null_s = results[0], results[1]
+    lanes_s = results[2:-2]
+    side_s = results[-2]
+    pos_s = results[-1]
+
+    first = jnp.ones((S, 1), dtype=bool)
+    rest = jnp.zeros((S, T - 1), dtype=bool)
+    for k in lanes_s:
+        rest = rest | (k[:, 1:] != k[:, :-1])
+    rest = rest | (null_s[:, 1:] | null_s[:, :-1]
+                   | pad_s[:, 1:] | pad_s[:, :-1]).astype(bool)
+    run_start = jnp.concatenate([first, rest], axis=1)
+
+    posT = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (S, T))
+    run_first = jax.lax.cummax(jnp.where(run_start, posT, 0), axis=1)
+    nxt = jnp.flip(jax.lax.cummin(jnp.flip(
+        jnp.where(run_start, posT, jnp.int32(T)), axis=1), axis=1),
+        axis=1)
+    run_last = jnp.concatenate(
+        [nxt[:, 1:], jnp.full((S, 1), T, jnp.int32)], axis=1) - 1
+
+    R = jnp.cumsum(side_s, axis=1)
+    take = jnp.take_along_axis
+    rights = (take(R, run_last, axis=1) - take(R, run_first, axis=1)
+              + take(side_s, run_first, axis=1))
+    rstart = run_last - rights + 1
+
+    is_left = (side_s == 0) & (pad_s == 0)
+    matchable = is_left & (null_s == 0)
+    counts = jnp.where(matchable, rights, 0)
+    if left_outer:
+        counts = jnp.maximum(counts, is_left.astype(counts.dtype))
+    counts64 = counts.astype(jnp.int64)
+    starts = jnp.cumsum(counts64, axis=1) - counts64  # per-shard excl.
+    shard_total = starts[:, -1] + counts64[:, -1]
+    expand_overflow = jnp.maximum(jnp.max(shard_total) - cap, 0)
+
+    # Static-capacity expansion: output slot j of shard s belongs to the
+    # left element whose [starts, starts+counts) window covers j.
+    slots = jnp.arange(cap, dtype=jnp.int64)
+    row = jax.vmap(lambda st: jnp.searchsorted(st, slots,
+                                               side="right"))(starts) - 1
+    row = jnp.clip(row, 0, T - 1).astype(jnp.int32)
+    offset = (slots[None, :] - take(starts, row, axis=1)).astype(jnp.int32)
+    l_slot = take(pos_s, row, axis=1)
+    li = l_slot.astype(jnp.int64) \
+        + (jnp.arange(S, dtype=jnp.int64) * Cl)[:, None]
+    matched = offset < take(rights, row, axis=1)
+    r_sorted = jnp.clip(take(rstart, row, axis=1) + offset, 0, T - 1)
+    r_slot = take(pos_s, r_sorted, axis=1) - Cl
+    ri = jnp.where(matched,
+                   take(r_gid, jnp.clip(r_slot, 0, Cr - 1), axis=1),
+                   jnp.int64(-1))
+    out_valid = slots[None, :] < jnp.minimum(shard_total, cap)[:, None]
+
+    un_gid_sorted = un_counts = None
+    if need_right:
+        run_len = run_last - run_first + 1
+        lefts = run_len - rights
+        r_unmatched = ((side_s == 1) & (pad_s == 0)
+                       & ((null_s == 1) | (lefts == 0)))
+        gid_sorted = take(r_gid,
+                          jnp.clip(pos_s - Cl, 0, Cr - 1), axis=1)
+        un_gid = jnp.where(r_unmatched, gid_sorted, jnp.int64(-1))
+        # Per-shard compaction IN-PROGRAM (unmatched gids first): the
+        # host then assembles the output from contiguous prefixes with
+        # one gather — no data-dependent-shaped eager op ever touches
+        # the sharded arrays (each such op would recompile per size).
+        un_sorted = jax.lax.sort(
+            [(un_gid < 0).astype(jnp.int32), un_gid],
+            num_keys=1, is_stable=True, dimension=1)
+        un_gid_sorted = un_sorted[1]
+        un_counts = jnp.sum(un_gid >= 0, axis=1)
+    return (li, ri, out_valid, shard_total, expand_overflow,
+            un_gid_sorted, un_counts, is_left, matchable, rights, pos_s)
+
+
+# Program cache: jax.Mesh hashes by value (devices + axis names), so the
+# per-query `distribution_mesh()` reconstruction still HITS here — a warm
+# repeat join re-dispatches the already-compiled program instead of
+# retracing (the retrace counters in `instrumented_jit` pin this).
+_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _cached_program(key: tuple, builder):
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        prog = builder()
+        if len(_PROGRAMS) > 256:  # runaway-shape backstop
+            _PROGRAMS.clear()
+        _PROGRAMS[key] = prog
+    return prog
+
+
+def _join_program(mesh, n_keys: int, Cl: int, Cr: int, cap: int,
+                  left_outer: bool, need_right: bool,
+                  repartition_to: Optional[int], route_capacity: int,
+                  membership: Optional[str] = None):
+    """Compile THE join as one jitted SPMD program: (optional) in-program
+    ICI repartition of the right side, lane decomposition, counting
+    match, static-capacity expansion, per-shard output compaction. All
+    shape parameters are static; the only host readback after dispatch
+    is the small per-shard count vector + overflow scalars, fetched in
+    ONE sync — every device-side output the host then gathers is a
+    contiguous per-shard prefix, so no data-dependent shape ever forces
+    an eager recompile on the sharded arrays.
+
+    `membership`: None (pair expansion) or "semi"/"anti" — membership
+    reads the match-phase masks and compacts hit LEFT indices per shard
+    in-program instead of expanding pairs."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.telemetry import instrumented_jit
+
+    S = total_shards(mesh)
+
+    def build():
+        def step(l_datas, l_ok, l_valid, r_datas, r_ok, r_valid):
+            l_d, r_d = _promote_pairs(list(l_datas), list(r_datas))
+            l_lanes = [x.reshape(S, Cl) for x in _side_lane_chain(l_d)]
+            l_pad = ~l_valid.reshape(S, Cl)
+            l_null = (jnp.zeros((S, Cl), bool) if l_ok is None
+                      else (~l_ok.reshape(S, Cl)) & ~l_pad)
+
+            r_lanes = _side_lane_chain(r_d)
+            r_null_f = (jnp.zeros(r_valid.shape[0], bool) if r_ok is None
+                        else ~r_ok)
+            r_gid_f = jnp.arange(r_valid.shape[0], dtype=jnp.int64)
+            route_ovf = jnp.int64(0)
+            if repartition_to is not None:
+                r_lanes, r_null_f, r_valid_f, r_gid_f, route_ovf = \
+                    _repartition_lanes(r_lanes, r_null_f, r_valid,
+                                       r_gid_f, repartition_to, mesh,
+                                       route_capacity)
+                Cr_eff = S * route_capacity
+            else:
+                r_valid_f = r_valid
+                Cr_eff = Cr
+            r_lanes2d = [x.reshape(S, Cr_eff) for x in r_lanes]
+            r_pad = ~r_valid_f.reshape(S, Cr_eff)
+            r_null2d = r_null_f.reshape(S, Cr_eff) & ~r_pad
+            r_gid2d = r_gid_f.reshape(S, Cr_eff)
+
+            (li, ri, _out_valid, shard_total, expand_ovf, un_gid,
+             un_counts, is_left, matchable, rights, pos_s) = \
+                _match_expand(l_lanes, r_lanes2d, l_null, r_null2d,
+                              l_pad, r_pad, r_gid2d, cap, left_outer,
+                              need_right)
+            if membership is not None:
+                # Semi/anti over the match masks: per-shard in-program
+                # compaction (hits first), host gathers the prefixes.
+                hit = (is_left & (rights == 0) if membership == "anti"
+                       else matchable & (rights > 0))
+                li2d = (jnp.clip(pos_s, 0, Cl - 1).astype(jnp.int64)
+                        + (jnp.arange(S, dtype=jnp.int64) * Cl)[:, None])
+                hit_sorted = jax.lax.sort(
+                    [(~hit).astype(jnp.int32), li2d], num_keys=1,
+                    is_stable=True, dimension=1)
+                hit_counts = jnp.sum(hit, axis=1)
+                return hit_sorted[1], hit_counts, route_ovf
+            counts = jnp.minimum(shard_total, cap)
+            if un_counts is None:
+                un_gid = jnp.zeros((S, 1), dtype=jnp.int64)
+                un_counts = jnp.zeros(S, dtype=jnp.int64)
+            return (li, ri, counts, un_gid, un_counts, expand_ovf,
+                    route_ovf)
+
+        return instrumented_jit("mesh.spmd_join", step)
+
+    key = ("join", mesh, n_keys, Cl, Cr, cap, left_outer, need_right,
+           repartition_to, route_capacity, membership)
+    return _cached_program(key, build)
+
+
+def _prefix_index(counts, width: int) -> np.ndarray:
+    """Flat gather index over per-shard contiguous prefixes: shard s
+    contributes rows [s*width, s*width + counts[s])."""
+    counts = np.asarray(counts, dtype=np.int64)
+    return np.concatenate(
+        [s * width + np.arange(int(c)) for s, c in enumerate(counts)]
+    ) if counts.sum() else np.zeros(0, dtype=np.int64)
+
+
+def _gather_prefixes(arrays, counts, width: int):
+    """ONE fused device gather of the per-shard prefixes (the output
+    sides stay device-resident; only the [S] count vector came to the
+    host)."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.io.columnar import _fused_take
+
+    idx = _prefix_index(counts, width)
+    if not len(idx):
+        return tuple(jnp.zeros(0, dtype=a.dtype) for a in arrays)
+    return _fused_take(tuple(a.reshape(-1) for a in arrays),
+                       jnp.asarray(idx))
+
+
+# Working-capacity memo: a warm repeat of the same join shape starts at
+# the capacity that last succeeded instead of re-discovering it through
+# the overflow-retry ladder (each failed attempt is a full dispatch).
+_CAP_MEMO: Dict[tuple, int] = {}
+
+
+def _join_capacity(left: ShardedBatch, right: ShardedBatch,
+                   left_outer: bool, factor: float,
+                   memo_key: Optional[tuple] = None) -> int:
+    """First-attempt static per-shard output capacity. When both sides'
+    per-bucket histograms are known, the EXACT per-shard upper bound
+    (sum of l_b*r_b [+ l_b for outer] over the shard's bucket range)
+    clips the heuristic — an expansion at the bound can never overflow,
+    so the doubling retry loop terminates — and a bound within 4x of
+    the heuristic is taken OUTRIGHT (one guaranteed-fit dispatch beats
+    a maybe-retry at modest extra slots)."""
+    if memo_key is not None and memo_key in _CAP_MEMO:
+        return _CAP_MEMO[memo_key]
+    heur = max(16, int(factor * (left.rows_per_shard
+                                 + right.rows_per_shard)))
+    if left.lengths is None or right.lengths is None \
+            or len(left.lengths) != len(right.lengths):
+        return heur
+    ll = left.lengths.astype(np.int64)
+    rl = right.lengths.astype(np.int64)
+    per_bucket = ll * rl + (ll if left_outer else 0)
+    bound = max(int(per_bucket[lo:hi].sum())
+                for lo, hi in bucket_ranges(len(ll), left.n_shards))
+    bound = max(bound, 1)
+    if bound <= 4 * heur:
+        return max(16, bound)
+    return max(16, min(heur, bound))
+
+
+def _route_cap(right: ShardedBatch) -> int:
+    """First-attempt per-peer slab capacity for the in-program
+    repartition (the build's `_stage_capacity` sizing)."""
+    S = right.n_shards
+    return max(16, int(right.rows_per_shard / S * CAPACITY_FACTOR))
+
+
+def _join_inputs(sh: ShardedBatch, keys: Sequence[str]):
+    datas, ok = _key_arrays(sh.batch, keys)
+    return tuple(datas), ok, sh.row_valid
+
+
+def _shard_rows_attribution(left: ShardedBatch, right: ShardedBatch):
+    """Per-shard TRUE input rows (the load-balance attribution the mesh
+    telemetry reports, legacy-event parity): from the bucket histograms
+    when known, else the padded per-shard capacities."""
+    S = left.n_shards
+    out = []
+    for sh in (left, right):
+        if sh.lengths is not None:
+            segs = shard_row_segments(sh.lengths, S)
+            out.append([e - s for s, e in segs])
+        else:
+            out.append([sh.rows_per_shard] * S)
+    return [l + r for l, r in zip(*out)]
+
+
+def _check_one_mesh(left: ShardedBatch, right: ShardedBatch):
+    if left.mesh is not right.mesh and \
+            mesh_device_list(left.mesh) != mesh_device_list(right.mesh):
+        raise HyperspaceException("sharded join requires one mesh")
+
+
+def _repartition_target(left: ShardedBatch, right: ShardedBatch):
+    if right.num_buckets == left.num_buckets:
+        return None, 16
+    if dcn_size(left.mesh) > 1:
+        raise HyperspaceException(
+            "in-program repartition supports flat (single-slice) meshes; "
+            "re-bucket through parallel.join.rebucket on multi-slice "
+            "topologies.")
+    return left.num_buckets, _route_cap(right)
+
+
+def sharded_join_indices(left: ShardedBatch, right: ShardedBatch,
+                         left_keys: Sequence[str],
+                         right_keys: Sequence[str],
+                         how: str = "inner",
+                         capacity_factor: Optional[float] = None):
+    """Join-pair indices over two born-sharded sides as ONE jitted SPMD
+    program per attempt (static capacity, on-device overflow detection,
+    in-program ICI repartition on bucket-count mismatch). Returns
+    (li, ri) device int32 arrays indexing the FLAT padded row spaces of
+    the two sides. `how`: inner / left_outer / full_outer (callers swap
+    sides for right_outer)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu import telemetry
+
+    if how not in ("inner", "left_outer", "full_outer"):
+        raise HyperspaceException(
+            f"sharded join supports inner/left_outer/full_outer; "
+            f"got {how}.")
+    _check_one_mesh(left, right)
+    mesh = left.mesh
+    S = total_shards(mesh)
+    left_outer = how in ("left_outer", "full_outer")
+    need_right = how == "full_outer"
+    repartition_to, route_capacity = _repartition_target(left, right)
+    l_in = _join_inputs(left, left_keys)
+    r_in = _join_inputs(right, right_keys)
+    factor = (capacity_factor if capacity_factor is not None
+              else CAPACITY_FACTOR)
+    memo_key = ("cap", mesh, left.rows_per_shard, right.rows_per_shard,
+                tuple(left_keys), tuple(right_keys), how)
+    cap = _join_capacity(left, right, left_outer, factor,
+                         memo_key=memo_key)
+
+    reg = telemetry.get_registry()
+    tracer = telemetry.tracer()
+    span_ts = tracer.now_us() if tracer is not None else 0.0
+    while True:
+        program = _join_program(mesh, len(left_keys), left.rows_per_shard,
+                                right.rows_per_shard, cap, left_outer,
+                                need_right, repartition_to,
+                                route_capacity)
+        with telemetry.span("mesh:join:spmd", "mesh", how=how, shards=S,
+                            cap=cap):
+            (li, ri, counts_d, un_gid, un_counts_d, expand_ovf,
+             route_ovf) = program(*l_in, *r_in)
+            t0 = _time.perf_counter()
+            # THE one host readback per attempt: the tiny per-shard
+            # count vectors + overflow scalars together, after
+            # everything (match AND expansion AND compaction) has
+            # dispatched — not a sizing sync in the middle.
+            counts, un_counts, e_ovf, r_ovf = jax.device_get(
+                (counts_d, un_counts_d, expand_ovf, route_ovf))
+            sync_s = _time.perf_counter() - t0
+        reg.counter("mesh.join.sync_s").inc(sync_s)
+        telemetry.add_seconds("mesh.sync_s", sync_s)
+        if int(e_ovf) == 0 and int(r_ovf) == 0:
+            if len(_CAP_MEMO) > 256:
+                _CAP_MEMO.clear()
+            _CAP_MEMO[memo_key] = cap
+            break
+        reg.counter("mesh.spmd.overflow_retries").inc()
+        if int(e_ovf):
+            cap *= 2
+        if int(r_ovf):
+            route_capacity *= 2
+
+    total = int(np.asarray(counts).sum())
+    extra = int(np.asarray(un_counts).sum()) if need_right else 0
+    reg.counter("mesh.join.execs").inc()
+    reg.counter("mesh.spmd.join_execs").inc()
+    shard_rows_attr = _shard_rows_attribution(left, right)
+    for rows in shard_rows_attr:
+        reg.histogram("mesh.join.shard_rows").observe(rows)
+    telemetry.event("mesh", "join", how=how, shards=S, pairs=total,
+                    lane="spmd", shard_rows=shard_rows_attr)
+    if tracer is not None:
+        tracer.device_spans("join", span_ts,
+                            [int(c) for c in np.asarray(counts)],
+                            how=how)
+    if total == 0:
+        li_f = jnp.zeros(0, dtype=jnp.int64)
+        ri_f = jnp.zeros(0, dtype=jnp.int64)
+    else:
+        # The valid pairs are contiguous per-shard prefixes by
+        # construction: ONE fused gather materializes both sides.
+        li_f, ri_f = _gather_prefixes((li, ri), counts, cap)
+    if extra:
+        (ugid,) = _gather_prefixes((un_gid,), un_counts,
+                                   un_gid.shape[1])
+        li_f = jnp.concatenate([li_f, jnp.full(extra, -1,
+                                               dtype=jnp.int64)])
+        ri_f = jnp.concatenate([ri_f, ugid])
+    return li_f.astype(jnp.int32), ri_f.astype(jnp.int32)
+
+
+def sharded_semi_anti_indices(left: ShardedBatch, right: ShardedBatch,
+                              left_keys: Sequence[str],
+                              right_keys: Sequence[str],
+                              anti: bool = False):
+    """LEFT SEMI / LEFT ANTI membership over born-sharded sides through
+    the same single program (anti emits null-key left rows — NOT EXISTS
+    semantics). Membership reads the match-phase masks; the expansion's
+    capacity is irrelevant, so only a repartition-route overflow can
+    force a retry. Returns indices into the left flat padded space."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu import telemetry
+
+    _check_one_mesh(left, right)
+    mesh = left.mesh
+    S = total_shards(mesh)
+    repartition_to, route_capacity = _repartition_target(left, right)
+
+    reg = telemetry.get_registry()
+    while True:
+        program = _join_program(mesh, len(left_keys), left.rows_per_shard,
+                                right.rows_per_shard, 16,
+                                left_outer=True, need_right=False,
+                                repartition_to=repartition_to,
+                                route_capacity=route_capacity,
+                                membership="anti" if anti else "semi")
+        li_sorted, hit_counts_d, route_ovf = program(
+            *_join_inputs(left, left_keys),
+            *_join_inputs(right, right_keys))
+        hit_counts, r_ovf = jax.device_get((hit_counts_d, route_ovf))
+        if repartition_to is None or int(r_ovf) == 0:
+            break
+        reg.counter("mesh.spmd.overflow_retries").inc()
+        route_capacity *= 2
+
+    total = int(np.asarray(hit_counts).sum())
+    shard_rows_attr = _shard_rows_attribution(left, right)
+    for rows in shard_rows_attr:
+        reg.histogram("mesh.join.shard_rows").observe(rows)
+    telemetry.event("mesh", "join", how=("anti" if anti else "semi"),
+                    shards=S, lane="spmd", shard_rows=shard_rows_attr)
+    reg.counter("mesh.join.execs").inc()
+    reg.counter("mesh.spmd.join_execs").inc()
+    if total == 0:
+        return jnp.zeros(0, dtype=jnp.int32)
+    (li,) = _gather_prefixes((li_sorted,), hit_counts,
+                             li_sorted.shape[1])
+    return li.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Stage-to-stage: repartition, filter, aggregate over the sharded layout
+# ---------------------------------------------------------------------------
+
+
+def repartition_sharded(batch: ColumnBatch, key_columns: Sequence[str],
+                        num_buckets: int, mesh,
+                        capacity_factor: float = CAPACITY_FACTOR
+                        ) -> ShardedBatch:
+    """Re-bucket a DEVICE-resident batch (e.g. a join output feeding the
+    next join) into a born-sharded layout over ICI: hash, contiguous-
+    range owner, one all_to_all — all inside one jitted program, with
+    the routed per-shard layout RETURNED AS-IS (padded + valid mask, no
+    global compaction), so no per-bucket histogram and no row data ever
+    touch the host between stages. Only the overflow scalar syncs."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu import telemetry
+    from hyperspace_tpu.io import transfer
+    from hyperspace_tpu.io.columnar import batch_to_tree, tree_to_batch
+    from hyperspace_tpu.telemetry import instrumented_jit
+
+    if dcn_size(mesh) > 1:
+        raise HyperspaceException(
+            "repartition_sharded supports flat meshes; use "
+            "parallel.build.distributed_build on multi-slice topologies.")
+    n_shards = total_shards(mesh)
+    n = batch.num_rows
+    local = -(-n // n_shards)
+    padded = local * n_shards
+    tree, aux = batch_to_tree(batch)
+
+    def pad(a):
+        return jnp.pad(jnp.asarray(a),
+                       [(0, padded - n)] + [(0, 0)] * (a.ndim - 1))
+
+    in_tree: dict = {}
+    for name, entry in tree.items():
+        out = dict(entry)
+        out["data"] = pad(entry["data"])
+        if "validity" in entry:
+            out["validity"] = pad(entry["validity"])
+        if "hash_hi" in entry:
+            out["hash_hi"] = jnp.tile(jnp.asarray(entry["hash_hi"]),
+                                      n_shards)
+            out["hash_lo"] = jnp.tile(jnp.asarray(entry["hash_lo"]),
+                                      n_shards)
+        in_tree[name] = out
+    in_tree["__valid__"] = {"data": jnp.concatenate(
+        [jnp.ones(n, bool), jnp.zeros(padded - n, bool)])}
+    sharding = shard_rows(mesh)
+    engine = transfer.get_engine()
+    in_tree = jax.tree_util.tree_map(
+        lambda a: engine.put(a, device=sharding), in_tree)
+
+    key_names = tuple(batch.schema.field(c).name for c in key_columns)
+    reg = telemetry.get_registry()
+    factor = capacity_factor
+    while True:
+        capacity = max(16, int(local / n_shards * factor))
+        rows_spec = row_spec(mesh)
+
+        def make_step(capacity=capacity):
+            def step(t):
+                def body(tt):
+                    from hyperspace_tpu.ops.build import _tree_hash_lanes
+                    from hyperspace_tpu.ops.hash_partition import \
+                        flat_hash32
+
+                    valid_l = tt["__valid__"]["data"]
+                    lanes = []
+                    for nm in key_names:
+                        lanes.extend(_tree_hash_lanes(tt[nm]))
+                    h = flat_hash32(lanes)
+                    bucket = (h % jnp.uint32(num_buckets)) \
+                        .astype(jnp.int64)
+                    owner = bucket_owner(bucket, num_buckets,
+                                         n_shards).astype(jnp.int32)
+                    dest = jnp.where(valid_l, owner, jnp.int32(n_shards))
+                    # Route data/validity leaves; dictionary hash tables
+                    # stay shard-local (replicated), like the build.
+                    to_route = []
+                    spec = []
+                    for nm, entry in tt.items():
+                        if nm == "__valid__":
+                            continue
+                        spec.append((nm, "data"))
+                        to_route.append(entry["data"])
+                        if "validity" in entry:
+                            spec.append((nm, "validity"))
+                            to_route.append(entry["validity"])
+                    routed, overflow = _route_local(
+                        to_route + [valid_l], dest, n_shards, capacity)
+                    out_t = {nm: dict(entry) for nm, entry in tt.items()
+                             if nm != "__valid__"}
+                    for (nm, part), arr in zip(spec, routed[:-1]):
+                        out_t[nm][part] = arr
+                    out_t["__valid__"] = {"data": routed[-1]}
+                    out_t["__overflow__"] = {
+                        "data": overflow.reshape(1)}
+                    return out_t
+
+                return compat_shard_map(
+                    body, mesh=mesh,
+                    in_specs=(jax.tree_util.tree_map(
+                        lambda _: rows_spec, t),),
+                    out_specs=rows_spec, check_vma=False)(t)
+
+            return step
+
+        program = _cached_program(
+            ("repartition", mesh, key_names, num_buckets, capacity),
+            lambda: instrumented_jit("mesh.spmd_repartition",
+                                     make_step()))
+        routed_tree = program(in_tree)
+        overflow = int(jnp.sum(routed_tree["__overflow__"]["data"]))
+        if overflow == 0:
+            break
+        reg.counter("mesh.spmd.overflow_retries").inc()
+        factor *= 2
+
+    C = n_shards * capacity
+    row_valid = routed_tree["__valid__"]["data"]
+    out_tree = {}
+    for name, entry in routed_tree.items():
+        if name.startswith("__"):
+            continue
+        cleaned = dict(entry)
+        if "hash_hi" in cleaned:
+            cleaned["hash_hi"] = tree[name]["hash_hi"]
+            cleaned["hash_lo"] = tree[name]["hash_lo"]
+        out_tree[name] = cleaned
+    flat = tree_to_batch(out_tree, batch.schema, aux)
+    telemetry.event("mesh", "repartition", shards=n_shards,
+                    buckets=num_buckets, rows=n, lane="spmd")
+    reg.counter("mesh.spmd.repartition_execs").inc()
+    return ShardedBatch(flat, row_valid, mesh, C, num_buckets,
+                        lengths=None)
+
+
+def sharded_filter(sh: ShardedBatch, expression) -> ColumnBatch:
+    """Predicate scan over the born-sharded layout as ONE jitted SPMD
+    program: the compiled predicate traces together with the validity
+    mask; each device evaluates its shard. Only the final compaction
+    gather crosses shards. Result equals the single-chip `apply_filter`
+    bit for bit."""
+    import time as _time
+
+    import jax.numpy as jnp
+
+    from hyperspace_tpu import telemetry
+    from hyperspace_tpu.engine.compiler import compile_predicate
+    from hyperspace_tpu.io.columnar import batch_to_tree, tree_to_batch
+    from hyperspace_tpu.telemetry import instrumented_jit
+
+    reg = telemetry.get_registry()
+    tree, aux = batch_to_tree(sh.batch)
+    schema = sh.batch.schema
+
+    def step(t, valid):
+        b = tree_to_batch(t, schema, aux)
+        return compile_predicate(expression, b) & valid
+
+    with telemetry.span("mesh:filter", "mesh", rows=sh.num_rows,
+                        shards=sh.n_shards):
+        try:
+            mask = instrumented_jit("mesh.spmd_filter", step)(
+                tree, sh.row_valid)
+        except HyperspaceException:
+            raise
+        except Exception:
+            # A predicate shape the tracer cannot close over (host-only
+            # op in a UDF, say) degrades to the eager SPMD evaluation —
+            # same math, more dispatches.
+            reg.counter("mesh.spmd.filter_eager_fallbacks").inc()
+            mask = compile_predicate(expression, sh.batch) & sh.row_valid
+        t0 = _time.perf_counter()
+        count = int(jnp.sum(mask))  # the one sizing readback
+        sync_s = _time.perf_counter() - t0
+        reg.counter("mesh.filter.execs").inc()
+        reg.counter("mesh.filter.sync_s").inc(sync_s)
+        telemetry.add_seconds("mesh.sync_s", sync_s)
+        telemetry.event("mesh", "filter", shards=sh.n_shards,
+                        rows=sh.num_rows, selected=count, lane="spmd")
+        (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
+        return sh.batch.take(indices)
+
+
+def sharded_group_aggregate(sh: ShardedBatch,
+                            group_columns: Sequence[str], aggregates,
+                            out_schema) -> ColumnBatch:
+    """Group-by aggregation straight over the born-sharded layout: the
+    SPMD partial step consumes the resident [S*C] arrays + validity —
+    no re-padding, no re-placement, no link traffic before the tiny
+    [n_shards, G] partial tables cross for the host combine."""
+    from hyperspace_tpu.parallel.aggregate import distributed_group_aggregate
+
+    return distributed_group_aggregate(
+        sh.batch, group_columns, aggregates, out_schema, sh.mesh,
+        pre_sharded=(sh.batch, sh.row_valid))

@@ -190,6 +190,13 @@ def migrate(doc: dict, source: str = "") -> dict:
     if source:
         out["migrated_from"] = source
     out.setdefault("process_metrics", {})
+    # Headline fields a driver-less legacy blob (e.g. the pre-r06
+    # MULTICHIP `{n_devices, rc, ok}` smoke checks) never carried:
+    # present-but-null keeps the shape canonical while every gate
+    # treats the non-numeric values as not-gateable history.
+    out.setdefault("metric", "legacy")
+    out.setdefault("value", None)
+    out.setdefault("vs_baseline", None)
     return out
 
 

@@ -172,6 +172,60 @@ def _skipping_rows(old: dict, new: dict):
              float(fp), float(fp), fp <= 0)]
 
 
+def compare_multichip(old: dict, new: dict, threshold: float):
+    """Multi-chip artifact gate rows (same row shape as `compare`):
+
+    - `smj_speedup_8dev` — the 8-vs-1-device SMJ speedup must not drop
+      >threshold between rounds (the scaling claim itself);
+    - `warm_h2d.<n>dev` — the warm per-device read of each rung must
+      cross the link ZERO times (absolute gate on the NEW artifact —
+      the healthy value is 0 and nothing ratio-gates against zero);
+    - `inter_stage_d2h.<q>@<n>dev` — a warm multi-stage query must
+      record zero D2H link crossings between stages (absolute);
+    - `bit_identical` — sharded results must equal the 1-device run
+      (absolute: False fails regardless of history).
+
+    Legacy MULTICHIP rounds (the migrated `{n_devices, rc, ok, tail}`
+    smoke blobs) carry no `multichip` section: their rows report as
+    not-gated, the new artifact's absolute rows still gate."""
+    o = old.get("multichip") or {}
+    n = new.get("multichip") or {}
+    rows = []
+
+    def ratio(name, old_v, new_v):
+        if not (isinstance(old_v, (int, float))
+                and isinstance(new_v, (int, float)) and old_v > 0):
+            rows.append((name, old_v, new_v, None, False))
+            return
+        change = new_v / old_v - 1.0
+        rows.append((name, old_v, new_v, change, change < -threshold))
+
+    ratio("smj_speedup_8dev", o.get("smj_speedup"), n.get("smj_speedup"))
+    if isinstance(n.get("smj_speedup"), (int, float)):
+        # Absolute floor: the whole point of the rung — the widest mesh
+        # must beat one device, this round, regardless of history.
+        rows.append(("smj_speedup_floor", 1.0, n["smj_speedup"],
+                     n["smj_speedup"] - 1.0, n["smj_speedup"] <= 1.0))
+    for ndev, chunks in sorted((n.get("warm_h2d_chunks") or {}).items()):
+        if isinstance(chunks, (int, float)):
+            old_c = (o.get("warm_h2d_chunks") or {}).get(ndev)
+            rows.append((f"warm_h2d.{ndev}dev",
+                         float(old_c) if isinstance(old_c, (int, float))
+                         else 0.0, float(chunks), float(chunks),
+                         chunks > 0))
+    for ndev, rung in sorted((n.get("devices") or {}).items()):
+        for q, res in sorted((rung.get("queries") or {}).items()):
+            d2h = res.get("inter_stage_d2h_chunks")
+            if isinstance(d2h, (int, float)):
+                rows.append((f"inter_stage_d2h.{q}@{ndev}dev", 0.0,
+                             float(d2h), float(d2h), d2h > 0))
+    bi = n.get("bit_identical")
+    if bi is not None:
+        rows.append(("bit_identical", 1.0, 1.0 if bi else 0.0,
+                     0.0 if bi else -1.0, not bi))
+    return rows
+
+
 def compare_serve(old: dict, new: dict, threshold: float):
     """Serving-artifact gate rows (same row shape as `compare`):
     scaling ratio + QPS drop >threshold, p50/p99 growth >threshold,
@@ -273,6 +327,11 @@ def main() -> int:
                          "(BENCH_SERVE_r*.json): scaling ratio, QPS, "
                          "p50/p99 latency growth, reject/timeout "
                          "rates")
+    ap.add_argument("--multichip", action="store_true",
+                    help="gate the multi-chip scaling family "
+                         "(MULTICHIP_r*.json): 8-device SMJ speedup, "
+                         "per-device warm link-freedom, inter-stage "
+                         "D2H, bit-identity vs 1 device")
     ap.add_argument("--no-diff", action="store_true",
                     help="skip the attribution tree on gate failure")
     args = ap.parse_args()
@@ -280,7 +339,8 @@ def main() -> int:
     if len(args.artifacts) == 2:
         old_path, new_path = args.artifacts
     elif not args.artifacts:
-        pattern = args.glob or ("BENCH_SERVE_r*.json" if args.serve
+        pattern = args.glob or ("MULTICHIP_r*.json" if args.multichip
+                                else "BENCH_SERVE_r*.json" if args.serve
                                 else "BENCH_TPCDS_r*.json" if args.tpcds
                                 else "BENCH_r*.json")
         old_path, new_path = pick_latest_two(pattern)
@@ -289,10 +349,12 @@ def main() -> int:
 
     old = load_artifact(old_path)
     new = load_artifact(new_path)
-    # Serving artifacts are content-detected like the other families,
-    # so explicit paths gate correctly without the flag.
+    # Serving / multichip artifacts are content-detected like the other
+    # families, so explicit paths gate correctly without the flag.
     serve_mode = args.serve or ("serve" in old and "serve" in new)
-    rows = (compare_serve(old, new, args.threshold) if serve_mode
+    multichip_mode = args.multichip or "multichip" in new
+    rows = (compare_multichip(old, new, args.threshold) if multichip_mode
+            else compare_serve(old, new, args.threshold) if serve_mode
             else compare(old, new, args.threshold))
 
     print(f"bench_regress: {os.path.basename(old_path)} -> "

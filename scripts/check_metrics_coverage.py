@@ -346,6 +346,46 @@ def check_sketch_seam(package_dir: str):
     return failures
 
 
+# The ONE sanctioned layout-spec seam: every NamedSharding /
+# PartitionSpec / shard_map the package constructs comes from
+# parallel/mesh.py (row_spec, shard_rows, replicated, compat_shard_map,
+# bucket_ranges) — the born-sharded on-disk layout, the per-device cache
+# residency, and the SPMD collectives all derive from that ONE map, and a
+# raw construction elsewhere is a layout that can silently drift from it.
+_RAW_SHARDING_RE = re.compile(
+    r"NamedSharding\s*\(|PartitionSpec\s*\(|(?<!compat_)shard_map\s*\(|"
+    r"from\s+jax\.sharding\s+import|from\s+jax\.experimental\s+import\s+"
+    r"shard_map|from\s+jax\.experimental\.shard_map\s+import")
+_SHARDING_ALLOWED = os.path.join("parallel", "mesh.py")
+
+
+def check_sharding_seam(package_dir: str):
+    """Source lint: no raw NamedSharding/PartitionSpec/shard_map
+    construction outside parallel/mesh.py."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _SHARDING_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_SHARDING_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: raw "
+                            "sharding/layout construction outside "
+                            "parallel/mesh.py — derive the spec from "
+                            "the canonical helpers (row_spec/"
+                            "shard_rows/replicated/compat_shard_map/"
+                            "bucket_ranges)")
+    return failures
+
+
 # The ONE sanctioned backoff point: every storage retry routes through
 # the policy in utils/retry.py (typed classification, conf-driven
 # backoff, io.retries/io.giveups counters, fault-injection coverage).
@@ -457,6 +497,8 @@ def main() -> int:
     failures.extend(check_serving_error_counters())
     failures.extend(check_index_kind_serde())
     failures.extend(check_sketch_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_sharding_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_retry_seams(
         os.path.dirname(hyperspace_tpu.__file__)))

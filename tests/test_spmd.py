@@ -1,0 +1,423 @@
+"""Born-sharded SPMD execution (`parallel/spmd.py`): bit-identity with
+the single-device operators at 1/2/4/8 virtual devices, the in-program
+mismatched-bucket repartition, static-capacity overflow recovery, the
+per-device segment-cache read path, and the device-resident stage-flow
+telemetry contract (zero D2H between stages of a warm two-stage SMJ)."""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from hyperspace_tpu import telemetry
+from hyperspace_tpu.io import columnar
+from hyperspace_tpu.parallel import spmd
+from hyperspace_tpu.parallel.build import distributed_build
+from hyperspace_tpu.parallel.mesh import (bucket_owner, bucket_ranges,
+                                          make_mesh, shard_row_segments)
+
+
+def make_batch(n, seed=0, keyspace=None):
+    rng = np.random.default_rng(seed)
+    return columnar.from_arrow(pa.table({
+        "k": rng.integers(0, keyspace or max(4, n // 8),
+                          n).astype(np.int64),
+        "v": rng.random(n).astype(np.float64),
+    }))
+
+
+def sharded_pair(n=1200, m=500, buckets=16, n_dev=8, seed=1,
+                 keyspace=None):
+    mesh = make_mesh(n_dev)
+    left = make_batch(n, seed=seed, keyspace=keyspace)
+    right = make_batch(m, seed=seed + 1, keyspace=keyspace)
+    lb, ll = distributed_build(left, ["k"], buckets, mesh)
+    rb, rl = distributed_build(right, ["k"], buckets, mesh)
+    return (mesh, spmd.shard_bucket_ordered(lb, ll, mesh),
+            spmd.shard_bucket_ordered(rb, rl, mesh), lb, rb, ll, rl)
+
+
+def pairs_frame(lsh, rsh, li, ri):
+    lk = np.asarray(lsh.batch.column("k").data)
+    rk = np.asarray(rsh.batch.column("k").data)
+    li, ri = np.asarray(li), np.asarray(ri)
+    return pd.DataFrame({
+        "lk": np.where(li >= 0, lk[np.clip(li, 0, None)], -1),
+        "rk": np.where(ri >= 0, rk[np.clip(ri, 0, None)], -1),
+    }).sort_values(["lk", "rk"]).reset_index(drop=True)
+
+
+def oracle_frame(lb, rb, how):
+    lpd = pd.DataFrame({"lk": np.asarray(lb.column("k").data)})
+    rpd = pd.DataFrame({"rk": np.asarray(rb.column("k").data)})
+    merged = lpd.assign(j=lpd.lk).merge(
+        rpd.assign(j=rpd.rk), on="j",
+        how={"inner": "inner", "left_outer": "left",
+             "full_outer": "outer"}[how]).drop(columns="j")
+    return (merged.fillna(-1).astype(np.int64)
+            .sort_values(["lk", "rk"]).reset_index(drop=True))
+
+
+def test_bucket_range_map_is_exact_inverse():
+    for B, n in ((16, 8), (64, 8), (5, 2), (7, 3), (8, 1)):
+        ranges = bucket_ranges(B, n)
+        assert ranges[0][0] == 0 and ranges[-1][1] == B
+        for s, (lo, hi) in enumerate(ranges):
+            for b in range(lo, hi):
+                assert bucket_owner(b, B, n) == s
+        # contiguous, non-overlapping
+        for s in range(1, n):
+            assert ranges[s][0] == ranges[s - 1][1]
+
+
+def test_shard_row_segments_cover_rows():
+    lengths = np.asarray([3, 0, 5, 2, 7, 1, 0, 4], dtype=np.int64)
+    segs = shard_row_segments(lengths, 4)
+    assert segs[0][0] == 0 and segs[-1][1] == int(lengths.sum())
+    for s in range(1, 4):
+        assert segs[s][0] == segs[s - 1][1]
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+def test_join_bit_identity_across_device_counts(n_dev):
+    """SMJ over the born-sharded layout equals the single-chip bucketed
+    join for every pair type, at every mesh size."""
+    from hyperspace_tpu.ops.bucketed_join import bucketed_join_indices
+
+    mesh, lsh, rsh, lb, rb, ll, rl = sharded_pair(n_dev=n_dev)
+    for how in ("inner", "left_outer", "full_outer"):
+        li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"],
+                                           how=how)
+        got = pairs_frame(lsh, rsh, li, ri)
+        pd.testing.assert_frame_equal(got, oracle_frame(lb, rb, how))
+    # membership
+    lk = np.asarray(lb.column("k").data)
+    member = np.isin(lk, np.asarray(rb.column("k").data))
+    for anti in (False, True):
+        idx = np.asarray(spmd.sharded_semi_anti_indices(
+            lsh, rsh, ["k"], ["k"], anti=anti))
+        keys = np.sort(np.asarray(lsh.batch.column("k").data)[idx])
+        exp = np.sort(lk[~member if anti else member])
+        assert (keys == exp).all(), f"anti={anti}"
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_filter_and_aggregate_bit_identity(n_dev):
+    from hyperspace_tpu.engine.compiler import apply_filter
+    from hyperspace_tpu.ops.aggregate import group_aggregate
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.plan.nodes import Aggregate, AggSpec, Scan
+    from hyperspace_tpu.plan.schema import Schema
+
+    mesh = make_mesh(n_dev)
+    batch = make_batch(2000, seed=7)
+    built, lengths = distributed_build(batch, ["k"], 16, mesh)
+    sh = spmd.shard_bucket_ordered(built, lengths, mesh)
+
+    pred = col("k") < lit(60)
+    got = columnar.to_arrow(spmd.sharded_filter(sh, pred)).to_pandas()
+    want = columnar.to_arrow(apply_filter(built, pred)).to_pandas()
+    cols = list(got.columns)
+    pd.testing.assert_frame_equal(
+        got.sort_values(cols).reset_index(drop=True),
+        want.sort_values(cols).reset_index(drop=True))
+
+    schema = Schema.from_arrow(pa.table(
+        {"k": np.zeros(1, np.int64), "v": np.zeros(1)}).schema)
+    specs = [AggSpec("count", "*", "cnt"), AggSpec("sum", "v", "sv"),
+             AggSpec("min", "v", "mn"), AggSpec("max", "v", "mx")]
+    out_schema = Aggregate(["k"], specs, Scan(["/nx"], schema)).schema
+    agg = spmd.sharded_group_aggregate(sh, ["k"], specs, out_schema)
+    single = group_aggregate(built, ["k"], specs, out_schema)
+    g = columnar.to_arrow(agg).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    s = columnar.to_arrow(single).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, s, check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+def test_mismatched_bucket_counts_repartition_in_program():
+    """The ranker's fallback: the right side arrives at HALF the bucket
+    count and re-buckets over ICI inside the jitted program; results
+    equal the equal-bucket join."""
+    mesh = make_mesh(8)
+    left = make_batch(900, seed=3)
+    right = make_batch(400, seed=4)
+    lb, ll = distributed_build(left, ["k"], 16, mesh)
+    rb8, rl8 = distributed_build(right, ["k"], 8, mesh)
+    lsh = spmd.shard_bucket_ordered(lb, ll, mesh)
+    rsh8 = spmd.shard_bucket_ordered(rb8, rl8, mesh)
+    assert rsh8.num_buckets != lsh.num_buckets
+    for how in ("inner", "left_outer"):
+        li, ri = spmd.sharded_join_indices(lsh, rsh8, ["k"], ["k"],
+                                           how=how)
+        got = pairs_frame(lsh, rsh8, li, ri)
+        pd.testing.assert_frame_equal(got, oracle_frame(lb, rb8, how))
+    idx = np.asarray(spmd.sharded_semi_anti_indices(
+        lsh, rsh8, ["k"], ["k"], anti=True))
+    lk = np.asarray(lb.column("k").data)
+    member = np.isin(lk, np.asarray(rb8.column("k").data))
+    assert len(idx) == int((~member).sum())
+
+
+def test_skewed_overflow_retries_exactly():
+    """A hot key whose match expansion blows past the first-attempt
+    static capacity must be recovered EXACTLY by the on-device overflow
+    detection + doubled retry — never silently truncated."""
+    mesh = make_mesh(4)
+    n = 2000
+    rng = np.random.default_rng(9)
+    hot = np.where(rng.random(n) < 0.7, 7, rng.integers(0, 64, n))
+    left = columnar.from_arrow(pa.table({
+        "k": hot.astype(np.int64), "v": rng.random(n)}))
+    right = columnar.from_arrow(pa.table({
+        "k": np.where(rng.random(300) < 0.5, 7,
+                      rng.integers(0, 64, 300)).astype(np.int64),
+        "v": rng.random(300)}))
+    lb, ll = distributed_build(left, ["k"], 16, mesh)
+    rb, rl = distributed_build(right, ["k"], 16, mesh)
+    lsh = spmd.shard_bucket_ordered(lb, ll, mesh)
+    rsh = spmd.shard_bucket_ordered(rb, rl, mesh)
+    spmd._CAP_MEMO.clear()
+    before = telemetry.get_registry().counters_dict().get(
+        "mesh.spmd.overflow_retries", 0)
+    # Tiny first-attempt capacity forces the overflow path.
+    li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"],
+                                       capacity_factor=0.01)
+    after = telemetry.get_registry().counters_dict().get(
+        "mesh.spmd.overflow_retries", 0)
+    assert after > before, "overflow retry never fired"
+    got = pairs_frame(lsh, rsh, li, ri)
+    pd.testing.assert_frame_equal(got, oracle_frame(lb, rb, "inner"))
+    spmd._CAP_MEMO.clear()
+
+
+def test_pad_blowup_guard():
+    lengths = np.zeros(16, dtype=np.int64)
+    lengths[3] = 1 << 17  # one hot bucket
+    lengths[4:] = 1
+    assert spmd.pad_blowup(lengths, 8)
+    even = np.full(16, 1 << 13, dtype=np.int64)
+    assert not spmd.pad_blowup(even, 8)
+
+
+def test_warm_two_stage_smj_zero_d2h_between_stages():
+    """Device-resident stage flow: join -> in-program repartition ->
+    second join -> SPMD aggregate, with ZERO D2H link crossings across
+    the whole pipeline (the engine-counted `link.d2h.*` series stays
+    flat until result materialization)."""
+    from hyperspace_tpu.ops.bucketed_join import assemble_join_output
+    from hyperspace_tpu.plan.nodes import Aggregate, AggSpec, Scan
+    from hyperspace_tpu.plan.schema import Schema
+
+    mesh, lsh, rsh, lb, rb, ll, rl = sharded_pair(n=1500, m=700,
+                                                  seed=21)
+
+    def pipeline():
+        li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"])
+        joined = assemble_join_output(lsh.batch, rsh.batch, li, ri,
+                                      how="inner")
+        stage2 = spmd.repartition_sharded(joined, ["k"], 16, mesh)
+        li2, ri2 = spmd.sharded_join_indices(stage2, rsh, ["k"], ["k"])
+        j2 = assemble_join_output(stage2.batch, rsh.batch, li2, ri2,
+                                  how="inner",
+                                  columns=["k", "v", "v_r"])
+        stage3 = spmd.repartition_sharded(j2, ["k"], 16, mesh)
+        schema = Schema.from_arrow(pa.table(
+            {"k": np.zeros(1, np.int64), "v": np.zeros(1),
+             "v_r": np.zeros(1)}).schema)
+        specs = [AggSpec("count", "*", "cnt"),
+                 AggSpec("sum", "v", "sv")]
+        out_schema = Aggregate(["k"], specs,
+                               Scan(["/nx"], schema)).schema
+        return spmd.sharded_group_aggregate(stage3, ["k"], specs,
+                                            out_schema)
+
+    cold = columnar.to_arrow(pipeline()).to_pandas()
+    reg = telemetry.get_registry()
+    before = dict(reg.counters_dict())
+    warm_out = pipeline()  # stop BEFORE materialization
+    after = dict(reg.counters_dict())
+    assert after.get("link.d2h.chunks", 0) == \
+        before.get("link.d2h.chunks", 0), "a stage crossed D2H"
+    assert after.get("link.d2h.bytes", 0) == \
+        before.get("link.d2h.bytes", 0)
+    warm = columnar.to_arrow(warm_out).to_pandas()
+    pd.testing.assert_frame_equal(
+        cold.sort_values("k").reset_index(drop=True),
+        warm.sort_values("k").reset_index(drop=True))
+
+
+@pytest.fixture
+def born_sharded_env(tmp_path, sample_parquet):
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.engine.session import HyperspaceSession
+    from hyperspace_tpu.facade import Hyperspace
+
+    conf = HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": 8,
+        "hyperspace.distribution.enabled": "true",
+        "hyperspace.broadcast.threshold": -1,
+    })
+    session = HyperspaceSession(conf)
+    return session, Hyperspace(session), sample_parquet
+
+
+def test_born_sharded_build_layout_and_log_entry(born_sharded_env):
+    """The mesh build writes per-device parquet shards (contiguous
+    bucket ranges, shard-tagged filenames), the `_shard_layout.json`
+    record, and the log entry carries the layout."""
+    session, hs, src = born_sharded_env
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.io.builder import read_shard_layout
+
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("born", ["clicks"], ["id"]))
+    vdir = os.path.join(session.conf.system_path, "born", "v__=0")
+    files = [os.path.basename(f)
+             for f in glob.glob(os.path.join(vdir, "part-*.parquet"))]
+    assert files and all("-s0" in f for f in files), files
+    layout = read_shard_layout(vdir)
+    assert layout is not None and layout["numShards"] == 8
+    assert layout["bucketRanges"] == [[s, s + 1] for s in range(8)]
+    entry = next(e for e in hs._manager.get_indexes()
+                 if e.name == "born")
+    assert entry.shard_layout == layout
+    # Shard tag s matches the contiguous-range owner of the bucket id.
+    from hyperspace_tpu.io.parquet import bucket_of_file
+    for f in files:
+        b = bucket_of_file(f)
+        s = int(f.split("-s")[1][:2])
+        assert bucket_owner(b, 8, 8) == s, f
+
+
+def test_engine_smj_spmd_lane_and_warm_link_free(born_sharded_env):
+    """The planner-selected bucketed SMJ rides the SPMD lane (counter
+    pinned), warm repeats read per-device from the segment cache with
+    ZERO H2D chunks, and results equal rules-off."""
+    session, hs, src = born_sharded_env
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.io import segcache
+
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("sjl", ["imprs"], ["id", "clicks"]))
+    hs.create_index(df, IndexConfig("sjr", ["imprs"], ["score"]))
+    left = df.select("imprs", "id", "clicks")
+    right = df.select("imprs", "score")
+    query = left.join(right, on="imprs")
+    sort_cols = ["imprs", "id", "score"]
+
+    session.disable_hyperspace()
+    plain = query.to_pandas().sort_values(sort_cols) \
+        .reset_index(drop=True)
+    session.enable_hyperspace()
+    segcache.clear()
+    reg = telemetry.get_registry()
+
+    def counters():
+        c = reg.counters_dict()
+        return {k: c.get(k, 0) for k in
+                ("mesh.spmd.join_execs", "link.h2d.chunks",
+                 "cache.segments.hits")}
+
+    c0 = counters()
+    cold = query.to_pandas().sort_values(sort_cols) \
+        .reset_index(drop=True)
+    c1 = counters()
+    warm = query.to_pandas().sort_values(sort_cols) \
+        .reset_index(drop=True)
+    c2 = counters()
+    session.disable_hyperspace()
+
+    assert c1["mesh.spmd.join_execs"] > c0["mesh.spmd.join_execs"], \
+        "SPMD lane not taken"
+    assert c2["link.h2d.chunks"] == c1["link.h2d.chunks"], \
+        "warm per-device read crossed the link"
+    assert c2["cache.segments.hits"] > c1["cache.segments.hits"]
+    pd.testing.assert_frame_equal(plain, cold)
+    pd.testing.assert_frame_equal(plain, warm)
+
+
+def test_spmd_disabled_falls_back_to_legacy_mesh(born_sharded_env):
+    session, hs, src = born_sharded_env
+    from hyperspace_tpu.index.index_config import IndexConfig
+
+    session.conf.set("spark.hyperspace.distribution.spmd.enabled",
+                     "false")
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("nsl", ["imprs"], ["id"]))
+    hs.create_index(df, IndexConfig("nsr", ["imprs"], ["score"]))
+    query = df.select("imprs", "id").join(df.select("imprs", "score"),
+                                          on="imprs")
+    session.disable_hyperspace()
+    plain = query.to_pandas().sort_values(["imprs", "id", "score"]) \
+        .reset_index(drop=True)
+    session.enable_hyperspace()
+    reg = telemetry.get_registry()
+    before = reg.counters_dict().get("mesh.spmd.join_execs", 0)
+    indexed = query.to_pandas().sort_values(["imprs", "id", "score"]) \
+        .reset_index(drop=True)
+    session.disable_hyperspace()
+    assert reg.counters_dict().get("mesh.spmd.join_execs", 0) == before
+    pd.testing.assert_frame_equal(plain, indexed)
+
+
+def test_segcache_get_or_fill_invalidation():
+    """Per-range entries ride the index-FSM invalidation hooks: a
+    version commit under the same root drops them; the single-flight
+    contract serves concurrent fills one decode."""
+    import threading
+
+    from hyperspace_tpu.io import segcache
+
+    cache = segcache.SegmentCache(budget_bytes=1 << 30)
+    ref = segcache.SegmentRef("idx", "/tmp/idx_root", 0, "mc")
+    fills = []
+
+    def fill():
+        fills.append(1)
+        return {"columns": {}, "rows": 1}, 1024
+
+    key = ref.key + (("spmd", 0, 4, 4, 10),)
+    results = []
+
+    def worker():
+        results.append(cache.get_or_fill(key, fill, ref=ref))
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(fills) == 1, "single-flight violated"
+    assert all(r is results[0] for r in results)
+    assert cache.get_or_fill(key, fill, ref=ref) is results[0]
+    assert len(fills) == 1
+    # FSM hook: a new committed version under the root evicts the range.
+    cache.invalidate_index("/tmp/idx_root", keep_version=1)
+    cache.get_or_fill(key, fill, ref=ref)
+    assert len(fills) == 2
+
+
+def test_repartition_sharded_routes_all_rows():
+    """Every input row survives the in-program re-bucket, lands on its
+    bucket's contiguous-range owner, and a join over the repartitioned
+    layout equals the oracle."""
+    mesh = make_mesh(8)
+    batch = make_batch(1000, seed=31)
+    sh = spmd.repartition_sharded(batch, ["k"], 16, mesh)
+    assert sh.num_rows == 1000
+    rsh_mesh, lsh, rsh, lb, rb, ll, rl = sharded_pair(n_dev=8, seed=31)
+    li, ri = spmd.sharded_join_indices(sh, rsh, ["k"], ["k"])
+    lk = np.asarray(sh.batch.column("k").data)
+    rk = np.asarray(rsh.batch.column("k").data)
+    li, ri = np.asarray(li), np.asarray(ri)
+    assert (lk[li] == rk[ri]).all()
+    exp = pd.DataFrame({"k": np.asarray(batch.column("k").data)}).merge(
+        pd.DataFrame({"k": np.asarray(rb.column("k").data)}), on="k")
+    assert len(exp) == len(li)

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Advisor rung: a synthetic recurring filter+join workload, advisor
+OFF vs advisor ON.
+
+Phase 1 (advisor off) runs K repetitions of a selective point-filter
+query and a co-keyed equi-join over an un-indexed source, measuring
+scanned bytes and wall per repetition. Phase 2 runs one
+`IndexAdvisor.run_once()` cycle — the miner reads exactly the flight
+ring phase 1 filled, the what-if scorer replays the recorded plans,
+and the executor auto-builds the winners through the lease path — then
+re-runs the identical workload and measures again. The rung's claim:
+
+- the advisor recommended AND built at least one index,
+- the repeat workload is served by it (rule-usage telemetry), and
+- it reads STRICTLY fewer bytes, with bit-identical results.
+
+Prints exactly ONE JSON line (canonical schema via
+`telemetry.artifact.make_artifact`; `scripts/bench_regress.py
+--advisor` gates built-count and the byte reduction from it).
+
+Env knobs: BENCH_ADVISOR_ROWS (40000), BENCH_ADVISOR_REPEATS (4).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+ROWS = int(os.environ.get("BENCH_ADVISOR_ROWS", 40_000))
+REPEATS = int(os.environ.get("BENCH_ADVISOR_REPEATS", 4))
+
+
+def _write(path: str, table) -> str:
+    import pyarrow.parquet as pq
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(table, os.path.join(path, "part-0.parquet"))
+    return path
+
+
+def _canonical(table):
+    """Row order is not part of the result contract (an index-served
+    SMJ legitimately orders by join key); bit-identity compares the
+    sorted table, same as the serving/chaos suites."""
+    return table.sort_by([(n, "ascending") for n in table.schema.names])
+
+
+def _scan_bytes(metrics) -> int:
+    return sum(op.detail.get("bytes_scanned", 0)
+               for op in metrics.operators if op.name == "Scan")
+
+
+def _run_workload(session, queries):
+    """One pass over the workload: total wall, total scanned bytes,
+    result tables (the bit-identity oracle), and whether any index rule
+    applied."""
+    wall = 0.0
+    nbytes = 0
+    applied = 0
+    tables = []
+    for q in queries:
+        t0 = time.perf_counter()
+        table = q.collect()
+        wall += time.perf_counter() - t0
+        m = session.last_query_metrics()
+        nbytes += _scan_bytes(m)
+        applied += sum(1 for e in m.events
+                       if e.get("category") == "rule"
+                       and e.get("action") == "applied")
+        tables.append(table)
+    return wall, nbytes, applied, tables
+
+
+def main():
+    import pyarrow as pa
+
+    from hyperspace_tpu import telemetry
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.engine.session import HyperspaceSession
+    from hyperspace_tpu.facade import Hyperspace
+    from hyperspace_tpu.plan import expr as E
+
+    work = tempfile.mkdtemp(prefix="bench_advisor_")
+    try:
+        rng = np.random.default_rng(7)
+        facts = pa.table({
+            "k": rng.integers(0, ROWS // 8, ROWS).astype(np.int64),
+            "v": rng.random(ROWS),
+            "tag": rng.integers(0, 50, ROWS).astype(np.int32),
+        })
+        dims = pa.table({
+            "k": np.arange(ROWS // 8, dtype=np.int64),
+            "label": rng.integers(0, 9, ROWS // 8).astype(np.int64),
+        })
+        facts_dir = _write(os.path.join(work, "facts"), facts)
+        dims_dir = _write(os.path.join(work, "dims"), dims)
+
+        conf = HyperspaceConf({
+            "spark.hyperspace.warehouse.dir": os.path.join(work, "wh"),
+            "spark.hyperspace.index.num.buckets": 8,
+            # One cycle may build the filter index, the skipping
+            # sketch, AND the join pair (default 2 spreads them over
+            # runs — fine in production, noisy in a bench).
+            "spark.hyperspace.advisor.max.builds": 6,
+        })
+        session = HyperspaceSession(conf).enable_hyperspace()
+        hs = Hyperspace(session)
+        f = session.read_parquet(facts_dir)
+        d = session.read_parquet(dims_dir)
+        queries = [
+            f.filter(E.col("tag") == 7).select("k", "v", "tag"),
+            f.join(d, on="k").select("k", "v", "label"),
+        ]
+
+        before_wall = before_bytes = 0
+        tables_before = None
+        for _ in range(REPEATS):
+            w, b, _a, tables_before = _run_workload(session, queries)
+            before_wall += w
+            before_bytes += b
+
+        advisor = hs.advisor()
+        t0 = time.perf_counter()
+        summary = advisor.run_once()
+        advise_s = time.perf_counter() - t0
+        built = [d for d in summary["decisions"]
+                 if d.get("action") == "built"]
+
+        after_wall = after_bytes = after_applied = 0
+        tables_after = None
+        for _ in range(REPEATS):
+            w, b, a, tables_after = _run_workload(session, queries)
+            after_wall += w
+            after_bytes += b
+            after_applied += a
+
+        bit_identical = all(_canonical(x).equals(_canonical(y))
+                            for x, y in
+                            zip(tables_before, tables_after))
+        advisor_block = {
+            "repeats": REPEATS,
+            "rows": ROWS,
+            "signatures": len(summary["signatures"]),
+            "recommended": len(summary["recommendations"]),
+            "built": sum(len(d.get("indexes", ())) for d in built),
+            "advise_s": round(advise_s, 4),
+            "bytes_scanned_before": before_bytes,
+            "bytes_scanned_after": after_bytes,
+            "bytes_reduction": round(1.0 - after_bytes
+                                     / max(before_bytes, 1), 4),
+            "wall_before_s": round(before_wall, 4),
+            "wall_after_s": round(after_wall, 4),
+            "rule_applied_after": after_applied,
+            "bit_identical": bit_identical,
+            "decisions": summary["decisions"],
+        }
+        print(f"# advisor: {advisor_block['built']} built, bytes "
+              f"{before_bytes} -> {after_bytes} "
+              f"({advisor_block['bytes_reduction']:.1%} less), "
+              f"applied {after_applied}, bit_identical {bit_identical}",
+              file=sys.stderr)
+
+        result = telemetry.artifact.make_artifact(
+            driver="bench_advisor.py",
+            metric="advisor_bytes_reduction",
+            value=advisor_block["bytes_reduction"],
+            unit="fraction",
+            vs_baseline=round(before_bytes / max(after_bytes, 1), 3),
+            extra={"advisor": advisor_block})
+        print(json.dumps(result))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,18 @@ from hyperspace_tpu.index.log_manager import IndexLogManager
 from hyperspace_tpu.actions.refresh import RefreshAction
 
 
+def _version_of(root: str):
+    """Committed `v__=N` parsed from a data root, or None (same parse
+    as `plan/rules/base._version_of_root`, inlined to keep actions/ off
+    the rules package)."""
+    import re
+
+    from hyperspace_tpu import constants
+    m = re.search(re.escape(constants.INDEX_VERSION_DIRECTORY_PREFIX)
+                  + r"=(\d+)$", os.path.basename(root.rstrip("/\\")))
+    return int(m.group(1)) if m else None
+
+
 def _link_or_copy(src: str, dst: str) -> None:
     from hyperspace_tpu.utils import file_utils, storage
     if storage.is_url(src) or storage.is_url(dst):
@@ -151,13 +163,16 @@ class RefreshIncrementalAction(RefreshAction):
                 "indexed files were modified in place — run a full refresh.")
 
     def _carry_previous_runs(self, out_dir: str,
-                             deleted_ids: List[int]) -> None:
+                             deleted_ids: List[int]) -> set:
         """Bring the previous version's bucket runs into `out_dir`.
         Without deletions every run hard-links (zero-copy). With
         deletions, runs containing a deleted file's rows are rewritten
         with those rows filtered out — a pure mask on the lineage column,
         so the run's sort order (and therefore the whole bucketed layout)
-        is preserved without touching a sort kernel."""
+        is preserved without touching a sort kernel. Returns the bucket
+        ids whose CONTENT changed relative to the previous version
+        (rewritten or emptied runs) — the bucket-scoped invalidation
+        input; hard-linked runs are byte-identical and stay out of it."""
         import numpy as np
         import pyarrow as pa
 
@@ -166,7 +181,8 @@ class RefreshIncrementalAction(RefreshAction):
 
         prev_root = self.previous_entry.content.root
         deleted_arr = np.asarray(sorted(deleted_ids), dtype=np.int64)
-        for _bucket, files in sorted(parquet.bucket_files(prev_root).items()):
+        touched = set()
+        for bucket, files in sorted(parquet.bucket_files(prev_root).items()):
             for f in files:
                 dst = os.path.join(out_dir, os.path.basename(f))
                 if not len(deleted_arr):
@@ -180,8 +196,13 @@ class RefreshIncrementalAction(RefreshAction):
                     _link_or_copy(f, dst)
                 elif keep.any():
                     parquet.write_table(table.filter(pa.array(keep)), dst)
-                # else: every row dropped -> no file (empty-bucket parity
-                # with the full build, which writes no file either).
+                    touched.add(int(bucket))
+                else:
+                    # every row dropped -> no file (empty-bucket parity
+                    # with the full build, which writes no file either)
+                    # — still a CONTENT change for the bucket.
+                    touched.add(int(bucket))
+        return touched
 
     def op(self) -> None:
         from hyperspace_tpu.io import parquet
@@ -194,11 +215,19 @@ class RefreshIncrementalAction(RefreshAction):
         self.annotate_report(appended_files=len(appended),
                              deleted_lineage_ids=len(deleted_ids))
         file_utils.create_directory(out_dir)
-        self._carry_previous_runs(out_dir, deleted_ids)
+        touched = self._carry_previous_runs(out_dir, deleted_ids)
         spec_path = os.path.join(prev_root, parquet.BUCKET_SPEC_FILE)
         if file_utils.exists(spec_path):
             _link_or_copy(spec_path,
                           os.path.join(out_dir, parquet.BUCKET_SPEC_FILE))
+        # Bucket-scoped invalidation channel: the commit names exactly
+        # the buckets whose bytes changed vs the carried-from version;
+        # everything else hard-linked byte-identically, so the segment
+        # cache rekeys those warm entries instead of dropping them.
+        prev_version = _version_of(prev_root)
+        if prev_version is not None:
+            self._touched_buckets = touched
+            self._carried_from_version = prev_version
 
         if not appended:
             self.commit_data_version()
@@ -221,7 +250,19 @@ class RefreshIncrementalAction(RefreshAction):
         written = write_bucketed_table(table, cfg.indexed_columns,
                                        self.num_buckets(), out_dir,
                                        file_suffix=f"delta{delta_version}")
+        if prev_version is not None:
+            for f in written:
+                m = parquet.BUCKET_FILE_RE.search(os.path.basename(f))
+                if m is not None:
+                    touched.add(int(m.group(1)))
+                else:
+                    # Unparseable delta name: the bucket set is no
+                    # longer provable — fall back to the full sweep.
+                    self._touched_buckets = None
+                    self._carried_from_version = None
+                    break
         self.annotate_report(delta_files_written=len(written),
-                             delta_rows=table.num_rows)
+                             delta_rows=table.num_rows,
+                             touched_buckets=sorted(touched))
         self.commit_data_version()
         self.stamp_stats()

@@ -157,6 +157,16 @@ class HyperspaceConf:
         return self.device_cache_bytes
 
     @property
+    def segment_cache_host_bytes(self) -> int:
+        """Host-RAM tier budget of the tiered segment cache
+        (`io/segcache.py`): device-tier evictions demote into host
+        memory up to this many bytes instead of dropping, and a later
+        read re-promotes through the TransferEngine fill lane (H2D
+        paid, parquet decode skipped). 0 (default) disables the tier."""
+        return self.get_int(constants.SEGMENT_CACHE_HOST_BYTES_KEY,
+                            constants.SEGMENT_CACHE_HOST_BYTES_DEFAULT)
+
+    @property
     def segment_cache_pin_indexes(self) -> str:
         """Comma-separated index names whose cached segments are never
         evicted by byte pressure (invalidation still drops them)."""
@@ -328,6 +338,68 @@ class HyperspaceConf:
         at data-skipping build time (more files = tighter zones)."""
         return self.get_int(constants.SKIPPING_ZORDER_FILES,
                             constants.SKIPPING_ZORDER_FILES_DEFAULT)
+
+    @property
+    def compile_cache_dir(self):
+        """Directory for JAX's persistent compilation cache (warm-start
+        compilation: a fresh replica's first canonical-shape query
+        loads persisted executables instead of tracing). None = off.
+        Wired at session init via
+        `telemetry.compilation.configure_persistent_cache`."""
+        return self.get(constants.COMPILE_CACHE_DIR)
+
+    @property
+    def advisor_enabled(self) -> bool:
+        """Self-driving index advisor (`hyperspace_tpu/advisor/`) on/off
+        — "false" makes `IndexAdvisor.run_once` a mine-only no-op (no
+        recommendations acted on, no builds)."""
+        return (self.get(constants.ADVISOR_ENABLED,
+                         constants.ADVISOR_ENABLED_DEFAULT)
+                or "true").lower() == "true"
+
+    @property
+    def advisor_build_budget_bytes(self) -> int:
+        """Per-run cap on summed ESTIMATED index bytes the advisor may
+        build (its per-warehouse build budget)."""
+        return self.get_int(constants.ADVISOR_BUILD_BUDGET_BYTES,
+                            constants.ADVISOR_BUILD_BUDGET_BYTES_DEFAULT)
+
+    @property
+    def advisor_max_builds(self) -> int:
+        """How many builds one advisor run may start."""
+        return self.get_int(constants.ADVISOR_MAX_BUILDS,
+                            constants.ADVISOR_MAX_BUILDS_DEFAULT)
+
+    @property
+    def advisor_serve_headroom(self) -> float:
+        """Fraction of `serve.hbm.budget.bytes` that may be admitted
+        before the advisor defers its builds (never starve admission)."""
+        return float(self.get(
+            constants.ADVISOR_SERVE_HEADROOM,
+            str(constants.ADVISOR_SERVE_HEADROOM_DEFAULT)))
+
+    @property
+    def advisor_min_benefit_bytes(self) -> int:
+        """Minimum amortized bytes-avoided estimate before a candidate
+        is recommended."""
+        return self.get_int(constants.ADVISOR_MIN_BENEFIT_BYTES,
+                            constants.ADVISOR_MIN_BENEFIT_BYTES_DEFAULT)
+
+    @property
+    def advisor_skipping_prune_fraction(self) -> float:
+        """Assumed prune effectiveness of a hypothetical data-skipping
+        index in the what-if math (sketches don't exist yet, so this is
+        a conservative constant, not a measurement)."""
+        return float(self.get(
+            constants.ADVISOR_SKIPPING_PRUNE_FRACTION,
+            str(constants.ADVISOR_SKIPPING_PRUNE_FRACTION_DEFAULT)))
+
+    @property
+    def advisor_min_repeats(self) -> int:
+        """Observed repeat count below which a workload signature is
+        not considered recurring."""
+        return self.get_int(constants.ADVISOR_MIN_REPEATS,
+                            constants.ADVISOR_MIN_REPEATS_DEFAULT)
 
     @property
     def maintenance_lease_seconds(self) -> int:

@@ -43,6 +43,18 @@ DEVICE_CACHE_BYTES_KEY = "spark.hyperspace.cache.device.bytes"
 SEGMENT_CACHE_BYTES_KEY = "spark.hyperspace.cache.segments.bytes"
 SEGMENT_CACHE_PIN_INDEXES = "spark.hyperspace.cache.segments.pin.indexes"
 
+# Tiered segment cache: host-RAM tier below HBM (`io/segcache.py`).
+# When > 0, a segment evicted from the device tier by byte pressure is
+# DEMOTED into a host-resident copy (decoded columns fetched D2H once)
+# instead of dropped outright, up to this many host bytes (host LRU
+# past the budget evicts for real). A later read of a demoted key
+# re-promotes through the TransferEngine fill lane — H2D cost paid,
+# parquet decode skipped. 0 (the default) disables the tier: eviction
+# drops, exactly the pre-tier behavior. Invalidation (refresh/vacuum/
+# drop) sweeps both tiers.
+SEGMENT_CACHE_HOST_BYTES_KEY = "spark.hyperspace.cache.segments.host.bytes"
+SEGMENT_CACHE_HOST_BYTES_DEFAULT = 0
+
 # Fusion cache byte budgets: the device-promotion cache (host source
 # columns promoted to device-resident jit arguments, keyed by host-array
 # identity) and the broadcast-table cache (direct-address join tables,
@@ -210,6 +222,59 @@ DISTRIBUTION_SPMD_DEFAULT = "true"
 DISTRIBUTION_CAPACITY_FACTOR = \
     "spark.hyperspace.distribution.capacity.factor"
 DISTRIBUTION_CAPACITY_FACTOR_DEFAULT = 2.0
+
+# Warm-start compilation: when set to a directory, JAX's persistent
+# compilation cache is enabled there (jax_compilation_cache_dir) via
+# `telemetry/compilation.configure_persistent_cache`, wired at session
+# init so every `instrumented_jit` entry point participates. A fresh
+# replica pointed at a shared cache dir serves its first
+# canonical-shape query from persisted executables instead of paying
+# the trace+compile (PR-3's warm-trace==0 property, made to survive
+# process restarts). Empty (default) = off. The size/compile-time
+# eligibility floors are dropped to zero so the engine's small bucketed
+# kernels qualify.
+COMPILE_CACHE_DIR = "spark.hyperspace.compile.cache.dir"
+
+# Self-driving index advisor (`hyperspace_tpu/advisor/`): mines the
+# query flight ring for recurring un-indexed filter/join signatures,
+# what-if scores hypothetical covering + data-skipping indexes by
+# replaying recorded plans through the real rewrite rules, and
+# auto-builds the winners through the normal Create actions (lease,
+# OCC, action reports — the executor module is the ONLY sanctioned
+# build caller inside advisor/, lint-enforced).
+ADVISOR_ENABLED = "spark.hyperspace.advisor.enabled"
+ADVISOR_ENABLED_DEFAULT = "true"
+# Per-run ceiling on the summed ESTIMATED on-disk bytes of indexes the
+# advisor may build (its per-warehouse build budget); candidates past
+# the budget are recorded as rejected, not silently dropped.
+ADVISOR_BUILD_BUDGET_BYTES = "spark.hyperspace.advisor.build.budget.bytes"
+ADVISOR_BUILD_BUDGET_BYTES_DEFAULT = 1 * 1024 ** 3
+# How many index builds one advisor run may start (a run that
+# recommends ten indexes still builds incrementally over runs).
+ADVISOR_MAX_BUILDS = "spark.hyperspace.advisor.max.builds"
+ADVISOR_MAX_BUILDS_DEFAULT = 2
+# Serving-pressure gate: the advisor defers every build while queries
+# wait in the scheduler queue, or while admitted bytes exceed this
+# fraction of `serve.hbm.budget.bytes` (advisor builds must never
+# starve admission; deferred runs retry on the next cycle).
+ADVISOR_SERVE_HEADROOM = "spark.hyperspace.advisor.serve.headroom"
+ADVISOR_SERVE_HEADROOM_DEFAULT = 0.5
+# Minimum estimated bytes avoided (amortized over the observed repeat
+# count) before a candidate is recommended at all.
+ADVISOR_MIN_BENEFIT_BYTES = "spark.hyperspace.advisor.min.benefit.bytes"
+ADVISOR_MIN_BENEFIT_BYTES_DEFAULT = 0
+# Assumed fraction of scan bytes a hypothetical DATA-SKIPPING index
+# prunes (zone/bloom effectiveness is unknowable without building the
+# sketches; the what-if math uses this conservative constant and the
+# docs tell you to tune it against `skipping.bytes_pruned` telemetry).
+ADVISOR_SKIPPING_PRUNE_FRACTION = \
+    "spark.hyperspace.advisor.skipping.prune.fraction"
+ADVISOR_SKIPPING_PRUNE_FRACTION_DEFAULT = 0.5
+# Minimum observed repeat count of a workload signature before the
+# advisor considers it recurring (one-off queries never justify a
+# build).
+ADVISOR_MIN_REPEATS = "spark.hyperspace.advisor.min.repeats"
+ADVISOR_MIN_REPEATS_DEFAULT = 2
 
 # XLA profiler integration: when set to a directory, every executed
 # query is captured as a profiler trace under it (one subdirectory per

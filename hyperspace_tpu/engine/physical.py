@@ -217,8 +217,16 @@ class ScanExec(PhysicalNode):
         to the scan hot path)."""
         if telemetry.current() is None:
             return
+        from hyperspace_tpu.plan import footprint as _footprint
         detail = {"lane": "host" if host else "device",
                   "files_scanned": len(files),
+                  # Raw on-disk bytes behind this read, via the stamp-
+                  # validated size cache admission control already
+                  # populated this collect (warm: no extra listing, one
+                  # cached stat per file). Feeds the regression differ
+                  # and the index advisor's per-relation scan-bytes
+                  # signal.
+                  "bytes_scanned": _footprint.file_sizes_total(files),
                   "roots": list(self.scan.root_paths)}
         spec = self.scan.bucket_spec
         if spec is not None:

@@ -311,6 +311,21 @@ class QueryScheduler:
         with self._cv:
             return self._admitted_bytes
 
+    def queue_depth(self) -> int:
+        """Queries currently WAITING for admission (0 = nothing queued)."""
+        with self._cv:
+            return len(self._waiters)
+
+    def pressure(self) -> dict:
+        """One-shot serving-pressure snapshot for background work that
+        must yield to live traffic (the index advisor's build gate):
+        admitted bytes, in-flight count, and queue depth under one lock
+        acquisition."""
+        with self._cv:
+            return {"admitted_bytes": self._admitted_bytes,
+                    "inflight": self._inflight,
+                    "queue_depth": len(self._waiters)}
+
     @property
     def breakers(self) -> BreakerBoard:
         return self._breakers
@@ -599,6 +614,12 @@ class QueryScheduler:
         description = ", ".join(df.schema.names[:6])
         metrics = telemetry.QueryMetrics(description=description)
         metrics.query_id = query_id  # cancel/log correlation handle
+        # The SOURCE (pre-optimization) logical plan rides the recorder
+        # into the flight ring: the index advisor's what-if scorer
+        # replays exactly this plan against hypothetical indexes
+        # (logical plans are immutable once built; holding the reference
+        # costs nothing per query — no serialization on the hot path).
+        metrics.logical_plan = df.plan
         with self._cv:
             self._active[query_id] = ent
         reg = telemetry.get_registry()

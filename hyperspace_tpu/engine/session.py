@@ -33,6 +33,12 @@ class HyperspaceSession:
         # each fused execution so late conf.set calls take effect.
         from hyperspace_tpu.io import transfer
         transfer.configure(self.conf)
+        # Warm-start compilation: `spark.hyperspace.compile.cache.dir`
+        # wires jax's persistent compilation cache so a fresh replica's
+        # first canonical-shape query loads persisted executables
+        # instead of tracing (no-op when the knob is unset).
+        from hyperspace_tpu.telemetry import compilation
+        compilation.configure_persistent_cache(self.conf)
 
     # -- serving plane ----------------------------------------------------
 

@@ -412,6 +412,84 @@ def to_arrow(batch: ColumnBatch):
     return pa.table(dict(zip(names, arrays)))
 
 
+def _owned_host(arr: np.ndarray) -> np.ndarray:
+    """An OWNING host copy of a fetched array. On zero-copy backends
+    (CPU PJRT) `np.asarray(device_array)` is a view whose base pins the
+    device buffer — a demoted entry built from views would keep its
+    "evicted" HBM alive, and re-promoting the view re-aliases it into
+    an unbounded buffer chain (the leak-sentinel test for the tiered
+    cache caught exactly this). A view materializes; an already-owning
+    array (real-accelerator D2H lands in fresh host memory) passes
+    through uncopied."""
+    return np.array(arr, copy=True) if arr.base is not None else arr
+
+
+def batch_to_host(batch: ColumnBatch) -> ColumnBatch:
+    """Device ColumnBatch -> fully host-resident copy (numpy payloads,
+    numpy dict hashes) — the segment cache's DEMOTION form: everything
+    needed to rebuild the device batch WITHOUT re-reading or re-decoding
+    parquet, at the cost of one D2H fetch per column now and one H2D put
+    at re-promotion. Fetches ride the transfer engine (d2h telemetry);
+    already-host columns pass through untouched. Every payload OWNS its
+    memory (`_owned_host`) so the demoted entry releases, not pins, the
+    device residency it replaced."""
+    from hyperspace_tpu.io import transfer
+
+    engine = transfer.get_engine()
+    for col in batch.columns.values():
+        engine.prefetch(col.data, *((col.validity,)
+                                    if col.validity is not None else ()))
+    out: Dict[str, DeviceColumn] = {}
+    for name, col in batch.columns.items():
+        hashes = col.dict_hashes
+        if hashes is not None:
+            hashes = (_owned_host(np.asarray(hashes[0])),
+                      _owned_host(np.asarray(hashes[1])))
+        out[name] = DeviceColumn(
+            data=_owned_host(engine.fetch(col.data)), dtype=col.dtype,
+            validity=(_owned_host(engine.fetch(col.validity))
+                      if col.validity is not None else None),
+            dictionary=col.dictionary,
+            dict_hashes=hashes)
+    return ColumnBatch(batch.schema, out)
+
+
+def host_batch_to_device(batch: ColumnBatch,
+                         transfer_tag: Optional[str] = None
+                         ) -> ColumnBatch:
+    """Host ColumnBatch (the demoted form above) -> device-resident
+    batch via the pipelined transfer engine — the segment cache's
+    RE-PROMOTION: H2D cost paid, parquet decode skipped. `transfer_tag`
+    rides the same lane accounting as fills (`tag="fill"` lands in
+    `transfer.fill.*`)."""
+    from hyperspace_tpu.io import transfer
+
+    def job(col: DeviceColumn):
+        def run() -> dict:
+            produced = {"data": np.asarray(col.data)}
+            if col.validity is not None:
+                produced["validity"] = np.asarray(col.validity)
+            if col.dict_hashes is not None:
+                produced["hash_hi"] = np.asarray(col.dict_hashes[0])
+                produced["hash_lo"] = np.asarray(col.dict_hashes[1])
+            return produced
+        return run
+
+    cols = [batch.columns[f.name] for f in batch.schema.fields]
+    placed = transfer.get_engine().put_group([job(c) for c in cols],
+                                             tag=transfer_tag)
+    out: Dict[str, DeviceColumn] = {}
+    for f, col, entry in zip(batch.schema.fields, cols, placed):
+        hashes = None
+        if "hash_hi" in entry:
+            hashes = (entry["hash_hi"], entry["hash_lo"])
+        out[f.name] = DeviceColumn(
+            data=entry["data"], dtype=col.dtype,
+            validity=entry.get("validity"),
+            dictionary=col.dictionary, dict_hashes=hashes)
+    return ColumnBatch(batch.schema, out)
+
+
 def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
     """Concatenate batches row-wise. String columns are re-unified through a
     merged sorted dictionary so codes stay order-preserving and comparable.

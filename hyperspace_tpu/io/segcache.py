@@ -47,13 +47,36 @@ the old `_device_cache`/`read_device_batch` access anywhere else):
   footprint size cache for the affected paths (the old mid-commit
   stamp-validation race).
 
+- **host tier (tiered cache)**: with
+  `spark.hyperspace.cache.segments.host.bytes` > 0, a `ColumnBatch`
+  evicted from the device tier by byte pressure DEMOTES into a
+  host-RAM copy (decoded columns fetched D2H once,
+  `io/columnar.batch_to_host`) instead of dropping. A later read of
+  the demoted key re-promotes through the TransferEngine FILL lane
+  (`host_batch_to_device(tag="fill")`) — the H2D cost is paid again,
+  the parquet decode is NOT. The host tier is its own byte-budgeted
+  LRU; invalidation sweeps both tiers. This is what lets the index
+  advisor keep more auto-built indexes warm-ish than HBM alone allows.
+- **bucket-scoped invalidation**: an incremental refresh names the
+  buckets it actually touched (`on_version_committed(...,
+  touched_buckets=, carried_from=)`); entries of the carried-from
+  version whose bucket selector provably avoids every touched bucket
+  are REKEYED to the new version (the new version hard-links those
+  buckets' files byte-for-byte, so content identity holds) instead of
+  dropped — the warm set survives an append that only landed in other
+  buckets. Selectors whose bucket coverage is unknowable ("all",
+  explicit file lists, SPMD range keys) drop conservatively.
+
 Telemetry: `cache.segments.{hits,misses,fills,evictions,bytes_held,
-entries,pins}` through the PR-3 helpers (per-query mirrors feed the
-regression differ's `cache` bucket), `segcache.fill` spans, and
-`transfer.fill.*` counters on the fill lane. Budget knob:
-`spark.hyperspace.cache.segments.bytes` (falls back to the legacy
-`cache.device.bytes` key, then the HYPERSPACE_SEGMENT_CACHE_BYTES /
-HYPERSPACE_DEVICE_CACHE_BYTES env defaults).
+entries,pins}` and `cache.segments.host.{hits,demotions,evictions,
+bytes_held,entries}` plus `cache.segments.rekeyed` through the PR-3
+helpers (per-query mirrors feed the regression differ's `cache`
+bucket), `segcache.fill` spans, and `transfer.fill.*` counters on the
+fill lane. Budget knobs: `spark.hyperspace.cache.segments.bytes`
+(falls back to the legacy `cache.device.bytes` key, then the
+HYPERSPACE_SEGMENT_CACHE_BYTES / HYPERSPACE_DEVICE_CACHE_BYTES env
+defaults) and `spark.hyperspace.cache.segments.host.bytes` (0 = host
+tier off).
 """
 
 from __future__ import annotations
@@ -80,6 +103,11 @@ __all__ = ["SegmentCache", "SegmentRef", "get_cache", "set_cache",
 SEGMENT_CACHE_BYTES = int(os.environ.get(
     "HYPERSPACE_SEGMENT_CACHE_BYTES",
     os.environ.get("HYPERSPACE_DEVICE_CACHE_BYTES", 4 * 1024 ** 3)))
+
+# Host-tier default (bytes); 0 = tier off. Session conf
+# (`cache.segments.host.bytes`) overrides.
+SEGMENT_CACHE_HOST_BYTES = int(os.environ.get(
+    "HYPERSPACE_SEGMENT_CACHE_HOST_BYTES", 0))
 
 # Wait quantum for single-flight waiters: short enough that a
 # cancelled waiter notices its deadline promptly, long enough not to
@@ -162,6 +190,40 @@ class _Entry:
         self.stamps = stamps
 
 
+class _HostEntry:
+    """One host-tier (demoted) segment: the fully-decoded host copy of
+    a device batch (`columnar.batch_to_host`), plus the identity it was
+    cached under so invalidation reaches it."""
+
+    __slots__ = ("batch", "nbytes", "ref", "stamps")
+
+    def __init__(self, batch, nbytes: int, ref: Optional[SegmentRef],
+                 stamps=None):
+        self.batch = batch
+        self.nbytes = nbytes
+        self.ref = ref
+        self.stamps = stamps
+
+
+def _selector_buckets(selector) -> Optional[frozenset]:
+    """The exact bucket-id set a cache-key selector covers, or None
+    when it is unknowable ("all", explicit file lists, foreign key
+    shapes) — the bucket-scoped invalidation's safety question: an
+    entry may only survive a touched-bucket commit when its coverage
+    PROVABLY avoids every touched bucket."""
+    if isinstance(selector, int):
+        return frozenset((selector,))
+    if isinstance(selector, tuple) and selector:
+        if selector[0] == "pruned" and len(selector) == 2:
+            try:
+                return frozenset(int(b) for b in selector[1])
+            except (TypeError, ValueError):
+                return None
+        if selector[0] == "bucketed" and len(selector) == 2:
+            return _selector_buckets(selector[1])
+    return None
+
+
 class _Fill:
     """One in-flight single-flight fill. `event` flips when the filler
     finishes (success or not); waiters read `batch`/`error` after it.
@@ -206,7 +268,8 @@ class SegmentCache:
     """Process-wide HBM segment cache (module docstring). All blocking
     happens on caller threads; the cache spawns none of its own."""
 
-    def __init__(self, budget_bytes: Optional[int] = None):
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 host_budget_bytes: Optional[int] = None):
         self._cv = threading.Condition()
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._fills: Dict[tuple, _Fill] = {}
@@ -214,6 +277,14 @@ class SegmentCache:
         self._reserved = 0
         self._default_budget = (SEGMENT_CACHE_BYTES if budget_bytes is None
                                 else int(budget_bytes))
+        # Host (demotion) tier: LRU of _HostEntry under its own byte
+        # budget. Guarded by the same cv as the device tier — demotion
+        # moves an entry between tiers atomically.
+        self._host: "OrderedDict[tuple, _HostEntry]" = OrderedDict()
+        self._host_bytes = 0
+        self._default_host_budget = (
+            SEGMENT_CACHE_HOST_BYTES if host_budget_bytes is None
+            else int(host_budget_bytes))
 
     # -- budget math ------------------------------------------------------
 
@@ -250,13 +321,81 @@ class SegmentCache:
         # Caller holds the cv lock.
         from hyperspace_tpu.telemetry import memory as _mem
         _mem.cache_stats("segments", self._bytes_held, len(self._entries))
+        _mem.cache_stats("segments.host", self._host_bytes,
+                         len(self._host))
         from hyperspace_tpu import telemetry
         telemetry.get_registry().gauge("cache.segments.pins").set(
             sum(1 for e in self._entries.values() if e.pinned))
 
-    def _evict_until(self, need: int, budget: int) -> int:
+    def _host_budget(self, conf) -> int:
+        if conf is not None:
+            try:
+                return int(conf.segment_cache_host_bytes)
+            except Exception:
+                pass  # conf-shaped fakes without the property
+        return self._default_host_budget
+
+    def _host_insert(self, key: tuple, hent: _HostEntry,
+                     host_budget: int) -> int:
+        """Insert one demoted entry into the host LRU, evicting host LRU
+        victims past the budget. Caller holds the cv lock. Returns host
+        evictions."""
+        evictions = 0
+        if key in self._host:
+            self._host_bytes -= self._host.pop(key).nbytes
+        while self._host and self._host_bytes + hent.nbytes > host_budget:
+            _k, victim = self._host.popitem(last=False)
+            self._host_bytes -= victim.nbytes
+            evictions += 1
+        if hent.nbytes <= host_budget:
+            self._host[key] = hent
+            self._host_bytes += hent.nbytes
+        else:
+            evictions += 0  # larger than the whole tier: dropped
+        return evictions
+
+    def _demote(self, key: tuple, ent: _Entry, conf) -> bool:
+        """Try to move an evicted device entry into the host tier.
+        Caller holds the cv lock. Only decoded `ColumnBatch` payloads
+        demote (the generic `get_or_fill` payloads — SPMD shard tuples —
+        have no host form the promote path could rebuild); anything
+        else, and any demotion failure, falls back to the plain drop.
+        The D2H fetch runs under the lock — demotion is an eviction-path
+        event, not a hot-path one, and on the CPU/virtual backends the
+        fetch is a view."""
+        from hyperspace_tpu import telemetry
+        host_budget = self._host_budget(conf)
+        if host_budget <= 0:
+            return False
+        from hyperspace_tpu.io import columnar
+        if not isinstance(ent.batch, columnar.ColumnBatch):
+            return False
+        try:
+            hbatch = columnar.batch_to_host(ent.batch)
+        except Exception:
+            return False  # a failed demotion is just an eviction
+        nbytes = _batch_nbytes(hbatch)
+        hent = _HostEntry(hbatch, nbytes, ent.ref, stamps=ent.stamps)
+        host_evictions = self._host_insert(key, hent, host_budget)
+        reg = telemetry.get_registry()
+        reg.counter("cache.segments.host.demotions").inc()
+        if host_evictions:
+            from hyperspace_tpu.telemetry import memory as _mem
+            _mem.cache_eviction("segments.host", host_evictions)
+        return key in self._host
+
+    def _host_take(self, key: tuple):
+        """Pop the host-tier entry for `key` (promotion consumes it),
+        or None. Caller holds the cv lock."""
+        hent = self._host.pop(key, None)
+        if hent is not None:
+            self._host_bytes -= hent.nbytes
+        return hent
+
+    def _evict_until(self, need: int, budget: int, conf=None) -> int:
         """Evict unpinned LRU entries until `need` extra bytes fit under
-        `budget`. Caller holds the cv lock. Returns evictions."""
+        `budget`, demoting each victim into the host tier when one is
+        configured. Caller holds the cv lock. Returns evictions."""
         evictions = 0
         while self._bytes_held + self._reserved + need > budget:
             victim_key = None
@@ -268,6 +407,7 @@ class SegmentCache:
                 break  # only pinned residency left
             ent = self._entries.pop(victim_key)
             self._bytes_held -= ent.nbytes
+            self._demote(victim_key, ent, conf)
             evictions += 1
         return evictions
 
@@ -446,7 +586,8 @@ class SegmentCache:
                     with self._cv:
                         if not fill.doomed:
                             evictions = self._evict_until(nbytes,
-                                                          budget_eff)
+                                                          budget_eff,
+                                                          conf)
                             self._entries[key] = _Entry(
                                 payload, nbytes, ref,
                                 pinned=(ref is not None
@@ -476,13 +617,68 @@ class SegmentCache:
         return columnar.from_arrow(table, schema, device=True,
                                    transfer_tag="fill")
 
+    def _promote(self, key, paths, stamps, conf, budget_override):
+        """Host-tier promotion: when the missed key has a demoted host
+        copy, rebuild the device batch from it through the transfer
+        engine's FILL lane — H2D paid, parquet decode skipped. Returns
+        (batch, nbytes) or None (no/stale host entry; fall through to
+        the real fill). Runs on the filler thread, inside its
+        single-flight slot, so concurrent waiters coalesce onto one
+        promotion exactly as they would onto one decode."""
+        from hyperspace_tpu.io import columnar, parquet
+        from hyperspace_tpu.telemetry import memory as _mem
+
+        with self._cv:
+            hent = self._host.get(key)
+        if hent is None:
+            return None
+        if hent.stamps is not None and parquet._stamps(paths) != hent.stamps:
+            # Unversioned entry demoted before a rewrite: stale.
+            with self._cv:
+                if self._host.get(key) is hent:
+                    self._host_take(key)
+                    self._publish_stats()
+            return None
+        with self._cv:
+            if self._host_take(key) is not hent:
+                return None  # raced an invalidation sweep
+            self._publish_stats()
+        batch = columnar.host_batch_to_device(hent.batch,
+                                              transfer_tag="fill")
+        nbytes = _batch_nbytes(batch)
+        # cache_hit mirrors onto the active per-query recorder too —
+        # the regression differ's cache bucket sees host-tier promotes
+        # per query, like every other cache series.
+        _mem.cache_hit("segments.host")
+        return batch, nbytes
+
     def _fill(self, key, fill: _Fill, paths, cols, schema, stamps, ref,
               conf, budget_override) -> Tuple[object, int]:
         """One fill: host decode, byte reservation (evicting LRU for
         headroom), H2D through the transfer engine's fill lane, insert.
-        Runs OUTSIDE the cache lock except for the bookkeeping."""
+        Runs OUTSIDE the cache lock except for the bookkeeping. A key
+        with a demoted host-tier copy promotes instead of decoding."""
         from hyperspace_tpu.io import columnar, parquet
         from hyperspace_tpu.telemetry import memory as _mem
+
+        promoted = self._promote(key, paths, stamps, conf,
+                                 budget_override)
+        if promoted is not None:
+            batch, nbytes = promoted
+            with self._cv:
+                budget = self._effective_budget(conf, budget_override)
+                if not fill.doomed and 0 < nbytes <= budget:
+                    evictions = self._evict_until(nbytes, budget, conf)
+                    self._entries[key] = _Entry(
+                        batch, nbytes, ref,
+                        pinned=(ref is not None and ref.index_name
+                                in _pinned_indexes(conf)),
+                        stamps=stamps)
+                    self._bytes_held += nbytes
+                    _mem.cache_eviction("segments", evictions)
+                self._publish_stats()
+                self._cv.notify_all()
+            return batch, nbytes
 
         table = parquet.read_table(paths, columns=list(cols) if cols
                                    else None)
@@ -496,7 +692,7 @@ class SegmentCache:
         cacheable = budget > 0 and projected <= budget
         if cacheable:
             with self._cv:
-                evictions = self._evict_until(projected, budget)
+                evictions = self._evict_until(projected, budget, conf)
                 self._reserved += projected
                 fill.reserved = projected
                 self._publish_stats()
@@ -520,7 +716,7 @@ class SegmentCache:
                 self._publish_stats()
                 self._cv.notify_all()
                 return batch, nbytes
-            evictions = self._evict_until(nbytes, budget)
+            evictions = self._evict_until(nbytes, budget, conf)
             self._entries[key] = _Entry(
                 batch, nbytes, ref,
                 pinned=(ref is not None
@@ -543,6 +739,10 @@ class SegmentCache:
                        if e.ref is not None and predicate(e.ref)]
             for k in victims:
                 self._bytes_held -= self._entries.pop(k).nbytes
+            host_victims = [k for k, e in self._host.items()
+                            if e.ref is not None and predicate(e.ref)]
+            for k in host_victims:
+                self._host_bytes -= self._host.pop(k).nbytes
             for f in self._fills.values():
                 if f.index_root is not None and predicate(
                         SegmentRef("", f.index_root, -1, "all")):
@@ -550,7 +750,78 @@ class SegmentCache:
             self._publish_stats()
             self._cv.notify_all()
         _mem.cache_eviction("segments", len(victims))
+        if host_victims:
+            _mem.cache_eviction("segments.host", len(host_victims))
         return len(victims)
+
+    def rekey_carried(self, index_root: str, new_version: int,
+                      carried_from: int, touched) -> int:
+        """Bucket-scoped commit handling for an INCREMENTAL refresh:
+        `v__=<new_version>` carried `v__=<carried_from>`'s bucket runs
+        forward (hard-linked, byte-identical) except for the buckets in
+        `touched` (delta runs appended / deletion-filtered rewrites).
+        Entries of the carried-from version whose bucket selector
+        provably avoids every touched bucket are REKEYED under the new
+        version — content identity holds, so the warm set survives the
+        commit — while touched-bucket, unknowable-selector, and
+        other-version entries drop as before. Both tiers. Returns how
+        many entries were rekeyed (`cache.segments.rekeyed`)."""
+        from dataclasses import replace as _replace
+
+        from hyperspace_tpu import telemetry
+        from hyperspace_tpu.telemetry import memory as _mem
+
+        root = index_root.rstrip("/\\")
+        touched = frozenset(int(b) for b in touched)
+        rekeyed = 0
+        dropped = 0
+        host_dropped = 0
+        with self._cv:
+            for tier in (self._entries, self._host):
+                for key in list(tier.keys()):
+                    ent = tier[key]
+                    ref = ent.ref
+                    if ref is None or ref.index_root != root \
+                            or ref.version == new_version:
+                        continue
+                    coverage = (_selector_buckets(ref.bucket)
+                                if ref.version == carried_from else None)
+                    # Key shape: ("seg", root, version, bucket, ...) —
+                    # rekey = same tuple with the version swapped. Any
+                    # other shape (generic get_or_fill keys) is
+                    # unknowable and drops.
+                    new_key = None
+                    if coverage is not None and not (coverage & touched) \
+                            and isinstance(key, tuple) and len(key) >= 4 \
+                            and key[0] == "seg":
+                        new_key = key[:2] + (new_version,) + key[3:]
+                    if new_key is not None and new_key not in tier:
+                        ent.ref = _replace(ref, version=new_version)
+                        tier[new_key] = tier.pop(key)
+                        rekeyed += 1
+                        continue
+                    victim = tier.pop(key)
+                    if tier is self._entries:
+                        self._bytes_held -= victim.nbytes
+                        dropped += 1
+                    else:
+                        self._host_bytes -= victim.nbytes
+                        host_dropped += 1
+            for f in self._fills.values():
+                if f.index_root == root:
+                    # Conservative: an in-flight fill may cover touched
+                    # buckets under the old version; serve its waiters,
+                    # never insert.
+                    f.doomed = True
+            self._publish_stats()
+            self._cv.notify_all()
+        if rekeyed:
+            telemetry.get_registry().counter(
+                "cache.segments.rekeyed").inc(rekeyed)
+        _mem.cache_eviction("segments", dropped)
+        if host_dropped:
+            _mem.cache_eviction("segments.host", host_dropped)
+        return rekeyed
 
     def invalidate_index(self, index_root: str,
                          keep_version: Optional[int] = None) -> int:
@@ -574,11 +845,16 @@ class SegmentCache:
             n = len(self._entries)
             self._entries.clear()
             self._bytes_held = 0
+            nh = len(self._host)
+            self._host.clear()
+            self._host_bytes = 0
             for f in self._fills.values():
                 f.doomed = True
             self._publish_stats()
             self._cv.notify_all()
         _mem.cache_eviction("segments", n)
+        if nh:
+            _mem.cache_eviction("segments.host", nh)
 
     # -- introspection ----------------------------------------------------
 
@@ -591,6 +867,8 @@ class SegmentCache:
                 "fills_in_flight": len(self._fills),
                 "pinned_entries": sum(1 for e in self._entries.values()
                                       if e.pinned),
+                "host_entries": len(self._host),
+                "host_bytes_held": self._host_bytes,
             }
 
 
@@ -663,15 +941,28 @@ def invalidate_source_paths(prefix: str) -> None:
     _invalidate_host_caches(prefix)
 
 
-def on_version_committed(index_root: str, version: int) -> None:
+def on_version_committed(index_root: str, version: int,
+                         touched_buckets=None,
+                         carried_from: Optional[int] = None) -> None:
     """A data-writing action committed `v__=<version>` under
     `index_root` (refresh/optimize/create/incremental). Older versions'
     segments are dropped — in-flight readers of those versions refill
     from disk if they come back (the dirs survive until vacuum); new
-    queries resolve the new version and fill fresh keys."""
+    queries resolve the new version and fill fresh keys.
+
+    BUCKET-SCOPED form: an incremental refresh that carried
+    `v__=<carried_from>`'s runs forward passes the set of bucket ids it
+    actually touched; carried-from entries over provably-untouched
+    buckets are rekeyed to the new version (byte-identical hard-linked
+    files) instead of dropped, so an append into bucket 7 no longer
+    torches the warm entries of buckets 0..6."""
     cache = _cache
     if cache is not None:
-        cache.invalidate_index(index_root, keep_version=version)
+        if touched_buckets is not None and carried_from is not None:
+            cache.rekey_carried(index_root, version, carried_from,
+                                touched_buckets)
+        else:
+            cache.invalidate_index(index_root, keep_version=version)
     _invalidate_host_caches(index_root)
 
 

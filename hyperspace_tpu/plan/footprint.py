@@ -31,8 +31,9 @@ from typing import Dict, Tuple
 
 from hyperspace_tpu.plan.nodes import LogicalPlan, Scan
 
-__all__ = ["projected_bytes", "invalidate_sizes", "DECODE_EXPANSION",
-           "DEFAULT_SCAN_BYTES", "MIN_FOOTPRINT_BYTES"]
+__all__ = ["projected_bytes", "scan_disk_bytes", "file_sizes_total",
+           "invalidate_sizes", "DECODE_EXPANSION", "DEFAULT_SCAN_BYTES",
+           "MIN_FOOTPRINT_BYTES"]
 
 # Decoded + staged + device-resident expansion over on-disk parquet.
 DECODE_EXPANSION = 3.0
@@ -136,6 +137,44 @@ def _scan_bytes(scan: Scan) -> int:
         if len(_pinned_bytes_cache) > 4096:
             _pinned_bytes_cache.clear()
         _pinned_bytes_cache[pin_key] = total
+    return total
+
+
+def file_sizes_total(files) -> int:
+    """Summed on-disk bytes of `files` through the stamp-validated size
+    cache (admission control stats the same files every collect, so
+    calls on the execute path hit warm cache/dentry entries). Unstatable
+    files contribute 0 — this is a telemetry/estimation input, not a
+    correctness one."""
+    total = 0
+    for f in files:
+        try:
+            size = _file_size(f)
+        except Exception:
+            size = -1
+        if size > 0:
+            total += size
+    return total
+
+
+def scan_disk_bytes(plan: LogicalPlan) -> int:
+    """Total RAW on-disk bytes of every Scan leaf of `plan` (no decode
+    expansion, no floor) — the what-if scorer's before/after unit
+    (`hyperspace_tpu/advisor/whatif.py`). Degrades like
+    `projected_bytes`: estimation failures return the default, never
+    raise."""
+    total = 0
+    try:
+        def visit(node):
+            nonlocal total
+            if isinstance(node, Scan):
+                total += max(0, _scan_bytes(node))
+            for c in node.children:
+                visit(c)
+
+        visit(plan)
+    except Exception:
+        return DEFAULT_SCAN_BYTES
     return total
 
 

@@ -47,6 +47,28 @@ def _entry_size_bytes(entry: IndexLogEntry) -> int:
         return 0
 
 
+def _eq_columns(condition) -> List[str]:
+    """Columns compared for EQUALITY against a literal anywhere in the
+    conjunction (lowercased, sorted) — the predicates bucket pruning
+    accelerates. Conservative: non-conjunctive shapes report empty."""
+    from hyperspace_tpu.plan import expr as E
+    out = set()
+    try:
+        for conjunct in E.split_conjunctive(condition):
+            if isinstance(conjunct, E.EqualTo):
+                for side, other in ((conjunct.left, conjunct.right),
+                                    (conjunct.right, conjunct.left)):
+                    if isinstance(side, E.Column) \
+                            and isinstance(other, E.Literal):
+                        out.add(side.name.lower())
+            elif isinstance(conjunct, E.In) \
+                    and isinstance(conjunct.child, E.Column):
+                out.add(conjunct.child.name.lower())
+    except Exception:
+        return []
+    return sorted(out)
+
+
 class FilterIndexRule(Rule):
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
         self._sig_cache = {}
@@ -100,13 +122,23 @@ class FilterIndexRule(Rule):
                 # cannot contain a matching row are dropped.
                 source = self._skipping_source(filt, scan)
             if source is None:
+                # The whyNot record carries everything an advisor needs
+                # to synthesize a candidate for THIS miss: the relation
+                # (scan roots), the predicate columns, which of them are
+                # point (equality) comparisons — bucket pruning only
+                # helps those — and the full column set a covering index
+                # would have to carry.
                 telemetry.event(
                     "rule", "FilterIndexRule", action="skipped",
                     reason="no ACTIVE covering index matches the plan "
                            "signature (filter must reference the first "
                            "indexed column; all columns must be covered) "
                            "and no data-skipping sketch prunes the scan",
-                    filter_columns=list(filter_columns))
+                    filter_columns=list(filter_columns),
+                    eq_columns=_eq_columns(filt.condition),
+                    project_columns=sorted(
+                        {c.lower() for c in project_columns}),
+                    roots=list(scan.root_paths))
                 return node
 
         rewritten: LogicalPlan = Filter(filt.condition, source)

@@ -79,8 +79,19 @@ class JoinIndexRule(Rule):
 
         pair = self._best_index_pair(join, mapping)
         if pair is None:
+            # whyNot with enough detail for the advisor to synthesize a
+            # candidate PAIR: per-side relation roots, join keys in
+            # mapping order, and the full column set each side's index
+            # would have to cover.
+            left_cols = sorted(mapping)
             _skip("no usable/compatible index pair",
-                  join_columns=sorted(mapping))
+                  join_columns=left_cols,
+                  left_join_columns=left_cols,
+                  right_join_columns=[mapping[c] for c in left_cols],
+                  left_roots=list(left_scan.root_paths),
+                  right_roots=list(right_scan.root_paths),
+                  left_referenced=self._referenced_columns(join.left),
+                  right_referenced=self._referenced_columns(join.right))
             return node
         ((left_index, left_appended, left_deleted),
          (right_index, right_appended, right_deleted)) = pair

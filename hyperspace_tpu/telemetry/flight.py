@@ -54,6 +54,11 @@ class FlightRecorder:
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._seq = itertools.count()  # dump-name monotonicity
+        # Per-query monotonic sequence id, stamped on every recorded
+        # QueryMetrics as `flight_seq` (1-based; 0 = "from the start").
+        # Incremental consumers — the index advisor's workload miner —
+        # poll `snapshot(since_seq)` instead of re-reading the ring.
+        self._record_seq = 0
         # Slow-dump writer lane: dumps are QUEUED to one background
         # thread instead of serializing + fsyncing on the serving
         # thread (a slow query is exactly the one whose caller is
@@ -71,6 +76,8 @@ class FlightRecorder:
         when a dump was QUEUED (None otherwise) — the write itself
         rides the background lane; `drain()` flushes it."""
         with self._lock:
+            self._record_seq += 1
+            metrics.flight_seq = self._record_seq
             self._ring.append(metrics)
         _registry.get_registry().counter("flight.queries").inc()
         if conf is None:
@@ -100,7 +107,32 @@ class FlightRecorder:
             out = list(self._ring)
         return out if n is None else out[-n:]
 
+    def snapshot(self, since_seq: int = 0):
+        """Incremental, lock-light poll: `(new_entries, last_seq)` where
+        `new_entries` are the ring's completed `QueryMetrics` with
+        `flight_seq > since_seq`, oldest first, and `last_seq` is the
+        highest sequence id ever recorded (pass it back as the next
+        `since_seq`). The lock is held only for the ring copy — the
+        filter runs outside it, and a consumer polling with its previous
+        `last_seq` re-reads nothing. Entries that rotated out of the
+        ring between polls are simply gone (the ring is a bounded
+        diagnosis buffer, not a durable log): `last_seq` still advances
+        past them, so a slow consumer skips rather than stalls."""
+        with self._lock:
+            entries = list(self._ring)
+            last = self._record_seq
+        fresh = [m for m in entries
+                 if getattr(m, "flight_seq", 0) > since_seq]
+        return fresh, last
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._record_seq
+
     def clear(self) -> None:
+        """Empty the ring (test isolation). Sequence ids keep counting —
+        a consumer's `since_seq` cursor stays valid across clears."""
         with self._lock:
             self._ring.clear()
 

@@ -226,6 +226,48 @@ def compare_multichip(old: dict, new: dict, threshold: float):
     return rows
 
 
+def compare_advisor(old: dict, new: dict, threshold: float):
+    """Advisor-rung gate rows (same row shape as `compare`):
+
+    - `advisor_built` — the cycle must have auto-built at least one
+      index (absolute: a run that recommends but never builds has not
+      closed the loop);
+    - `advisor_bytes_reduction` — the recommended index must REDUCE
+      scanned bytes on the repeat workload (absolute > 0), and must not
+      drop >threshold vs the previous round;
+    - `advisor_bit_identical` — index-served results must equal the
+      unindexed run (absolute: False fails regardless of history);
+    - `advisor_rule_applied` — the rebuilt workload must actually be
+      SERVED by an index (rule-usage telemetry > 0, absolute)."""
+    o = old.get("advisor") or {}
+    n = new.get("advisor") or {}
+    rows = []
+    built = n.get("built")
+    if isinstance(built, (int, float)):
+        ob = o.get("built")
+        rows.append(("advisor_built",
+                     float(ob) if isinstance(ob, (int, float)) else 0.0,
+                     float(built), float(built), built < 1))
+    red = n.get("bytes_reduction")
+    if isinstance(red, (int, float)):
+        rows.append(("advisor_bytes_reduction_floor", 0.0, float(red),
+                     float(red), red <= 0))
+        ored = o.get("bytes_reduction")
+        if isinstance(ored, (int, float)) and ored > 0:
+            change = red / ored - 1.0
+            rows.append(("advisor_bytes_reduction", float(ored),
+                         float(red), change, change < -threshold))
+    applied = n.get("rule_applied_after")
+    if isinstance(applied, (int, float)):
+        rows.append(("advisor_rule_applied", 0.0, float(applied),
+                     float(applied), applied < 1))
+    bi = n.get("bit_identical")
+    if bi is not None:
+        rows.append(("advisor_bit_identical", 1.0, 1.0 if bi else 0.0,
+                     0.0 if bi else -1.0, not bi))
+    return rows
+
+
 def compare_serve(old: dict, new: dict, threshold: float):
     """Serving-artifact gate rows (same row shape as `compare`):
     scaling ratio + QPS drop >threshold, p50/p99 growth >threshold,
@@ -327,6 +369,11 @@ def main() -> int:
                          "(BENCH_SERVE_r*.json): scaling ratio, QPS, "
                          "p50/p99 latency growth, reject/timeout "
                          "rates")
+    ap.add_argument("--advisor", action="store_true",
+                    help="gate the index-advisor family "
+                         "(BENCH_ADVISOR_r*.json): at least one "
+                         "auto-built index, scanned-bytes reduction, "
+                         "index-served repeats, bit-identity")
     ap.add_argument("--multichip", action="store_true",
                     help="gate the multi-chip scaling family "
                          "(MULTICHIP_r*.json): 8-device SMJ speedup, "
@@ -340,6 +387,8 @@ def main() -> int:
         old_path, new_path = args.artifacts
     elif not args.artifacts:
         pattern = args.glob or ("MULTICHIP_r*.json" if args.multichip
+                                else "BENCH_ADVISOR_r*.json"
+                                if args.advisor
                                 else "BENCH_SERVE_r*.json" if args.serve
                                 else "BENCH_TPCDS_r*.json" if args.tpcds
                                 else "BENCH_r*.json")
@@ -353,7 +402,10 @@ def main() -> int:
     # families, so explicit paths gate correctly without the flag.
     serve_mode = args.serve or ("serve" in old and "serve" in new)
     multichip_mode = args.multichip or "multichip" in new
+    advisor_mode = args.advisor or "advisor" in new
     rows = (compare_multichip(old, new, args.threshold) if multichip_mode
+            else compare_advisor(old, new, args.threshold)
+            if advisor_mode
             else compare_serve(old, new, args.threshold) if serve_mode
             else compare(old, new, args.threshold))
 

@@ -386,6 +386,45 @@ def check_sharding_seam(package_dir: str):
     return failures
 
 
+# The ONE sanctioned advisor build point: every index the advisor
+# creates goes through its executor module, which routes through the
+# collection manager's lease-gated Create path (stale-writer recovery,
+# OCC one-winner, action reports). Constructing an Action — or even
+# importing the actions package — anywhere else under advisor/ is a
+# build that could bypass the lease and corrupt an index a concurrent
+# maintenance verb owns.
+_RAW_ADVISOR_BUILD_RE = re.compile(
+    r"\b[A-Z]\w*Action\s*\(|from\s+hyperspace_tpu\.actions\b|"
+    r"import\s+hyperspace_tpu\.actions\b")
+_ADVISOR_BUILD_ALLOWED = os.path.join("advisor", "executor.py")
+
+
+def check_advisor_build_seam(package_dir: str):
+    """Source lint: no Action construction / actions import inside
+    advisor/ outside executor.py."""
+    failures = []
+    advisor_dir = os.path.join(package_dir, "advisor")
+    for root, _dirs, files in os.walk(advisor_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _ADVISOR_BUILD_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_ADVISOR_BUILD_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: Action "
+                            "construction inside advisor/ outside the "
+                            "executor — advisor builds must go through "
+                            "advisor/executor.py's lease path")
+    return failures
+
+
 # The ONE sanctioned backoff point: every storage retry routes through
 # the policy in utils/retry.py (typed classification, conf-driven
 # backoff, io.retries/io.giveups counters, fault-injection coverage).
@@ -499,6 +538,8 @@ def main() -> int:
     failures.extend(check_sketch_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_sharding_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_advisor_build_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_retry_seams(
         os.path.dirname(hyperspace_tpu.__file__)))

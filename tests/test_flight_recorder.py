@@ -185,6 +185,37 @@ def test_load_dump_rejects_non_dumps(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+def test_snapshot_incremental_cursor():
+    """`snapshot(since_seq)` is the miner's incremental poll: each call
+    returns only entries newer than the cursor, the cursor survives
+    ring rotation (a slow consumer skips, never stalls), and clear()
+    keeps sequence monotonicity."""
+    rec = flight.FlightRecorder(capacity=4)
+    for i in range(3):
+        rec.record(_finished_metrics(f"q{i}"))
+    fresh, cursor = rec.snapshot(0)
+    assert [m.description for m in fresh] == ["q0", "q1", "q2"]
+    assert cursor == rec.last_seq
+    # Nothing new: empty, same cursor.
+    again, cursor2 = rec.snapshot(cursor)
+    assert again == [] and cursor2 == cursor
+    # More entries than capacity arrive between polls: the consumer
+    # gets what survived, and the cursor jumps past the rotated-out.
+    for i in range(3, 10):
+        rec.record(_finished_metrics(f"q{i}"))
+    fresh, cursor3 = rec.snapshot(cursor)
+    assert [m.description for m in fresh] == ["q6", "q7", "q8", "q9"]
+    assert cursor3 == cursor + 7
+    # Sequence ids are stamped on the metrics and strictly increasing.
+    seqs = [m.flight_seq for m in rec.queries()]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    rec.clear()
+    rec.record(_finished_metrics("post-clear"))
+    fresh, cursor4 = rec.snapshot(cursor3)
+    assert [m.description for m in fresh] == ["post-clear"]
+    assert cursor4 == cursor3 + 1
+
+
 def test_concurrent_record_is_safe():
     rec = flight.FlightRecorder(capacity=32)
     n_threads, per_thread = 8, 50

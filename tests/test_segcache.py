@@ -514,3 +514,208 @@ def test_chaos_with_concurrent_refresh(indexed_env):
     snap = segcache.get_cache().snapshot()
     assert snap["reserved_bytes"] == 0
     assert snap["fills_in_flight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Tiered cache: host-RAM tier below HBM (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def _two_files(tmp_path):
+    rng = np.random.default_rng(21)
+    paths = []
+    schema = None
+    for i in (0, 1):
+        t = pa.table({
+            "a": rng.integers(0, 1000, 3000).astype(np.int64),
+            "b": rng.random(3000).astype(np.float64),
+        })
+        p = tmp_path / f"tier{i}.parquet"
+        pq.write_table(t, str(p))
+        paths.append(str(p))
+        schema = Schema.from_arrow(t.schema)
+    return paths, schema
+
+
+def _tier_conf(host_bytes):
+    return HyperspaceConf({
+        "spark.hyperspace.cache.segments.host.bytes": str(host_bytes)})
+
+
+def test_eviction_demotes_to_host_tier_and_promotes_without_decode(
+        tmp_path, monkeypatch):
+    """Device-tier eviction lands the victim in the host tier within
+    its byte budget; a subsequent read of the demoted key re-promotes
+    through the TransferEngine fill lane with cache.segments.host.hits
+    moving and NO host-side parquet re-decode."""
+    (p1, p2), schema = _two_files(tmp_path)
+    conf = _tier_conf(1 << 20)
+    # Budget fits exactly one decoded file on device.
+    cache = segcache.set_cache(SegmentCache(budget_bytes=60_000))
+
+    before_demote = _counter("cache.segments.host.demotions")
+    b1 = cache.read([p1], None, schema, conf=conf)
+    cache.read([p2], None, schema, conf=conf)  # evicts+demotes p1
+    snap = cache.snapshot()
+    assert snap["host_entries"] == 1
+    assert 0 < snap["host_bytes_held"] <= (1 << 20)
+    assert _counter("cache.segments.host.demotions") == before_demote + 1
+
+    fill_bytes = _counter("transfer.fill.bytes")
+    host_hits = _counter("cache.segments.host.hits")
+
+    def boom(*a, **k):
+        raise AssertionError("host-side parquet decode on the promote "
+                             "path")
+
+    monkeypatch.setattr(parquet, "read_table", boom)
+    b1_again = cache.read([p1], None, schema, conf=conf)
+    monkeypatch.undo()
+
+    assert _counter("cache.segments.host.hits") == host_hits + 1
+    # The promotion crossed the link through the FILL lane.
+    assert _counter("transfer.fill.bytes") > fill_bytes
+    from hyperspace_tpu.io import columnar
+    assert columnar.to_arrow(b1_again).equals(columnar.to_arrow(b1))
+    # p1 is back on device; p2 was demoted to make room.
+    snap = cache.snapshot()
+    assert snap["entries"] == 1 and snap["host_entries"] == 1
+
+
+def test_host_tier_byte_accounting_and_budget(tmp_path):
+    """Host-tier LRU honors its own byte budget (a tier smaller than
+    one entry holds nothing), and the snapshot's byte accounting stays
+    exact across demote/evict cycles."""
+    (p1, p2), schema = _two_files(tmp_path)
+    cache = segcache.set_cache(SegmentCache(budget_bytes=60_000))
+
+    # Tier too small for any entry: demotion degrades to a plain drop.
+    tiny = _tier_conf(1024)
+    cache.read([p1], None, schema, conf=tiny)
+    cache.read([p2], None, schema, conf=tiny)
+    snap = cache.snapshot()
+    assert snap["host_entries"] == 0 and snap["host_bytes_held"] == 0
+
+    # Tier fits ONE entry: the second demotion evicts the first.
+    cache.clear()
+    one = _tier_conf(50_000)
+    evictions = _counter("cache.segments.host.evictions")
+    cache.read([p1], None, schema, conf=one)
+    cache.read([p2], None, schema, conf=one)   # p1 -> host
+    cache.read([p1], None, schema, conf=one)   # p1 promoted, p2 -> host
+    snap = cache.snapshot()
+    assert snap["host_entries"] == 1
+    assert snap["host_bytes_held"] <= 50_000
+    assert _counter("cache.segments.host.evictions") >= evictions
+
+
+def test_host_tier_demote_promote_leaks_nothing(tmp_path, leak_sentinel):
+    """Steady-state demote/promote ping-pong accretes no device
+    arrays (the leak_sentinel contract: warm first, then repeat)."""
+    (p1, p2), schema = _two_files(tmp_path)
+    conf = _tier_conf(1 << 20)
+    cache = segcache.set_cache(SegmentCache(budget_bytes=60_000))
+    # Warm one full cycle (jit constants, staging pools).
+    cache.read([p1], None, schema, conf=conf)
+    cache.read([p2], None, schema, conf=conf)
+    cache.read([p1], None, schema, conf=conf)
+    with leak_sentinel(tolerance=2):
+        for _ in range(3):
+            cache.read([p2], None, schema, conf=conf)
+            cache.read([p1], None, schema, conf=conf)
+    snap = cache.snapshot()
+    assert snap["entries"] == 1 and snap["host_entries"] == 1
+
+
+def test_invalidation_sweeps_host_tier(tmp_path):
+    """FSM invalidation reaches demoted entries too: a version commit
+    drops the old version's host-tier copies."""
+    (p1, p2), schema = _two_files(tmp_path)
+    conf = _tier_conf(1 << 20)
+    cache = segcache.set_cache(SegmentCache(budget_bytes=60_000))
+    root = str(tmp_path / "idx")
+    ref1 = SegmentRef("t_idx", root, 0, 0)
+    cache.read([p1], None, schema, ref=ref1, conf=conf)
+    cache.read([p2], None, schema,
+               ref=SegmentRef("t_idx", root, 0, 1), conf=conf)
+    assert cache.snapshot()["host_entries"] == 1
+    cache.invalidate_index(root, keep_version=7)
+    snap = cache.snapshot()
+    assert snap["entries"] == 0 and snap["host_entries"] == 0
+    assert snap["host_bytes_held"] == 0 and snap["bytes_held"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Bucket-scoped invalidation (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_rekey_carried_keeps_untouched_buckets(tmp_path):
+    """`on_version_committed(touched_buckets=..., carried_from=...)`
+    rekeys carried-forward entries of untouched buckets to the new
+    version (same batch object — no refill) and drops touched /
+    unknowable ones."""
+    (p1, p2), schema = _two_files(tmp_path)
+    cache = segcache.set_cache(SegmentCache(budget_bytes=1 << 30))
+    root = str(tmp_path / "idx")
+    batch0 = cache.read([p1], None, schema,
+                        ref=SegmentRef("t_idx", root, 0, 0))
+    cache.read([p2], None, schema, ref=SegmentRef("t_idx", root, 0, 1))
+    cache.read([p1], None, schema, ref=SegmentRef("t_idx", root, 0,
+                                                  "all"))
+    assert cache.snapshot()["entries"] == 3
+    rekeyed_before = _counter("cache.segments.rekeyed")
+
+    segcache.on_version_committed(root, 1, touched_buckets={1},
+                                  carried_from=0)
+
+    # Bucket 0 survived under the NEW version — the same batch object,
+    # zero fills; bucket 1 (touched) and "all" (unknowable) dropped.
+    assert cache.snapshot()["entries"] == 1
+    assert _counter("cache.segments.rekeyed") == rekeyed_before + 1
+    fills = _counter("cache.segments.fills")
+    again = cache.read([p1], None, schema,
+                       ref=SegmentRef("t_idx", root, 1, 0))
+    assert again is batch0
+    assert _counter("cache.segments.fills") == fills
+
+
+def test_incremental_refresh_commits_bucket_scoped(tmp_path,
+                                                   monkeypatch):
+    """The incremental-refresh action reports the buckets it touched
+    and hands them to the commit hook — an append that lands in a few
+    buckets no longer torches the whole warm set."""
+    rng = np.random.default_rng(5)
+    src = tmp_path / "incsrc"
+    src.mkdir()
+    pq.write_table(pa.table({
+        "key": rng.integers(0, 100, 4000).astype(np.int64),
+        "val": rng.random(4000).astype(np.float64),
+    }), str(src / "part-0.parquet"))
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "spark.hyperspace.index.num.buckets": "4"}))
+    hs = Hyperspace(sess)
+    hs.create_index(sess.read_parquet(str(src)),
+                    IndexConfig("inc_idx", ["key"], ["val"]))
+
+    calls = []
+    real = segcache.on_version_committed
+
+    def capture(root, version, touched_buckets=None, carried_from=None):
+        calls.append((version, touched_buckets, carried_from))
+        return real(root, version, touched_buckets=touched_buckets,
+                    carried_from=carried_from)
+
+    monkeypatch.setattr(segcache, "on_version_committed", capture)
+    # Appended rows: a handful of keys -> a strict subset of buckets.
+    pq.write_table(pa.table({
+        "key": np.asarray([3, 3, 3, 7], dtype=np.int64),
+        "val": rng.random(4).astype(np.float64),
+    }), str(src / "part-1.parquet"))
+    hs.refresh_index("inc_idx", mode="incremental")
+
+    assert calls, "incremental commit never reached the cache hook"
+    version, touched, carried = calls[-1]
+    assert carried == version - 1
+    assert touched is not None and 0 < len(touched) < 4

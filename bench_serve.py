@@ -1,35 +1,54 @@
-"""Closed-loop serving benchmark — the traffic the ROADMAP's serving
-item gates on.
+"""Serving benchmark — closed-loop scaling plus the sustained open-loop
+rung the ROADMAP's serving item gates on.
 
-K client threads drive a mixed filter / join / aggregate workload
-through ONE session (every `collect` routes through the process-wide
-`QueryScheduler`), closed-loop: each client issues its next query the
-moment the previous one returns. Reported:
+Three phases, one artifact:
 
-  - p50 / p95 / p99 latency over successful queries,
-  - QPS (successes / loop wall),
-  - typed outcome counts (rejected / deadline-exceeded / cancelled),
-  - the scheduler's serve.* counter block and peak admitted bytes.
+1. **AOT replica phase** (runs FIRST, while the process is genuinely
+   cold): `engine.batcher.warmup(df)` pre-compiles the batched
+   predicate programs for every batchable workload shape across the
+   canonical cohort-size buckets — then a concurrent burst must record
+   ZERO new `compile.serve.batch.traces` (`serve.aot.warm_traces`,
+   gated absolutely). With `spark.hyperspace.compile.cache.dir` set,
+   the same warmup on a real fresh replica loads the persisted
+   executables instead of compiling.
+2. **Closed loop**: K client threads drive the serving mix through ONE
+   session, each issuing its next query the moment the previous
+   returns. `vs_baseline` is closed-loop QPS at K clients over
+   single-client QPS on the same warm mix — with inter-query batched
+   execution (`engine/batcher.py`) this must be >= 1.0: concurrency
+   WINS (gated absolutely via `scaling_floor`), and
+   `serve.batch.members / serve.batch.invocations` (occupancy) must
+   exceed 1. Every success is checked against its serial-run oracle.
+3. **Open loop**: Poisson arrivals swept across arrival rates to the
+   latency knee — queries are dispatched on schedule regardless of
+   completions (hundreds of logical clients; latency counts from the
+   SCHEDULED arrival, so dispatch queueing is visible, the way real
+   traffic experiences it). Reports per-rate achieved QPS and
+   p50/p95/p99, and the headline `qps_at_p99_slo`: the highest
+   achieved rate whose p99 meets BENCH_SERVE_SLO_MS.
 
-`vs_baseline` is the concurrency scaling ratio: closed-loop QPS at K
-clients over single-client QPS on the same warm mix — the number the
-scheduler must not regress (admission overhead, queue convoying, lock
-contention all land here). Every successful query's result is compared
-against its serial-run table, so a correctness break under concurrency
-fails the bench before any number is reported.
+The workload is the serving shape the batch lane exists for: point
+lookups and range/IN filters over a fact table (differing only in
+literals — one execution signature each), plus a join and an aggregate
+so the mix never degenerates into pure batchable traffic.
 
 Prints exactly ONE JSON line (canonical schema via
 `telemetry.artifact.make_artifact`; `scripts/bench_regress.py --serve`
-gates p99, reject rate, and QPS from it).
+gates scaling ratio + floor, QPS, p50/p99 growth, reject/timeout
+rates, batch occupancy, and the AOT warm-trace zero).
 
-Env knobs: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_QUERIES (200 total),
-BENCH_SERVE_ROWS (50000), BENCH_SERVE_BUDGET_BYTES (serving HBM budget;
-0 = unlimited), BENCH_SERVE_TIMEOUT_S (per-query deadline; 0 = none),
-BENCH_SERVE_QUEUE_DEPTH (32).
+Env knobs: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_QUERIES (240 total),
+BENCH_SERVE_ROWS (50000), BENCH_SERVE_BUDGET_BYTES (0 = unlimited),
+BENCH_SERVE_TIMEOUT_S (0 = none), BENCH_SERVE_QUEUE_DEPTH (32),
+BENCH_SERVE_OPEN_SECONDS (6 per rate; minutes-long soaks raise it),
+BENCH_SERVE_OPEN_WORKERS (64 logical clients), BENCH_SERVE_SLO_MS
+(150), BENCH_SERVE_RATES (comma fractions of serial QPS,
+"0.5,0.75,1.0,1.25,1.5").
 """
 
 import json
 import os
+import queue as queue_mod
 import shutil
 import sys
 import tempfile
@@ -41,11 +60,16 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np  # noqa: E402
 
 CLIENTS = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
-TOTAL_QUERIES = int(os.environ.get("BENCH_SERVE_QUERIES", 200))
+TOTAL_QUERIES = int(os.environ.get("BENCH_SERVE_QUERIES", 800))
 ROWS = int(os.environ.get("BENCH_SERVE_ROWS", 50_000))
 BUDGET_BYTES = int(os.environ.get("BENCH_SERVE_BUDGET_BYTES", 0))
 TIMEOUT_S = float(os.environ.get("BENCH_SERVE_TIMEOUT_S", 0))
 QUEUE_DEPTH = int(os.environ.get("BENCH_SERVE_QUEUE_DEPTH", 32))
+OPEN_SECONDS = float(os.environ.get("BENCH_SERVE_OPEN_SECONDS", 6))
+OPEN_WORKERS = int(os.environ.get("BENCH_SERVE_OPEN_WORKERS", 64))
+SLO_MS = float(os.environ.get("BENCH_SERVE_SLO_MS", 150))
+RATES = [float(r) for r in os.environ.get(
+    "BENCH_SERVE_RATES", "0.5,0.75,1.0,1.25,1.5").split(",")]
 
 from bench_common import link_probe, log  # noqa: E402
 from hyperspace_tpu import telemetry  # noqa: E402
@@ -58,26 +82,40 @@ def _percentile(sorted_vals, q: float):
     return sorted_vals[idx]
 
 
+def _counter(name: str) -> float:
+    return telemetry.get_registry().counters_dict().get(name, 0)
+
+
 def build_workload(session, data_dir: str):
-    """The mixed query set. Deterministic plans — each query's serial
-    result is the correctness oracle for its concurrent runs."""
+    """The serving mix. Deterministic plans — each query's serial
+    result is the correctness oracle for its concurrent runs. The
+    point/range/IN entries share execution signatures (same shape,
+    different literals), which is exactly what the batch lane
+    coalesces; the join and aggregate keep the mix honest."""
     from hyperspace_tpu.plan.expr import col, lit
 
     facts = session.read_parquet(os.path.join(data_dir, "facts"))
     dims = session.read_parquet(os.path.join(data_dir, "dims"))
-    return [
-        ("filter", facts.filter(col("v") > lit(0.9))
-         .select("k", "v")),
-        ("agg", facts.group_by("g").agg(("sum", "v", "total"),
-                                        cnt=("count", "*"))),
-        ("join", facts.join(dims, on="k")
-         .filter(col("w") > lit(0.5))
-         .group_by("g").agg(("avg", "v", "avg_v"))),
-        ("filter2", facts.filter((col("g") == lit(7)))
-         .select("k", "g", "v")),
-        ("join_agg", facts.join(dims, on="k")
-         .group_by("label").agg(("sum", "w", "tw"))),
-    ]
+    workload = []
+    for g in range(8):
+        workload.append((f"point_g{g}",
+                         facts.filter(col("g") == lit(g))
+                         .select("k", "g", "v")))
+    for i, (lo, hi) in enumerate(((0.90, 0.95), (0.40, 0.45))):
+        workload.append((f"range_v{i}",
+                         facts.filter((col("v") > lit(lo))
+                                      & (col("v") <= lit(hi)))
+                         .select("k", "v")))
+    workload.append(("in_g0", facts.filter(col("g").isin(3, 11, 19))
+                     .select("k", "g")))
+    workload.append(("in_g1", facts.filter(col("g").isin(5, 21))
+                     .select("k", "g")))
+    workload.append(("agg", facts.group_by("g")
+                     .agg(("sum", "v", "total"), cnt=("count", "*"))))
+    workload.append(("join", facts.join(dims, on="k")
+                     .filter(col("w") > lit(0.5))
+                     .group_by("g").agg(("avg", "v", "avg_v"))))
+    return workload
 
 
 def generate(data_dir: str) -> None:
@@ -105,14 +143,279 @@ def canonical(table):
     return table.sort_by([(n, "ascending") for n in names])
 
 
+def aot_replica_phase(workload):
+    """Phase 1 (cold process): warm the batched executables, then prove
+    a concurrent burst traces NOTHING new on the serve.batch entry."""
+    from hyperspace_tpu.engine import batcher
+
+    warmed = 0
+    batchable = []
+    for name, df in workload:
+        sig = batcher.plan_signature(df.session.optimize(df.plan),
+                                     id(df.session))
+        if sig is not None:
+            batchable.append((name, df))
+    # One warmup per distinct signature shape (the memo dedups).
+    for _name, df in batchable:
+        warmed += batcher.warmup(df)
+    traces_before = _counter("compile.serve.batch.traces")
+    burst_errors = []
+
+    def burst_client(entries):
+        for _name, df in entries:
+            try:
+                df.collect()
+            except Exception as exc:  # pragma: no cover
+                burst_errors.append(repr(exc))
+
+    per = max(1, 32 // max(1, len(batchable)))
+    threads = [threading.Thread(target=burst_client,
+                                args=(batchable * per,),
+                                name=f"aot-burst-{c}")
+               for c in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(120)
+    warm_traces = _counter("compile.serve.batch.traces") - traces_before
+    log(f"aot replica phase: {warmed} programs warmed, "
+        f"{len(batchable)} batchable shapes, burst warm traces "
+        f"{warm_traces:.0f}, errors {len(burst_errors)}")
+    return {
+        "programs_warmed": warmed,
+        "batchable_shapes": len(batchable),
+        "warm_traces": warm_traces,
+        "burst_errors": len(burst_errors),
+    }
+
+
+def closed_loop(workload, expected):
+    """Phase 2: K closed-loop clients vs the single-client baseline."""
+    from hyperspace_tpu.exceptions import (QueryCancelledError,
+                                           QueryDeadlineExceededError,
+                                           QueryRejectedError)
+
+    # Single-client baseline QPS on the warm mix: median of three
+    # laps — this shared container's CPU wobbles run to run, and the
+    # scaling ratio is only as trustworthy as its denominator.
+    lap_qps = []
+    for _lap in range(3):
+        t0 = time.perf_counter()
+        serial_runs = 0
+        while serial_runs < max(len(workload) * 8, 112):
+            _name, df = workload[serial_runs % len(workload)]
+            df.collect()
+            serial_runs += 1
+        lap_qps.append(serial_runs / (time.perf_counter() - t0))
+    serial_qps = sorted(lap_qps)[1]
+    log(f"serial baseline: laps "
+        + ", ".join(f"{q:.1f}" for q in lap_qps)
+        + f" QPS -> median {serial_qps:.1f}")
+
+    next_q = [0]
+    budget = [0]
+    take_lock = threading.Lock()
+    latencies = []
+    outcomes = {"ok": 0, "rejected": 0, "deadline": 0,
+                "cancelled": 0, "error": 0}
+    mismatches = []
+    produced = []
+    res_lock = threading.Lock()
+
+    def client(cid: int):
+        while True:
+            with take_lock:
+                if next_q[0] >= budget[0]:
+                    return
+                qi = next_q[0]
+                next_q[0] += 1
+            name, df = workload[qi % len(workload)]
+            t1 = time.perf_counter()
+            try:
+                table = df.collect(
+                    timeout=TIMEOUT_S if TIMEOUT_S > 0 else None)
+            except QueryRejectedError:
+                with res_lock:
+                    outcomes["rejected"] += 1
+                continue
+            except QueryDeadlineExceededError:
+                with res_lock:
+                    outcomes["deadline"] += 1
+                continue
+            except QueryCancelledError:
+                with res_lock:
+                    outcomes["cancelled"] += 1
+                continue
+            except Exception as exc:  # pragma: no cover
+                with res_lock:
+                    outcomes["error"] += 1
+                    mismatches.append(f"{name}: {exc!r}")
+                continue
+            wall = time.perf_counter() - t1
+            # Correctness is verified AFTER the loop (every result,
+            # none skipped) — the serial baseline doesn't pay a
+            # canonicalize+compare per query, so neither may the
+            # concurrent lap it is the denominator for.
+            with res_lock:
+                latencies.append(wall)
+                outcomes["ok"] += 1
+                produced.append((name, table))
+
+    # Warm lap (not measured): thread spawn, cohort formation, and any
+    # residual compiles settle before the timed loop — the committed
+    # number is steady-state serving, matching how the serial baseline
+    # ran on the already-warm mix.
+    budget[0] = max(CLIENTS * 16, 120)
+    warm = [threading.Thread(target=client, args=(c,),
+                             name=f"serve-warm-{c}")
+            for c in range(CLIENTS)]
+    for th in warm:
+        th.start()
+    for th in warm:
+        th.join()
+    for name, table in produced:  # warm lap is still correctness-checked
+        if not canonical(table).equals(expected[name]):
+            mismatches.append(f"{name}: result differs from serial run")
+    with res_lock:
+        latencies.clear()
+        produced.clear()
+        for k in outcomes:
+            outcomes[k] = 0
+    next_q[0] = 0
+    budget[0] = TOTAL_QUERIES
+    batch0 = {k: _counter(f"serve.batch.{k}")
+              for k in ("invocations", "members", "fallbacks", "solo")}
+    threads = [threading.Thread(target=client, args=(c,),
+                                name=f"serve-client-{c}")
+               for c in range(CLIENTS)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    loop_wall = time.perf_counter() - t0
+    for name, table in produced:
+        if not canonical(table).equals(expected[name]):
+            mismatches.append(f"{name}: result differs from serial run")
+
+    if mismatches:
+        log("CORRECTNESS FAILURES under concurrency:")
+        for m in mismatches[:10]:
+            log(f"  {m}")
+        raise SystemExit(1)
+
+    batch = {k: _counter(f"serve.batch.{k}") - batch0[k]
+             for k in batch0}
+    batch["occupancy"] = (round(batch["members"] / batch["invocations"],
+                                3) if batch["invocations"] else None)
+    latencies.sort()
+    qps = outcomes["ok"] / loop_wall if loop_wall else 0.0
+    return {
+        "loop_wall_s": round(loop_wall, 3),
+        "qps": round(qps, 2),
+        "serial_qps": round(serial_qps, 2),
+        "p50_s": round(_percentile(latencies, 0.50) or 0, 5),
+        "p95_s": round(_percentile(latencies, 0.95) or 0, 5),
+        "p99_s": round(_percentile(latencies, 0.99) or 0, 5),
+        "max_s": round(latencies[-1], 5) if latencies else None,
+        "outcomes": outcomes,
+        "reject_rate": round(outcomes["rejected"] / TOTAL_QUERIES, 5),
+        "timeout_rate": round(outcomes["deadline"] / TOTAL_QUERIES, 5),
+        "batch": batch,
+    }
+
+
+def open_loop(workload, expected, serial_qps):
+    """Phase 3: Poisson arrivals swept across rates. Open-loop latency
+    counts from the SCHEDULED arrival time — a saturated server shows
+    its queueing delay instead of silently slowing the clients."""
+    rng = np.random.default_rng(23)
+    sweep = []
+    for frac in RATES:
+        rate = max(1.0, frac * serial_qps)
+        horizon = OPEN_SECONDS
+        gaps = rng.exponential(1.0 / rate, size=int(rate * horizon * 1.2)
+                               + 16)
+        sched = np.cumsum(gaps)
+        sched = sched[sched < horizon]
+        work = queue_mod.Queue()
+        latencies = []
+        outcomes = {"ok": 0, "failed": 0, "mismatch": 0}
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                item = work.get()
+                if item is None:
+                    return
+                qi, t_sched_abs = item
+                name, df = workload[qi % len(workload)]
+                try:
+                    table = df.collect(
+                        timeout=TIMEOUT_S if TIMEOUT_S > 0 else None)
+                except Exception:
+                    with lock:
+                        outcomes["failed"] += 1
+                    continue
+                done = time.perf_counter()
+                ok = canonical(table).equals(expected[name])
+                with lock:
+                    latencies.append(done - t_sched_abs)
+                    outcomes["ok" if ok else "mismatch"] += 1
+
+        workers = [threading.Thread(target=worker,
+                                    name=f"open-worker-{w}")
+                   for w in range(OPEN_WORKERS)]
+        for th in workers:
+            th.start()
+        t0 = time.perf_counter()
+        for qi, t_rel in enumerate(sched):
+            now = time.perf_counter() - t0
+            if t_rel > now:
+                time.sleep(t_rel - now)
+            work.put((qi, t0 + t_rel))
+        for _ in workers:
+            work.put(None)
+        for th in workers:
+            th.join(300)
+        wall = time.perf_counter() - t0
+        latencies.sort()
+        achieved = outcomes["ok"] / wall if wall else 0.0
+        entry = {
+            "offered_qps": round(rate, 2),
+            "offered_fraction_of_serial": frac,
+            "arrivals": int(len(sched)),
+            "achieved_qps": round(achieved, 2),
+            "p50_s": round(_percentile(latencies, 0.50) or 0, 5),
+            "p95_s": round(_percentile(latencies, 0.95) or 0, 5),
+            "p99_s": round(_percentile(latencies, 0.99) or 0, 5),
+            "outcomes": outcomes,
+        }
+        sweep.append(entry)
+        log(f"open loop @ {rate:7.1f}/s offered: "
+            f"{achieved:7.1f}/s achieved, "
+            f"p50 {entry['p50_s'] * 1e3:6.1f} ms, "
+            f"p99 {entry['p99_s'] * 1e3:6.1f} ms")
+        if entry["outcomes"]["mismatch"]:
+            log("CORRECTNESS FAILURES in the open loop")
+            raise SystemExit(1)
+    slo_s = SLO_MS / 1e3
+    meeting = [e for e in sweep if e["p99_s"] <= slo_s
+               and e["outcomes"]["ok"] > 0]
+    qps_at_slo = max((e["achieved_qps"] for e in meeting), default=None)
+    return {
+        "slo_p99_ms": SLO_MS,
+        "seconds_per_rate": OPEN_SECONDS,
+        "workers": OPEN_WORKERS,
+        "sweep": sweep,
+        "qps_at_p99_slo": qps_at_slo,
+    }
+
+
 def slow_decile_attribution():
-    """The p99 diagnosis the flight recorder exists for (ROADMAP item):
-    pull the slowest DECILE of the ring's completed queries and diff
-    each against the ring's median-wall query with the regression
-    differ, so the committed artifact carries *why* the tail is slow
-    (compute vs link vs compile vs cache vs cancellation), not just
-    that it is. The ring holds the most recent completed queries of the
-    closed loop — the exact population the p99 is computed over."""
+    """The p99 diagnosis the flight recorder exists for: diff the
+    slowest decile of the ring against the median-wall query so the
+    committed artifact carries *why* the tail is slow."""
     from hyperspace_tpu.telemetry import diff, flight
 
     ring = [q for q in flight.get_recorder().queries()
@@ -143,9 +446,6 @@ def slow_decile_attribution():
 
 def main():
     from hyperspace_tpu import HyperspaceConf, HyperspaceSession
-    from hyperspace_tpu.exceptions import (QueryCancelledError,
-                                           QueryDeadlineExceededError,
-                                           QueryRejectedError)
 
     work = tempfile.mkdtemp(prefix="hs_serve_")
     try:
@@ -158,126 +458,47 @@ def main():
         }))
         workload = build_workload(session, data_dir)
 
-        # Warm + correctness oracles (serial run of every query).
+        # Phase 1 while the process is cold: AOT warm-start proof.
+        aot = aot_replica_phase(workload)
+
+        # Correctness oracles (serial run of every query).
         expected = {}
         for name, df in workload:
             expected[name] = canonical(df.collect())
 
-        # Single-client baseline QPS on the warm mix.
-        t0 = time.perf_counter()
-        serial_runs = 0
-        while serial_runs < max(len(workload) * 4, 20):
-            _name, df = workload[serial_runs % len(workload)]
-            df.collect()
-            serial_runs += 1
-        serial_wall = time.perf_counter() - t0
-        serial_qps = serial_runs / serial_wall
-        log(f"serial baseline: {serial_runs} queries in "
-            f"{serial_wall:.2f}s = {serial_qps:.1f} QPS")
-
-        # Closed loop: K clients share one global query budget.
-        next_q = [0]
-        take_lock = threading.Lock()
-        latencies = []
-        outcomes = {"ok": 0, "rejected": 0, "deadline": 0,
-                    "cancelled": 0, "error": 0}
-        mismatches = []
-        res_lock = threading.Lock()
-
-        def client(cid: int):
-            while True:
-                with take_lock:
-                    if next_q[0] >= TOTAL_QUERIES:
-                        return
-                    qi = next_q[0]
-                    next_q[0] += 1
-                name, df = workload[qi % len(workload)]
-                t1 = time.perf_counter()
-                try:
-                    table = df.collect(
-                        timeout=TIMEOUT_S if TIMEOUT_S > 0 else None)
-                except QueryRejectedError:
-                    with res_lock:
-                        outcomes["rejected"] += 1
-                    continue
-                except QueryDeadlineExceededError:
-                    with res_lock:
-                        outcomes["deadline"] += 1
-                    continue
-                except QueryCancelledError:
-                    with res_lock:
-                        outcomes["cancelled"] += 1
-                    continue
-                except Exception as exc:  # pragma: no cover
-                    with res_lock:
-                        outcomes["error"] += 1
-                        mismatches.append(f"{name}: {exc!r}")
-                    continue
-                wall = time.perf_counter() - t1
-                ok = canonical(table).equals(expected[name])
-                with res_lock:
-                    latencies.append(wall)
-                    outcomes["ok"] += 1
-                    if not ok:
-                        mismatches.append(
-                            f"{name}: result differs from serial run")
-
-        threads = [threading.Thread(target=client, args=(c,),
-                                    name=f"serve-client-{c}")
-                   for c in range(CLIENTS)]
-        t0 = time.perf_counter()
-        for th in threads:
-            th.start()
-        for th in threads:
-            th.join()
-        loop_wall = time.perf_counter() - t0
-
-        if mismatches:
-            log("CORRECTNESS FAILURES under concurrency:")
-            for m in mismatches[:10]:
-                log(f"  {m}")
-            raise SystemExit(1)
-
-        latencies.sort()
-        qps = outcomes["ok"] / loop_wall if loop_wall else 0.0
-        slow_decile = slow_decile_attribution()
-        sched = session.scheduler()
-        counters = telemetry.get_registry().counters_dict()
-        serve_counters = {k: v for k, v in counters.items()
-                          if k.startswith(("serve.", "resilience."))}
-        attempted = TOTAL_QUERIES
-        serve = {
-            "clients": CLIENTS,
-            "queries": attempted,
-            "rows": ROWS,
-            "budget_bytes": BUDGET_BYTES,
-            "deadline_s": TIMEOUT_S,
-            "loop_wall_s": round(loop_wall, 3),
-            "qps": round(qps, 2),
-            "serial_qps": round(serial_qps, 2),
-            "p50_s": round(_percentile(latencies, 0.50) or 0, 5),
-            "p95_s": round(_percentile(latencies, 0.95) or 0, 5),
-            "p99_s": round(_percentile(latencies, 0.99) or 0, 5),
-            "max_s": round(latencies[-1], 5) if latencies else None,
-            "outcomes": outcomes,
-            "reject_rate": round(outcomes["rejected"] / attempted, 5),
-            "timeout_rate": round(outcomes["deadline"] / attempted, 5),
-            "peak_admitted_bytes": sched.peak_admitted_bytes,
-            "counters": serve_counters,
-            "slow_decile": slow_decile,
-        }
-        log(f"closed loop: {outcomes['ok']}/{attempted} ok in "
-            f"{loop_wall:.2f}s = {qps:.1f} QPS "
+        # Phase 2: closed loop.
+        serve = closed_loop(workload, expected)
+        qps, serial_qps = serve["qps"], serve["serial_qps"]
+        log(f"closed loop: {serve['outcomes']['ok']}/{TOTAL_QUERIES} ok "
+            f"in {serve['loop_wall_s']:.2f}s = {qps:.1f} QPS "
             f"(x{qps / serial_qps:.2f} vs 1 client), "
             f"p50 {serve['p50_s'] * 1e3:.1f} ms, "
             f"p99 {serve['p99_s'] * 1e3:.1f} ms, "
-            f"rejected {outcomes['rejected']}, "
-            f"deadline {outcomes['deadline']}")
+            f"batch occupancy {serve['batch']['occupancy']}")
 
+        # Phase 3: open loop to the knee.
+        serve["open_loop"] = open_loop(workload, expected, serial_qps)
+
+        sched = session.scheduler()
+        counters = telemetry.get_registry().counters_dict()
+        serve.update({
+            "clients": CLIENTS,
+            "queries": TOTAL_QUERIES,
+            "rows": ROWS,
+            "budget_bytes": BUDGET_BYTES,
+            "deadline_s": TIMEOUT_S,
+            "aot": aot,
+            "peak_admitted_bytes": sched.peak_admitted_bytes,
+            "counters": {k: v for k, v in counters.items()
+                         if k.startswith(("serve.", "resilience.",
+                                          "compile.aot.",
+                                          "cache.segments.shared."))},
+            "slow_decile": slow_decile_attribution(),
+        })
         result = telemetry.artifact.make_artifact(
             driver="bench_serve.py",
             metric="serve_closed_loop_qps",
-            value=round(qps, 2),
+            value=qps,
             unit="queries/s",
             vs_baseline=round(qps / serial_qps, 3) if serial_qps else None,
             extra={"serve": serve, "link_probe": link_probe()})

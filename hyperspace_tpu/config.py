@@ -264,6 +264,42 @@ class HyperspaceConf:
                               str(constants.SERVE_DEADLINE_SECONDS_DEFAULT)))
 
     @property
+    def serve_batch_enabled(self) -> bool:
+        """Inter-query batched execution (`engine/batcher.py`):
+        concurrent same-signature point/filter queries coalesce into
+        one jitted predicate program over the shared scan. "false"
+        restores strictly per-query execution."""
+        return (self.get(constants.SERVE_BATCH_ENABLED,
+                         constants.SERVE_BATCH_ENABLED_DEFAULT)
+                or "true").lower() == "true"
+
+    @property
+    def serve_batch_window_ms(self) -> float:
+        """Gather window: how long the first query of a signature waits
+        for cohort joiners before executing. Skipped when nothing else
+        is in flight (serial latency untouched)."""
+        return float(self.get(
+            constants.SERVE_BATCH_WINDOW_MS,
+            str(constants.SERVE_BATCH_WINDOW_MS_DEFAULT)))
+
+    @property
+    def serve_batch_max(self) -> int:
+        """Cohort-size cap per batched invocation; also the top padded
+        constant-lane bucket (cohorts pad to the next power of two up
+        to this, so K is a compile bucket, not a retrace)."""
+        return self.get_int(constants.SERVE_BATCH_MAX,
+                            constants.SERVE_BATCH_MAX_DEFAULT)
+
+    @property
+    def serve_batch_aot_warmup(self) -> bool:
+        """Pre-compile the canonical cohort-size buckets of a batch
+        signature the first time it is seen (and for the explicit
+        `engine.batcher.warmup(df)` replica API)."""
+        return (self.get(constants.SERVE_BATCH_AOT_WARMUP,
+                         constants.SERVE_BATCH_AOT_WARMUP_DEFAULT)
+                or "true").lower() == "true"
+
+    @property
     def serve_breaker_failures(self) -> int:
         """Degraded-fallback count within the window that OPENS a
         per-index circuit breaker (known-bad index skips straight to

@@ -136,6 +136,30 @@ SERVE_QUEUE_DEPTH_DEFAULT = 32
 SERVE_DEADLINE_SECONDS = "spark.hyperspace.serve.deadline.seconds"
 SERVE_DEADLINE_SECONDS_DEFAULT = 0.0
 
+# Inter-query batched execution (`engine/batcher.py`): concurrent
+# point/filter queries sharing one execution signature (same scan
+# identity + pinned index version + predicate SHAPE, literals free)
+# coalesce into ONE jitted predicate program over the shared resident
+# segments — PR-8's coalescing dedupes the cache FILL, this dedupes the
+# EXECUTION. The first query of a signature gathers joiners for
+# `batch.window.ms` (skipped entirely when nothing else is in flight,
+# so serial latency is untouched), up to `batch.max` cohort members per
+# invocation; predicate constants ride padded power-of-two lanes so the
+# cohort size is a compile-time bucket, not a retrace per K.
+# `batch.aot.warmup` pre-compiles the canonical cohort-size buckets the
+# first time a signature is seen (and via the explicit
+# `engine.batcher.warmup(df)` replica API), riding the persistent
+# compile cache (`compile.cache.dir`) so a fresh replica's first
+# batched query loads executables instead of tracing.
+SERVE_BATCH_ENABLED = "spark.hyperspace.serve.batch.enabled"
+SERVE_BATCH_ENABLED_DEFAULT = "true"
+SERVE_BATCH_WINDOW_MS = "spark.hyperspace.serve.batch.window.ms"
+SERVE_BATCH_WINDOW_MS_DEFAULT = 2.0
+SERVE_BATCH_MAX = "spark.hyperspace.serve.batch.max"
+SERVE_BATCH_MAX_DEFAULT = 16
+SERVE_BATCH_AOT_WARMUP = "spark.hyperspace.serve.batch.aot.warmup"
+SERVE_BATCH_AOT_WARMUP_DEFAULT = "true"
+
 # Degradation circuit breaker (per index): after `breaker.failures`
 # IndexDataUnavailableError fallbacks within `breaker.window.seconds`,
 # the breaker OPENS and queries selecting that index skip straight to

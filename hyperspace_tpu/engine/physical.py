@@ -169,11 +169,18 @@ class ScanExec(PhysicalNode):
     name = "Scan"
 
     def __init__(self, scan: Scan, columns: Sequence[str],
-                 allowed_buckets: Optional[Set[int]] = None, conf=None):
+                 allowed_buckets: Optional[Set[int]] = None, conf=None,
+                 shared_members: int = 0):
         self.scan = scan
         self.columns = list(columns)
         self.out_schema = scan.schema.select(columns)
         self.conf = conf
+        # >0: this scan is the SHARED read of an inter-query batch
+        # cohort (`engine/batcher.py`) — one read serving that many
+        # concurrent queries. Threaded to the segment cache's shared-
+        # read counters and onto the operator record so the differ can
+        # attribute amortized reads.
+        self.shared_members = shared_members
         # Bucket pruning: when a filter above constrains every bucket
         # column to literal values, only these buckets can contain matches
         # (set by the planner, `_prune_buckets`). The index read then
@@ -206,7 +213,8 @@ class ScanExec(PhysicalNode):
         return segcache.read_segment(files, self.columns,
                                      self.out_schema, ref=ref,
                                      conf=self.conf,
-                                     budget=self._budget(device=True))
+                                     budget=self._budget(device=True),
+                                     shared_members=self.shared_members)
 
     def _annotate_read(self, files: List[str], host: bool,
                        files_total: Optional[int] = None) -> None:
@@ -228,6 +236,8 @@ class ScanExec(PhysicalNode):
                   # signal.
                   "bytes_scanned": _footprint.file_sizes_total(files),
                   "roots": list(self.scan.root_paths)}
+        if self.shared_members:
+            detail["shared_members"] = self.shared_members
         spec = self.scan.bucket_spec
         if spec is not None:
             detail["buckets_total"] = spec.num_buckets

@@ -38,6 +38,15 @@ can reach:
   buffers unwind through the normal release paths (the leak-sentinel
   tests in `tests/test_serving.py` pin this).
 
+- **inter-query batched execution**: after optimization (and the
+  footprint credits), eligible point/filter plans route through the
+  batching lane (`engine/batcher.py`): K concurrent queries sharing an
+  execution signature coalesce into ONE jitted stacked-predicate
+  invocation over the shared scan, with per-query slicing, deadlines,
+  metrics, and the fallback contract preserved. `None` from the lane —
+  ineligible shape, nothing to coalesce with, or a batch-lane
+  fallback — lands on the per-query resilient path below unchanged.
+
 - **degradation circuit breaker**: the PR-4 `IndexDataUnavailableError`
   fallback is wrapped in a per-index breaker (closed -> open after N
   failures in a window -> half-open probe; `serve.breaker.*` knobs).
@@ -673,8 +682,21 @@ class QueryScheduler:
                             metrics.event("serve", "footprint_credit",
                                           query_id=query_id,
                                           credited_bytes=credited)
-                    batch = self._execute_resilient(df, plan, metrics,
-                                                    conf)
+                    # Inter-query batched execution (`engine/batcher.py`):
+                    # concurrent same-signature point/filter queries
+                    # coalesce into one jitted predicate invocation over
+                    # the shared scan. None = ineligible shape, nothing
+                    # to coalesce with, or batch-lane fallback — the
+                    # per-query resilient path below stays the general
+                    # executor (and the fallback target).
+                    batch = None
+                    if conf is not None and conf.serve_batch_enabled:
+                        from hyperspace_tpu.engine import batcher
+                        batch = batcher.get_batcher().try_collect(
+                            df, plan, metrics, conf, deadline, self)
+                    if batch is None:
+                        batch = self._execute_resilient(df, plan,
+                                                        metrics, conf)
                     if not batch.is_host:
                         # Query-end HBM watermark, FORCED (throttling
                         # may have swallowed every span-boundary sample

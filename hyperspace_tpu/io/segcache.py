@@ -909,8 +909,21 @@ def clear() -> None:
 
 
 def read_segment(paths, columns, schema, ref=None, conf=None,
-                 budget=None):
-    """Module-level convenience: `get_cache().read(...)`."""
+                 budget=None, shared_members: int = 0):
+    """Module-level convenience: `get_cache().read(...)`.
+
+    `shared_members > 1` marks the SHARED read of an inter-query batch
+    cohort (`engine/batcher.py`): one pass through the cache — one hit,
+    or one single-flight fill — serves that many concurrent queries.
+    Counted as `cache.segments.shared.{reads,members}` so the
+    amortization is scrape-able next to the hit/miss series (PR-8's
+    single-flight dedupes concurrent fills of one key; the batch lane
+    goes further and dedupes the LOOKUP to one caller)."""
+    if shared_members > 1:
+        from hyperspace_tpu import telemetry
+        reg = telemetry.get_registry()
+        reg.counter("cache.segments.shared.reads").inc()
+        reg.counter("cache.segments.shared.members").inc(shared_members)
     return get_cache().read(paths, columns, schema, ref=ref, conf=conf,
                             budget=budget)
 

@@ -1175,3 +1175,130 @@ def sharded_group_aggregate(sh: ShardedBatch,
     return distributed_group_aggregate(
         sh.batch, group_columns, aggregates, out_schema, sh.mesh,
         pre_sharded=(sh.batch, sh.row_valid))
+
+
+# ---------------------------------------------------------------------------
+# Inter-query batched predicate lane (`engine/batcher.py` is the ONLY
+# sanctioned caller — `scripts/check_metrics_coverage.py` enforces it)
+# ---------------------------------------------------------------------------
+#
+# K concurrent point/filter queries over one shared scan differ only in
+# their predicate CONSTANTS once they share an execution signature
+# (`engine/batcher.py` groups them). This program evaluates all K
+# predicates in ONE `instrumented_jit("serve.batch")` dispatch: the
+# constants ride [K, T] lanes (K padded to a power-of-two bucket by the
+# batcher, so cohort size is a compile bucket, not a retrace per K) and
+# the result is a [K, N] boolean mask matrix the batcher slices
+# per-query. Term semantics mirror `engine/compiler.py`'s definite-truth
+# masks exactly for the supported shapes — numeric comparisons against
+# literals (compared in the COLUMN's dtype, matching numpy's
+# weak-scalar promotion on the solo path), integer IN lists, and
+# IS [NOT] NULL — so a batched member's rows are bit-identical to its
+# solo run.
+
+# One shape term is a tuple:
+#   ("cmp", op, col_index, lane)       lane: "i" (int64) | "f" (float64)
+#   ("in", col_index, padded_len)      int lane, `padded_len` values
+#   ("isnull"|"notnull", col_index)
+_BATCH_CMP_OPS = ("eq", "ne", "lt", "le", "gt", "ge")
+
+
+def _batched_predicate_program(shape: tuple, dtypes: tuple,
+                               valid_flags: tuple):
+    """Build (memoized) the jitted K-predicate program for one static
+    term shape over columns of the given dtypes/validity presence."""
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.telemetry import instrumented_jit
+
+    def build():
+        def body(datas, valids, iconst, fconst):
+            ops = {"eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+                   "lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+                   "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b}
+            vmap = {}
+            vi = 0
+            for ci, flag in enumerate(valid_flags):
+                if flag:
+                    vmap[ci] = valids[vi]
+                    vi += 1
+            total = None
+            ii = fi = 0
+            for term in shape:
+                kind = term[0]
+                if kind == "cmp":
+                    _k, op, ci, lane = term
+                    data = jnp.asarray(datas[ci])
+                    if lane == "f":
+                        const = fconst[:, fi]
+                        fi += 1
+                        # Compare in the column's own float width (the
+                        # solo path's numpy weak-scalar promotion); int
+                        # columns against float literals promote to
+                        # float64 on both paths.
+                        if data.dtype.kind == "f":
+                            const = const.astype(data.dtype)
+                        else:
+                            data = data.astype(jnp.float64)
+                    else:
+                        const = iconst[:, ii]
+                        ii += 1
+                        # Integer compares are exact at any width; lift
+                        # the column to int64 so the [K] lane broadcasts
+                        # without narrowing the literal.
+                        if data.dtype.kind == "f":
+                            const = const.astype(data.dtype)
+                        else:
+                            data = data.astype(jnp.int64)
+                    m = ops[op](data[None, :], const[:, None])
+                elif kind == "in":
+                    _k, ci, padded = term
+                    vals = iconst[:, ii:ii + padded]
+                    ii += padded
+                    data = jnp.asarray(datas[ci]).astype(jnp.int64)
+                    m = jnp.any(data[None, :, None] == vals[:, None, :],
+                                axis=-1)
+                elif kind == "isnull":
+                    _k, ci = term
+                    v = vmap.get(ci)
+                    n = jnp.asarray(datas[ci]).shape[0]
+                    m = (jnp.zeros((1, n), bool) if v is None
+                         else (~v)[None, :])
+                else:  # notnull
+                    _k, ci = term
+                    v = vmap.get(ci)
+                    n = jnp.asarray(datas[ci]).shape[0]
+                    m = (jnp.ones((1, n), bool) if v is None
+                         else v[None, :])
+                if kind in ("cmp", "in"):
+                    v = vmap.get(term[2] if kind == "cmp" else term[1])
+                    if v is not None:
+                        m = m & v[None, :]
+                total = m if total is None else total & m
+            # A constants-free shape (only null-ness terms) evaluates
+            # as one [1, N] row — broadcast so every member slices its
+            # own lane regardless.
+            return jnp.broadcast_to(
+                total, (iconst.shape[0],) + total.shape[1:])
+
+        return instrumented_jit("serve.batch", body)
+
+    return _cached_program(("serve.batch", shape, dtypes, valid_flags),
+                           build)
+
+
+def batched_predicate_masks(shape: tuple, datas: tuple, valids: tuple,
+                            iconst, fconst):
+    """THE batched-execution entry point: evaluate the K stacked
+    predicates described by `shape` over the shared columns. `datas` is
+    one array per referenced column (shape order indexes into it),
+    `valids` the validity arrays of the columns that HAVE one (presence
+    is static program structure), `iconst`/`fconst` the [K_bucket, T]
+    padded constant lanes. Returns the [K_bucket, N] boolean mask
+    matrix (a jax array; callers slice rows per member)."""
+    valid_flags = tuple(v is not None for v in valids)
+    dtypes = tuple(str(np.asarray(d).dtype) if isinstance(d, np.ndarray)
+                   else str(d.dtype) for d in datas)
+    prog = _batched_predicate_program(shape, dtypes, valid_flags)
+    present = tuple(v for v in valids if v is not None)
+    return prog(tuple(datas), present, iconst, fconst)

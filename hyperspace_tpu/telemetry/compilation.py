@@ -40,7 +40,7 @@ from typing import Dict, Optional
 from hyperspace_tpu.telemetry import registry as _registry
 
 __all__ = ["instrumented_jit", "REGISTRY", "configure_persistent_cache",
-           "persistent_cache_dir"]
+           "persistent_cache_dir", "aot_warmup", "reset_aot_memo"]
 
 # name -> instrumented wrapper (the coverage lint audits the stamps).
 REGISTRY: Dict[str, object] = {}
@@ -120,6 +120,51 @@ def configure_persistent_cache(conf) -> bool:
         _registry.get_registry().counter(
             "compile.persistent_cache.configured").inc()
         return True
+
+
+# Warm-start AOT executables: keys already primed this process (e.g.
+# one per (index root, version, predicate shape, cohort bucket) for the
+# batched serve lane). The memo makes priming idempotent — a replica
+# warming on every index open never re-pays an executed warmup.
+_aot_keys: set = set()
+_aot_lock = threading.Lock()
+
+
+def reset_aot_memo() -> None:
+    """Forget which warmup keys ran (tests simulating a fresh replica).
+    Does NOT drop compiled executables — jax's caches are untouched."""
+    with _aot_lock:
+        _aot_keys.clear()
+
+
+def aot_warmup(key: tuple, fn, args_fn) -> bool:
+    """Prime a jit entry point for one canonical shape, once per `key`:
+    call `fn(*args_fn())` so the trace + backend compile (or, on a
+    fresh replica pointed at the persistent compile cache, the
+    executable LOAD) happens now — at index-open / replica-start time —
+    instead of inside the first serving query. A real dummy-argument
+    call is used rather than `.lower().compile()` because only a
+    dispatched call populates jax's executable cache: the warmed shape's
+    first serving query must show `compile.traces == 0`, not a cheap
+    re-trace. Returns True iff the warmup ran (False: memo hit, or the
+    attempt failed — warm-start is an optimization, never a failure).
+    Counted as `compile.aot.{warmups,memo_hits,errors}`."""
+    with _aot_lock:
+        if key in _aot_keys:
+            _registry.get_registry().counter("compile.aot.memo_hits").inc()
+            return False
+        _aot_keys.add(key)
+    try:
+        fn(*args_fn())
+        _registry.get_registry().counter("compile.aot.warmups").inc()
+        return True
+    except Exception:
+        import logging
+        logging.getLogger(__name__).warning(
+            "AOT warmup failed for %r (serving proceeds; the first "
+            "query of this shape pays the trace)", key, exc_info=True)
+        _registry.get_registry().counter("compile.aot.errors").inc()
+        return False
 
 
 def _frames() -> list:

@@ -271,7 +271,22 @@ def compare_advisor(old: dict, new: dict, threshold: float):
 def compare_serve(old: dict, new: dict, threshold: float):
     """Serving-artifact gate rows (same row shape as `compare`):
     scaling ratio + QPS drop >threshold, p50/p99 growth >threshold,
-    reject/timeout rate growth > RATE_SLACK absolute."""
+    reject/timeout rate growth > RATE_SLACK absolute — plus, for
+    artifacts carrying the batched-execution sections (PR 12), the
+    ABSOLUTE wins the lane exists for:
+
+    - `scaling_floor` — the 8-client closed loop must BEAT serial
+      (`vs_baseline >= 1.0`; concurrency that loses is the regression,
+      whatever history said);
+    - `batch_occupancy` — `serve.batch.members / serve.batch.
+      invocations` on the concurrent rung must exceed 1 (an occupancy
+      of exactly 1 means the lane ran but never coalesced anything);
+    - `aot_warm_traces` — the AOT-warmed replica phase must record
+      ZERO new `compile.traces` (absolute, like the warm-H2D rows:
+      the healthy value is 0 and nothing ratio-gates against zero).
+
+    Absolute rows gate on the NEW artifact alone; rounds predating the
+    sections are not gated on them."""
     o = old.get("serve") or {}
     n = new.get("serve") or {}
     rows = []
@@ -294,6 +309,28 @@ def compare_serve(old: dict, new: dict, threshold: float):
         if isinstance(ov, (int, float)) and isinstance(nv, (int, float)):
             delta = nv - ov
             rows.append((rate, ov, nv, delta, delta > RATE_SLACK))
+
+    vb = new.get("vs_baseline")
+    if isinstance(vb, (int, float)) and ("batch" in n or "aot" in n):
+        rows.append(("scaling_floor", 1.0, vb, vb - 1.0, vb < 1.0))
+    b = n.get("batch") or {}
+    inv, mem = b.get("invocations"), b.get("members")
+    if isinstance(inv, (int, float)) and isinstance(mem, (int, float)):
+        occ = (mem / inv) if inv > 0 else 0.0
+        rows.append(("batch_occupancy", 1.0, occ, occ - 1.0, occ <= 1.0))
+    a = n.get("aot") or {}
+    wt = a.get("warm_traces")
+    if isinstance(wt, (int, float)):
+        rows.append(("aot_warm_traces", 0.0, float(wt), float(wt),
+                     wt > 0))
+    ol = n.get("open_loop") or {}
+    slo_qps = ol.get("qps_at_p99_slo")
+    oslo = (old.get("serve") or {}).get("open_loop") or {}
+    if isinstance(slo_qps, (int, float)):
+        add("qps_at_p99_slo", oslo.get("qps_at_p99_slo"), slo_qps)
+        if not isinstance(oslo.get("qps_at_p99_slo"), (int, float)):
+            rows.append(("qps_at_p99_slo_floor", 0.0, float(slo_qps),
+                         float(slo_qps), slo_qps <= 0))
     return rows
 
 

@@ -425,6 +425,44 @@ def check_advisor_build_seam(package_dir: str):
     return failures
 
 
+# The ONE sanctioned batched-execution point: the stacked-predicate
+# program (`parallel/spmd.batched_predicate_masks`, the serve.batch jit
+# entry) may only be invoked by the batching lane in engine/batcher.py.
+# Any other caller is a K-query execution the scheduler never grouped:
+# its members would have no cohort accounting, no per-member deadline
+# settlement, and no fallback contract — exactly the properties
+# tests/test_batcher.py pins on the sanctioned lane.
+_RAW_BATCH_RE = re.compile(r"\bbatched_predicate_masks\s*\(")
+_BATCH_DEF = os.path.join("parallel", "spmd.py")
+_BATCH_ALLOWED = os.path.join("engine", "batcher.py")
+
+
+def check_batch_seam(package_dir: str):
+    """Source lint: no `batched_predicate_masks(...)` calls outside the
+    defining module and engine/batcher.py."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel in (_BATCH_DEF, _BATCH_ALLOWED):
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_BATCH_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: batched-"
+                            "program invocation outside the batching "
+                            "lane — route it through engine/batcher.py "
+                            "so cohort accounting, per-member deadlines,"
+                            " and the fallback contract apply")
+    return failures
+
+
 # The ONE sanctioned backoff point: every storage retry routes through
 # the policy in utils/retry.py (typed classification, conf-driven
 # backoff, io.retries/io.giveups counters, fault-injection coverage).
@@ -540,6 +578,8 @@ def main() -> int:
     failures.extend(check_sharding_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_advisor_build_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_batch_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_retry_seams(
         os.path.dirname(hyperspace_tpu.__file__)))

@@ -1,0 +1,476 @@
+"""Inter-query batched execution (`engine/batcher.py`): signature
+grouping, bit-identity vs the solo path, per-member deadline
+settlement, snapshot-pin safety vs a concurrent refresher, the
+per-query fallback contract on batch-lane failure, AOT warm-start, and
+the PR-7 chaos harness rerun with batching ON.
+
+Tests that need a cohort to form deterministically park a pad entry in
+the scheduler (`_hold`, the test_serving.py idiom) so the lane's
+"anything else in flight?" engagement check passes, and use a wide
+gather window so staggered client threads land in one cohort.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import (Hyperspace, HyperspaceConf, HyperspaceSession,
+                            IndexConfig, telemetry)
+from hyperspace_tpu.engine import batcher as batcher_mod
+from hyperspace_tpu.engine import scheduler as sched_mod
+from hyperspace_tpu.engine.batcher import (QueryBatcher, plan_signature,
+                                           warmup)
+from hyperspace_tpu.engine.scheduler import (Deadline, QueryScheduler,
+                                             _QueryEntry)
+from hyperspace_tpu.exceptions import QueryDeadlineExceededError
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.plan.nodes import Filter, Project, Scan
+from hyperspace_tpu.plan.schema import Field, Schema
+from hyperspace_tpu.utils.faults import FaultRule
+
+from chaos import canonical, run_chaos
+
+
+def _counter(name):
+    return telemetry.get_registry().counters_dict().get(name, 0)
+
+
+@pytest.fixture
+def fresh_lane():
+    """Fresh scheduler AND batcher (cohorts, solo streaks, warm memo)."""
+    sch = sched_mod.set_scheduler(QueryScheduler())
+    bat = batcher_mod.set_batcher(QueryBatcher())
+    yield sch, bat
+    sched_mod.set_scheduler(QueryScheduler())
+    batcher_mod.set_batcher(QueryBatcher())
+
+
+@pytest.fixture
+def batch_env(tmp_path):
+    """A fact table (with a NULLABLE column) + session factory."""
+    rng = np.random.default_rng(3)
+    n = 20_000
+    facts = tmp_path / "facts"
+    facts.mkdir()
+    w = rng.random(n)
+    w_valid = rng.random(n) > 0.1  # ~10% nulls: validity lanes exercised
+    pq.write_table(pa.table({
+        "k": rng.integers(0, 500, n).astype(np.int64),
+        "g": rng.integers(0, 32, n).astype(np.int64),
+        "v": rng.random(n).astype(np.float64),
+        "w": pa.array([float(x) if ok else None
+                       for x, ok in zip(w, w_valid)], type=pa.float64()),
+    }), str(facts / "part-0.parquet"))
+
+    def session(**extra):
+        conf = {"hyperspace.warehouse.dir": str(tmp_path / "wh")}
+        conf.update({k: str(v) for k, v in extra.items()})
+        return HyperspaceSession(HyperspaceConf(conf))
+
+    return session, str(facts)
+
+
+def _hold(sch, qid="pad"):
+    """Occupy one in-flight slot so the lane's engagement check (is
+    anything else running?) passes for single-threaded arrivals."""
+    ent = _QueryEntry(qid, Deadline(qid), 0, None)
+    with sch._cv:
+        sch._active[qid] = ent
+        sch._grant(ent, telemetry.get_registry())
+    return ent
+
+
+def _run_concurrent(dfs, timeout_for=None):
+    """Collect every df on its own thread; returns (results, errors)."""
+    results = [None] * len(dfs)
+    errors = [None] * len(dfs)
+
+    def run(i):
+        try:
+            t = (timeout_for(i) if timeout_for is not None else None)
+            results[i] = dfs[i].collect(timeout=t)
+        except Exception as exc:
+            errors[i] = exc
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(dfs))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(60)
+    assert not any(th.is_alive() for th in threads), "batch lane hung"
+    return results, errors
+
+
+# ---------------------------------------------------------------------------
+# Signature parsing
+# ---------------------------------------------------------------------------
+
+
+def _scan(root="/tmp/x", pinned=None, index=None):
+    schema = Schema([Field("a", "int64"), Field("s", "string"),
+                     Field("f", "float64")])
+    return Scan([root], schema, pinned_version=pinned, index_name=index)
+
+
+def test_signature_shapes_and_declines():
+    s = _scan()
+    sig = plan_signature(Project(["a"], Filter(
+        (col("a") == lit(3)) & (col("f") > lit(0.5)), s)), 1)
+    assert sig is not None
+    assert sig.shape == (("cmp", "eq", 0, "i"), ("cmp", "gt", 1, "f"))
+    assert sig.ints == [3] and sig.floats == [0.5]
+    assert sig.projection == ("a",)
+    # Same shape, different literals -> SAME key (they batch).
+    sig2 = plan_signature(Project(["a"], Filter(
+        (col("a") == lit(9)) & (col("f") > lit(0.25)), s)), 1)
+    assert sig2.key == sig.key and sig2.ints == [9]
+    # IN pads to a power of two and keys on the padded length.
+    sig_in = plan_signature(Filter(col("a").isin(1, 2, 3), s), 1)
+    assert sig_in.shape == (("in", 0, 4),)
+    assert sig_in.ints == [1, 2, 3, 3]
+    # Declines: string predicate, OR, computed projection, bare scan.
+    assert plan_signature(Filter(col("s") == lit("x"), s), 1) is None
+    assert plan_signature(Filter(
+        (col("a") == lit(1)) | (col("a") == lit(2)), s), 1) is None
+    assert plan_signature(Project(
+        [(col("a") + lit(1)).alias("b")],
+        Filter(col("a") == lit(1), s)), 1) is None
+    assert plan_signature(s, 1) is None
+
+
+def test_signature_never_mixes_index_versions():
+    """Snapshot-pin safety: two plans over different committed versions
+    (a refresher racing the serve path) can never share a cohort."""
+    base = Filter(col("a") == lit(1), _scan("/w/idx/v__=0", 0, "idx"))
+    newer = Filter(col("a") == lit(1), _scan("/w/idx/v__=1", 1, "idx"))
+    k0 = plan_signature(base, 1).key
+    k1 = plan_signature(newer, 1).key
+    assert k0 != k1
+    # ... and different sessions never share one either.
+    assert plan_signature(base, 2).key != k0
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: batched vs solo, for every supported shape
+# ---------------------------------------------------------------------------
+
+
+def test_batched_results_bit_identical_to_solo(batch_env, fresh_lane):
+    session, facts_dir = batch_env
+    sch, _bat = fresh_lane
+    sess = session(**{"spark.hyperspace.serve.batch.window.ms": 250})
+    facts = sess.read_parquet(facts_dir)
+    dfs = (
+        # point: same signature, different constants
+        [facts.filter(col("g") == lit(i)).select("k", "g", "v")
+         for i in range(6)]
+        # float range conjunctions
+        + [facts.filter((col("v") > lit(lo)) & (col("v") <= lit(lo + .2)))
+           .select("k", "v") for lo in (0.1, 0.6)]
+        # IN over ints
+        + [facts.filter(col("g").isin(2, 12, 22)).select("k", "g"),
+           facts.filter(col("g").isin(5, 15, 25)).select("k", "g")]
+        # nullable column: validity lanes + IS NOT NULL term
+        + [facts.filter((col("w") > lit(0.5)) & col("w").is_not_null())
+           .select("k", "w"),
+           facts.filter((col("w") > lit(0.2)) & col("w").is_not_null())
+           .select("k", "w")]
+    )
+    expected = [canonical(df.collect()) for df in dfs]  # solo oracle
+    inv0 = _counter("serve.batch.invocations")
+    pad = _hold(sch)
+    try:
+        results, errors = _run_concurrent(dfs)
+    finally:
+        sch._release(pad)
+    assert not any(errors), [repr(e) for e in errors if e]
+    for r, e in zip(results, expected):
+        assert canonical(r).equals(e)
+    assert _counter("serve.batch.invocations") > inv0
+    assert _counter("serve.batch.members") >= 2
+
+
+def test_member_metrics_carry_cohort_and_operator(batch_env, fresh_lane):
+    session, facts_dir = batch_env
+    sch, _bat = fresh_lane
+    sess = session(**{"spark.hyperspace.serve.batch.window.ms": 250})
+    facts = sess.read_parquet(facts_dir)
+    dfs = [facts.filter(col("g") == lit(i)).select("k", "v")
+           for i in range(4)]
+    for df in dfs:
+        df.collect()  # warm solo
+    collected = {}
+    lock = threading.Lock()
+
+    def run(i):
+        table, m = dfs[i].collect(with_metrics=True)
+        with lock:
+            collected[i] = (table, m)
+
+    pad = _hold(sch)
+    try:
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60)
+    finally:
+        sch._release(pad)
+    batched = [(i, m) for i, (_t, m) in collected.items()
+               if m.events_of("serve", "batched")]
+    assert batched, "no query recorded a batched event"
+    for _i, m in batched:
+        ev = m.events_of("serve", "batched")[-1]
+        assert ev["cohort"] >= 2
+        if not ev["leader"]:
+            ops = [o for o in m.operators if o.name == "BatchedQuery"]
+            assert ops and ops[-1].rows_out is not None
+            assert ops[-1].detail["cohort"] == ev["cohort"]
+
+
+# ---------------------------------------------------------------------------
+# Per-member deadline: a cancelled member drops its slice, not the batch
+# ---------------------------------------------------------------------------
+
+
+def test_member_deadline_cancels_only_its_slice(batch_env, fresh_lane):
+    session, facts_dir = batch_env
+    sch, _bat = fresh_lane
+    sess = session(**{"spark.hyperspace.serve.batch.window.ms": 700})
+    facts = sess.read_parquet(facts_dir)
+    leader_df = facts.filter(col("g") == lit(1)).select("k", "v")
+    doomed_df = facts.filter(col("g") == lit(2)).select("k", "v")
+    other_df = facts.filter(col("g") == lit(3)).select("k", "v")
+    oracles = {id(d): canonical(d.collect())
+               for d in (leader_df, doomed_df, other_df)}
+
+    outcome = {}
+    lock = threading.Lock()
+
+    def run(tag, df, timeout=None, delay=0.0):
+        time.sleep(delay)
+        try:
+            table = df.collect(timeout=timeout)
+            with lock:
+                outcome[tag] = table
+        except Exception as exc:
+            with lock:
+                outcome[tag] = exc
+
+    pad = _hold(sch)
+    try:
+        threads = [
+            threading.Thread(target=run, args=("leader", leader_df)),
+            threading.Thread(target=run,
+                             args=("doomed", doomed_df, 0.15, 0.1)),
+            threading.Thread(target=run,
+                             args=("other", other_df, None, 0.2)),
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60)
+        assert not any(th.is_alive() for th in threads)
+    finally:
+        sch._release(pad)
+
+    doomed = outcome["doomed"]
+    assert isinstance(doomed, QueryDeadlineExceededError), repr(doomed)
+    assert doomed.phase == "batch"
+    # The survivors got their exact slices.
+    assert canonical(outcome["leader"]).equals(oracles[id(leader_df)])
+    assert canonical(outcome["other"]).equals(oracles[id(other_df)])
+
+
+# ---------------------------------------------------------------------------
+# Batch-lane failure: per-query fallback, never a cohort failure
+# ---------------------------------------------------------------------------
+
+
+def test_batch_lane_failure_falls_back_per_query(batch_env, fresh_lane,
+                                                 fault_injector):
+    session, facts_dir = batch_env
+    sch, _bat = fresh_lane
+    sess = session(**{"spark.hyperspace.serve.batch.window.ms": 250})
+    facts = sess.read_parquet(facts_dir)
+    dfs = [facts.filter(col("g") == lit(i)).select("k", "v")
+           for i in range(4)]
+    expected = [canonical(df.collect()) for df in dfs]
+    fault_injector(FaultRule("batch.execute", kind="transient", nth=1,
+                             times=-1))
+    fb0 = _counter("serve.batch.fallbacks")
+    pad = _hold(sch)
+    try:
+        results, errors = _run_concurrent(dfs)
+    finally:
+        sch._release(pad)
+    # EVERY query succeeded via the per-query path, bit-identically.
+    assert not any(errors), [repr(e) for e in errors if e]
+    for r, e in zip(results, expected):
+        assert canonical(r).equals(e)
+    assert _counter("serve.batch.fallbacks") - fb0 >= 2
+
+
+# ---------------------------------------------------------------------------
+# Snapshot-pin safety, end to end, vs a concurrent refresher
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_refresher_never_breaks_batched_reads(
+        tmp_path, fresh_lane):
+    sch, _bat = fresh_lane
+    rng = np.random.default_rng(11)
+    src = tmp_path / "src"
+    src.mkdir()
+    pq.write_table(pa.table({
+        "k": rng.integers(0, 50, 6000).astype(np.int64),
+        "x": rng.random(6000).astype(np.float64),
+    }), str(src / "part-0.parquet"))
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": "4",
+        "spark.hyperspace.serve.batch.window.ms": 100}))
+    hs = Hyperspace(sess)
+    df = sess.read_parquet(str(src))
+    hs.create_index(df, IndexConfig("bidx", ["k"], ["x"]))
+    sess.enable_hyperspace()
+    queries = [df.filter(col("k") == lit(i)).select("x")
+               for i in range(8)]
+    oracles = [canonical(q.collect()) for q in queries]
+    # The rewritten plan is index-served and pinned: batchable.
+    sig = plan_signature(sess.optimize(queries[0].plan), id(sess))
+    assert sig is not None and sig.scan.index_name == "bidx"
+    assert sig.scan.pinned_version is not None
+
+    stop = threading.Event()
+    failures = []
+
+    def serve_loop(qi):
+        while not stop.is_set():
+            try:
+                got = canonical(queries[qi].collect())
+                if not got.equals(oracles[qi]):
+                    failures.append(f"q{qi}: mismatch")
+            except Exception as exc:
+                failures.append(f"q{qi}: {exc!r}")
+
+    threads = [threading.Thread(target=serve_loop, args=(i,))
+               for i in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        # Full refresh commits a NEW index version mid-traffic: plans
+        # pinned to v0 and plans pinned to v1 must form separate
+        # cohorts and both read exactly their pinned bytes.
+        hs.refresh_index("bidx", mode="full")
+        time.sleep(0.3)
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(60)
+    assert not failures, failures[:5]
+
+
+# ---------------------------------------------------------------------------
+# AOT warm-start
+# ---------------------------------------------------------------------------
+
+
+def test_aot_warmup_makes_first_cohorts_trace_free(tmp_path, fresh_lane):
+    sch, _bat = fresh_lane
+    # A UNIQUE shape + row count for this test: three-term conjunction
+    # over a 7777-row table no other test reads, so process-wide jit
+    # caches cannot mask a missing warmup.
+    rng = np.random.default_rng(7)
+    src = tmp_path / "aotsrc"
+    src.mkdir()
+    pq.write_table(pa.table({
+        "a": rng.integers(0, 9, 7777).astype(np.int64),
+        "b": rng.integers(0, 99, 7777).astype(np.int64),
+        "c": rng.random(7777).astype(np.float64),
+    }), str(src / "part-0.parquet"))
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "spark.hyperspace.serve.batch.window.ms": 250}))
+    t = sess.read_parquet(str(src))
+    dfs = [t.filter((col("a") == lit(i)) & (col("b") >= lit(10))
+                    & (col("c") < lit(0.9))).select("a", "c")
+           for i in range(5)]
+    primed = warmup(dfs[0])
+    assert primed >= 2  # one program per cohort bucket 2..max
+    assert warmup(dfs[1]) == 0  # same signature: memo hit, nothing new
+    expected = [canonical(df.collect()) for df in dfs]
+    traces0 = _counter("compile.serve.batch.traces")
+    inv0 = _counter("serve.batch.invocations")
+    pad = _hold(sch)
+    try:
+        results, errors = _run_concurrent(dfs)
+    finally:
+        sch._release(pad)
+    assert not any(errors), [repr(e) for e in errors if e]
+    for r, e in zip(results, expected):
+        assert canonical(r).equals(e)
+    assert _counter("serve.batch.invocations") > inv0
+    assert _counter("compile.serve.batch.traces") == traces0, \
+        "warmed cohort shapes must dispatch without tracing"
+
+
+# ---------------------------------------------------------------------------
+# The PR-7 chaos harness, batching ON
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_with_batching_on(batch_env, fresh_lane):
+    session, facts_dir = batch_env
+    _sch, _bat = fresh_lane
+    sess = session(**{"spark.hyperspace.serve.queue.depth": 16})
+    facts = sess.read_parquet(facts_dir)
+    workload = (
+        [(f"point{i}", facts.filter(col("g") == lit(i))
+          .select("k", "g", "v")) for i in range(5)]
+        + [("range", facts.filter((col("v") > lit(0.8))
+                                  & (col("v") <= lit(0.9)))
+            .select("k", "v")),
+           ("inq", facts.filter(col("g").isin(7, 17, 27))
+            .select("k", "g")),
+           ("agg", facts.group_by("g").agg(("sum", "v", "total")))]
+    )
+    expected = {name: canonical(df.collect()) for name, df in workload}
+    c0 = {k: _counter(k) for k in (
+        "serve.rejected", "serve.deadline_exceeded", "serve.cancelled")}
+    report = run_chaos(
+        workload, expected, clients=8, total_queries=240,
+        timeout_for=lambda i: 0.002 if i % 11 == 0 else None,
+        join_timeout_s=300.0)
+    # Zero deadlocks, zero untyped failures, bit-identical successes.
+    assert not report.stuck_threads, report.summary()
+    assert report.total == 240
+    assert report.outcomes["error"] == 0, report.errors[:5]
+    assert not report.mismatches, report.mismatches[:5]
+    assert report.outcomes["ok"] >= 120, report.summary()
+    # EXACT typed-outcome/counter agreement, batching engaged.
+    assert _counter("serve.rejected") - c0["serve.rejected"] \
+        == report.outcomes["rejected"]
+    assert (_counter("serve.deadline_exceeded")
+            - c0["serve.deadline_exceeded"]) \
+        == report.outcomes["deadline"]
+    assert _counter("serve.cancelled") - c0["serve.cancelled"] \
+        == report.outcomes["cancelled"]
+    assert all(p in ("queue", "plan", "scan", "operator", "stage",
+                     "transfer", "write", "batch")
+               for p in report.typed_phases)
+    assert _counter("serve.batch.invocations") > 0
+    # Occupancy: every invocation carries a real cohort (>= 2 members
+    # by construction — an empty gather never invokes the program).
+    assert _counter("serve.batch.members") \
+        >= 2 * _counter("serve.batch.invocations")
+    # The scheduler drained completely (no leaked admissions).
+    sch = sched_mod.get_scheduler()
+    assert sch.admitted_bytes() == 0

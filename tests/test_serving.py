@@ -302,7 +302,7 @@ def test_deadline_mid_query_is_typed_and_flight_recorded(
         df.collect(timeout=0.002)
     exc = ei.value
     assert exc.phase in ("plan", "scan", "operator", "stage",
-                         "transfer", "write", "queue")
+                         "transfer", "write", "queue", "batch")
     assert _counter("serve.deadline_exceeded") == before + 1
     assert _counter(f"serve.interrupted.{exc.phase}") >= 1
 
@@ -648,7 +648,8 @@ def test_chaos_concurrent_serving_with_faults(serving_env,
     # 4. The deadline path actually fired under load, typed.
     assert report.outcomes["deadline"] >= 1, report.summary()
     assert all(p in ("queue", "plan", "scan", "operator", "stage",
-                     "transfer", "write") for p in report.typed_phases)
+                     "transfer", "write", "batch")
+               for p in report.typed_phases)
 
     # 5. Budget: the scheduler never admitted past it, and no
     # successful query's HBM watermark breached it.

@@ -17,6 +17,10 @@ scaled to the bench budget) runs the BORN-SHARDED pipeline end to end at
             aggregate (the second join's side arrives with a DIFFERENT
             bucket count, exercising the in-program repartition)
   q64       SMJ over MISMATCHED bucket counts (64 vs 32) direct
+  qstr      STRING-keyed SMJ (born-sharded per-range dictionaries,
+            in-program rank remaps — PR 13) + a string-predicate
+            sharded filter; reported as `string_smj_wall_s` /
+            `string_smj_speedup`, gated like the numeric headline
 
 Reported per device count: build wall, per-query cold/warm walls, the
 SMJ-stage wall (the distributed claim), the warm H2D chunk delta, and
@@ -98,7 +102,26 @@ def generate():
         "cs_item_sk": rng.integers(0, n_items, k).astype(np.int64),
         "cs_qty": rng.integers(1, 8, k).astype(np.int64),
     }))
-    return ss, sr, cs
+    # String-keyed pair (TPC-DS joins ride i_item_id-style business
+    # keys): high-cardinality dictionaries so the remap tables are a
+    # real workload, not a toy.
+    n_ids = max(ROWS // 8, 64)
+    sk = ROWS // 2
+    ssk = columnar.from_arrow(pa.table({
+        "ss_item_id": pa.array(
+            [f"AAAA{int(x):08d}"
+             for x in rng.integers(0, n_ids, sk)]),
+        "ssk_qty": rng.integers(1, 10, sk).astype(np.int64),
+        "ssk_price": rng.random(sk).astype(np.float64),
+    }))
+    im = ROWS // 4
+    itm = columnar.from_arrow(pa.table({
+        "i_item_id": pa.array(
+            [f"AAAA{int(x):08d}"
+             for x in rng.integers(0, n_ids, im)]),
+        "i_qty": rng.integers(1, 6, im).astype(np.int64),
+    }))
+    return ss, sr, cs, ssk, itm
 
 
 def agg_schema(group_col, specs, schema):
@@ -195,6 +218,34 @@ def run_rung(n, data_dirs, lengths_map):
                 "checksum": join_checksum(stage2, li2, "ss_item_sk"),
                 "smj_s": smj_s}
 
+    def qstr(ssk, itm):
+        # String-predicate sharded filter (code-space range test on the
+        # global dictionary), then the string-keyed SMJ: rank-remap
+        # tables unify the two per-version dictionaries in-program.
+        from hyperspace_tpu.plan.expr import col, lit
+        cutoff = "AAAA%08d" % (ROWS // 16)
+        filt = spmd.sharded_filter(ssk, col("ss_item_id") < lit(cutoff))
+        t0 = time.perf_counter()
+        li, ri = spmd.sharded_join_indices(ssk, itm, ["ss_item_id"],
+                                           ["i_item_id"])
+        jax.block_until_ready((li, ri))
+        smj_s = time.perf_counter() - t0
+        joined = assemble_join_output(
+            ssk.batch, itm.batch, li, ri, how="inner",
+            columns=["ssk_qty", "ssk_price", "i_qty"])
+        stage2 = spmd.repartition_sharded(joined, ["ssk_qty"], BUCKETS,
+                                          mesh)
+        specs = [AggSpec("count", "*", "cnt"),
+                 AggSpec("avg", "ssk_price", "avg_price"),
+                 AggSpec("sum", "i_qty", "i_qty_sum")]
+        out = spmd.sharded_group_aggregate(
+            stage2, ["ssk_qty"], specs,
+            agg_schema("ssk_qty", specs, joined.schema))
+        return {"agg": agg_frame(out), "pairs": len(np.asarray(li)),
+                "checksum": (join_checksum(ssk, li, "ssk_qty")
+                             + filt.num_rows),
+                "smj_s": smj_s}
+
     def q64(ss, cs):
         t0 = time.perf_counter()
         li, ri = spmd.sharded_join_indices(ss, cs, ["ss_item_sk"],
@@ -223,12 +274,16 @@ def run_rung(n, data_dirs, lengths_map):
     ss = read("ss")
     sr = read("sr")
     cs = read("cs")
+    ssk = read("ssk")
+    itm = read("itm")
     out["read_cold_s"] = round(time.perf_counter() - t0, 3)
     before = _counters("link.h2d.chunks")
     t0 = time.perf_counter()
     ss = read("ss")
     sr = read("sr")
     cs = read("cs")
+    ssk = read("ssk")
+    itm = read("itm")
     out["read_warm_s"] = round(time.perf_counter() - t0, 3)
     after = _counters("link.h2d.chunks")
     out["warm_h2d_chunks"] = after["link.h2d.chunks"] \
@@ -236,7 +291,8 @@ def run_rung(n, data_dirs, lengths_map):
 
     runners = {"q17": lambda: q17(ss, sr),
                "q25": lambda: q25(ss, sr, cs),
-               "q64": lambda: q64(ss, cs)}
+               "q64": lambda: q64(ss, cs),
+               "qstr": lambda: qstr(ssk, itm)}
     for name, fn in runners.items():
         t0 = time.perf_counter()
         cold = fn()
@@ -270,9 +326,10 @@ def main():
 
     work = tempfile.mkdtemp(prefix="hs_multichip_")
     try:
-        ss, sr, cs = generate()
+        ss, sr, cs, ssk, itm = generate()
         log(f"generated SF100-shaped tables: ss={ss.num_rows} "
-            f"sr={sr.num_rows} cs={cs.num_rows} rows, "
+            f"sr={sr.num_rows} cs={cs.num_rows} "
+            f"ssk={ssk.num_rows} itm={itm.num_rows} rows, "
             f"B={BUCKETS} buckets")
 
         # Build rung per device count (the all_to_all exchange), then
@@ -290,6 +347,10 @@ def main():
                                             mesh)
             built["cs"] = distributed_build(cs, ["cs_item_sk"],
                                             BUCKETS // 2, mesh)
+            built["ssk"] = distributed_build(ssk, ["ss_item_id"],
+                                             BUCKETS, mesh)
+            built["itm"] = distributed_build(itm, ["i_item_id"],
+                                             BUCKETS, mesh)
             build_walls[str(n)] = round(time.perf_counter() - t0, 3)
             log(f"build n={n}: {build_walls[str(n)]}s")
 
@@ -297,7 +358,8 @@ def main():
         lengths_map = {}
         widest = make_mesh(max(DEVICES))
         for tag, num_buckets in (("ss", BUCKETS), ("sr", BUCKETS),
-                                 ("cs", BUCKETS // 2)):
+                                 ("cs", BUCKETS // 2),
+                                 ("ssk", BUCKETS), ("itm", BUCKETS)):
             batch, lengths = built[tag]
             root = os.path.join(work, tag)
             builder.write_bucket_ordered(batch, lengths, num_buckets,
@@ -346,6 +408,13 @@ def main():
                for k, r in rungs.items()}
         repart = {k: r["queries"]["q64"]["smj_s"]
                   for k, r in rungs.items()}
+        # The string-keyed SMJ rung: same co-bucketed shuffle-free shape
+        # as the headline, with in-program rank remaps doing the
+        # dictionary unification — gated like the numeric speedup.
+        string_smj = {k: r["queries"]["qstr"]["smj_s"]
+                      for k, r in rungs.items()}
+        string_speedup = (round(string_smj[n_lo] / string_smj[n_hi], 3)
+                          if string_smj[n_hi] else None)
         wall = {k: sum(q["warm_s"] for q in r["queries"].values())
                 for k, r in rungs.items()}
         speedup = round(smj[n_lo] / smj[n_hi], 3) if smj[n_hi] else None
@@ -359,6 +428,9 @@ def main():
             "smj_wall_s": {k: round(v, 3) for k, v in smj.items()},
             "repartition_smj_wall_s": {k: round(v, 4)
                                        for k, v in repart.items()},
+            "string_smj_wall_s": {k: round(v, 4)
+                                  for k, v in string_smj.items()},
+            "string_smj_speedup": string_speedup,
             "query_wall_s": {k: round(v, 3) for k, v in wall.items()},
             "smj_speedup": speedup,
             "efficiency": efficiency,
@@ -369,7 +441,8 @@ def main():
         log(f"co-bucketed SMJ walls {multichip['smj_wall_s']} -> "
             f"speedup {speedup} at {n_hi} devices; efficiency "
             f"{efficiency}; repartition rung "
-            f"{multichip['repartition_smj_wall_s']}; "
+            f"{multichip['repartition_smj_wall_s']}; string SMJ "
+            f"{multichip['string_smj_wall_s']} -> {string_speedup}x; "
             f"bit_identical={bit_identical}")
 
         result = telemetry.artifact.make_artifact(

@@ -102,6 +102,13 @@ def main():
         probe = link_probe()
         queries = {}
         tot_on = tot_off = tot_cpu = 0.0
+        # Fallback-freedom: with strings born-sharded there is ONE
+        # execution architecture — any `spmd.fallbacks` increment during
+        # the query set means a bucketed SMJ with an active mesh dropped
+        # off the SPMD lane. Asserted here and gated absolutely by
+        # `bench_regress.py`.
+        fallbacks0 = telemetry.get_registry().counters_dict().get(
+            "spmd.fallbacks", 0)
         for name, (build, oracle) in selected.items():
             cpu_s, cpu_med, expected = best_of(lambda: oracle(pdfs),
                                                label=f"{name} pandas")
@@ -144,6 +151,11 @@ def main():
             tot_off += off_s
             tot_cpu += cpu_s
 
+        spmd_fallbacks = telemetry.get_registry().counters_dict().get(
+            "spmd.fallbacks", 0) - fallbacks0
+        assert spmd_fallbacks == 0, (
+            f"{spmd_fallbacks} SPMD-lane fallbacks during the TPC-DS "
+            "set — the one-architecture contract is broken")
         # Canonical, versioned artifact (telemetry/artifact.py): the
         # ONE emitter both bench drivers share, so TPC-DS rounds and
         # micro-ladder rounds diff with the same tooling
@@ -160,7 +172,8 @@ def main():
             queries=queries,
             extra={"scale": SCALE,
                    "index_build_s": round(index_build_s, 2),
-                   "link_probe": probe})))
+                   "link_probe": probe,
+                   "spmd": {"fallbacks": float(spmd_fallbacks)}})))
     finally:
         shutil.rmtree(work, ignore_errors=True)
 

@@ -247,10 +247,15 @@ class CreateActionBase(Action):
         # range without touching the data dir (the ISSUE's "recorded in
         # the index log entry" contract). Single-device builds carry no
         # layout and the key stays absent.
-        from hyperspace_tpu.io.builder import read_shard_layout
+        from hyperspace_tpu.io.builder import (read_shard_layout,
+                                               summarize_shard_layout)
         layout = read_shard_layout(self._entry.content.root)
         if layout is not None:
-            self._entry.extra["shardLayout"] = layout
+            # Per-range string dictionary VALUES stay in the JSON file
+            # (they can be large); the entry carries per-range entry
+            # counts (`dictionaryEntries`).
+            self._entry.extra["shardLayout"] = \
+                summarize_shard_layout(layout)
         else:
             self._entry.extra.pop("shardLayout", None)
         # The SAME numbers land in the action report: rows/bytes the

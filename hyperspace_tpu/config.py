@@ -119,6 +119,14 @@ class HyperspaceConf:
                 else constants.DISTRIBUTION_CAPACITY_FACTOR_DEFAULT)
 
     @property
+    def distribution_dict_max_entries(self) -> int:
+        """Per-range string-dictionary entry cap for the recorded
+        born-sharded layout (`_shard_layout.json`); <= 0 disables
+        recording (readers derive dictionaries from the files)."""
+        return self.get_int(constants.DISTRIBUTION_DICT_MAX_ENTRIES,
+                            constants.DISTRIBUTION_DICT_MAX_ENTRIES_DEFAULT)
+
+    @property
     def broadcast_threshold(self) -> int:
         """Join sides estimated under this many bytes broadcast as a
         direct-address table instead of riding Exchange+Sort; <= 0

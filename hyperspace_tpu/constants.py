@@ -246,6 +246,15 @@ DISTRIBUTION_SPMD_DEFAULT = "true"
 DISTRIBUTION_CAPACITY_FACTOR = \
     "spark.hyperspace.distribution.capacity.factor"
 DISTRIBUTION_CAPACITY_FACTOR_DEFAULT = 2.0
+# Born-sharded string layout: a mesh build records each device range's
+# sorted local string dictionary in `_shard_layout.json` so query-time
+# global-dictionary resolution is pure JSON (no data read). A range
+# whose dictionary exceeds this entry cap is recorded as null and the
+# reader derives it from the parquet files instead (one host read per
+# committed version, then cached). <= 0 disables recording entirely.
+DISTRIBUTION_DICT_MAX_ENTRIES = \
+    "spark.hyperspace.distribution.dictionary.max.entries"
+DISTRIBUTION_DICT_MAX_ENTRIES_DEFAULT = 65536
 
 # Warm-start compilation: when set to a directory, JAX's persistent
 # compilation cache is enabled there (jax_compilation_cache_dir) via

@@ -96,13 +96,18 @@ def _pow2(n: int) -> int:
 class BatchSignature:
     """One query's parsed batchable form. `key` is the grouping
     identity (queries batch iff their keys are equal); the constant
-    vectors are this MEMBER's literals in shape order."""
+    vectors are this MEMBER's literals in shape order. String literals
+    cannot ride the lanes directly — their code-space translation is
+    per-dictionary state — so `strs` records (int-lane slot, column,
+    op, value) resolutions the leader performs against the SHARED
+    scan's dictionary at gather time; the resolved codes then ride the
+    int lanes like any other constant."""
 
     __slots__ = ("key", "scan", "shape", "columns", "projection",
-                 "needed", "ints", "floats")
+                 "needed", "ints", "floats", "strs")
 
     def __init__(self, key, scan, shape, columns, projection, needed,
-                 ints, floats):
+                 ints, floats, strs=()):
         self.key = key
         self.scan = scan
         self.shape = shape            # static term tuple (spmd contract)
@@ -111,12 +116,17 @@ class BatchSignature:
         self.needed = needed          # columns the shared scan must read
         self.ints = ints              # this member's int-lane constants
         self.floats = floats          # this member's float-lane constants
+        self.strs = strs              # deferred string resolutions
 
 
 def _parse_terms(condition, schema):
-    """Conjunction -> (shape, cols, ints, floats) or None when any term
-    falls outside the batched lane's exactly-mirrored subset (see
-    `parallel/spmd.batched_predicate_masks`)."""
+    """Conjunction -> (shape, cols, ints, floats, strs) or None when any
+    term falls outside the batched lane's exactly-mirrored subset (see
+    `parallel/spmd.batched_predicate_masks`). String comparisons and
+    string IN lists qualify: their constants ride the INT lanes as
+    dictionary codes, resolved per member at gather time (`strs` —
+    the dictionary is shared-scan state, so the translation mirrors the
+    solo compiler's code-space tests exactly)."""
     cols: List[str] = []
     index: Dict[str, int] = {}
 
@@ -131,6 +141,7 @@ def _parse_terms(condition, schema):
     shape: List[tuple] = []
     ints: List[int] = []
     floats: List[float] = []
+    strs: List[tuple] = []
     for term in E.split_conjunctive(condition):
         if type(term) in _CMP_OPS:
             op = _CMP_OPS[type(term)]
@@ -152,26 +163,49 @@ def _parse_terms(condition, schema):
             elif type(v) is float and dtype in _INT_DTYPES + _FLOAT_DTYPES:
                 shape.append(("cmp", op, col_idx(left.name), "f"))
                 floats.append(float(v))
+            elif type(v) is str and dtype == "string":
+                # Code-space translation deferred to gather time: the
+                # resolved code occupies this int-lane slot.
+                ci = col_idx(left.name)
+                shape.append(("cmp", op, ci, "i"))
+                strs.append(("cmp", len(ints), ci, op, v))
+                ints.append(0)
             else:
                 return None
         elif isinstance(term, E.In):
-            # Mirror the solo engine's isin fast path exactly: integer
-            # column, all-int literal list (anything else folds through
-            # OR semantics the batched program does not carry).
+            # Mirror the solo engine's fast paths exactly: integer
+            # column with an all-int literal list (one vectorized isin),
+            # or string column with an all-string list (OR-fold of
+            # code-space equalities — identical definite-truth mask).
             if not isinstance(term.child, E.Column) or not term.values:
                 return None
             if not schema.contains(term.child.name):
                 return None
-            if schema.field(term.child.name).dtype not in _INT_DTYPES:
+            dtype = schema.field(term.child.name).dtype
+            if dtype in _INT_DTYPES:
+                vals = [v.value for v in term.values
+                        if isinstance(v, E.Literal)
+                        and type(v.value) is int]
+                if len(vals) != len(term.values):
+                    return None
+                padded = _pow2(len(vals))
+                shape.append(("in", col_idx(term.child.name), padded))
+                # Padding repeats the last value — harmless for
+                # membership.
+                ints.extend(vals + [vals[-1]] * (padded - len(vals)))
+            elif dtype == "string":
+                svals = [v.value for v in term.values
+                         if isinstance(v, E.Literal)
+                         and type(v.value) is str]
+                if len(svals) != len(term.values):
+                    return None
+                ci = col_idx(term.child.name)
+                padded = _pow2(len(svals))
+                shape.append(("in", ci, padded))
+                strs.append(("in", len(ints), ci, padded, tuple(svals)))
+                ints.extend([0] * padded)
+            else:
                 return None
-            vals = [v.value for v in term.values
-                    if isinstance(v, E.Literal) and type(v.value) is int]
-            if len(vals) != len(term.values):
-                return None
-            padded = _pow2(len(vals))
-            shape.append(("in", col_idx(term.child.name), padded))
-            # Padding repeats the last value — harmless for membership.
-            ints.extend(vals + [vals[-1]] * (padded - len(vals)))
         elif isinstance(term, (E.IsNull, E.IsNotNull)):
             if not isinstance(term.child, E.Column) \
                     or not schema.contains(term.child.name):
@@ -182,15 +216,15 @@ def _parse_terms(condition, schema):
             return None
     if not shape:
         return None
-    return tuple(shape), tuple(cols), ints, floats
+    return tuple(shape), tuple(cols), ints, floats, tuple(strs)
 
 
 def plan_signature(plan, session_key) -> Optional[BatchSignature]:
     """The plan's batch signature, or None when its shape does not
     qualify: exactly `[Project(simple)] <- Filter <- Scan`, with every
-    predicate term in the mirrored subset. String-column predicates
-    decline (their code-space translation is per-batch state the
-    stacked constant lanes do not carry)."""
+    predicate term in the mirrored subset — numeric comparisons,
+    int/string IN lists, null-ness, and string comparisons (constants
+    resolved to dictionary codes per member at gather time)."""
     node = plan
     projection: Optional[Tuple[str, ...]] = None
     if isinstance(node, Project):
@@ -208,7 +242,7 @@ def plan_signature(plan, session_key) -> Optional[BatchSignature]:
     parsed = _parse_terms(condition, scan.schema)
     if parsed is None:
         return None
-    shape, cols, ints, floats = parsed
+    shape, cols, ints, floats, strs = parsed
     if projection is None:
         projection = tuple(scan.schema.names)
     else:
@@ -219,7 +253,7 @@ def plan_signature(plan, session_key) -> Optional[BatchSignature]:
     key = (session_key, tuple(scan.root_paths), scan.pinned_version,
            scan.index_name, files_tag, shape, cols, projection, needed)
     return BatchSignature(key, scan, shape, cols, projection, needed,
-                          ints, floats)
+                          ints, floats, strs)
 
 
 # ---------------------------------------------------------------------------
@@ -493,7 +527,9 @@ class QueryBatcher:
             self._maybe_warm(sig, batch, conf)
             Kb = _pow2(K)
             iconst, fconst = _constant_lanes(
-                [m.sig for m in live], Kb)
+                [_resolve_string_constants(m.sig, batch)
+                 for m in live],
+                [m.sig.floats for m in live], Kb)
             datas = tuple(batch.column(c).data for c in sig.columns)
             valids = tuple(batch.column(c).validity
                            for c in sig.columns)
@@ -582,16 +618,57 @@ def _warm_masks(*args):
     return out
 
 
-def _constant_lanes(sigs: List[BatchSignature], Kb: int):
+def _string_code_constant(d, op: str, value: str) -> int:
+    """One string literal -> one int-lane constant, mirroring the solo
+    compiler's code-space tests (`_string_literal_compare`) as a plain
+    integer comparison over codes: eq/ne use the value's code when
+    present else -1 (no code equals -1, so eq is all-false and ne
+    all-true — the absent-value semantics); lt/ge use the left
+    insertion point, le/gt `right - 1` (`x <= right-1` == `x < right`
+    on integer codes)."""
+    left = int(np.searchsorted(d, value, side="left"))
+    right = int(np.searchsorted(d, value, side="right"))
+    if op in ("eq", "ne"):
+        return left if left < right else -1
+    if op in ("lt", "ge"):
+        return left
+    return right - 1  # le, gt
+
+
+def _resolve_string_constants(sig: BatchSignature, batch):
+    """This member's int-lane constants with every deferred string term
+    translated against the SHARED scan's sorted dictionary (per-member,
+    at gather time — counted as `spmd.strings.dict_lookups`)."""
+    if not sig.strs:
+        return sig.ints
+    ints = list(sig.ints)
+    lookups = 0
+    for term in sig.strs:
+        d = batch.column(sig.columns[term[2]]).dictionary
+        if term[0] == "cmp":
+            _kind, slot, _ci, op, value = term
+            ints[slot] = _string_code_constant(d, op, value)
+            lookups += 1
+        else:  # ("in", start, ci, padded, values)
+            _kind, start, _ci, padded, values = term
+            codes = [_string_code_constant(d, "eq", v) for v in values]
+            codes = codes + [codes[-1]] * (padded - len(codes))
+            ints[start:start + padded] = codes
+            lookups += len(values)
+    telemetry.get_registry().counter(
+        "spmd.strings.dict_lookups").inc(lookups)
+    return ints
+
+
+def _constant_lanes(ints: List[List[int]], floats: List[List[float]],
+                    Kb: int):
     """[Kb, T] padded constant lanes; padding rows replicate member 0
     (any valid constants do — padded masks are never sliced)."""
-    ints = [s.ints for s in sigs]
-    floats = [s.floats for s in sigs]
     ti, tf = len(ints[0]), len(floats[0])
     iconst = np.zeros((Kb, ti), dtype=np.int64)
     fconst = np.zeros((Kb, tf), dtype=np.float64)
     for k in range(Kb):
-        src = k if k < len(sigs) else 0
+        src = k if k < len(ints) else 0
         if ti:
             iconst[k] = ints[src]
         if tf:

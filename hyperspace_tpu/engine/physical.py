@@ -130,9 +130,9 @@ class PhysicalNode:
         """Born-sharded execution (`parallel/spmd.py`): produce this
         node's output as a device-resident `ShardedBatch` whose shard s
         holds bucket range s, or None when the shape does not qualify
-        (unbucketed source, string columns, host-lane row counts, hot
-        skew). None is a ROUTING answer, not an error — callers fall
-        back to the general paths. Default: not shardable."""
+        (unbucketed source, host-lane row counts, hot skew). None is a
+        ROUTING answer, not an error — callers fall back to the
+        single-chip paths. Default: not shardable."""
         return None
 
     def execute_bucketed(self, num_buckets: int):
@@ -379,7 +379,7 @@ class ScanExec(PhysicalNode):
         if self.scan.bucket_spec is None:
             return None
         if not spmd.supports_sharded(self.out_schema):
-            return None  # string columns: legacy path (module docstring)
+            return None  # a dtype outside the host-lane map (defensive)
         per_bucket: dict = {}
         files_total = 0
         for b, files in self._per_bucket_files().items():
@@ -411,9 +411,8 @@ class ScanExec(PhysicalNode):
         n_shards = total_shards(mesh)
         if spmd.pad_blowup(lengths, n_shards):
             # Hot-bucket skew: range padding would blow the [S*C]
-            # layout; the legacy path splits hot buckets instead.
-            telemetry.event("mesh", "sharded-read-declined",
-                            reason="bucket-range skew")
+            # layout; the single-chip counting join's memory is bounded
+            # by true rows, so the read belongs on that lane.
             return None
         per_shard_files = [[f for b in range(lo, hi)
                             for f in per_bucket.get(b, [])]
@@ -544,7 +543,9 @@ class FilterExec(PhysicalNode):
         if sh is None:
             return None
         from hyperspace_tpu.engine.compiler import compile_predicate
-        from hyperspace_tpu.parallel.spmd import ShardedBatch
+        from hyperspace_tpu.parallel.spmd import (
+            ShardedBatch, count_string_predicate_lookups)
+        count_string_predicate_lookups(self.condition, sh.batch)
         mask = compile_predicate(self.condition, sh.batch)
         return ShardedBatch(sh.batch, sh.row_valid & mask, sh.mesh,
                             sh.rows_per_shard, sh.num_buckets,
@@ -1101,32 +1102,25 @@ class SortMergeJoinExec(PhysicalNode):
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         from hyperspace_tpu.ops.join import sort_merge_join
         if self.bucketed and bucket is None:
-            # Born-sharded SPMD fast path: both sides resident per
-            # device by bucket range, ONE jitted program for the match +
-            # expansion, no host re-placement and no mid-join sizing
-            # sync. None = some precondition failed; the general paths
-            # below remain fully capable.
+            # Born-sharded SPMD fast path — THE distributed execution
+            # architecture: both sides resident per device by bucket
+            # range, ONE jitted program for the match + expansion, no
+            # host re-placement and no mid-join sizing sync. None = some
+            # precondition failed (counted as `spmd.fallbacks` when a
+            # mesh was available); the single-chip bucketed path below
+            # remains fully capable.
             out = self._try_spmd()
             if out is not None:
                 return out
         if self.how in ("left_semi", "left_anti"):
             # Membership joins: no expansion, no output from the right —
             # one encode + counting-match membership flags, then a
-            # single left-side gather. Over co-bucketed index layouts the
-            # match runs shard-local on the mesh (each shard owns both
-            # sides' rows of its buckets).
+            # single left-side gather.
             from hyperspace_tpu.ops.join import semi_anti_indices
             anti = self.how == "left_anti"
             if self.bucketed:
-                lbatch, rbatch, l_lengths, r_lengths, mesh = \
+                lbatch, rbatch, _l_lengths, _r_lengths = \
                     self._bucketed_inputs()
-                if mesh is not None:
-                    from hyperspace_tpu.parallel.join import (
-                        distributed_semi_anti_indices)
-                    idx = distributed_semi_anti_indices(
-                        lbatch, rbatch, l_lengths, r_lengths,
-                        self.left_keys, self.right_keys, mesh, anti=anti)
-                    return lbatch.take(idx)
             else:
                 lbatch = self.left.execute(bucket)
                 rbatch = self.right.execute(bucket)
@@ -1136,30 +1130,10 @@ class SortMergeJoinExec(PhysicalNode):
         if self.bucketed:
             # Co-partitioned bucket joins, batched into ONE compiled program
             # (`ops/bucketed_join.py`): zero shuffle, zero global sort, no
-            # per-bucket compile explosion. Buckets are independent ->
-            # mesh-parallel in `parallel/join.py`.
+            # per-bucket compile explosion.
             from hyperspace_tpu.ops.bucketed_join import (
                 bucketed_sort_merge_join)
-            lbatch, rbatch, l_lengths, r_lengths, mesh = \
-                self._bucketed_inputs()
-            if mesh is not None:
-                from hyperspace_tpu.ops.bucketed_join import (
-                    assemble_join_output)
-                from hyperspace_tpu.parallel.join import (
-                    distributed_bucketed_join_indices)
-                if self.how == "right_outer":
-                    ri, li = distributed_bucketed_join_indices(
-                        rbatch, lbatch, r_lengths, l_lengths,
-                        self.right_keys, self.left_keys, mesh,
-                        how="left_outer")
-                else:
-                    li, ri = distributed_bucketed_join_indices(
-                        lbatch, rbatch, l_lengths, r_lengths,
-                        self.left_keys, self.right_keys, mesh,
-                        how=self.how)
-                return assemble_join_output(lbatch, rbatch, li, ri,
-                                            how=self.how,
-                                            columns=self.out_columns)
+            lbatch, rbatch, l_lengths, r_lengths = self._bucketed_inputs()
             return bucketed_sort_merge_join(lbatch, rbatch, l_lengths,
                                             r_lengths, self.left_keys,
                                             self.right_keys, how=self.how,
@@ -1188,34 +1162,43 @@ class SortMergeJoinExec(PhysicalNode):
     def _try_spmd(self) -> Optional[columnar.ColumnBatch]:
         """The born-sharded SPMD join (`parallel/spmd.py`), or None when
         any precondition fails: no mesh / bucket count not divisible /
-        either side not shardable (strings, host-lane sizing, skew).
-        Covers every equi-join type the sharded counting match handles;
-        right_outer swaps sides like the legacy path."""
+        either side not shardable (host-lane sizing, skew). Strings are
+        first-class (per-range dictionaries + in-program rank remaps).
+        Covers every equi-join type of the sharded counting match;
+        right_outer swaps sides. A decline WITH a mesh available is a
+        real lane miss — counted as `spmd.fallbacks` (the TPC-DS bench
+        asserts the flagship set runs fallback-free)."""
         from hyperspace_tpu.parallel import spmd
         from hyperspace_tpu.parallel.context import (distribution_mesh,
                                                      mesh_size)
 
-        if self.how not in ("inner", "left_outer", "right_outer",
-                            "full_outer", "left_semi", "left_anti"):
-            return None
         if self.num_buckets <= 0:
             return None
         if self.conf is not None and not self.conf.distribution_spmd:
-            return None  # the escape hatch: legacy mesh path only
+            return None  # the operational escape hatch: single-chip only
         mesh = distribution_mesh(self.conf)
-        if mesh is None or self.num_buckets % mesh_size(mesh) != 0:
+        if mesh is None:
+            return None
+        if self.how not in ("inner", "left_outer", "right_outer",
+                            "full_outer", "left_semi", "left_anti"):
+            spmd.spmd_fallback("join-type")
+            return None
+        if self.num_buckets % mesh_size(mesh) != 0:
+            spmd.spmd_fallback("bucket-count-indivisible")
             return None
         lsh = self.left.execute_sharded(self.num_buckets, mesh)
         if lsh is None:
+            spmd.spmd_fallback("left-not-shardable")
             return None
         rsh = self.right.execute_sharded(self.num_buckets, mesh)
         if rsh is None:
+            spmd.spmd_fallback("right-not-shardable")
             return None
         telemetry.annotate(lane="spmd")
         if self.how in ("left_semi", "left_anti"):
             idx = spmd.sharded_semi_anti_indices(
                 lsh, rsh, self.left_keys, self.right_keys,
-                anti=self.how == "left_anti")
+                anti=self.how == "left_anti", conf=self.conf)
             return lsh.batch.take(idx)
         from hyperspace_tpu.ops.bucketed_join import assemble_join_output
         factor = (self.conf.distribution_capacity_factor
@@ -1223,22 +1206,21 @@ class SortMergeJoinExec(PhysicalNode):
         if self.how == "right_outer":
             ri, li = spmd.sharded_join_indices(
                 rsh, lsh, self.right_keys, self.left_keys,
-                how="left_outer", capacity_factor=factor)
+                how="left_outer", capacity_factor=factor,
+                conf=self.conf)
         else:
             li, ri = spmd.sharded_join_indices(
                 lsh, rsh, self.left_keys, self.right_keys, how=self.how,
-                capacity_factor=factor)
+                capacity_factor=factor, conf=self.conf)
         return assemble_join_output(lsh.batch, rsh.batch, li, ri,
                                     how=self.how,
                                     columns=self.out_columns)
 
     def _bucketed_inputs(self):
-        """Read both sides in bucket order (overlapped IO) and decide the
-        mesh: None when no mesh applies, the batches are host-lane in
-        "auto" mode (distribution would pay the device transfers the lane
-        exists to avoid), or hot-bucket skew would blow up the [S, C]
-        shard layout (single-chip counting memory is bounded by true
-        rows). Shared by the payload join and the membership branch."""
+        """Read both sides in bucket order (overlapped IO) for the
+        single-chip batched bucketed join — the one general path under
+        the SPMD lane (the legacy per-query-placement mesh join is
+        gone; `parallel/mesh.py` is the sole sharding seam)."""
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(max_workers=2) as pool:
             # telemetry.propagating: pool threads don't inherit the
@@ -1250,39 +1232,9 @@ class SortMergeJoinExec(PhysicalNode):
                 self.right.execute_bucketed), self.num_buckets)
             lbatch, l_lengths = lf.result()
             rbatch, r_lengths = rf.result()
-        mesh = self._join_mesh(lbatch.num_rows + rbatch.num_rows,
-                               host_batch=lbatch.is_host and rbatch.is_host)
-        if mesh is not None and self.how == "full_outer":
-            # Hot buckets split across shards for every other join type
-            # (`parallel/join.shard_plan`); full_outer's unmatched-right
-            # detection needs whole buckets, so extreme skew still
-            # routes it single-chip.
-            from hyperspace_tpu.parallel.context import mesh_size
-            from hyperspace_tpu.parallel.join import shard_skew
-            if shard_skew(l_lengths, r_lengths, mesh_size(mesh)):
-                mesh = None
-                telemetry.event("join", "mesh-declined",
-                                reason="full_outer hot-bucket skew")
-        telemetry.annotate(lane="mesh" if mesh is not None else
-                           ("host" if lbatch.is_host and rbatch.is_host
-                            else "device"))
-        return lbatch, rbatch, l_lengths, r_lengths, mesh
-
-    def _join_mesh(self, total_rows: int, host_batch: bool = False):
-        """Mesh for the distributed co-bucketed join, or None — every
-        equi-join type the sharded counting match covers (inner, the
-        outers, and the semi/anti membership probes). Requires the
-        bucket<->shard map (num_buckets divisible by mesh size)."""
-        from hyperspace_tpu.parallel.context import (mesh_size,
-                                                     should_distribute)
-        if self.how not in ("inner", "left_outer", "right_outer",
-                            "full_outer", "left_semi", "left_anti"):
-            return None
-        mesh = should_distribute(self.conf, total_rows,
-                                 host_batch=host_batch)
-        if mesh is None or self.num_buckets % mesh_size(mesh) != 0:
-            return None
-        return mesh
+        telemetry.annotate(lane=("host" if lbatch.is_host
+                                 and rbatch.is_host else "device"))
+        return lbatch, rbatch, l_lengths, r_lengths
 
 
 class BroadcastHashJoinExec(PhysicalNode):

@@ -410,28 +410,82 @@ def _plain_scan_source(plan) -> Optional[tuple]:
 SHARD_LAYOUT_FILE = "_shard_layout.json"
 
 
-def write_shard_layout(path: str, num_buckets: int, n_shards: int) -> dict:
+def write_shard_layout(path: str, num_buckets: int, n_shards: int,
+                       dictionaries=None) -> dict:
     """Persist the born-sharded layout record next to the bucket spec:
     which contiguous bucket range each device shard owns (THE map,
-    `parallel/mesh.bucket_ranges`). `stamp_stats` lifts it into the
-    index log entry so a reader knows the build's shard shape without
-    walking the data dir."""
+    `parallel/mesh.bucket_ranges`) and — for string columns — each
+    range's sorted local dictionary (`dictionaries`: {column: [values
+    per shard | None]}; None marks a range past the
+    `distribution.dictionary.max.entries` cap, which the reader derives
+    from parquet instead). `stamp_stats` lifts the record (dictionaries
+    summarized to entry counts) into the index log entry so a reader
+    knows the build's shard shape without walking the data dir."""
     import json
 
     from hyperspace_tpu.parallel.mesh import bucket_ranges
     from hyperspace_tpu.utils import file_utils, storage
 
     layout = {
-        "version": 1,
+        "version": 2,
         "numBuckets": num_buckets,
         "numShards": n_shards,
         "bucketRanges": [[lo, hi]
                          for lo, hi in bucket_ranges(num_buckets,
                                                      n_shards)],
     }
+    if dictionaries:
+        layout["dictionaries"] = dictionaries
     file_utils.create_file(storage.join(path, SHARD_LAYOUT_FILE),
                            json.dumps(layout, indent=2))
     return layout
+
+
+def summarize_shard_layout(layout):
+    """The log-entry form of a shard-layout record: per-range
+    dictionary VALUES stay in `_shard_layout.json` (they can be large);
+    the entry carries only per-range entry COUNTS (-1 = over-cap range
+    recorded as null)."""
+    if not layout or "dictionaries" not in layout:
+        return layout
+    out = dict(layout)
+    out["dictionaryEntries"] = {
+        col: [len(r) if r is not None else -1 for r in ranges]
+        for col, ranges in layout["dictionaries"].items()}
+    del out["dictionaries"]
+    return out
+
+
+def _range_dictionaries(table, schema, lengths, num_buckets: int,
+                        n_shards: int, max_entries: int):
+    """{string column: [sorted per-range value list | None]} over the
+    bucket-ordered arrow table — the build-time half of the born-sharded
+    string story: each device range's dictionary recorded so query-time
+    global resolution is pure JSON. A range whose distinct count
+    exceeds `max_entries` records None (reader falls back to the
+    files)."""
+    import numpy as np
+
+    from hyperspace_tpu.parallel.mesh import (bucket_ranges,
+                                              shard_row_segments)
+
+    str_fields = [f.name for f in schema.fields if f.dtype == "string"]
+    if not str_fields or max_entries <= 0:
+        return None
+    segs = shard_row_segments(np.asarray(lengths, dtype=np.int64),
+                              n_shards)
+    out = {}
+    for name in str_fields:
+        col = table.column(name)
+        ranges = []
+        for lo, hi in segs:
+            chunk = col.slice(lo, hi - lo).drop_null()
+            values = np.unique(np.asarray(
+                chunk.to_numpy(zero_copy_only=False), dtype=str))
+            ranges.append([str(v) for v in values]
+                          if len(values) <= max_entries else None)
+        out[name] = ranges
+    return out
 
 
 def read_shard_layout(path: str) -> Optional[dict]:
@@ -453,17 +507,22 @@ def read_shard_layout(path: str) -> Optional[dict]:
 def write_bucket_ordered(batch: columnar.ColumnBatch, lengths,
                          num_buckets: int, path: str,
                          file_suffix: Optional[str] = None,
-                         mesh=None) -> List[str]:
+                         mesh=None,
+                         dict_max_entries: Optional[int] = None
+                         ) -> List[str]:
     """Write a batch already concatenated in bucket order (the distributed
     build's output shape) as bucketed parquet files.
 
     With `mesh`, the index is BORN SHARDED: each flat shard's contiguous
     bucket range writes as that device's parquet shard — files carry the
     owning shard in their suffix (`part-00003-s01.parquet`), the
-    `_shard_layout.json` record pins the range map, and because
-    ownership is contiguous, shard s's files are exactly the rows its
-    device held after the build exchange (and exactly what its device
-    re-fills on a born-sharded read)."""
+    `_shard_layout.json` record pins the range map PLUS each range's
+    sorted local string dictionaries (capped per
+    `distribution.dictionary.max.entries`; the query-time global
+    dictionary then resolves from pure JSON), and because ownership is
+    contiguous, shard s's files are exactly the rows its device held
+    after the build exchange (and exactly what its device re-fills on a
+    born-sharded read)."""
     table = columnar.to_arrow(batch)
     written: List[str] = []
     from hyperspace_tpu.utils import file_utils
@@ -492,7 +551,14 @@ def write_bucket_ordered(batch: columnar.ColumnBatch, lengths,
     for s, (lo, hi) in enumerate(bucket_ranges(num_buckets, n_shards)):
         suffix = f"{file_suffix or ''}s{s:02d}"
         offset = write_range(lo, hi, offset, suffix)
-    write_shard_layout(path, num_buckets, n_shards)
+    from hyperspace_tpu.constants import (
+        DISTRIBUTION_DICT_MAX_ENTRIES_DEFAULT)
+    cap = (dict_max_entries if dict_max_entries is not None
+           else DISTRIBUTION_DICT_MAX_ENTRIES_DEFAULT)
+    dictionaries = _range_dictionaries(table, batch.schema, lengths,
+                                       num_buckets, n_shards, cap)
+    write_shard_layout(path, num_buckets, n_shards,
+                       dictionaries=dictionaries)
     return written
 
 
@@ -550,10 +616,13 @@ def write_index(df, indexed_columns: Sequence[str],
         built, lengths = distributed_build(batch, indexed_columns,
                                            num_buckets, mesh)
         # Born sharded: per-device parquet shards over the contiguous
-        # bucket-range map, with the layout record next to the bucket
-        # spec (lifted into the log entry by `stamp_stats`).
-        return write_bucket_ordered(built, lengths, num_buckets, path,
-                                    mesh=mesh)
+        # bucket-range map, with the layout record (incl. per-range
+        # string dictionaries) next to the bucket spec (lifted into the
+        # log entry by `stamp_stats`).
+        return write_bucket_ordered(
+            built, lengths, num_buckets, path, mesh=mesh,
+            dict_max_entries=(conf.distribution_dict_max_entries
+                              if conf is not None else None))
 
     columns = list(indexed_columns) + list(included_columns)
     source = _plain_scan_source(df.plan)

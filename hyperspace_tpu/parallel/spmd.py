@@ -1,10 +1,11 @@
 """Born-sharded SPMD query execution: device-resident, bucket-range-
 sharded inputs flowing stage to stage as single jitted programs.
 
-The legacy `parallel/` path (join.py / scan.py) parallelizes the BATCH:
-every query re-gathers key lanes on the host, re-places a fresh [S, C]
-layout onto the mesh, and syncs to the host between stages to size
-outputs. This module parallelizes the INDEX, the way the paper's bucketed
+The deleted legacy `parallel/join.py` path parallelized the BATCH:
+every query re-gathered key lanes on the host, re-placed a fresh [S, C]
+layout onto the mesh, and synced to the host between stages to size
+outputs. This module — now the ONE distributed join architecture —
+parallelizes the INDEX, the way the paper's bucketed
 layout intends: a committed covering index is *born sharded* — the build
 writes per-device parquet shards over the contiguous bucket-range map
 (`parallel/mesh.bucket_ranges`), the per-device segment cache holds each
@@ -16,8 +17,8 @@ execute as single jitted SPMD programs under the canonical row sharding:
 - **one program per join**: key-lane decomposition, the counting match,
   and the static-capacity pair expansion trace into ONE `instrumented_jit`
   dispatch. The legacy path's host-side sizing sync between match and
-  expansion (`parallel/join.py` reads `sum(counts)` to shape the
-  expansion) is replaced by a STATIC per-shard output capacity with
+  expansion (it read `sum(counts)` to shape the expansion) is replaced
+  by a STATIC per-shard output capacity with
   on-device overflow detection — the expansion never waits on the host,
   and the one scalar readback per join carries (total, extra, overflow)
   together *after* everything has dispatched. Overflow triggers an exact
@@ -45,10 +46,26 @@ range, same-key rows co-locate on one shard by construction and the
 counting match needs no bucket lane: equal keys hash to one bucket, one
 bucket lives on one shard.
 
-String columns are not yet supported in this layout (per-range dictionary
-unification would re-ship remap tables on warm reads, breaking the
-link-free contract); callers fall back to the legacy mesh path, which
-remains fully general.
+String columns are FIRST-CLASS in this layout. Each device's bucket
+range carries its own sorted local dictionary (written next to the
+parquet shards and recorded in `_shard_layout.json` by mesh builds); a
+born-sharded read unifies the ranges into ONE global sorted dictionary
+(host metadata, cached version-keyed in the segment cache) and remaps
+each shard's codes into it on the host before placement, so the cached
+device payload is globally comparable int32 code lanes riding the same
+[S*C] row sharding as every numeric column — string BYTES never cross
+the link at query time, and a warm read is as link-free as a numeric
+one. Joins whose two sides carry different dictionaries unify IN-PROGRAM
+through compact rank-remap tables (`string_remap_tables`, THE
+lint-enforced remap seam): the int32 local-code -> pair-merged-rank
+tables are built once on the host from the dictionaries (derived from
+the same precomputed value-hash identity the bucket layout uses), cached
+content-keyed in the segment cache, and replicated into the single
+jitted SMJ program over ICI — warm repeats serve them straight from HBM
+(`spmd.strings.remap_cache_hits`) and ship zero string bytes. String
+predicates compile to code-space range tests against the global
+dictionary (`engine/compiler.py`), so the jitted filter program never
+touches bytes either.
 """
 
 from __future__ import annotations
@@ -78,7 +95,7 @@ CAPACITY_FACTOR = 2.0
 
 # Born-sharded skew guard: when the padded [S, C] layout would out-size
 # the true rows by more than this, the caller should fall back to the
-# load-balanced legacy path (which splits hot buckets across shards).
+# single-chip counting join (whose memory is bounded by the true rows).
 PAD_BLOWUP_FACTOR = 4
 
 
@@ -112,24 +129,58 @@ class ShardedBatch:
 
 
 def supports_sharded(schema, key_columns: Sequence[str] = ()) -> bool:
-    """Whether a schema fits the born-sharded layout (no string columns
-    — module docstring)."""
+    """Whether a schema fits the born-sharded layout. Strings are
+    first-class (per-range dictionaries, module docstring); only a dtype
+    outside the engine's host-lane map declines."""
+    from hyperspace_tpu.io.columnar import HOST_NP_DTYPES
     try:
         for f in schema.fields:
-            if f.dtype == "string":
+            if f.dtype not in HOST_NP_DTYPES:
                 return False
         for c in key_columns:
-            if schema.field(c).dtype == "string":
-                return False
+            schema.field(c)
     except Exception:
         return False
     return True
 
 
+def spmd_fallback(reason: str) -> None:
+    """Record a decline of the born-sharded SPMD lane while a mesh was
+    AVAILABLE (`spmd.fallbacks` + a query event). The counter is the
+    one-architecture contract: `bench_tpcds.py` asserts the whole TPC-DS
+    set runs with `spmd.fallbacks == 0` and `bench_regress.py` gates it
+    absolutely."""
+    from hyperspace_tpu import telemetry
+    telemetry.get_registry().counter("spmd.fallbacks").inc()
+    telemetry.event("spmd", "fallback", reason=reason)
+
+
+def count_string_predicate_lookups(expression, batch: ColumnBatch) -> None:
+    """`spmd.strings.dict_lookups`: one per string column a predicate
+    resolves literals against on the SPMD lane (the compiler's
+    code-space binary searches, `engine/compiler._string_literal_compare`
+    — the jitted program itself never touches bytes)."""
+    from hyperspace_tpu import telemetry
+    try:
+        refs = expression.references()
+    except Exception:
+        return
+    n = 0
+    for r in refs:
+        try:
+            if batch.column(r).is_string:
+                n += 1
+        except Exception:
+            continue
+    if n:
+        telemetry.get_registry().counter(
+            "spmd.strings.dict_lookups").inc(n)
+
+
 def pad_blowup(lengths, n_shards: int) -> bool:
     """True when per-shard padding to the hottest shard's row count
     would blow the [S*C] layout far past the true rows (the caller
-    falls back to the hot-bucket-splitting legacy path)."""
+    falls back to the single-chip counting join)."""
     segs = shard_row_segments(lengths, n_shards)
     C = max(1, max(e - s for s, e in segs))
     rows = int(np.asarray(lengths).sum())
@@ -213,6 +264,115 @@ def _expand_mask(mask, ndim: int):
     return out
 
 
+def _build_global_dicts(files: List[str], str_fields: Sequence[str],
+                        schema) -> dict:
+    """The GLOBAL sorted dictionary (+ precomputed value hashes) of each
+    string column of a born-sharded version: preferred source is the
+    per-range dictionaries the mesh build recorded in
+    `_shard_layout.json` (pure JSON, no data read — any query mesh size
+    merges the same union); a version without the record (single-device
+    builds, ranges past the `distribution.dictionary.max.entries` cap)
+    derives them from one host-side read of the string columns."""
+    import os
+
+    from hyperspace_tpu.io.columnar import _string_hash64
+
+    out: dict = {}
+    if not files:
+        for name in str_fields:
+            empty = np.asarray([], dtype=str)
+            out[name] = {"dictionary": empty,
+                         "hashes": _string_hash64(empty)}
+        return out
+
+    remaining = list(str_fields)
+    roots = {os.path.dirname(f) for f in files}
+    if len(roots) == 1:
+        from hyperspace_tpu.io.builder import read_shard_layout
+        layout = read_shard_layout(next(iter(roots)))
+        recorded = (layout or {}).get("dictionaries") or {}
+        for name in list(remaining):
+            ranges = recorded.get(name)
+            if ranges is None or any(r is None for r in ranges):
+                continue  # uncapped record absent: derive from files
+            merged = np.unique(np.concatenate(
+                [np.asarray(r, dtype=str) for r in ranges]
+                + [np.asarray([], dtype=str)]))
+            out[name] = {"dictionary": merged,
+                         "hashes": _string_hash64(merged)}
+            remaining.remove(name)
+
+    if remaining:
+        from hyperspace_tpu.io import columnar, parquet
+        table = parquet.read_table(files, columns=remaining)
+        for name in remaining:
+            _codes, dictionary, hashes, _validity = \
+                columnar._encode_strings_arrow(table.column(name))
+            out[name] = {"dictionary": dictionary, "hashes": hashes}
+    return out
+
+
+def _resolve_global_dicts(per_shard_files: List[List[str]],
+                          str_fields: Sequence[str], schema, base_ref,
+                          conf, budget, cache) -> dict:
+    """Version-keyed cached resolution of the global dictionaries (one
+    entry per committed version + column set; warm queries never re-read
+    or re-merge — `spmd.strings.remap_cache_hits`)."""
+    from hyperspace_tpu import telemetry
+
+    all_files = [f for files in per_shard_files for f in files]
+    if base_ref is None:
+        return _build_global_dicts(all_files, str_fields, schema)
+    filled: List[bool] = []
+
+    def fill():
+        filled.append(True)
+        payload = _build_global_dicts(all_files, str_fields, schema)
+        nbytes = sum(int(e["dictionary"].nbytes) + int(e["hashes"].nbytes)
+                     for e in payload.values())
+        return payload, max(nbytes, 1)
+
+    key = base_ref.key + (("spmd-dicts", tuple(str_fields)),)
+    payload = cache.get_or_fill(key, fill, ref=base_ref, conf=conf,
+                                budget=budget)
+    if not filled:
+        telemetry.get_registry().counter(
+            "spmd.strings.remap_cache_hits").inc()
+    return payload
+
+
+def _remap_to_global(host: ColumnBatch, global_dicts: dict) -> ColumnBatch:
+    """Swap each string column's LOCAL codes for codes in the global
+    dictionary (host-side, before placement) — the cached device payload
+    then holds globally comparable int32 lanes and no per-shard
+    dictionary state. Fails loudly if a valid local value is missing
+    from the global dictionary (the two derive from the same committed
+    files, so a miss means the record and the data disagree)."""
+    for name, col in host.columns.items():
+        if not col.is_string:
+            continue
+        g = global_dicts[name]["dictionary"]
+        local = np.asarray(col.dictionary)
+        if len(g):
+            remap = np.searchsorted(g, local).astype(np.int32)
+            found = g[np.clip(remap, 0, len(g) - 1)] == local
+        else:
+            remap = np.zeros(len(local), dtype=np.int32)
+            found = np.zeros(len(local), dtype=bool)
+        codes = np.asarray(col.data)
+        used = codes if col.validity is None else codes[col.validity]
+        if len(used) and not found[used].all():
+            raise HyperspaceException(
+                f"Born-sharded read: string column {name!r} holds values "
+                "absent from the version's global dictionary — the "
+                "recorded per-range dictionaries and the data disagree.")
+        safe = np.where(found, remap, 0).astype(np.int32)
+        host.columns[name] = DeviceColumn(
+            data=safe[codes], dtype="string", validity=col.validity,
+            dictionary=col.dictionary, dict_hashes=col.dict_hashes)
+    return host
+
+
 def read_sharded(per_shard_files: List[List[str]], lengths,
                  columns: Sequence[str], schema, mesh,
                  base_ref=None, conf=None, budget=None) -> ShardedBatch:
@@ -235,12 +395,25 @@ def read_sharded(per_shard_files: List[List[str]], lengths,
     ranges = bucket_ranges(len(lengths), n_shards)
     cache = segcache.get_cache()
 
+    out_schema = schema.select(cols)
+    str_fields = tuple(f.name for f in out_schema.fields
+                       if f.dtype == "string")
+    global_dicts = None
+    if str_fields:
+        # One global sorted dictionary per string column (version-keyed
+        # cached): per-shard fills remap their local codes into it on
+        # the host, so the cached device lanes are globally comparable.
+        global_dicts = _resolve_global_dicts(per_shard_files, str_fields,
+                                             schema, base_ref, conf,
+                                             budget, cache)
+
     def fill_one(s: int):
         rows = segs[s][1] - segs[s][0]
 
         def fill():
             return _fill_device_shard(per_shard_files[s], cols, schema,
-                                      rows, C, devices[s])
+                                      rows, C, devices[s],
+                                      global_dicts=global_dicts)
 
         if base_ref is None:
             return fill()[0]
@@ -259,7 +432,6 @@ def read_sharded(per_shard_files: List[List[str]], lengths,
     shards = list(_read_pool().map(
         telemetry.propagating(fill_one), range(n_shards)))
 
-    out_schema = schema.select(cols)
     columns_out = {}
     for f in out_schema.fields:
         data = assemble_sharded_rows(
@@ -270,8 +442,18 @@ def read_sharded(per_shard_files: List[List[str]], lengths,
             validity = assemble_sharded_rows(
                 mesh, [_shard_validity(sh, f.name, C, devices[s])
                        for s, sh in enumerate(shards)])
+        dictionary = dict_hashes = None
+        if f.dtype == "string":
+            # Codes are already global (the fills remapped); the
+            # dictionary is HOST metadata — no bytes on the link.
+            from hyperspace_tpu.io.columnar import _split_hashes
+            entry = global_dicts[f.name]
+            dictionary = entry["dictionary"]
+            dict_hashes = _split_hashes(entry["hashes"], device=False)
         columns_out[f.name] = DeviceColumn(data=data, dtype=f.dtype,
-                                           validity=validity)
+                                           validity=validity,
+                                           dictionary=dictionary,
+                                           dict_hashes=dict_hashes)
     row_valid = assemble_sharded_rows(
         mesh, [_on_device(devices[s],
                           partial(_valid_mask, segs[s][1] - segs[s][0], C))
@@ -335,11 +517,13 @@ def _shard_validity(shard: dict, name: str, C: int, device):
 
 
 def _fill_device_shard(files: List[str], cols, schema, rows: int, C: int,
-                       device) -> Tuple[dict, int]:
+                       device, global_dicts=None) -> Tuple[dict, int]:
     """Cold fill of one device's bucket range: parquet decode, pad to
     the common per-shard capacity on the host, place every column onto
-    THIS device through the transfer engine's fill lane. Returns
-    (payload, resident bytes)."""
+    THIS device through the transfer engine's fill lane. String columns
+    decode to their LOCAL per-range dictionary and remap to the global
+    codes on the host (`_remap_to_global`) — only int32 code lanes ever
+    cross the link. Returns (payload, resident bytes)."""
     from hyperspace_tpu.io import parquet, transfer
 
     out_schema = schema.select(cols)
@@ -364,6 +548,8 @@ def _fill_device_shard(files: List[str], cols, schema, rows: int, C: int,
             f"{table.num_rows} — footer metadata and data disagree.")
     from hyperspace_tpu.io import columnar
     host = columnar.from_arrow(table, out_schema, device=False)
+    if global_dicts:
+        host = _remap_to_global(host, global_dicts)
     jobs = []
     for f in out_schema.fields:
         col = host.columns[f.name]
@@ -401,21 +587,101 @@ def _payload_nbytes(payload: dict) -> int:
 
 
 def _key_arrays(batch: ColumnBatch, names: Sequence[str]):
-    """(data arrays, combined key validity | None) for the key columns."""
+    """(data arrays, combined key validity | None) for the key columns.
+    String key columns contribute their int32 CODE lanes; cross-side
+    comparability comes from the rank-remap tables the join program
+    applies in-program (`string_remap_tables`)."""
     import jax.numpy as jnp
 
     datas = []
     ok = None
     for name in names:
         col = batch.column(name)
-        if col.is_string:
-            raise HyperspaceException(
-                "string keys are not supported in the born-sharded path")
         datas.append(jnp.asarray(col.data))
         if col.validity is not None:
             v = jnp.asarray(col.validity)
             ok = v if ok is None else (ok & v)
     return datas, ok
+
+
+def _dict_fingerprint(dictionary) -> tuple:
+    """Content identity of a sorted dictionary (entry count + md5 of the
+    packed values) — the cache key of cross-side remap tables. Content
+    keying is strictly stronger than version keying: two committed
+    versions with identical dictionaries share one resident table."""
+    import hashlib
+
+    d = np.ascontiguousarray(np.asarray(dictionary))
+    return (int(d.shape[0]), hashlib.md5(d.tobytes()).hexdigest())
+
+
+def string_remap_tables(lcol: DeviceColumn, rcol: DeviceColumn,
+                        conf=None):
+    """THE dictionary-remap constructor for the SPMD lane (lint-enforced:
+    `check_metrics_coverage.py::check_string_remap_seam` bans calls
+    outside this module's consumers). Builds the compact int32
+    local-code -> pair-merged-rank tables that make two sides' string
+    codes mutually comparable inside the single jitted SMJ program —
+    derived from the host dictionaries, NEVER shipping string bytes:
+    the tables ride one H2D put cold, are cached content-keyed in the
+    segment cache, and replicate into the program over ICI. Warm
+    repeats serve them straight from the cache
+    (`spmd.strings.remap_cache_hits`) with zero link traffic."""
+    from hyperspace_tpu import telemetry
+    from hyperspace_tpu.io import segcache, transfer
+    from hyperspace_tpu.io.columnar import _merged_dictionary
+
+    key = ("spmd-remap", _dict_fingerprint(lcol.dictionary),
+           _dict_fingerprint(rcol.dictionary))
+    filled: List[bool] = []
+
+    def fill():
+        filled.append(True)
+        _merged, (ra, rb), _hashes = _merged_dictionary(
+            [lcol.dictionary, rcol.dictionary], device=False)
+        engine = transfer.get_engine()
+        payload = {"l": engine.put(ra), "r": engine.put(rb)}
+        return payload, max(int(ra.nbytes) + int(rb.nbytes), 1)
+
+    payload = segcache.get_cache().get_or_fill(key, fill, conf=conf)
+    if not filled:
+        telemetry.get_registry().counter(
+            "spmd.strings.remap_cache_hits").inc()
+    return payload["l"], payload["r"]
+
+
+def _string_key_plan(left: "ShardedBatch", right: "ShardedBatch",
+                     left_keys: Sequence[str],
+                     right_keys: Sequence[str], need_hashes: bool,
+                     conf=None):
+    """Per-key string unification plan for the SPMD join: which key
+    positions are strings (`remap_idx`, static program structure), their
+    rank-remap tables, and — when an in-program repartition will route
+    the right side — the right dictionaries' value-hash tables (bucket
+    identity must hash the VALUE, exactly like the build)."""
+    import jax.numpy as jnp
+
+    idx: List[int] = []
+    l_remaps: List = []
+    r_remaps: List = []
+    r_hashes: List = []
+    for i, (lk, rk) in enumerate(zip(left_keys, right_keys)):
+        lcol = left.batch.column(lk)
+        rcol = right.batch.column(rk)
+        if lcol.is_string != rcol.is_string:
+            raise HyperspaceException(
+                f"Join key type mismatch: {lk} vs {rk}")
+        if not lcol.is_string:
+            continue
+        ra, rb = string_remap_tables(lcol, rcol, conf=conf)
+        idx.append(i)
+        l_remaps.append(ra)
+        r_remaps.append(rb)
+        if need_hashes:
+            hi, lo = rcol.dict_hashes
+            r_hashes.append((jnp.asarray(hi), jnp.asarray(lo)))
+    return (tuple(idx), tuple(l_remaps), tuple(r_remaps),
+            tuple(r_hashes))
 
 
 def _promote_pairs(l_datas, r_datas):
@@ -473,27 +739,33 @@ def _route_local(arrs, dest, n_peers: int, capacity: int):
     return [route(a) for a in arrs], overflow
 
 
-def _repartition_lanes(lanes, null, valid, gid, num_buckets_to: int,
-                       mesh, route_capacity: int):
+def _repartition_lanes(lanes, hash_lanes, null, valid, gid,
+                       num_buckets_to: int, mesh, route_capacity: int):
     """In-program ICI re-bucket of one side's KEY LANES (+ null/valid
     masks and original-row ids): each row moves to the shard owning its
-    bucket under the TARGET bucket count. Runs as a shard_map stage
-    inside the caller's jitted program — payload never routes, nothing
-    touches the host. Returns ([S*C'] lanes..., null, valid, gid,
-    route_overflow)."""
+    bucket under the TARGET bucket count. `hash_lanes` carry the BUCKET
+    identity (the build's value-hash lanes — for string keys the
+    gathered dictionary value hashes, NOT the rank lanes used for
+    matching) and are consumed for routing only, never routed. Runs as
+    a shard_map stage inside the caller's jitted program — payload
+    never routes, nothing touches the host. Returns ([S*C'] lanes...,
+    null, valid, gid, route_overflow)."""
     import jax.numpy as jnp
 
     n_shards = total_shards(mesh)
     rows_spec = row_spec(mesh)
+    k = len(lanes)
+    kh = len(hash_lanes)
 
     def body(*flat):
-        lanes_l = list(flat[:-3])
+        lanes_l = list(flat[:k])
+        hlanes_l = list(flat[k:k + kh])
         null_l, valid_l, gid_l = flat[-3], flat[-2], flat[-1]
         from hyperspace_tpu.ops.hash_partition import flat_hash32
-        hash_lanes = [jnp.where(null_l | ~valid_l, jnp.uint32(0),
-                                lane.astype(jnp.uint32))
-                      for lane in lanes_l]
-        h = flat_hash32(hash_lanes)
+        zeroed = [jnp.where(null_l | ~valid_l, jnp.uint32(0),
+                            lane.astype(jnp.uint32))
+                  for lane in hlanes_l]
+        h = flat_hash32(zeroed)
         bucket = (h % jnp.uint32(num_buckets_to)).astype(jnp.int64)
         owner = bucket_owner(bucket, num_buckets_to,
                              n_shards).astype(jnp.int32)
@@ -503,15 +775,14 @@ def _repartition_lanes(lanes, null, valid, gid, num_buckets_to: int,
             route_capacity)
         return tuple(routed) + (overflow.reshape(1),)
 
-    flat_in = tuple(lanes) + (null, valid, gid)
+    flat_in = tuple(lanes) + tuple(hash_lanes) + (null, valid, gid)
     out = compat_shard_map(
         body, mesh=mesh,
         in_specs=tuple(rows_spec for _ in flat_in),
-        out_specs=tuple([rows_spec] * (len(flat_in) + 1)),
+        out_specs=tuple([rows_spec] * (k + 4)),
         check_vma=False)(*flat_in)
     routed = out[:-1]
     overflow = jnp.sum(out[-1])
-    k = len(lanes)
     return (list(routed[:k]), routed[k], routed[k + 1], routed[k + 2],
             overflow)
 
@@ -644,7 +915,8 @@ def _cached_program(key: tuple, builder):
 def _join_program(mesh, n_keys: int, Cl: int, Cr: int, cap: int,
                   left_outer: bool, need_right: bool,
                   repartition_to: Optional[int], route_capacity: int,
-                  membership: Optional[str] = None):
+                  membership: Optional[str] = None,
+                  remap_idx: Tuple[int, ...] = ()):
     """Compile THE join as one jitted SPMD program: (optional) in-program
     ICI repartition of the right side, lane decomposition, counting
     match, static-capacity expansion, per-shard output compaction. All
@@ -656,7 +928,16 @@ def _join_program(mesh, n_keys: int, Cl: int, Cr: int, cap: int,
 
     `membership`: None (pair expansion) or "semi"/"anti" — membership
     reads the match-phase masks and compacts hit LEFT indices per shard
-    in-program instead of expanding pairs."""
+    in-program instead of expanding pairs.
+
+    `remap_idx` marks the STRING key positions: those keys arrive as
+    int32 code lanes plus per-side rank-remap tables
+    (`string_remap_tables`), applied as in-program takes so equal
+    values compare equal across the two dictionaries — the tables are
+    the only cross-side state, replicated over ICI by GSPMD; string
+    bytes never enter the program. When the right side repartitions,
+    its string keys route by their gathered dictionary VALUE hashes
+    (the build's bucket identity), not the rank lanes."""
     import jax
     import jax.numpy as jnp
 
@@ -665,23 +946,39 @@ def _join_program(mesh, n_keys: int, Cl: int, Cr: int, cap: int,
     S = total_shards(mesh)
 
     def build():
-        def step(l_datas, l_ok, l_valid, r_datas, r_ok, r_valid):
-            l_d, r_d = _promote_pairs(list(l_datas), list(r_datas))
+        def step(l_datas, l_ok, l_valid, r_datas, r_ok, r_valid,
+                 l_remaps, r_remaps, r_hash_tables):
+            l_d = list(l_datas)
+            r_d = list(r_datas)
+            r_hash_sub = {}
+            for j, ki in enumerate(remap_idx):
+                if repartition_to is not None:
+                    hi, lo = r_hash_tables[j]
+                    r_hash_sub[ki] = [jnp.take(hi, r_d[ki]),
+                                      jnp.take(lo, r_d[ki])]
+                l_d[ki] = jnp.take(l_remaps[j], l_d[ki])
+                r_d[ki] = jnp.take(r_remaps[j], r_d[ki])
+            l_d, r_d = _promote_pairs(l_d, r_d)
             l_lanes = [x.reshape(S, Cl) for x in _side_lane_chain(l_d)]
             l_pad = ~l_valid.reshape(S, Cl)
             l_null = (jnp.zeros((S, Cl), bool) if l_ok is None
                       else (~l_ok.reshape(S, Cl)) & ~l_pad)
 
-            r_lanes = _side_lane_chain(r_d)
+            r_lanes = []
+            r_hash_lanes = []
+            for ki, d in enumerate(r_d):
+                match_lanes = keymod.key_lanes(d)
+                r_lanes.extend(match_lanes)
+                r_hash_lanes.extend(r_hash_sub.get(ki, match_lanes))
             r_null_f = (jnp.zeros(r_valid.shape[0], bool) if r_ok is None
                         else ~r_ok)
             r_gid_f = jnp.arange(r_valid.shape[0], dtype=jnp.int64)
             route_ovf = jnp.int64(0)
             if repartition_to is not None:
                 r_lanes, r_null_f, r_valid_f, r_gid_f, route_ovf = \
-                    _repartition_lanes(r_lanes, r_null_f, r_valid,
-                                       r_gid_f, repartition_to, mesh,
-                                       route_capacity)
+                    _repartition_lanes(r_lanes, r_hash_lanes, r_null_f,
+                                       r_valid, r_gid_f, repartition_to,
+                                       mesh, route_capacity)
                 Cr_eff = S * route_capacity
             else:
                 r_valid_f = r_valid
@@ -718,7 +1015,7 @@ def _join_program(mesh, n_keys: int, Cl: int, Cr: int, cap: int,
         return instrumented_jit("mesh.spmd_join", step)
 
     key = ("join", mesh, n_keys, Cl, Cr, cap, left_outer, need_right,
-           repartition_to, route_capacity, membership)
+           repartition_to, route_capacity, membership, remap_idx)
     return _cached_program(key, build)
 
 
@@ -819,8 +1116,8 @@ def _repartition_target(left: ShardedBatch, right: ShardedBatch):
     if dcn_size(left.mesh) > 1:
         raise HyperspaceException(
             "in-program repartition supports flat (single-slice) meshes; "
-            "re-bucket through parallel.join.rebucket on multi-slice "
-            "topologies.")
+            "re-bucket through parallel.build.distributed_build on "
+            "multi-slice topologies.")
     return left.num_buckets, _route_cap(right)
 
 
@@ -828,7 +1125,8 @@ def sharded_join_indices(left: ShardedBatch, right: ShardedBatch,
                          left_keys: Sequence[str],
                          right_keys: Sequence[str],
                          how: str = "inner",
-                         capacity_factor: Optional[float] = None):
+                         capacity_factor: Optional[float] = None,
+                         conf=None):
     """Join-pair indices over two born-sharded sides as ONE jitted SPMD
     program per attempt (static capacity, on-device overflow detection,
     in-program ICI repartition on bucket-count mismatch). Returns
@@ -854,6 +1152,9 @@ def sharded_join_indices(left: ShardedBatch, right: ShardedBatch,
     repartition_to, route_capacity = _repartition_target(left, right)
     l_in = _join_inputs(left, left_keys)
     r_in = _join_inputs(right, right_keys)
+    remap_idx, l_remaps, r_remaps, r_hashes = _string_key_plan(
+        left, right, left_keys, right_keys,
+        need_hashes=repartition_to is not None, conf=conf)
     factor = (capacity_factor if capacity_factor is not None
               else CAPACITY_FACTOR)
     memo_key = ("cap", mesh, left.rows_per_shard, right.rows_per_shard,
@@ -868,11 +1169,12 @@ def sharded_join_indices(left: ShardedBatch, right: ShardedBatch,
         program = _join_program(mesh, len(left_keys), left.rows_per_shard,
                                 right.rows_per_shard, cap, left_outer,
                                 need_right, repartition_to,
-                                route_capacity)
+                                route_capacity, remap_idx=remap_idx)
         with telemetry.span("mesh:join:spmd", "mesh", how=how, shards=S,
                             cap=cap):
             (li, ri, counts_d, un_gid, un_counts_d, expand_ovf,
-             route_ovf) = program(*l_in, *r_in)
+             route_ovf) = program(*l_in, *r_in, l_remaps, r_remaps,
+                                  r_hashes)
             t0 = _time.perf_counter()
             # THE one host readback per attempt: the tiny per-shard
             # count vectors + overflow scalars together, after
@@ -926,7 +1228,7 @@ def sharded_join_indices(left: ShardedBatch, right: ShardedBatch,
 def sharded_semi_anti_indices(left: ShardedBatch, right: ShardedBatch,
                               left_keys: Sequence[str],
                               right_keys: Sequence[str],
-                              anti: bool = False):
+                              anti: bool = False, conf=None):
     """LEFT SEMI / LEFT ANTI membership over born-sharded sides through
     the same single program (anti emits null-key left rows — NOT EXISTS
     semantics). Membership reads the match-phase masks; the expansion's
@@ -941,6 +1243,9 @@ def sharded_semi_anti_indices(left: ShardedBatch, right: ShardedBatch,
     mesh = left.mesh
     S = total_shards(mesh)
     repartition_to, route_capacity = _repartition_target(left, right)
+    remap_idx, l_remaps, r_remaps, r_hashes = _string_key_plan(
+        left, right, left_keys, right_keys,
+        need_hashes=repartition_to is not None, conf=conf)
 
     reg = telemetry.get_registry()
     while True:
@@ -949,10 +1254,12 @@ def sharded_semi_anti_indices(left: ShardedBatch, right: ShardedBatch,
                                 left_outer=True, need_right=False,
                                 repartition_to=repartition_to,
                                 route_capacity=route_capacity,
-                                membership="anti" if anti else "semi")
+                                membership="anti" if anti else "semi",
+                                remap_idx=remap_idx)
         li_sorted, hit_counts_d, route_ovf = program(
             *_join_inputs(left, left_keys),
-            *_join_inputs(right, right_keys))
+            *_join_inputs(right, right_keys),
+            l_remaps, r_remaps, r_hashes)
         hit_counts, r_ovf = jax.device_get((hit_counts_d, route_ovf))
         if repartition_to is None or int(r_ovf) == 0:
             break
@@ -1131,6 +1438,7 @@ def sharded_filter(sh: ShardedBatch, expression) -> ColumnBatch:
     from hyperspace_tpu.telemetry import instrumented_jit
 
     reg = telemetry.get_registry()
+    count_string_predicate_lookups(expression, sh.batch)
     tree, aux = batch_to_tree(sh.batch)
     schema = sh.batch.schema
 

@@ -172,6 +172,21 @@ def _skipping_rows(old: dict, new: dict):
              float(fp), float(fp), fp <= 0)]
 
 
+def _spmd_rows(old: dict, new: dict):
+    """One-architecture gate row: a TPC-DS artifact carrying the
+    `spmd.fallbacks` counter must report ZERO (absolute — the healthy
+    value is 0 and nothing ratio-gates against zero). A fallback means
+    a bucketed SMJ with an active mesh dropped off the single-program
+    SPMD lane, i.e. a second execution architecture crept back."""
+    fb = (new.get("spmd") or {}).get("fallbacks")
+    if not isinstance(fb, (int, float)):
+        return []
+    old_fb = (old.get("spmd") or {}).get("fallbacks")
+    return [("spmd_fallbacks",
+             float(old_fb) if isinstance(old_fb, (int, float)) else 0.0,
+             float(fb), float(fb), fb > 0)]
+
+
 def compare_multichip(old: dict, new: dict, threshold: float):
     """Multi-chip artifact gate rows (same row shape as `compare`):
 
@@ -206,6 +221,15 @@ def compare_multichip(old: dict, new: dict, threshold: float):
         # must beat one device, this round, regardless of history.
         rows.append(("smj_speedup_floor", 1.0, n["smj_speedup"],
                      n["smj_speedup"] - 1.0, n["smj_speedup"] <= 1.0))
+    # String-keyed SMJ (strings born-sharded, PR 13): gated exactly like
+    # the numeric co-bucketed headline — ratio vs the previous round
+    # when it carried the rung, plus the absolute >1x floor.
+    ratio("string_smj_speedup", o.get("string_smj_speedup"),
+          n.get("string_smj_speedup"))
+    if isinstance(n.get("string_smj_speedup"), (int, float)):
+        v = n["string_smj_speedup"]
+        rows.append(("string_smj_speedup_floor", 1.0, v, v - 1.0,
+                     v <= 1.0))
     for ndev, chunks in sorted((n.get("warm_h2d_chunks") or {}).items()):
         if isinstance(chunks, (int, float)):
             old_c = (o.get("warm_h2d_chunks") or {}).get(ndev)
@@ -372,6 +396,7 @@ def compare(old: dict, new: dict, threshold: float):
         _rung1_link_share(new), lower_is_better=True)
     rows.extend(_segment_rows(old, new, threshold))
     rows.extend(_skipping_rows(old, new))
+    rows.extend(_spmd_rows(old, new))
     return rows
 
 

@@ -463,6 +463,78 @@ def check_batch_seam(package_dir: str):
     return failures
 
 
+# The legacy per-query-placement mesh join (`parallel/join.py`) is
+# DELETED — the born-sharded SPMD lane (`parallel/spmd.py`) is the one
+# distributed execution architecture. Any import or call of its entry
+# points is a resurrection of the second architecture the deletion
+# exists to prevent.
+_LEGACY_JOIN_RE = re.compile(
+    r"hyperspace_tpu\.parallel\.join\b|"
+    r"from\s+hyperspace_tpu\.parallel\s+import\s+(?:[\w,\s]*\b)?join\b|"
+    r"\bdistributed_bucketed_join_indices\s*\(|"
+    r"\bdistributed_semi_anti_indices\s*\(")
+
+
+def check_legacy_mesh_path(repo_root: str):
+    """Source lint: no references to the deleted legacy mesh-join entry
+    points anywhere in the repo (package, tests, benches, scripts)."""
+    failures = []
+    for root, dirs, files in os.walk(repo_root):
+        dirs[:] = [d for d in dirs
+                   if d not in ("__pycache__", ".git", "node_modules")]
+        for fname in files:
+            if not fname.endswith(".py") or fname == os.path.basename(
+                    __file__):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, repo_root)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _LEGACY_JOIN_RE.search(line):
+                        failures.append(
+                            f"{rel}:{lineno}: reference to the deleted "
+                            "legacy mesh join (parallel/join.py) — the "
+                            "born-sharded SPMD lane (parallel/spmd.py) "
+                            "is the one distributed join architecture")
+    return failures
+
+
+# The ONE sanctioned dictionary-remap constructor: cross-side string
+# unification on the SPMD lane goes through
+# `parallel/spmd.string_remap_tables` (content-keyed segment-cache
+# residency, `spmd.strings.*` accounting, in-program application). A
+# remap built elsewhere would re-pay the merge per query and ship
+# uncached tables over the link.
+_RAW_REMAP_RE = re.compile(r"\bstring_remap_tables\s*\(")
+_REMAP_ALLOWED = os.path.join("parallel", "spmd.py")
+
+
+def check_string_remap_seam(package_dir: str):
+    """Source lint: no `string_remap_tables(...)` construction outside
+    parallel/spmd.py."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _REMAP_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_REMAP_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: dictionary-"
+                            "remap construction outside parallel/spmd.py"
+                            " — remap tables must come from the cached "
+                            "seam (string_remap_tables) so warm queries "
+                            "never rebuild or reship them")
+    return failures
+
+
 # The ONE sanctioned backoff point: every storage retry routes through
 # the policy in utils/retry.py (typed classification, conf-driven
 # backoff, io.retries/io.giveups counters, fault-injection coverage).
@@ -582,6 +654,10 @@ def main() -> int:
     failures.extend(check_batch_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_retry_seams(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_legacy_mesh_path(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    failures.extend(check_string_remap_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_bench_artifact_seam(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))

@@ -65,6 +65,10 @@ def batch_env(tmp_path):
         "v": rng.random(n).astype(np.float64),
         "w": pa.array([float(x) if ok else None
                        for x, ok in zip(w, w_valid)], type=pa.float64()),
+        # String column (with nulls): the batched lane's dictionary-code
+        # constants resolve per member at gather time.
+        "s": pa.array([f"cat{int(x):02d}" if x < 30 else None
+                       for x in rng.integers(0, 33, n)]),
     }), str(facts / "part-0.parquet"))
 
     def session(**extra):
@@ -134,8 +138,20 @@ def test_signature_shapes_and_declines():
     sig_in = plan_signature(Filter(col("a").isin(1, 2, 3), s), 1)
     assert sig_in.shape == (("in", 0, 4),)
     assert sig_in.ints == [1, 2, 3, 3]
-    # Declines: string predicate, OR, computed projection, bare scan.
-    assert plan_signature(Filter(col("s") == lit("x"), s), 1) is None
+    # String eq/IN qualify: code resolution is DEFERRED (int-lane
+    # placeholder + a `strs` record the leader resolves against the
+    # shared scan's dictionary at gather time).
+    sig_s = plan_signature(Filter(col("s") == lit("x"), s), 1)
+    assert sig_s.shape == (("cmp", "eq", 0, "i"),)
+    assert sig_s.ints == [0]
+    assert sig_s.strs == (("cmp", 0, 0, "eq", "x"),)
+    sig_sin = plan_signature(Filter(col("s").isin("x", "y", "z"), s), 1)
+    assert sig_sin.shape == (("in", 0, 4),)
+    assert sig_sin.strs == (("in", 0, 0, 4, ("x", "y", "z")),)
+    # Two members differing only in their string literals share a key.
+    assert plan_signature(Filter(col("s") == lit("y"), s), 1).key \
+        == sig_s.key
+    # Declines: OR, computed projection, bare scan.
     assert plan_signature(Filter(
         (col("a") == lit(1)) | (col("a") == lit(2)), s), 1) is None
     assert plan_signature(Project(
@@ -181,6 +197,13 @@ def test_batched_results_bit_identical_to_solo(batch_env, fresh_lane):
            .select("k", "w"),
            facts.filter((col("w") > lit(0.2)) & col("w").is_not_null())
            .select("k", "w")]
+        # string eq (incl. an absent value) and string IN: constants
+        # ride dictionary-code lanes resolved per member at gather time
+        + [facts.filter(col("s") == lit(v)).select("k", "s")
+           for v in ("cat03", "cat11", "no-such-value")]
+        + [facts.filter(col("s").isin("cat01", "cat02", "cat29"))
+           .select("k", "s"),
+           facts.filter(col("s").isin("cat05", "zzz")).select("k", "s")]
     )
     expected = [canonical(df.collect()) for df in dfs]  # solo oracle
     inv0 = _counter("serve.batch.invocations")

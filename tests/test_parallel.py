@@ -8,9 +8,8 @@ import pyarrow as pa
 import pytest
 
 from hyperspace_tpu.io import columnar
+from hyperspace_tpu.parallel import spmd
 from hyperspace_tpu.parallel.build import distributed_build
-from hyperspace_tpu.parallel.join import (distributed_bucketed_join_indices,
-                                          rebucket)
 from hyperspace_tpu.parallel.mesh import make_mesh
 
 
@@ -86,71 +85,70 @@ def test_distributed_build_capacity_overflow_retry(mesh):
     assert int(lengths.max()) == n  # all in one bucket
 
 
-def test_distributed_join_matches_pandas(mesh):
+def _sharded_pair(mesh, left, right, buckets=16):
+    lb, ll = distributed_build(left, ["k"], buckets, mesh)
+    rb, rl = distributed_build(right, ["k"], buckets, mesh)
+    return (spmd.shard_bucket_ordered(lb, ll, mesh),
+            spmd.shard_bucket_ordered(rb, rl, mesh), lb, rb)
+
+
+def test_spmd_join_matches_pandas(mesh):
     left = make_batch(600, seed=5, with_strings=False)
     right = make_batch(300, seed=6, with_strings=False)
-    lb, ll = distributed_build(left, ["k"], 16, mesh)
-    rb, rl = distributed_build(right, ["k"], 16, mesh)
-    li, ri = distributed_bucketed_join_indices(lb, rb, ll, rl, ["k"], ["k"],
-                                               mesh)
-    lk = np.asarray(lb.column("k").data)[np.asarray(li)]
-    rk = np.asarray(rb.column("k").data)[np.asarray(ri)]
+    lsh, rsh, lb, rb = _sharded_pair(mesh, left, right)
+    li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"])
+    lk = np.asarray(lsh.batch.column("k").data)[np.asarray(li)]
+    rk = np.asarray(rsh.batch.column("k").data)[np.asarray(ri)]
     assert (lk == rk).all()
     ref = pd.DataFrame({"k": np.asarray(lb.column("k").data)}).merge(
         pd.DataFrame({"k": np.asarray(rb.column("k").data)}), on="k")
     assert len(ref) == len(np.asarray(li))
 
 
-def _indices_oracle(lb, rb, how):
-    lk = pd.DataFrame({"k": np.asarray(lb.column("k").data),
-                       "li": np.arange(lb.num_rows)})
-    rk = pd.DataFrame({"k": np.asarray(rb.column("k").data),
-                       "ri": np.arange(rb.num_rows)})
-    merged = lk.merge(rk, on="k", how={"inner": "inner",
-                                       "left_outer": "left",
-                                       "full_outer": "outer"}[how])
-    return merged
-
-
-def test_distributed_full_outer_matches_pandas(mesh):
+def test_spmd_full_outer_matches_pandas(mesh):
     left = make_batch(500, seed=8, with_strings=False)
     right = make_batch(260, seed=9, with_strings=False)
-    lb, ll = distributed_build(left, ["k"], 16, mesh)
-    rb, rl = distributed_build(right, ["k"], 16, mesh)
-    li, ri = distributed_bucketed_join_indices(lb, rb, ll, rl, ["k"], ["k"],
-                                               mesh, how="full_outer")
-    got = pd.DataFrame({"li": np.asarray(li), "ri": np.asarray(ri)})
-    exp = _indices_oracle(lb, rb, "full_outer")
-    exp = exp.fillna(-1).astype({"li": "int64", "ri": "int64"})
-    key = ["li", "ri"]
+    lsh, rsh, lb, rb = _sharded_pair(mesh, left, right)
+    li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"],
+                                       how="full_outer")
+    li, ri = np.asarray(li), np.asarray(ri)
+    lk_p = np.asarray(lsh.batch.column("k").data)
+    rk_p = np.asarray(rsh.batch.column("k").data)
+    got = pd.DataFrame({
+        "lk": np.where(li >= 0, lk_p[np.clip(li, 0, None)], -1),
+        "rk": np.where(ri >= 0, rk_p[np.clip(ri, 0, None)], -1)})
+    lpd = pd.DataFrame({"lk": np.asarray(lb.column("k").data)})
+    rpd = pd.DataFrame({"rk": np.asarray(rb.column("k").data)})
+    exp = lpd.assign(j=lpd.lk).merge(rpd.assign(j=rpd.rk), on="j",
+                                     how="outer").drop(columns="j")
+    exp = exp.fillna(-1).astype(np.int64)
+    key = ["lk", "rk"]
     pd.testing.assert_frame_equal(
         got.sort_values(key).reset_index(drop=True),
         exp[key].sort_values(key).reset_index(drop=True),
         check_dtype=False)
 
 
-def test_distributed_semi_anti_matches_pandas(mesh):
-    from hyperspace_tpu.parallel.join import distributed_semi_anti_indices
-
+def test_spmd_semi_anti_matches_pandas(mesh):
     left = make_batch(500, seed=10, with_strings=False)
     right = make_batch(120, seed=11, with_strings=False)
-    lb, ll = distributed_build(left, ["k"], 16, mesh)
-    rb, rl = distributed_build(right, ["k"], 16, mesh)
+    lsh, rsh, lb, rb = _sharded_pair(mesh, left, right)
     lk = np.asarray(lb.column("k").data)
     rset = set(np.asarray(rb.column("k").data))
     for anti in (False, True):
-        li = distributed_semi_anti_indices(lb, rb, ll, rl, ["k"], ["k"],
-                                           mesh, anti=anti)
-        got = sorted(np.asarray(li))
+        li = spmd.sharded_semi_anti_indices(lsh, rsh, ["k"], ["k"],
+                                            anti=anti)
         member = np.asarray([k in rset for k in lk])
-        exp = sorted(np.nonzero(~member if anti else member)[0])
-        assert got == exp, f"anti={anti}"
+        exp = int((~member if anti else member).sum())
+        assert len(np.asarray(li)) == exp, f"anti={anti}"
+        keys = np.asarray(lsh.batch.column("k").data)[np.asarray(li)]
+        assert np.isin(keys, list(rset)).all() != anti or exp == 0
 
 
-def test_distributed_join_hot_bucket_skew(mesh):
+def test_spmd_join_hot_bucket_overflow_retry(mesh):
     """A hot key concentrating most rows in ONE bucket must still join
-    correctly through the sharded path (the [S, C] layout pads only the
-    owner shard, not every bucket)."""
+    exactly: the static-capacity expansion overflows and the doubling
+    retry recovers every pair (nothing silently truncated)."""
     n = 1200
     hot = np.full(n - 100, 7, dtype=np.int64)
     rest = np.arange(100, dtype=np.int64) + 100
@@ -160,148 +158,63 @@ def test_distributed_join_hot_bucket_skew(mesh):
     right = columnar.from_arrow(pa.table({
         "k": np.asarray([7, 7, 120, 150], dtype=np.int64),
         "w": np.arange(4, dtype=np.float64)}))
-    lb, ll = distributed_build(left, ["k"], 16, mesh)
-    rb, rl = distributed_build(right, ["k"], 16, mesh)
-    li, ri = distributed_bucketed_join_indices(lb, rb, ll, rl, ["k"], ["k"],
-                                               mesh, how="inner")
-    lk = np.asarray(lb.column("k").data)[np.asarray(li)]
-    rk = np.asarray(rb.column("k").data)[np.asarray(ri)]
+    lsh, rsh, lb, rb = _sharded_pair(mesh, left, right)
+    spmd._CAP_MEMO.clear()
+    li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"],
+                                       capacity_factor=0.01)
+    lk = np.asarray(lsh.batch.column("k").data)[np.asarray(li)]
+    rk = np.asarray(rsh.batch.column("k").data)[np.asarray(ri)]
     assert (lk == rk).all()
     # hot key expands (n-100)*2; the two singles match once each
     assert len(np.asarray(li)) == (n - 100) * 2 + 2
+    spmd._CAP_MEMO.clear()
 
 
-def test_distributed_join_memory_is_sharded(mesh):
-    """The round-3 design replicated both sides' key lanes to every
-    device (per-chip O(total rows)); the [S, C] layout must give every
-    device ~1/S of the cells — assert the actual per-shard bytes."""
-    from hyperspace_tpu.parallel.join import _sharded_inputs
-
+def test_spmd_join_memory_is_sharded(mesh):
+    """The born-sharded [S*C] layout must give every device ~1/S of the
+    rows — assert the actual per-shard bytes of the resident columns."""
     left = make_batch(4000, seed=12, with_strings=False)
     right = make_batch(2000, seed=13, with_strings=False)
-    lb, ll = distributed_build(left, ["k"], 16, mesh)
-    rb, rl = distributed_build(right, ["k"], 16, mesh)
-    lanes2d, pad, null, l_idx, r_idx, Cl, Cr, shard_rows = _sharded_inputs(
-        lb, rb, ll, rl, ["k"], ["k"], mesh)
-    assert len(shard_rows) == 8 and sum(shard_rows) >= lb.num_rows
-    for arr in (*lanes2d, pad, null, l_idx, r_idx):
+    lsh, _rsh, _lb, _rb = _sharded_pair(mesh, left, right)
+    for name in ("k", "v"):
+        arr = lsh.batch.column(name).data
         shards = arr.addressable_shards
         assert len(shards) == 8
         per_dev = max(s.data.nbytes for s in shards)
         assert per_dev <= arr.nbytes / 8 + 1024, (
             f"device holds {per_dev}B of a {arr.nbytes}B array — "
             "not sharded")
-    # and the layout itself is tight: padded cells within 2x of true rows
-    S = 8
-    assert S * (Cl + Cr) <= 2 * (lb.num_rows + rb.num_rows) + S
+    # and the padded layout is tight: cells within 2x of true rows
+    assert 8 * lsh.rows_per_shard <= 2 * left.num_rows + 8 * 16
 
 
-def test_distributed_join_empty_sides(mesh):
-    """Empty sides must not reach the mesh layout (review regression:
-    fancy-indexing a length-0 lane array raised IndexError)."""
-    from hyperspace_tpu.parallel.join import distributed_semi_anti_indices
-
+def test_spmd_left_semi_empty_right(mesh):
+    """Degenerate sides stay off the mesh at the ENGINE level
+    (`ScanExec._execute_sharded` returns None for zero rows); at the
+    spmd API level an all-padding right side must still answer
+    membership correctly."""
     left = make_batch(300, seed=14, with_strings=False)
+    empty_rows = columnar.from_arrow(pa.table({
+        "k": np.zeros(1, dtype=np.int64), "v": np.zeros(1)}))
     lb, ll = distributed_build(left, ["k"], 16, mesh)
-    empty = columnar.from_arrow(pa.table({
-        "k": np.zeros(0, dtype=np.int64), "v": np.zeros(0)}))
-    el = np.zeros(16, dtype=np.int64)
-    li, ri = distributed_bucketed_join_indices(lb, empty, ll, el, ["k"],
-                                               ["k"], mesh, how="inner")
-    assert len(np.asarray(li)) == 0
-    li, ri = distributed_bucketed_join_indices(lb, empty, ll, el, ["k"],
-                                               ["k"], mesh,
-                                               how="left_outer")
-    assert (np.asarray(ri) == -1).all() and len(np.asarray(li)) == 300
-    li, ri = distributed_bucketed_join_indices(empty, lb, el, ll, ["k"],
-                                               ["k"], mesh,
-                                               how="full_outer")
-    assert (np.asarray(li) == -1).all() and len(np.asarray(ri)) == 300
-    assert sorted(np.asarray(ri).tolist()) == list(range(300))
-    anti = distributed_semi_anti_indices(lb, empty, ll, el, ["k"], ["k"],
-                                         mesh, anti=True)
-    assert len(np.asarray(anti)) == 300
-    semi = distributed_semi_anti_indices(lb, empty, ll, el, ["k"], ["k"],
-                                         mesh, anti=False)
-    assert len(np.asarray(semi)) == 0
+    eb, el = distributed_build(empty_rows, ["k"], 16, mesh)
+    lsh = spmd.shard_bucket_ordered(lb, ll, mesh)
+    esh = spmd.shard_bucket_ordered(eb, el, mesh)
+    anti = spmd.sharded_semi_anti_indices(lsh, esh, ["k"], ["k"],
+                                          anti=True)
+    lk = np.asarray(lb.column("k").data)
+    assert len(np.asarray(anti)) == int((lk != 0).sum())
 
 
-def test_hot_bucket_splits_across_shards(mesh):
-    """One key holding 90% of the rows must NOT forfeit the mesh: the
-    hot bucket's rows split across shards (replicating the other side's
-    bucket rows), per-shard capacity stays <= 2x ideal, and the join
-    result equals the single-chip counting join (round-4 review item 5)."""
-    from hyperspace_tpu.ops.bucketed_join import bucketed_sort_merge_join
-    from hyperspace_tpu.parallel.join import (
-        _rows_to_layout, distributed_bucketed_join_indices,
-        distributed_semi_anti_indices, shard_plan)
-
-    n = 4000
-    rng = np.random.default_rng(11)
-    hot_k = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 64, n))
-    left = columnar.from_arrow(pa.table({
-        "k": hot_k.astype(np.int64), "v": rng.random(n)}))
-    m = 400
-    rk = np.where(rng.random(m) < 0.5, 7, rng.integers(0, 64, m))
-    right = columnar.from_arrow(pa.table({
-        "k": rk.astype(np.int64), "w": rng.random(m)}))
-    lb, ll = distributed_build(left, ["k"], 16, mesh)
-    rb, rl = distributed_build(right, ["k"], 16, mesh)
-
-    # Capacity bound: the [S, C] layout stays near-balanced.
-    for split in ("left", "larger"):
-        l_rows, r_rows = shard_plan(ll, rl, 8, split)
-        _, _, cl = _rows_to_layout(l_rows)
-        _, _, cr = _rows_to_layout(r_rows)
-        ideal = (int(ll.sum()) + int(rl.sum()) + 7) // 8
-        assert cl + cr <= 2 * ideal, (split, cl, cr, ideal)
-
-    for how in ("inner", "left_outer"):
-        from hyperspace_tpu.ops.bucketed_join import assemble_join_output
-        li, ri = distributed_bucketed_join_indices(
-            lb, rb, ll, rl, ["k"], ["k"], mesh, how=how)
-        got = assemble_join_output(lb, rb, li, ri, how=how)
-        expected = bucketed_sort_merge_join(lb, rb, ll, rl, ["k"], ["k"],
-                                            how=how)
-        g = columnar.to_arrow(got).to_pandas()
-        e = columnar.to_arrow(expected).to_pandas()
-        cols = list(g.columns)
-        pd.testing.assert_frame_equal(
-            g.sort_values(cols).reset_index(drop=True),
-            e.sort_values(cols).reset_index(drop=True), check_dtype=False)
-
-    # Membership over the same skew: anti needs the FULL right set per
-    # left row (left-only splitting) — counts must match single-chip.
-    from hyperspace_tpu.ops.join import semi_anti_indices
-    for anti in (False, True):
-        idx = distributed_semi_anti_indices(lb, rb, ll, rl, ["k"], ["k"],
-                                            mesh, anti=anti)
-        ref = semi_anti_indices(lb, rb, ["k"], ["k"], anti=anti)
-        assert sorted(np.asarray(idx).tolist()) == sorted(
-            np.asarray(ref).tolist())
-
-
-def test_shard_skew_guard():
-    from hyperspace_tpu.parallel.join import (SKEW_BLOWUP_FACTOR,
-                                              SKEW_MIN_CELLS, shard_skew)
-    B, S = 16, 8
-    even = np.full(B, SKEW_MIN_CELLS // B, dtype=np.int64)
-    assert not shard_skew(even, even, S)
-    # one bucket holds everything: cells = S * total >> rows
-    hot = np.zeros(B, dtype=np.int64)
-    hot[3] = SKEW_MIN_CELLS
-    tiny = np.ones(B, dtype=np.int64)
-    assert shard_skew(hot, tiny, S)
-    assert SKEW_BLOWUP_FACTOR < S  # the guard bites before replication
-
-
-def test_rebucket_mismatched_counts(mesh):
-    """The ranker's fallback: re-bucket one side to the other's count."""
+def test_repartition_sharded_mismatched_counts(mesh):
+    """The ranker's fallback, post-deletion form: a device-resident
+    batch re-buckets to a new count entirely in-program
+    (`repartition_sharded`), and a join over the result matches the
+    co-bucketed layout."""
     batch = make_batch(400, seed=7, with_strings=False)
-    rebucketed, lengths = rebucket(batch, ["k"], 32, mesh)
-    assert rebucketed.num_rows == 400
-    assert len(lengths) == 32
-    assert int(lengths.sum()) == 400
+    sh = spmd.repartition_sharded(batch, ["k"], 32, mesh)
+    assert sh.num_buckets == 32
+    assert sh.num_rows == 400
 
 
 def test_graft_entry():
@@ -384,10 +297,9 @@ def test_distributed_aggregate_int64_exact(mesh):
     assert int(d.mny[0]) == big and int(d.mxy[0]) == big + 2
 
 
-def test_distributed_left_outer_join_with_nulls(mesh):
-    """Mesh left_outer: unmatched and null-key left rows emit right -1;
-    matches equal pandas. Exercises the shard-local per-bucket encode's
-    null-group forcing."""
+def test_spmd_left_outer_join_with_nulls(mesh):
+    """SPMD left_outer: unmatched and null-key left rows emit right -1;
+    matches equal pandas (null keys never match — Kleene)."""
     rng = np.random.default_rng(9)
     lk = rng.integers(0, 30, 400).astype(np.float64)
     lk[::17] = np.nan  # null keys via mask below
@@ -399,31 +311,31 @@ def test_distributed_left_outer_join_with_nulls(mesh):
     right = columnar.from_arrow(pa.table({
         "k": rng.integers(10, 50, 150).astype(np.int64),
         "y": rng.random(150)}))
-    lb, ll = distributed_build(left, ["k"], 16, mesh)
-    rb, rl = distributed_build(right, ["k"], 16, mesh)
-    li, ri = distributed_bucketed_join_indices(lb, rb, ll, rl, ["k"], ["k"],
-                                               mesh, how="left_outer")
+    lsh, rsh, lb, rb = _sharded_pair(mesh, left, right)
+    li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"],
+                                       how="left_outer")
     li, ri = np.asarray(li), np.asarray(ri)
+    lkey_p = np.asarray(lsh.batch.column("k").data)
+    lval_p = (np.asarray(lsh.batch.column("k").validity)
+              if lsh.batch.column("k").validity is not None
+              else np.ones(len(lkey_p), bool))
+    rkey_p = np.asarray(rsh.batch.column("k").data)
+    # Matched pairs agree with pandas over the ORIGINAL layouts.
     lkey = np.asarray(lb.column("k").data)
     lval = (np.asarray(lb.column("k").validity)
             if lb.column("k").validity is not None
             else np.ones(len(lkey), bool))
     rkey = np.asarray(rb.column("k").data)
-    # pandas oracle over the built layouts
-    lpd = pd.DataFrame({"k": np.where(lval, lkey, -999),
-                        "li": np.arange(len(lkey)),
-                        "valid": lval})
-    rpd = pd.DataFrame({"k": rkey, "ri": np.arange(len(rkey))})
-    matched = lpd[lpd.valid].merge(rpd, on="k")
-    exp_pairs = set(zip(matched.li.tolist(), matched.ri.tolist()))
-    got_matched = {(int(a), int(b)) for a, b in zip(li, ri) if b >= 0}
-    assert got_matched == exp_pairs
-    # every left row appears at least once; unmatched exactly once with -1
-    got_left_counts = pd.Series(li).value_counts()
-    assert set(got_left_counts.index) == set(range(len(lkey)))
-    unmatched_left = set(range(len(lkey))) - set(matched.li)
-    for row in unmatched_left:
-        assert got_left_counts[row] == 1
+    lpd = pd.DataFrame({"k": lkey[lval]})
+    rpd = pd.DataFrame({"k": rkey})
+    matched = lpd.merge(rpd, on="k")
+    got_matched = ri >= 0
+    assert int(got_matched.sum()) == len(matched)
+    assert (lkey_p[li[got_matched]] == rkey_p[ri[got_matched]]).all()
+    assert lval_p[li[got_matched]].all()
+    # every REAL left row appears; null/unmatched carry right -1 once
+    assert len(li) == len(matched) + int((~lval).sum()) \
+        + int((~np.isin(lkey, rkey) & lval).sum())
 
 
 # -- two-axis (dcn x shard) mesh: multi-host topology ---------------------
@@ -452,15 +364,21 @@ def test_two_axis_build_matches_single_chip(mesh24):
 
 
 def test_two_axis_join_matches_pandas(mesh24):
+    """Co-bucketed SPMD join over the 2-axis (dcn x shard) mesh —
+    equal bucket counts need no in-program repartition, so the single
+    program runs on multi-slice topologies too."""
     left = make_batch(700, seed=22, with_strings=False)
     right = make_batch(350, seed=23, with_strings=False)
     lb, ll = distributed_build(left, ["k"], 16, mesh24)
     rb, rl = distributed_build(right, ["k"], 16, mesh24)
-    li, ri = distributed_bucketed_join_indices(lb, rb, ll, rl, ["k"], ["k"],
-                                               mesh24)
+    lsh = spmd.shard_bucket_ordered(lb, ll, mesh24)
+    rsh = spmd.shard_bucket_ordered(rb, rl, mesh24)
+    li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"])
+    lk_p = np.asarray(lsh.batch.column("k").data)
+    rk_p = np.asarray(rsh.batch.column("k").data)
+    assert (lk_p[np.asarray(li)] == rk_p[np.asarray(ri)]).all()
     lk = np.asarray(lb.column("k").data)
     rk = np.asarray(rb.column("k").data)
-    assert (lk[np.asarray(li)] == rk[np.asarray(ri)]).all()
     exp = pd.DataFrame({"k": lk}).merge(pd.DataFrame({"k": rk}), on="k")
     assert len(exp) == len(np.asarray(li))
 

@@ -343,7 +343,62 @@ def test_engine_smj_spmd_lane_and_warm_link_free(born_sharded_env):
     pd.testing.assert_frame_equal(plain, warm)
 
 
-def test_spmd_disabled_falls_back_to_legacy_mesh(born_sharded_env):
+def test_engine_string_smj_spmd_lane_fallback_free(born_sharded_env):
+    """A STRING-keyed planner-selected SMJ runs the SPMD lane end to
+    end — no per-query placement, no host fallback (`spmd.fallbacks`
+    delta is 0), warm repeats link-free with remap tables served from
+    the segment cache — and equals rules-off bit for bit."""
+    session, hs, src = born_sharded_env
+    from hyperspace_tpu.index.index_config import IndexConfig
+    from hyperspace_tpu.io import segcache
+
+    df = session.read_parquet(src)
+    hs.create_index(df, IndexConfig("strl", ["query"],
+                                    ["id", "clicks"]))
+    hs.create_index(df, IndexConfig("strr", ["query"], ["score"]))
+    left = df.select("query", "id", "clicks")
+    right = df.select("query", "score")
+    query = left.join(right, on="query")
+    sort_cols = ["query", "id", "score"]
+
+    session.disable_hyperspace()
+    plain = query.to_pandas().sort_values(sort_cols) \
+        .reset_index(drop=True)
+    session.enable_hyperspace()
+    segcache.clear()
+    reg = telemetry.get_registry()
+
+    def counters():
+        c = reg.counters_dict()
+        return {k: c.get(k, 0) for k in
+                ("mesh.spmd.join_execs", "spmd.fallbacks",
+                 "link.h2d.chunks", "spmd.strings.remap_cache_hits")}
+
+    c0 = counters()
+    cold = query.to_pandas().sort_values(sort_cols) \
+        .reset_index(drop=True)
+    c1 = counters()
+    warm = query.to_pandas().sort_values(sort_cols) \
+        .reset_index(drop=True)
+    c2 = counters()
+    session.disable_hyperspace()
+
+    assert c1["mesh.spmd.join_execs"] > c0["mesh.spmd.join_execs"], \
+        "string SMJ did not take the SPMD lane"
+    assert c2["spmd.fallbacks"] == c0["spmd.fallbacks"], \
+        "string join fell off the SPMD lane"
+    assert c2["link.h2d.chunks"] == c1["link.h2d.chunks"], \
+        "warm string join crossed the link"
+    assert c2["spmd.strings.remap_cache_hits"] > \
+        c1["spmd.strings.remap_cache_hits"]
+    pd.testing.assert_frame_equal(plain, cold)
+    pd.testing.assert_frame_equal(plain, warm)
+
+
+def test_spmd_disabled_falls_back_to_single_chip(born_sharded_env):
+    """`spark.hyperspace.distribution.spmd.enabled=false` is the
+    operational escape hatch: with the legacy mesh path deleted, the
+    bucketed SMJ runs single-chip, identical results."""
     session, hs, src = born_sharded_env
     from hyperspace_tpu.index.index_config import IndexConfig
 
@@ -365,6 +420,227 @@ def test_spmd_disabled_falls_back_to_legacy_mesh(born_sharded_env):
     session.disable_hyperspace()
     assert reg.counters_dict().get("mesh.spmd.join_execs", 0) == before
     pd.testing.assert_frame_equal(plain, indexed)
+
+
+def make_string_batch(n, seed=0, keyspace=80, null_frac=0.0,
+                      prefix="key"):
+    """String-keyed batch; `null_frac` > 0 inserts NULL keys,
+    `keyspace` controls dictionary cardinality."""
+    rng = np.random.default_rng(seed)
+    keys = np.array([f"{prefix}{int(x):07d}"
+                     for x in rng.integers(0, keyspace, n)])
+    if null_frac:
+        keys = np.where(rng.random(n) < null_frac, None, keys)
+    return columnar.from_arrow(pa.table({
+        "k": pa.array(list(keys)),
+        "v": rng.random(n).astype(np.float64),
+    }))
+
+
+def string_sharded_pair(n_dev, n=900, m=400, buckets=16, seed=5,
+                        keyspace=80, null_frac=0.0):
+    mesh = make_mesh(n_dev)
+    left = make_string_batch(n, seed=seed, keyspace=keyspace,
+                             null_frac=null_frac)
+    right = make_string_batch(m, seed=seed + 1, keyspace=keyspace)
+    lb, ll = distributed_build(left, ["k"], buckets, mesh)
+    rb, rl = distributed_build(right, ["k"], buckets, mesh)
+    return (mesh, spmd.shard_bucket_ordered(lb, ll, mesh),
+            spmd.shard_bucket_ordered(rb, rl, mesh), lb, rb, ll, rl)
+
+
+def _string_values(batch, name="k"):
+    col = batch.column(name)
+    vals = np.asarray(col.dictionary)[np.asarray(col.data)]
+    ok = (np.asarray(col.validity) if col.validity is not None
+          else np.ones(len(vals), bool))
+    return vals, ok
+
+
+def string_pairs_frame(lsh, rsh, li, ri):
+    lv, lo = _string_values(lsh.batch)
+    rv, ro = _string_values(rsh.batch)
+    li, ri = np.asarray(li), np.asarray(ri)
+    lk = np.where(li >= 0,
+                  np.where(lo[np.clip(li, 0, None)],
+                           lv[np.clip(li, 0, None)], "~null"), "~none")
+    rk = np.where(ri >= 0,
+                  np.where(ro[np.clip(ri, 0, None)],
+                           rv[np.clip(ri, 0, None)], "~null"), "~none")
+    return pd.DataFrame({"lk": lk, "rk": rk}) \
+        .sort_values(["lk", "rk"]).reset_index(drop=True)
+
+
+def string_oracle_frame(lb, rb, how):
+    lv, lo = _string_values(lb)
+    rv, ro = _string_values(rb)
+    lpd = pd.DataFrame({
+        "lk": np.where(lo, lv, "~null"),
+        "j": np.where(lo, lv, [f"__null{i}" for i in range(len(lv))])})
+    rpd = pd.DataFrame({
+        "rk": np.where(ro, rv, "~null"),
+        "j": np.where(ro, rv,
+                      [f"__rnull{i}" for i in range(len(rv))])})
+    merged = lpd.merge(rpd, on="j", how={
+        "inner": "inner", "left_outer": "left",
+        "full_outer": "outer"}[how]).drop(columns="j")
+    merged["lk"] = merged["lk"].fillna("~none")
+    merged["rk"] = merged["rk"].fillna(
+        "~none" if how == "left_outer" else "~none")
+    # left_outer/full_outer: unmatched rows carry "~none" on the
+    # missing side, EXCEPT null-key left rows which legitimately pair
+    # with right "~none" too — the spmd frame reports unmatched as
+    # "~none", so align: any row whose rk is NaN means no match.
+    return merged.sort_values(["lk", "rk"]).reset_index(drop=True)
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+def test_string_join_bit_identity_across_device_counts(n_dev):
+    """String-keyed SMJ over born-sharded sides — per-range
+    dictionaries unified by in-program rank remaps — equals the pandas
+    oracle at every mesh size, NULL-bearing keys included."""
+    mesh, lsh, rsh, lb, rb, ll, rl = string_sharded_pair(
+        n_dev, null_frac=0.08)
+    for how in ("inner", "left_outer", "full_outer"):
+        li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"],
+                                           how=how)
+        got = string_pairs_frame(lsh, rsh, li, ri)
+        want = string_oracle_frame(lb, rb, how)
+        pd.testing.assert_frame_equal(got, want), how
+    # membership (anti emits null-key left rows — NOT EXISTS)
+    lv, lo = _string_values(lb)
+    rv, _ro = _string_values(rb)
+    member = np.isin(lv, rv) & lo
+    for anti in (False, True):
+        idx = np.asarray(spmd.sharded_semi_anti_indices(
+            lsh, rsh, ["k"], ["k"], anti=anti))
+        exp = int((~member).sum()) if anti else int(member.sum())
+        assert len(idx) == exp, f"anti={anti}"
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_string_filter_and_aggregate_bit_identity(n_dev):
+    """String predicate (code-space range test against the GLOBAL
+    dictionary) and group-by-string aggregation over the sharded layout
+    equal the single-device operators."""
+    from hyperspace_tpu.engine.compiler import apply_filter
+    from hyperspace_tpu.ops.aggregate import group_aggregate
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.plan.nodes import Aggregate, AggSpec, Scan
+    from hyperspace_tpu.plan.schema import Schema
+
+    mesh = make_mesh(n_dev)
+    batch = make_string_batch(1500, seed=11, keyspace=60,
+                              null_frac=0.05)
+    built, lengths = distributed_build(batch, ["k"], 16, mesh)
+    sh = spmd.shard_bucket_ordered(built, lengths, mesh)
+
+    for pred in (col("k") < lit("key0000030"),
+                 col("k") == lit("key0000007"),
+                 col("k").isin("key0000001", "key0000002",
+                               "no-such-key")):
+        got = columnar.to_arrow(spmd.sharded_filter(sh, pred)) \
+            .to_pandas()
+        want = columnar.to_arrow(apply_filter(built, pred)).to_pandas()
+        cols = list(got.columns)
+        pd.testing.assert_frame_equal(
+            got.sort_values(cols).reset_index(drop=True),
+            want.sort_values(cols).reset_index(drop=True))
+
+    schema = Schema.from_arrow(pa.table(
+        {"k": np.array(["x"]), "v": np.zeros(1)}).schema)
+    specs = [AggSpec("count", "*", "cnt"), AggSpec("sum", "v", "sv"),
+             AggSpec("min", "v", "mn")]
+    out_schema = Aggregate(["k"], specs, Scan(["/nx"], schema)).schema
+    agg = spmd.sharded_group_aggregate(sh, ["k"], specs, out_schema)
+    single = group_aggregate(built, ["k"], specs, out_schema)
+    g = columnar.to_arrow(agg).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    s = columnar.to_arrow(single).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    pd.testing.assert_frame_equal(g, s, check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+def test_string_high_cardinality_dictionaries():
+    """A dictionary with one entry per row (worst case for the remap
+    tables) still joins exactly, through the in-program repartition
+    path too (value-hash routing, not rank routing)."""
+    mesh = make_mesh(4)
+    _m, lsh, rsh, lb, rb, _ll, _rl = string_sharded_pair(
+        4, n=1200, m=600, keyspace=1 << 20, seed=31)
+    li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"])
+    got = string_pairs_frame(lsh, rsh, li, ri)
+    pd.testing.assert_frame_equal(got,
+                                  string_oracle_frame(lb, rb, "inner"))
+    # mismatched bucket counts: right re-buckets in-program by VALUE
+    # hash (the rank lanes are pair-local and must not route)
+    right2 = make_string_batch(600, seed=32, keyspace=1 << 20)
+    rb8, rl8 = distributed_build(right2, ["k"], 8, mesh)
+    rsh8 = spmd.shard_bucket_ordered(rb8, rl8, mesh)
+    li2, ri2 = spmd.sharded_join_indices(lsh, rsh8, ["k"], ["k"])
+    got2 = string_pairs_frame(lsh, rsh8, li2, ri2)
+    pd.testing.assert_frame_equal(got2,
+                                  string_oracle_frame(lb, rb8, "inner"))
+
+
+def test_string_warm_repeat_remaps_from_cache_zero_h2d(tmp_path):
+    """The warm-repeat contract for strings: a second born-sharded read
+    + string-keyed join serves BOTH the global dictionaries and the
+    join's rank-remap tables from the segment cache — zero H2D chunks,
+    `spmd.strings.remap_cache_hits` advancing, results identical."""
+    from hyperspace_tpu.io import builder, parquet, segcache
+    from hyperspace_tpu.io.segcache import SegmentRef
+    from hyperspace_tpu.parallel.mesh import bucket_ranges
+
+    mesh = make_mesh(4)
+    left = make_string_batch(800, seed=41, keyspace=120,
+                             null_frac=0.05)
+    right = make_string_batch(300, seed=42, keyspace=120)
+    roots = {}
+    lengths_map = {}
+    for tag, batch in (("l", left), ("r", right)):
+        built, lengths = distributed_build(batch, ["k"], 16, mesh)
+        root = str(tmp_path / tag)
+        builder.write_bucket_ordered(built, lengths, 16, root,
+                                     mesh=mesh)
+        roots[tag] = root
+        lengths_map[tag] = (lengths, built.schema)
+        layout = builder.read_shard_layout(root)
+        assert layout is not None and "dictionaries" in layout
+        assert len(layout["dictionaries"]["k"]) == 4  # one per range
+
+    segcache.clear()
+
+    def read(tag):
+        lengths, schema = lengths_map[tag]
+        per_bucket = parquet.bucket_files(roots[tag])
+        per_shard = [[f for b in range(lo, hi)
+                      for f in per_bucket.get(b, [])]
+                     for lo, hi in bucket_ranges(16, 4)]
+        ref = SegmentRef(index_name=f"str_{tag}", index_root=roots[tag],
+                         version=0, bucket="t")
+        return spmd.read_sharded(per_shard, lengths,
+                                 [f.name for f in schema.fields],
+                                 schema, mesh, base_ref=ref)
+
+    def join_once():
+        lsh = read("l")
+        rsh = read("r")
+        li, ri = spmd.sharded_join_indices(lsh, rsh, ["k"], ["k"])
+        return string_pairs_frame(lsh, rsh, li, ri)
+
+    reg = telemetry.get_registry()
+    cold = join_once()
+    c0 = dict(reg.counters_dict())
+    warm = join_once()
+    c1 = dict(reg.counters_dict())
+    assert c1.get("link.h2d.chunks", 0) == c0.get("link.h2d.chunks", 0), \
+        "warm string read/join crossed the link"
+    assert c1.get("spmd.strings.remap_cache_hits", 0) > \
+        c0.get("spmd.strings.remap_cache_hits", 0), \
+        "remap tables not served from the segment cache"
+    pd.testing.assert_frame_equal(cold, warm)
 
 
 def test_segcache_get_or_fill_invalidation():

@@ -43,8 +43,27 @@ Prints exactly ONE JSON line (canonical schema via
 `telemetry.artifact.make_artifact`; `scripts/bench_regress.py
 --multichip` gates speedup, warm link-freedom, and bit-identity).
 
+NEW (PR 14): the SCALE-OUT grid — devices x slices x concurrent
+clients. A serving-shaped workload (Zipf-skewed point joins + semi
+membership, the traffic shape a hot-keyed serving plane actually sees)
+runs at topologies 1x8 / 2x4 / 4x2 with 1 and 8 concurrent clients:
+the flat mesh serializes every query over all 8 devices, while the
+replicated topologies route each query to a replica slice
+(`parallel/replica.py` least-loaded routing — the routed counts feed
+the balance gate) holding the full bucket-range map at slice
+granularity. Reported as `multislice`: per-cell QPS, the headline
+`qps_ratio` (2x4 replicated @ 8 clients over 1x8 flat @ 8 clients —
+scale-out must WIN concurrency), `replica_max_share`,
+`dcn_byte_share` of the 2-axis in-program repartition, warm-fill
+link-freedom, `spmd.fallbacks` delta, and cross-topology
+bit-identity. Replication smooths the padded [S*C] layout too: 8
+narrow ranges each pad to the hot bucket's rows where 4 merged ranges
+absorb it — the skewed-traffic case is where scale-out wins even on
+emulated devices.
+
 Env knobs: MULTICHIP_ROWS (fact rows, default 1200000),
-MULTICHIP_BUCKETS (default 64), MULTICHIP_DEVICES (default "1,4,8").
+MULTICHIP_BUCKETS (default 64), MULTICHIP_DEVICES (default "1,4,8"),
+MULTICHIP_GRID_CLIENTS (default "1,8").
 """
 
 import json
@@ -225,6 +244,11 @@ def run_rung(n, data_dirs, lengths_map):
         from hyperspace_tpu.plan.expr import col, lit
         cutoff = "AAAA%08d" % (ROWS // 16)
         filt = spmd.sharded_filter(ssk, col("ss_item_id") < lit(cutoff))
+        # Timer hygiene: the filter's compaction gather is async — let
+        # it land before the SMJ timer starts, or its wall (which the
+        # retrace of the per-call filter program dominates) books
+        # against the join stage.
+        jax.block_until_ready([c.data for c in filt.columns.values()])
         t0 = time.perf_counter()
         li, ri = spmd.sharded_join_indices(ssk, itm, ["ss_item_id"],
                                            ["i_item_id"])
@@ -302,6 +326,14 @@ def run_rung(n, data_dirs, lengths_map):
         warm = fn()
         warm_s = time.perf_counter() - t0
         inter_d2h = _counters("link.d2h.chunks")["link.d2h.chunks"] - d2h0
+        # SMJ stage wall = BEST of three warm laps: the ratio claim
+        # rides this number, and on the shared container a single lap
+        # is hostage to background load (the r06->r07 comparator-side
+        # variance, docs/round10-notes.md).
+        for _ in range(2):
+            lap = fn()
+            if lap["smj_s"] < warm["smj_s"]:
+                warm["smj_s"] = lap["smj_s"]
         out["queries"][name] = {
             "cold_s": round(cold_s, 3),
             "warm_s": round(warm_s, 3),
@@ -315,6 +347,181 @@ def run_rung(n, data_dirs, lengths_map):
             f"(smj {warm['smj_s']:.3f}s, {warm['pairs']} pairs, "
             f"d2h {inter_d2h:+.0f})")
     return out
+
+
+GRID_CLIENTS = [int(x) for x in
+                os.environ.get("MULTICHIP_GRID_CLIENTS", "1,8").split(",")]
+
+
+def run_multislice_grid(work: str):
+    """The devices x slices x concurrent-clients serving grid (module
+    docstring). Returns the `multislice` artifact section."""
+    import threading
+
+    import pandas as pd  # noqa: F401  (env parity with main)
+
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.engine.scheduler import QueryScheduler
+    from hyperspace_tpu.io import builder, parquet
+    from hyperspace_tpu.io.segcache import SegmentRef
+    from hyperspace_tpu.parallel import replica as replica_mod
+    from hyperspace_tpu.parallel import spmd
+    from hyperspace_tpu.parallel.build import distributed_build
+    from hyperspace_tpu.parallel.mesh import (bucket_ranges, make_mesh,
+                                              slice_submesh, total_shards)
+
+    rng = np.random.default_rng(23)
+    N, M, B = 4000, 1500, 64
+    # Zipf-shaped point-join traffic: one dominant key (~half the left
+    # rows — the hot-product / default-value shape) over a long tail.
+    hot_l = np.where(rng.random(N) < 0.52, 7,
+                     rng.integers(0, 4000, N))
+    hot_r = np.where(rng.random(M) < 0.05, 7,
+                     rng.integers(0, 4000, M))
+    left = columnar.from_arrow(pa.table({
+        "g_key": hot_l.astype(np.int64), "g_val": rng.random(N)}))
+    right = columnar.from_arrow(pa.table({
+        "g_key": hot_r.astype(np.int64), "g_val": rng.random(M)}))
+
+    widest = make_mesh(8)
+    roots = {}
+    for tag, batch in (("gl", left), ("gr", right)):
+        built, lengths = distributed_build(batch, ["g_key"], B, widest)
+        root = os.path.join(work, tag)
+        builder.write_bucket_ordered(built, lengths, B, root, mesh=widest)
+        roots[tag] = (root, lengths, built.schema)
+
+    def read_pair(mesh):
+        out = []
+        for tag in ("gl", "gr"):
+            root, lengths, schema = roots[tag]
+            per_bucket = parquet.bucket_files(root)
+            S = total_shards(mesh)
+            per_shard = [[f for b in range(lo, hi)
+                          for f in per_bucket.get(b, [])]
+                         for lo, hi in bucket_ranges(B, S)]
+            ref = SegmentRef(index_name=f"grid_{tag}", index_root=root,
+                             version=0, bucket="grid")
+            out.append(spmd.read_sharded(
+                per_shard, lengths, [f.name for f in schema.fields],
+                schema, mesh, base_ref=ref))
+        return tuple(out)
+
+    def query(pair, q):
+        """One serving query: point join (even q) / semi membership
+        (odd q); returns the topology-invariant identity
+        (result rows, int64 key checksum)."""
+        import jax.numpy as jnp
+        lsh, rsh = pair
+        with spmd.dispatch_guard(lsh.mesh):
+            if q % 2:
+                li = spmd.sharded_semi_anti_indices(lsh, rsh,
+                                                    ["g_key"], ["g_key"])
+            else:
+                li, _ri = spmd.sharded_join_indices(lsh, rsh,
+                                                    ["g_key"], ["g_key"])
+            keys = jnp.take(jnp.asarray(lsh.batch.column("g_key").data),
+                            li)
+            return len(np.asarray(li)), int(jnp.sum(keys))
+
+    topologies = {"1x8": 1, "2x4": 2, "4x2": 4}
+    cells = {}
+    identities = {}
+    warm_h2d = 0.0
+    replica_routed = {}
+    reg = telemetry.get_registry()
+    fallbacks0 = reg.counters_dict().get("spmd.fallbacks", 0)
+    for topo, n_slices in topologies.items():
+        conf = HyperspaceConf({
+            "hyperspace.distribution.enabled": "true",
+            "hyperspace.distribution.slices": n_slices})
+        replica_mod.reset_router()
+        router = replica_mod.get_router()
+        sched = QueryScheduler()
+        if n_slices == 1:
+            pairs = [read_pair(make_mesh(8))]
+        else:
+            mesh = make_mesh(8, dcn_size=n_slices)
+            pairs = [read_pair(slice_submesh(mesh, i))
+                     for i in range(n_slices)]
+        # Warm every replica, then assert the timed phase is fill-free.
+        for pair in pairs:
+            for q in range(2):
+                query(pair, q)
+        h2d0 = _counters("link.h2d.chunks")["link.h2d.chunks"]
+        # Cross-topology bit-identity: one deterministic lap.
+        identities[topo] = [query(pairs[0], q) for q in range(4)]
+        cells[topo] = {}
+        for K in GRID_CLIENTS:
+            Q = 6
+            done = []
+
+            def client(i):
+                for q in range(Q):
+                    rep = router.route(None, conf, sched)
+                    pair = pairs[rep if rep is not None else 0]
+                    query(pair, q)
+                done.append(Q)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(K)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            qps = sum(done) / wall
+            cells[topo][str(K)] = {"qps": round(qps, 2),
+                                   "wall_s": round(wall, 3),
+                                   "queries": sum(done)}
+            log(f"  grid {topo} K={K}: {qps:.1f} QPS")
+        if n_slices == 2:
+            replica_routed = {str(k): v for k, v
+                              in router.routed_counts().items()}
+        warm_h2d += _counters("link.h2d.chunks")["link.h2d.chunks"] - h2d0
+
+    # Cross-slice repartition attribution: one mismatched-bucket join
+    # over the FULL 2-axis mesh (key lanes cross slices over DCN,
+    # re-bucket within over ICI) — the dcn_byte_share gate's evidence.
+    mesh2 = make_mesh(8, dcn_size=2)
+    rb2, rl2 = distributed_build(right, ["g_key"], B // 2, mesh2)
+    lb2, ll2 = distributed_build(left, ["g_key"], B, mesh2)
+    lsh2 = spmd.shard_bucket_ordered(lb2, ll2, mesh2)
+    rsh2 = spmd.shard_bucket_ordered(rb2, rl2, mesh2)
+    c0 = _counters("spmd.repartition.ici.bytes",
+                   "spmd.repartition.dcn.bytes")
+    li2, _ri2 = spmd.sharded_join_indices(lsh2, rsh2, ["g_key"],
+                                          ["g_key"])
+    repart_pairs = len(np.asarray(li2))
+    c1 = _counters("spmd.repartition.ici.bytes",
+                   "spmd.repartition.dcn.bytes")
+    ici = c1["spmd.repartition.ici.bytes"] - c0["spmd.repartition.ici.bytes"]
+    dcn = c1["spmd.repartition.dcn.bytes"] - c0["spmd.repartition.dcn.bytes"]
+    dcn_share = round(dcn / (ici + dcn), 4) if (ici + dcn) else None
+
+    base = identities["1x8"]
+    bit_identical = all(identities[t] == base for t in topologies)
+    fallbacks = reg.counters_dict().get("spmd.fallbacks", 0) - fallbacks0
+    flat = cells["1x8"][str(max(GRID_CLIENTS))]["qps"]
+    repl = cells["2x4"][str(max(GRID_CLIENTS))]["qps"]
+    routed_total = sum(replica_routed.values()) or 1
+    return {
+        "workload": {"left_rows": N, "right_rows": M, "buckets": B,
+                     "hot_fraction": 0.52,
+                     "clients": GRID_CLIENTS},
+        "cells": cells,
+        "qps_ratio": round(repl / flat, 3) if flat else None,
+        "replica_routed": replica_routed,
+        "replica_max_share": round(
+            max(replica_routed.values()) / routed_total, 3)
+        if replica_routed else None,
+        "dcn_byte_share": dcn_share,
+        "repartition_pairs": repart_pairs,
+        "warm_h2d_chunks": warm_h2d,
+        "spmd_fallbacks": fallbacks,
+        "bit_identical": bit_identical,
+    }
 
 
 def main():
@@ -371,6 +578,15 @@ def main():
         rungs = {}
         for n in DEVICES:
             rungs[str(n)] = run_rung(n, data_dirs, lengths_map)
+
+        log("multislice serving grid (devices x slices x clients)...")
+        multislice = run_multislice_grid(work)
+        log(f"grid: qps_ratio {multislice['qps_ratio']} "
+            f"(2x4 replicated vs 1x8 flat at "
+            f"{max(GRID_CLIENTS)} clients), replica shares "
+            f"{multislice['replica_routed']}, dcn byte share "
+            f"{multislice['dcn_byte_share']}, bit_identical="
+            f"{multislice['bit_identical']}")
 
         # Bit-identity vs the 1-device run: aggregate frames equal,
         # join pair counts + int64 key checksums equal.
@@ -437,6 +653,7 @@ def main():
             "bit_identical": bit_identical,
             "warm_h2d_chunks": {k: r["warm_h2d_chunks"]
                                 for k, r in rungs.items()},
+            "multislice": multislice,
         }
         log(f"co-bucketed SMJ walls {multichip['smj_wall_s']} -> "
             f"speedup {speedup} at {n_hi} devices; efficiency "

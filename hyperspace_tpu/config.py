@@ -113,6 +113,41 @@ class HyperspaceConf:
                 or "true").lower() == "true"
 
     @property
+    def distribution_slices(self) -> int:
+        """Number of slices (DCN rows) in the mesh topology.
+        `distribution.slices` is canonical; the original
+        `distribution.dcn.size` spelling is the legacy fallback."""
+        value = self.get(constants.DISTRIBUTION_SLICES)
+        if value is not None:
+            try:
+                return int(value)
+            except ValueError:
+                return constants.DISTRIBUTION_DCN_SIZE_DEFAULT
+        return self.get_int(constants.DISTRIBUTION_DCN_SIZE,
+                            constants.DISTRIBUTION_DCN_SIZE_DEFAULT)
+
+    @property
+    def distribution_replication(self) -> bool:
+        """Read replication across slices (`parallel/replica.py`): each
+        slice serves as a full replica and the scheduler routes queries
+        to the least-loaded one."""
+        return (self.get(constants.DISTRIBUTION_REPLICATION,
+                         constants.DISTRIBUTION_REPLICATION_DEFAULT)
+                or "true").lower() == "true"
+
+    @property
+    def distribution_replication_min_slices(self) -> int:
+        return self.get_int(
+            constants.DISTRIBUTION_REPLICATION_MIN_SLICES,
+            constants.DISTRIBUTION_REPLICATION_MIN_SLICES_DEFAULT)
+
+    @property
+    def distribution_replication_hot_fraction(self) -> float:
+        value = self.get(constants.DISTRIBUTION_REPLICATION_HOT_FRACTION)
+        return (float(value) if value is not None else
+                constants.DISTRIBUTION_REPLICATION_HOT_FRACTION_DEFAULT)
+
+    @property
     def distribution_capacity_factor(self) -> float:
         value = self.get(constants.DISTRIBUTION_CAPACITY_FACTOR)
         return (float(value) if value is not None

@@ -227,11 +227,43 @@ DISTRIBUTION_MIN_ROWS = "spark.hyperspace.distribution.min.rows"
 DISTRIBUTION_MIN_ROWS_DEFAULT = 4096
 # Multi-host topology: number of slices (DCN rows) in the mesh. 1 (the
 # default) = a flat single-axis ICI mesh; >1 builds a 2-axis
-# (dcn, shard) mesh whose build exchange routes hierarchically — the
-# heavy re-bucket all_to_all confined to the inner ICI axis, one
-# cross-slice hop over DCN (SURVEY §2.12 "DCN only across slices").
+# (dcn, shard) mesh whose exchanges route hierarchically — the heavy
+# re-bucket all_to_all confined to the inner ICI axis, one cross-slice
+# hop over DCN (SURVEY §2.12 "DCN only across slices"). This covers the
+# build exchange AND the in-program query-time repartitions
+# (`parallel/spmd._repartition_lanes` / `repartition_sharded`), whose
+# per-axis traffic is attributed as `spmd.repartition.{ici,dcn}.bytes`.
+# `distribution.slices` is the canonical knob; the original
+# `distribution.dcn.size` spelling is honored as a legacy fallback.
+DISTRIBUTION_SLICES = "spark.hyperspace.distribution.slices"
 DISTRIBUTION_DCN_SIZE = "spark.hyperspace.distribution.dcn.size"
 DISTRIBUTION_DCN_SIZE_DEFAULT = 1
+
+# Read replication across slices (`parallel/replica.py`): on a
+# multi-slice mesh, each slice is a full REPLICA — its devices hold the
+# whole bucket-range map at slice-local granularity — and the query
+# scheduler routes each admitted query's fills + execution to the
+# least-loaded replica slice (`serve.replica.*` series). Replicas are
+# coherent by construction: the segment cache keys device residency by
+# (index root, committed version, bucket range, device set), so a
+# version commit invalidates every slice's entries through the same FSM
+# hooks. "true" (default) replicates whenever the mesh has >= 2 slices.
+DISTRIBUTION_REPLICATION = \
+    "spark.hyperspace.distribution.replication.enabled"
+DISTRIBUTION_REPLICATION_DEFAULT = "true"
+# Minimum slice count before replica routing engages (below it the
+# whole mesh executes each query, the PR-10/13 behavior).
+DISTRIBUTION_REPLICATION_MIN_SLICES = \
+    "spark.hyperspace.distribution.replication.min.slices"
+DISTRIBUTION_REPLICATION_MIN_SLICES_DEFAULT = 2
+# Hot-bucket mining threshold: a bucket whose flight-ring access count
+# reaches this fraction of the hottest bucket's count is HOT — queries
+# over hot buckets fan to the least-loaded replica (so hot ranges end
+# up resident on >= 2 slices), while provably-cold-range queries pin to
+# their range's home slice so cold data is not duplicated across HBMs.
+DISTRIBUTION_REPLICATION_HOT_FRACTION = \
+    "spark.hyperspace.distribution.replication.hot.fraction"
+DISTRIBUTION_REPLICATION_HOT_FRACTION_DEFAULT = 0.5
 # Born-sharded SPMD execution (`parallel/spmd.py`): bucketed SMJ /
 # scan / aggregate over device-resident bucket-range shards as single
 # jitted programs. "true" (default) uses it whenever the shape

@@ -334,20 +334,32 @@ class ExpressionCompiler:
                 return xp.zeros(n, bool), None
             return folded
         if isinstance(e, E.Like):
-            # LIKE in DICTIONARY space: run the anchored pattern over the
-            # distinct values (host, O(dictionary)), then one vectorized
-            # code-membership test per row.
+            # LIKE in DICTIONARY space. Device lane: the per-dictionary
+            # membership bitmask comes from the segment cache
+            # (`parallel/spmd.string_like_mask` — host regex paid once
+            # per (dictionary, pattern), mask resident in HBM), so the
+            # row test is ONE take and a warm repeat is link-free
+            # instead of re-running the regex and shipping a fresh
+            # code list every evaluation. Host lane: numpy end to end,
+            # no device round-trip (the adaptive small-read path).
             import re as _re
             s = self.string_column(e.child)
             if s is None:
                 raise HyperspaceException(
                     f"LIKE requires a string operand: {e!r}")
-            rx = _re.compile(e.regex(), _re.DOTALL)
-            d = np.asarray(s.dictionary)
-            codes = np.nonzero([rx.fullmatch(str(v)) is not None
-                                for v in d])[0]
-            member = xp.isin(xp.asarray(s.data),
-                             xp.asarray(codes.astype(np.int32)))
+            if xp is not np and len(s.dictionary):
+                from hyperspace_tpu.parallel.spmd import string_like_mask
+                mask_d = string_like_mask(s, e.regex())
+                member = xp.take(xp.asarray(mask_d),
+                                 xp.clip(xp.asarray(s.data), 0,
+                                         len(s.dictionary) - 1))
+            else:
+                rx = _re.compile(e.regex(), _re.DOTALL)
+                d = np.asarray(s.dictionary)
+                codes = np.nonzero([rx.fullmatch(str(v)) is not None
+                                    for v in d])[0]
+                member = xp.isin(xp.asarray(s.data),
+                                 xp.asarray(codes.astype(np.int32)))
             if s.validity is None:
                 return member, None
             return member & s.validity, s.validity

@@ -126,13 +126,17 @@ class PhysicalNode:
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
         raise NotImplementedError
 
-    def execute_sharded(self, num_buckets: int, mesh):
+    def execute_sharded(self, num_buckets: int, mesh, align_plan=None):
         """Born-sharded execution (`parallel/spmd.py`): produce this
         node's output as a device-resident `ShardedBatch` whose shard s
         holds bucket range s, or None when the shape does not qualify
-        (unbucketed source, host-lane row counts, hot skew). None is a
-        ROUTING answer, not an error — callers fall back to the
-        single-chip paths. Default: not shardable."""
+        (unbucketed source, host-lane row counts). None is a ROUTING
+        answer, not an error — callers fall back to the single-chip
+        paths. Hot-bucket skew no longer declines: the scan splits the
+        hot range into virtual sub-shards (`spmd.subshard_plan`) and
+        stamps the split onto the batch; `align_plan` asks this side to
+        read ALIGNED to the other side's split (intersected buckets
+        replicated per covering shard). Default: not shardable."""
         return None
 
     def execute_bucketed(self, num_buckets: int):
@@ -244,6 +248,13 @@ class ScanExec(PhysicalNode):
             detail["buckets_scanned"] = (len(self.allowed_buckets)
                                          if self.allowed_buckets is not None
                                          else spec.num_buckets)
+            if self.allowed_buckets is not None \
+                    and len(self.allowed_buckets) <= 128:
+                # Per-bucket access identity for the replica router's
+                # hot-range miner (`parallel/replica.py`) — only when
+                # pruning narrowed the read (full-range scans carry no
+                # hotness signal) and small enough to ride the ring.
+                detail["bucket_ids"] = sorted(self.allowed_buckets)
         if files_total is not None:
             detail["files_total"] = files_total
         telemetry.annotate(**detail)
@@ -360,16 +371,25 @@ class ScanExec(PhysicalNode):
         return self._guard_index_read(
             lambda: self._execute_bucketed(num_buckets))
 
-    def execute_sharded(self, num_buckets: int, mesh):
+    def execute_sharded(self, num_buckets: int, mesh, align_plan=None):
         return self._guard_index_read(
-            lambda: self._execute_sharded(num_buckets, mesh))
+            lambda: self._execute_sharded(num_buckets, mesh,
+                                          align_plan=align_plan))
 
-    def _execute_sharded(self, num_buckets: int, mesh):
+    def _execute_sharded(self, num_buckets: int, mesh, align_plan=None):
         """Born-sharded bucket-range read: shard s's bucket range decodes
         and places onto DEVICE s through the per-device segment cache
         (per-bucket fill granularity), so each device's HBM holds only
         its range and a warm read is link-free per device. Returns a
-        ShardedBatch, or None when the read belongs on another lane."""
+        ShardedBatch, or None when the read belongs on another lane.
+
+        Hot-bucket skew (`pad_blowup`) splits the hot range into
+        VIRTUAL SUB-SHARDS instead of declining: equal row segments
+        whose cuts may fall inside a hot bucket (`spmd.plan_skew_read`),
+        stamped as `split_plan` so the join reads its other side
+        aligned. `align_plan` IS that other side's read: each shard
+        holds every row of the buckets intersecting the plan's segment
+        (split buckets replicated per covering shard)."""
         import numpy as np
 
         from hyperspace_tpu.parallel import spmd
@@ -409,24 +429,53 @@ class ScanExec(PhysicalNode):
             if total < max(min_dev, min_dist):
                 return None  # host / single-chip lane territory
         n_shards = total_shards(mesh)
-        if spmd.pad_blowup(lengths, n_shards):
-            # Hot-bucket skew: range padding would blow the [S*C]
-            # layout; the single-chip counting join's memory is bounded
-            # by true rows, so the read belongs on that lane.
-            return None
-        per_shard_files = [[f for b in range(lo, hi)
-                            for f in per_bucket.get(b, [])]
-                           for lo, hi in bucket_ranges(num_buckets,
-                                                       n_shards)]
         ref = segcache.segment_ref_for_scan(
             self.scan, allowed_buckets=self.allowed_buckets,
             bucketed=True)
+        budget = self._budget(device=True)
         self._annotate_read([f for _, f in ordered], host=False,
                             files_total=files_total)
-        return spmd.read_sharded(per_shard_files, lengths, self.columns,
-                                 self.scan.schema, mesh, base_ref=ref,
-                                 conf=self.conf,
-                                 budget=self._budget(device=True))
+        if align_plan is not None:
+            # The other side of a sub-shard join: intersected buckets
+            # replicated per covering shard. Decline when replication
+            # would itself blow the padded layout (both sides hot).
+            if (align_plan.num_buckets != num_buckets
+                    or align_plan.n_shards != n_shards):
+                return None
+            specs = spmd.plan_aligned_read(per_bucket, lengths,
+                                           align_plan)
+            C = max(1, max(spec[2] for spec in specs))
+            if C * n_shards > max(spmd.PAD_BLOWUP_FACTOR * total,
+                                  1 << 16):
+                return None
+            return spmd.read_sharded([], lengths, self.columns,
+                                     self.scan.schema, mesh,
+                                     base_ref=ref, conf=self.conf,
+                                     budget=budget, shard_specs=specs)
+        split_plan = None
+        shard_specs = None
+        per_shard_files = None
+        if spmd.pad_blowup(lengths, n_shards):
+            # Hot-bucket skew: whole-bucket ownership would pad the
+            # [S*C] layout past the blow-up bar — split the hot range
+            # into row-balanced virtual sub-shards and stay on the
+            # SPMD lane (the join reads its other side aligned).
+            split_plan, shard_specs = spmd.plan_skew_read(
+                per_bucket, lengths, n_shards)
+            telemetry.get_registry().counter(
+                "mesh.spmd.subshard_reads").inc()
+            telemetry.annotate(subsharded=True)
+        else:
+            per_shard_files = [[f for b in range(lo, hi)
+                                for f in per_bucket.get(b, [])]
+                               for lo, hi in bucket_ranges(num_buckets,
+                                                           n_shards)]
+        return spmd.read_sharded(per_shard_files or [], lengths,
+                                 self.columns, self.scan.schema, mesh,
+                                 base_ref=ref, conf=self.conf,
+                                 budget=budget,
+                                 shard_specs=shard_specs,
+                                 split_plan=split_plan)
 
     def _execute_bucketed(self, num_buckets: int):
         """Read all bucket files in bucket order; lengths come from parquet
@@ -532,14 +581,17 @@ class FilterExec(PhysicalNode):
         (indices,) = jnp.nonzero(mask, size=count, fill_value=0)
         return batch.take(indices), new_lengths
 
-    def execute_sharded(self, num_buckets: int, mesh):
+    def execute_sharded(self, num_buckets: int, mesh, align_plan=None):
         """Filter preserves the sharded layout: rows never move, the
         predicate mask just narrows `row_valid` — each device evaluates
         its shard, nothing crosses the link, and the downstream join /
         aggregate skips masked rows exactly as it skips padding. The
         per-bucket histogram is stale after filtering, so it is dropped
-        (capacity heuristics fall back to the overflow-retry loop)."""
-        sh = self.child.execute_sharded(num_buckets, mesh)
+        (capacity heuristics fall back to the overflow-retry loop); a
+        child's virtual-sub-shard split survives (row-local narrowing
+        cannot move rows across shards)."""
+        sh = self.child.execute_sharded(num_buckets, mesh,
+                                        align_plan=align_plan)
         if sh is None:
             return None
         from hyperspace_tpu.engine.compiler import compile_predicate
@@ -549,7 +601,7 @@ class FilterExec(PhysicalNode):
         mask = compile_predicate(self.condition, sh.batch)
         return ShardedBatch(sh.batch, sh.row_valid & mask, sh.mesh,
                             sh.rows_per_shard, sh.num_buckets,
-                            lengths=None)
+                            lengths=None, split_plan=sh.split_plan)
 
 
 class ProjectExec(PhysicalNode):
@@ -608,19 +660,21 @@ class ProjectExec(PhysicalNode):
         batch, lengths = self.child.execute_bucketed(num_buckets)
         return self._project(batch), lengths
 
-    def execute_sharded(self, num_buckets: int, mesh):
+    def execute_sharded(self, num_buckets: int, mesh, align_plan=None):
         """Pure column selection/renaming preserves the sharded layout
         (same rows, same residency); computed entries evaluate
         element-wise over the sharded columns, which XLA keeps
         shard-local."""
-        sh = self.child.execute_sharded(num_buckets, mesh)
+        sh = self.child.execute_sharded(num_buckets, mesh,
+                                        align_plan=align_plan)
         if sh is None:
             return None
         from hyperspace_tpu.parallel.spmd import ShardedBatch
         projected = self._project(sh.batch)
         return ShardedBatch(projected, sh.row_valid, sh.mesh,
                             sh.rows_per_shard, sh.num_buckets,
-                            lengths=sh.lengths)
+                            lengths=sh.lengths,
+                            split_plan=sh.split_plan)
 
 
 class ExchangeExec(PhysicalNode):
@@ -1186,13 +1240,48 @@ class SortMergeJoinExec(PhysicalNode):
         if self.num_buckets % mesh_size(mesh) != 0:
             spmd.spmd_fallback("bucket-count-indivisible")
             return None
+        # One device-queue scope for the whole sharded join (reads,
+        # match program, output assembly): on emulated meshes two
+        # concurrent multi-device programs over one device set can
+        # interleave into a collective-rendezvous deadlock; the
+        # reentrant per-device-set guard serializes them exactly as a
+        # real device queue would, while queries pinned to DISJOINT
+        # replica slices still run concurrently (no-op off CPU).
+        with spmd.dispatch_guard(mesh):
+            return self._run_spmd(mesh)
+
+    def _run_spmd(self, mesh) -> Optional[columnar.ColumnBatch]:
+        from hyperspace_tpu.parallel import spmd
+
         lsh = self.left.execute_sharded(self.num_buckets, mesh)
         if lsh is None:
             spmd.spmd_fallback("left-not-shardable")
             return None
-        rsh = self.right.execute_sharded(self.num_buckets, mesh)
+        align = lsh.split_plan
+        if align is not None:
+            # Hot-bucket skew on the left: the right side reads ALIGNED
+            # to the split (intersected buckets replicated per covering
+            # shard). Replication breaks unmatched-right uniqueness, so
+            # full_outer routes off the lane; membership/inner/left
+            # shapes are bit-identical (each left row lives on exactly
+            # one shard and meets every matching right row locally).
+            if self.how == "full_outer":
+                spmd.spmd_fallback("subshard-join-type")
+                return None
+            if self.how == "right_outer":
+                spmd.spmd_fallback("subshard-right-outer")
+                return None
+            rsh = self.right.execute_sharded(self.num_buckets, mesh,
+                                             align_plan=align)
+        else:
+            rsh = self.right.execute_sharded(self.num_buckets, mesh)
         if rsh is None:
             spmd.spmd_fallback("right-not-shardable")
+            return None
+        if align is None and rsh.split_plan is not None:
+            # Right-side-only skew: the counting layout would need the
+            # LEFT replicated; swapping sides is only sound for inner.
+            spmd.spmd_fallback("subshard-right")
             return None
         telemetry.annotate(lane="spmd")
         if self.how in ("left_semi", "left_anti"):

@@ -280,7 +280,7 @@ class BreakerBoard:
 
 class _QueryEntry:
     __slots__ = ("query_id", "deadline", "footprint", "session_id",
-                 "admitted")
+                 "admitted", "replica", "n_replicas")
 
     def __init__(self, query_id: str, deadline: Deadline, footprint: int,
                  session_id: Optional[int]):
@@ -289,6 +289,13 @@ class _QueryEntry:
         self.footprint = footprint
         self.session_id = session_id
         self.admitted = False
+        # Replica routing (`parallel/replica.py`): the slice this
+        # query's fills + execution are pinned to, or None. With a
+        # replica set, admission charges the PER-REPLICA budget
+        # (budget / n_replicas) so one hot replica cannot starve the
+        # others' admission headroom.
+        self.replica: Optional[int] = None
+        self.n_replicas: int = 0
 
 
 class QueryScheduler:
@@ -308,6 +315,12 @@ class QueryScheduler:
         self._ids = itertools.count(1)
         self.peak_admitted_bytes = 0
         self._breakers = BreakerBoard()
+        # Per-replica load (replica routing, `parallel/replica.py`):
+        # admitted bytes + in-flight counts keyed by replica slice.
+        # The router reads these to pick the least-loaded replica; the
+        # gauges `serve.replica.<i>.admitted_bytes` mirror them.
+        self._replica_bytes: Dict[int, int] = {}
+        self._replica_inflight: Dict[int, int] = {}
 
     # -- introspection ----------------------------------------------------
 
@@ -334,6 +347,16 @@ class QueryScheduler:
             return {"admitted_bytes": self._admitted_bytes,
                     "inflight": self._inflight,
                     "queue_depth": len(self._waiters)}
+
+    def replica_admitted_bytes(self) -> Dict[int, int]:
+        """Per-replica admitted bytes (the router's load signal)."""
+        with self._cv:
+            return dict(self._replica_bytes)
+
+    def replica_inflight(self) -> Dict[int, int]:
+        """Per-replica in-flight query counts (the router's tiebreak)."""
+        with self._cv:
+            return dict(self._replica_inflight)
 
     @property
     def breakers(self) -> BreakerBoard:
@@ -391,16 +414,26 @@ class QueryScheduler:
         except Exception:
             return 0
 
-    def _fits(self, footprint: int, budget: int) -> bool:
+    def _fits(self, ent: "_QueryEntry", budget: int) -> bool:
         # Caller holds the cv lock. Progress guarantee: with nothing in
         # flight a query larger than the whole budget still admits —
         # the budget bounds CONCURRENCY, it must never wedge serving.
         if self._inflight == 0:
             return True
+        if ent.replica is not None and ent.n_replicas > 1:
+            # Per-replica admission: the query charges its SLICE's
+            # share of the budget, with the same per-replica progress
+            # guarantee — an idle replica always admits.
+            if self._replica_inflight.get(ent.replica, 0) == 0:
+                return True
+            per = budget // ent.n_replicas
+            if self._replica_bytes.get(ent.replica, 0) \
+                    + ent.footprint > per:
+                return False
         live = self._live_device_bytes()
         used = max(self._admitted_bytes,
                    live - self._idle_baseline if live else 0)
-        return used + footprint <= budget
+        return used + ent.footprint <= budget
 
     def _admit(self, ent: _QueryEntry, conf) -> float:
         """Admit `ent` (blocking in FIFO order when over budget).
@@ -413,7 +446,7 @@ class QueryScheduler:
         budget = conf.serve_hbm_budget_bytes if conf is not None else 0
         with self._cv:
             if budget <= 0 or (not self._waiters
-                               and self._fits(ent.footprint, budget)):
+                               and self._fits(ent, budget)):
                 self._grant(ent, reg)
                 reg.histogram("serve.queue_wait_s").observe(0.0)
                 return 0.0
@@ -432,7 +465,7 @@ class QueryScheduler:
             reg.gauge("serve.queue_depth").set(len(self._waiters))
             try:
                 while not (self._waiters[0] is ent
-                           and self._fits(ent.footprint, budget)):
+                           and self._fits(ent, budget)):
                     ent.deadline.check("queue")
                     rem = ent.deadline.remaining()
                     self._cv.wait(timeout=(_WAIT_QUANTUM_S if rem is None
@@ -461,6 +494,14 @@ class QueryScheduler:
         reg.counter("serve.admitted").inc()
         reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
         reg.gauge("serve.active").set(self._inflight)
+        if ent.replica is not None:
+            r = ent.replica
+            self._replica_bytes[r] = (self._replica_bytes.get(r, 0)
+                                      + ent.footprint)
+            self._replica_inflight[r] = \
+                self._replica_inflight.get(r, 0) + 1
+            reg.gauge(f"serve.replica.{r}.admitted_bytes").set(
+                self._replica_bytes[r])
 
     def _credit(self, ent: _QueryEntry, nbytes: int) -> int:
         """Footprint credit for already-HBM-resident bytes: once the
@@ -485,6 +526,12 @@ class QueryScheduler:
             reg = telemetry.get_registry()
             reg.counter("serve.footprint_credit_bytes").inc(delta)
             reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
+            if ent.replica is not None:
+                r = ent.replica
+                self._replica_bytes[r] = max(
+                    0, self._replica_bytes.get(r, 0) - delta)
+                reg.gauge(f"serve.replica.{r}.admitted_bytes").set(
+                    self._replica_bytes[r])
             self._cv.notify_all()
         return delta
 
@@ -495,11 +542,21 @@ class QueryScheduler:
             if ent.admitted:
                 self._admitted_bytes -= ent.footprint
                 self._inflight -= 1
+                if ent.replica is not None:
+                    r = ent.replica
+                    self._replica_bytes[r] = max(
+                        0, self._replica_bytes.get(r, 0) - ent.footprint)
+                    self._replica_inflight[r] = max(
+                        0, self._replica_inflight.get(r, 0) - 1)
+                    reg.gauge(f"serve.replica.{r}.admitted_bytes").set(
+                        self._replica_bytes[r])
                 if self._inflight == 0:
                     # Re-anchor: bookkeeping drift cannot accumulate,
                     # and the idle baseline tracks resident caches so
                     # `_fits` charges queries only for QUERY memory.
                     self._admitted_bytes = 0
+                    self._replica_bytes.clear()
+                    self._replica_inflight.clear()
                     self._idle_baseline = self._live_device_bytes()
                 reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
                 reg.gauge("serve.active").set(self._inflight)
@@ -620,6 +677,22 @@ class QueryScheduler:
         ent = _QueryEntry(query_id, deadline,
                           _footprint.projected_bytes(df.plan),
                           id(session) if session is not None else None)
+        # Replica routing (`parallel/replica.py`): on a multi-slice
+        # topology with replication on, pin this query's fills +
+        # execution to the least-loaded replica slice (cold-range
+        # queries pin to their home slice). Routed BEFORE admission so
+        # the per-replica budget charges the right slice; routing must
+        # never fail a query.
+        try:
+            from hyperspace_tpu.parallel import replica as _replica
+            from hyperspace_tpu.parallel.context import topology
+            rep = _replica.get_router().route(df.plan, conf, self)
+            if rep is not None:
+                topo = topology(conf)
+                ent.replica = rep
+                ent.n_replicas = topo[0] if topo is not None else 0
+        except Exception:
+            logger.debug("replica routing skipped", exc_info=True)
         description = ", ".join(df.schema.names[:6])
         metrics = telemetry.QueryMetrics(description=description)
         metrics.query_id = query_id  # cancel/log correlation handle
@@ -695,8 +768,22 @@ class QueryScheduler:
                         batch = batcher.get_batcher().try_collect(
                             df, plan, metrics, conf, deadline, self)
                     if batch is None:
-                        batch = self._execute_resilient(df, plan,
-                                                        metrics, conf)
+                        # Replica-pinned execution: under the scope,
+                        # every distribution decision (fills, SPMD
+                        # programs) sees the routed slice's flat
+                        # submesh. The batched lane above is exempt by
+                        # design — its one invocation already serves
+                        # the whole cohort.
+                        from hyperspace_tpu.parallel.context import \
+                            replica_scope
+                        if ent.replica is not None:
+                            metrics.event("serve", "replica",
+                                          query_id=query_id,
+                                          replica=ent.replica)
+                        with replica_scope(ent.replica):
+                            batch = self._execute_resilient(df, plan,
+                                                            metrics,
+                                                            conf)
                     if not batch.is_host:
                         # Query-end HBM watermark, FORCED (throttling
                         # may have swallowed every span-boundary sample

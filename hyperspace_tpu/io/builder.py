@@ -411,28 +411,40 @@ SHARD_LAYOUT_FILE = "_shard_layout.json"
 
 
 def write_shard_layout(path: str, num_buckets: int, n_shards: int,
-                       dictionaries=None) -> dict:
+                       dictionaries=None, n_slices: int = 1) -> dict:
     """Persist the born-sharded layout record next to the bucket spec:
     which contiguous bucket range each device shard owns (THE map,
     `parallel/mesh.bucket_ranges`) and — for string columns — each
     range's sorted local dictionary (`dictionaries`: {column: [values
     per shard | None]}; None marks a range past the
     `distribution.dictionary.max.entries` cap, which the reader derives
-    from parquet instead). `stamp_stats` lifts the record (dictionaries
+    from parquet instead). Version 3 records the (slice, device)
+    HIERARCHY of multi-slice builds: `numSlices` and the slice-level
+    `sliceBucketRanges` (which nest exactly over the flat shard map,
+    `parallel/mesh.slice_bucket_ranges`), so a reader can route
+    per-slice replica fills or cross-slice repartitions without
+    rederiving the topology; a flat build records the degenerate
+    1-slice hierarchy. `stamp_stats` lifts the record (dictionaries
     summarized to entry counts) into the index log entry so a reader
     knows the build's shard shape without walking the data dir."""
     import json
 
-    from hyperspace_tpu.parallel.mesh import bucket_ranges
+    from hyperspace_tpu.parallel.mesh import (bucket_ranges,
+                                              slice_bucket_ranges)
     from hyperspace_tpu.utils import file_utils, storage
 
+    n_slices = max(1, int(n_slices))
     layout = {
-        "version": 2,
+        "version": 3,
         "numBuckets": num_buckets,
         "numShards": n_shards,
+        "numSlices": n_slices,
         "bucketRanges": [[lo, hi]
                          for lo, hi in bucket_ranges(num_buckets,
                                                      n_shards)],
+        "sliceBucketRanges": [
+            [lo, hi] for lo, hi in slice_bucket_ranges(
+                num_buckets, n_slices, n_shards // n_slices)],
     }
     if dictionaries:
         layout["dictionaries"] = dictionaries
@@ -544,7 +556,8 @@ def write_bucket_ordered(batch: columnar.ColumnBatch, lengths,
         write_range(0, num_buckets, 0, file_suffix)
         return written
 
-    from hyperspace_tpu.parallel.mesh import bucket_ranges, total_shards
+    from hyperspace_tpu.parallel.mesh import (bucket_ranges, dcn_size,
+                                              total_shards)
 
     n_shards = total_shards(mesh)
     offset = 0
@@ -558,7 +571,8 @@ def write_bucket_ordered(batch: columnar.ColumnBatch, lengths,
     dictionaries = _range_dictionaries(table, batch.schema, lengths,
                                        num_buckets, n_shards, cap)
     write_shard_layout(path, num_buckets, n_shards,
-                       dictionaries=dictionaries)
+                       dictionaries=dictionaries,
+                       n_slices=dcn_size(mesh))
     return written
 
 

@@ -823,6 +823,33 @@ class SegmentCache:
             _mem.cache_eviction("segments.host", host_dropped)
         return rekeyed
 
+    def replica_residency(self, index_root: Optional[str] = None) -> dict:
+        """{device tag: resident per-device shard entry count} over the
+        born-sharded (spmd) entries, optionally restricted to one index
+        root — the replica-coverage introspection: a bucket range hot
+        enough that concurrent traffic filled it on two slices shows up
+        here as two device tags covering the same root, and replica
+        coherence tests assert the version hooks sweep EVERY tag.
+        Device tags come from the spmd key component
+        (`parallel/mesh.mesh_device_tag`); non-spmd entries are not
+        counted."""
+        out: dict = {}
+        with self._cv:
+            for key, ent in self._entries.items():
+                if index_root is not None and (
+                        ent.ref is None
+                        or ent.ref.index_root
+                        != index_root.rstrip("/\\")):
+                    continue
+                for part in key:
+                    if (isinstance(part, tuple) and part
+                            and part[0] in ("spmd", "spmd-sub")
+                            and isinstance(part[-1], tuple)):
+                        tag = part[-1]
+                        out[tag] = out.get(tag, 0) + 1
+                        break
+        return out
+
     def invalidate_index(self, index_root: str,
                          keep_version: Optional[int] = None) -> int:
         """Drop every cached segment of the index rooted at

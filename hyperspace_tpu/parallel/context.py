@@ -12,13 +12,47 @@ jax devices. `spark.hyperspace.distribution.enabled`:
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from typing import Optional
 
 from hyperspace_tpu import constants
 
+# Replica scope: when a query has been routed to a replica slice
+# (`parallel/replica.py` via the scheduler), every distribution decision
+# under the scope sees THAT slice's flat submesh instead of the full
+# multi-slice mesh — fills land on the slice's devices, the SPMD
+# programs run over the slice, and the flat (PR-13) execution path
+# applies verbatim. A contextvar so the scope follows the query across
+# `telemetry.propagating` pool threads like the recorder/deadline do.
+_replica_slice: contextvars.ContextVar = contextvars.ContextVar(
+    "hs_replica_slice", default=None)
 
-def distribution_mesh(conf=None):
-    """The mesh to distribute over, or None for single-chip execution."""
+
+def active_replica() -> Optional[int]:
+    """The replica slice index the current context is pinned to, or
+    None (execute over the full mesh)."""
+    return _replica_slice.get()
+
+
+@contextlib.contextmanager
+def replica_scope(slice_idx: Optional[int]):
+    """Pin distribution decisions in this context to replica
+    `slice_idx` (None = no pin; the scope is then a no-op)."""
+    if slice_idx is None:
+        yield
+        return
+    token = _replica_slice.set(int(slice_idx))
+    try:
+        yield
+    finally:
+        _replica_slice.reset(token)
+
+
+def topology(conf=None):
+    """(n_slices, n_ici) of the configured topology, or None when fewer
+    than two devices are visible / distribution is off. n_slices folds
+    back to 1 when the knob does not divide the device count."""
     mode = conf.distribution if conf is not None else "auto"
     if mode == "false":
         return None
@@ -28,22 +62,38 @@ def distribution_mesh(conf=None):
         devices = jax.devices()
     except RuntimeError:
         return None
-    if len(devices) < 2:
+    n = len(devices)
+    if n < 2:
         return None
-    from hyperspace_tpu.parallel.mesh import make_mesh
-
-    dcn = (conf.get_int(constants.DISTRIBUTION_DCN_SIZE,
-                        constants.DISTRIBUTION_DCN_SIZE_DEFAULT)
-           if conf is not None
-           else constants.DISTRIBUTION_DCN_SIZE_DEFAULT)
-    if dcn > 1 and len(devices) % dcn != 0:
+    slices = (conf.distribution_slices if conf is not None
+              else constants.DISTRIBUTION_DCN_SIZE_DEFAULT)
+    if slices > 1 and n % slices != 0:
         import logging
         logging.getLogger(__name__).warning(
-            "distribution.dcn.size=%d does not divide the %d visible "
-            "devices; falling back to a FLAT mesh — build re-bucket "
-            "collectives will span DCN.", dcn, len(devices))
-        dcn = 1
-    return make_mesh(len(devices), dcn_size=dcn if dcn > 1 else None)
+            "distribution.slices=%d does not divide the %d visible "
+            "devices; falling back to a FLAT mesh — re-bucket "
+            "collectives will span DCN.", slices, n)
+        slices = 1
+    slices = max(1, slices)
+    return slices, n // slices
+
+
+def distribution_mesh(conf=None):
+    """The mesh to distribute over, or None for single-chip execution.
+    Under an active replica scope on a multi-slice topology, the
+    pinned slice's FLAT submesh is returned — the one seam through
+    which replica routing confines a query's fills and execution."""
+    topo = topology(conf)
+    if topo is None:
+        return None
+    slices, ici = topo
+    from hyperspace_tpu.parallel.mesh import make_mesh, slice_submesh
+
+    mesh = make_mesh(slices * ici, dcn_size=slices if slices > 1 else None)
+    replica = active_replica()
+    if replica is not None and slices > 1:
+        return slice_submesh(mesh, replica % slices)
+    return mesh
 
 
 def mesh_size(mesh) -> int:

@@ -104,6 +104,49 @@ def dcn_size(mesh) -> int:
     return mesh.shape.get(DCN_AXIS, 1)
 
 
+def ici_size(mesh) -> int:
+    """Devices per slice (the inner ICI axis; the whole mesh when
+    flat)."""
+    return mesh.shape.get(SHARD_AXIS, total_shards(mesh))
+
+
+def slice_of_shard(shard: int, n_ici: int) -> int:
+    """Owning slice of flat shard `shard` under row-major (dcn, shard)
+    flat order."""
+    return shard // n_ici
+
+
+def slice_submesh(mesh, idx: int):
+    """Flat 1-axis submesh over slice `idx`'s devices — THE replica
+    execution mesh: with replication on, a query routed to slice `idx`
+    runs the whole born-sharded pipeline over this submesh exactly as a
+    single-slice deployment would (`bucket_ranges(B, n_ici)` over the
+    slice's devices), so replica execution is the degenerate flat case
+    by construction. On a flat mesh only slice 0 exists and the mesh is
+    returned as-is."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    grid = np.asarray(mesh.devices)
+    if grid.ndim == 1:
+        if idx != 0:
+            raise ValueError(f"flat mesh has one slice; asked for {idx}")
+        return mesh
+    if not 0 <= idx < grid.shape[0]:
+        raise ValueError(
+            f"slice {idx} out of range for a {grid.shape[0]}-slice mesh")
+    return Mesh(grid[idx], (SHARD_AXIS,))
+
+
+def mesh_device_tag(mesh) -> tuple:
+    """Stable identity of the mesh's device set in flat shard order —
+    the replica discriminator in per-device segment-cache keys: two
+    slices of one topology hold the SAME bucket ranges on DIFFERENT
+    devices, and their cached shards must never alias."""
+    return tuple(int(getattr(d, "id", i))
+                 for i, d in enumerate(mesh_device_list(mesh)))
+
+
 def row_spec(mesh):
     """PartitionSpec splitting axis 0 across ALL mesh axes — THE row
     sharding used by every parallel operator (build/join/aggregate/scan)."""
@@ -145,6 +188,19 @@ def bucket_owner(bucket, num_buckets: int, n_shards: int):
     under the contiguous-range map — the exact inverse of
     `bucket_ranges`."""
     return bucket * n_shards // num_buckets
+
+
+def slice_bucket_ranges(num_buckets: int, n_slices: int, n_ici: int):
+    """[(lo, hi)) bucket range per SLICE of an (n_slices x n_ici)
+    topology. The hierarchy nests exactly: because flat shard
+    `s = d * n_ici + i` owns `[ceil(s*B/n), ...)` with
+    `n = n_slices * n_ici`, slice d's union of its shards' ranges is
+    `[ceil(d*B/n_slices), ceil((d+1)*B/n_slices))` — i.e. the slice-level
+    map IS `bucket_ranges(B, n_slices)`, so a slice-granular record
+    (layout v3, replica residency) and the flat shard map can never
+    disagree."""
+    del n_ici  # the identity above makes the inner size irrelevant
+    return bucket_ranges(num_buckets, n_slices)
 
 
 def shard_row_segments(lengths, n_shards: int):

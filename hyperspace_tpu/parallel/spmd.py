@@ -80,10 +80,12 @@ import hyperspace_tpu._jax_config  # noqa: F401
 from hyperspace_tpu.exceptions import HyperspaceException
 from hyperspace_tpu.io.columnar import ColumnBatch, DeviceColumn
 from hyperspace_tpu.ops import keys as keymod
-from hyperspace_tpu.parallel.mesh import (SHARD_AXIS, assemble_sharded_rows,
+from hyperspace_tpu.parallel.mesh import (DCN_AXIS, SHARD_AXIS,
+                                          assemble_sharded_rows,
                                           bucket_owner, bucket_ranges,
                                           compat_shard_map, dcn_size,
-                                          mesh_device_list, row_spec,
+                                          ici_size, mesh_device_list,
+                                          mesh_device_tag, row_spec,
                                           shard_row_segments, shard_rows,
                                           total_shards)
 
@@ -114,6 +116,12 @@ class ShardedBatch:
     rows_per_shard: int         # C
     num_buckets: int
     lengths: Optional[np.ndarray] = None
+    # Virtual sub-shards (hot-bucket skew): set when the layout was
+    # row-balanced INSIDE hot buckets instead of bucket-aligned — keys
+    # no longer co-locate per shard, so a join over this side must read
+    # its other side ALIGNED to this plan (hot buckets replicated onto
+    # every covering shard). None = the canonical bucket-range layout.
+    split_plan: Optional["SubshardPlan"] = None
 
     @property
     def n_shards(self) -> int:
@@ -180,11 +188,118 @@ def count_string_predicate_lookups(expression, batch: ColumnBatch) -> None:
 def pad_blowup(lengths, n_shards: int) -> bool:
     """True when per-shard padding to the hottest shard's row count
     would blow the [S*C] layout far past the true rows (the caller
-    falls back to the single-chip counting join)."""
+    splits the hot range into virtual sub-shards — `subshard_plan` —
+    or falls back to the single-chip counting join)."""
     segs = shard_row_segments(lengths, n_shards)
     C = max(1, max(e - s for s, e in segs))
     rows = int(np.asarray(lengths).sum())
     return C * n_shards > max(PAD_BLOWUP_FACTOR * rows, 1 << 16)
+
+
+# ---------------------------------------------------------------------------
+# Virtual sub-shards: hot-bucket skew without leaving the SPMD lane
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SubshardPlan:
+    """Row-balanced virtual sub-shards over a skewed bucket histogram.
+
+    When one bucket range is hot enough that whole-bucket ownership
+    would pad the [S*C] layout past `PAD_BLOWUP_FACTOR`x the true rows,
+    the skewed side's bucket-ordered row space is cut into EQUAL row
+    segments instead — cuts may fall inside a hot bucket, so a hot
+    bucket's rows span several consecutive shards (the hierarchical
+    range map makes this representation free: segments are just row
+    intervals, exactly like `shard_row_segments`' output).
+
+    Splitting breaks per-shard key co-location, so a join over the
+    split side reads its OTHER side aligned to this plan:
+    `bucket_spans[s]` is the contiguous bucket interval intersecting
+    shard s's row segment, and the aligned read places ALL of those
+    buckets' rows on shard s — a split bucket's other-side rows are
+    REPLICATED onto every shard covering part of it. Each split-side
+    row then meets every matching row locally and lives on exactly one
+    shard, so inner/left_outer/semi/anti results are bit-identical to
+    the unsplit join (full_outer needs unmatched-RIGHT uniqueness and
+    stays off this lane)."""
+
+    num_buckets: int
+    n_shards: int
+    segments: tuple      # per-shard (row_lo, row_hi) into the row space
+    bucket_spans: tuple  # per-shard (b_lo, b_hi) intersecting buckets
+
+
+def subshard_plan(lengths, n_shards: int) -> SubshardPlan:
+    """The deterministic split plan for a skewed histogram: equal row
+    segments (±1) with their covering bucket intervals."""
+    lengths = np.asarray(lengths, dtype=np.int64)
+    total = int(lengths.sum())
+    per = -(-max(total, 1) // n_shards)
+    cum = np.concatenate([[0], np.cumsum(lengths)])
+    segments = []
+    spans = []
+    for s in range(n_shards):
+        lo, hi = min(s * per, total), min((s + 1) * per, total)
+        segments.append((lo, hi))
+        if hi <= lo:
+            spans.append((0, 0))
+            continue
+        # buckets b with cum[b] < hi and cum[b+1] > lo
+        b_lo = int(np.searchsorted(cum, lo, side="right")) - 1
+        b_hi = int(np.searchsorted(cum, hi, side="left"))
+        spans.append((max(b_lo, 0), min(b_hi, len(lengths))))
+    return SubshardPlan(len(lengths), n_shards, tuple(segments),
+                        tuple(spans))
+
+
+def _file_cuts(per_bucket: dict, num_buckets: int):
+    """Ordered (bucket, file, rows) over the bucket-ordered file list
+    plus the cumulative row offsets — the geometry both sub-shard read
+    planners slice against. Row counts come from parquet footers."""
+    from hyperspace_tpu.io import parquet
+
+    ordered = [(b, f) for b in range(num_buckets)
+               for f in per_bucket.get(b, [])]
+    counts = parquet.file_row_counts([f for _, f in ordered])
+    cum = np.concatenate([[0], np.cumsum(np.asarray(counts,
+                                                    dtype=np.int64))])
+    return ordered, counts, cum
+
+
+def plan_skew_read(per_bucket: dict, lengths, n_shards: int):
+    """(plan, shard_specs) for the SKEWED side: each shard s reads rows
+    [lo, hi) of the bucket-ordered file list — the covering files plus
+    a (skip, take) window so a file holding a cut boundary decodes once
+    per touching shard but ships only its slice."""
+    lengths = np.asarray(lengths, dtype=np.int64)
+    plan = subshard_plan(lengths, n_shards)
+    ordered, counts, cum = _file_cuts(per_bucket, len(lengths))
+    specs = []
+    for lo, hi in plan.segments:
+        if hi <= lo:
+            specs.append(((), 0, 0))
+            continue
+        f_lo = int(np.searchsorted(cum, lo, side="right")) - 1
+        f_hi = int(np.searchsorted(cum, hi, side="left"))
+        files = tuple(f for _b, f in ordered[f_lo:f_hi])
+        specs.append((files, lo - int(cum[f_lo]), hi - lo))
+    return plan, specs
+
+
+def plan_aligned_read(per_bucket: dict, lengths, plan: SubshardPlan):
+    """shard_specs for the side ALIGNED to a split plan: shard s holds
+    every row of the buckets intersecting the plan's shard-s segment —
+    buckets on a cut boundary are replicated onto each covering
+    shard."""
+    lengths = np.asarray(lengths, dtype=np.int64)
+    cum = np.concatenate([[0], np.cumsum(lengths)])
+    specs = []
+    for b_lo, b_hi in plan.bucket_spans:
+        files = tuple(f for b in range(b_lo, b_hi)
+                      for f in per_bucket.get(b, []))
+        specs.append((files, 0, int(cum[b_hi] - cum[b_lo])))
+    return specs
 
 
 # ---------------------------------------------------------------------------
@@ -375,24 +490,52 @@ def _remap_to_global(host: ColumnBatch, global_dicts: dict) -> ColumnBatch:
 
 def read_sharded(per_shard_files: List[List[str]], lengths,
                  columns: Sequence[str], schema, mesh,
-                 base_ref=None, conf=None, budget=None) -> ShardedBatch:
+                 base_ref=None, conf=None, budget=None,
+                 shard_specs=None,
+                 split_plan: Optional[SubshardPlan] = None
+                 ) -> ShardedBatch:
     """Born-sharded read: each flat shard s's bucket-range files decode
     and place onto DEVICE s through the per-device segment cache
     (per-bucket-range fill granularity — the PR-8 "remaining on this
     axis" item). A warm read touches neither parquet nor the link: the
     cached per-device padded shards assemble into the global sharded
-    arrays with zero data movement."""
+    arrays with zero data movement. Cache keys carry the mesh's DEVICE
+    TAG: two replica slices of one topology hold the same ranges on
+    different devices and must never alias each other's entries.
+
+    `shard_specs` overrides the canonical whole-bucket segmentation
+    with explicit per-shard (files, skip_rows, n_rows) windows — the
+    virtual-sub-shard lanes (`plan_skew_read` / `plan_aligned_read`);
+    `split_plan` is stamped onto the result so the join knows the
+    layout is row-balanced, not bucket-aligned."""
     from hyperspace_tpu import telemetry
     from hyperspace_tpu.io import segcache
 
     lengths = np.asarray(lengths, dtype=np.int64)
     n_shards = total_shards(mesh)
-    segs = shard_row_segments(lengths, n_shards)
-    C = max(1, max(e - s for s, e in segs))
+    if shard_specs is None:
+        segs = shard_row_segments(lengths, n_shards)
+        ranges = bucket_ranges(len(lengths), n_shards)
+        shard_specs = [(tuple(per_shard_files[s]), 0, segs[s][1] - segs[s][0])
+                       for s in range(n_shards)]
+        key_tags = [("spmd", ranges[s][0], ranges[s][1], n_shards)
+                    for s in range(n_shards)]
+        out_lengths = lengths
+        windowed = False
+    else:
+        if len(shard_specs) != n_shards:
+            raise HyperspaceException(
+                f"shard_specs covers {len(shard_specs)} shards; the mesh "
+                f"has {n_shards}.")
+        key_tags = [("spmd-sub", spec[1], spec[2], n_shards, s)
+                    for s, spec in enumerate(shard_specs)]
+        out_lengths = None
+        windowed = True
+    C = max(1, max(spec[2] for spec in shard_specs))
     devices = mesh_device_list(mesh)
+    dev_tag = mesh_device_tag(mesh)
     cols = tuple(columns)
     schema_json = schema.to_json()
-    ranges = bucket_ranges(len(lengths), n_shards)
     cache = segcache.get_cache()
 
     out_schema = schema.select(cols)
@@ -403,23 +546,25 @@ def read_sharded(per_shard_files: List[List[str]], lengths,
         # One global sorted dictionary per string column (version-keyed
         # cached): per-shard fills remap their local codes into it on
         # the host, so the cached device lanes are globally comparable.
-        global_dicts = _resolve_global_dicts(per_shard_files, str_fields,
+        all_files = list(dict.fromkeys(
+            f for spec in shard_specs for f in spec[0]))
+        global_dicts = _resolve_global_dicts([all_files], str_fields,
                                              schema, base_ref, conf,
                                              budget, cache)
 
     def fill_one(s: int):
-        rows = segs[s][1] - segs[s][0]
+        files, skip, rows = shard_specs[s]
 
         def fill():
-            return _fill_device_shard(per_shard_files[s], cols, schema,
+            return _fill_device_shard(list(files), cols, schema,
                                       rows, C, devices[s],
-                                      global_dicts=global_dicts)
+                                      global_dicts=global_dicts,
+                                      skip=skip, windowed=windowed)
 
         if base_ref is None:
             return fill()[0]
-        key = base_ref.key + (
-            ("spmd", ranges[s][0], ranges[s][1], n_shards, C),
-            cols, schema_json)
+        key = base_ref.key + (key_tags[s] + (C, dev_tag),
+                              cols, schema_json)
         return cache.get_or_fill(key, fill, ref=base_ref, conf=conf,
                                  budget=budget)
 
@@ -456,11 +601,11 @@ def read_sharded(per_shard_files: List[List[str]], lengths,
                                            dict_hashes=dict_hashes)
     row_valid = assemble_sharded_rows(
         mesh, [_on_device(devices[s],
-                          partial(_valid_mask, segs[s][1] - segs[s][0], C))
+                          partial(_valid_mask, shard_specs[s][2], C))
                for s in range(n_shards)])
     flat = ColumnBatch(out_schema, columns_out)
     return ShardedBatch(flat, row_valid, mesh, C, len(lengths),
-                        lengths=lengths)
+                        lengths=out_lengths, split_plan=split_plan)
 
 
 _pool = None
@@ -517,13 +662,17 @@ def _shard_validity(shard: dict, name: str, C: int, device):
 
 
 def _fill_device_shard(files: List[str], cols, schema, rows: int, C: int,
-                       device, global_dicts=None) -> Tuple[dict, int]:
+                       device, global_dicts=None, skip: int = 0,
+                       windowed: bool = False) -> Tuple[dict, int]:
     """Cold fill of one device's bucket range: parquet decode, pad to
     the common per-shard capacity on the host, place every column onto
     THIS device through the transfer engine's fill lane. String columns
     decode to their LOCAL per-range dictionary and remap to the global
     codes on the host (`_remap_to_global`) — only int32 code lanes ever
-    cross the link. Returns (payload, resident bytes)."""
+    cross the link. A virtual-sub-shard window (`skip` > 0 or `rows`
+    short of the decoded count) slices the decoded table before
+    staging, so a hot bucket split across shards ships each shard only
+    its slice. Returns (payload, resident bytes)."""
     from hyperspace_tpu.io import parquet, transfer
 
     out_schema = schema.select(cols)
@@ -542,10 +691,14 @@ def _fill_device_shard(files: List[str], cols, schema, rows: int, C: int,
         return payload, _payload_nbytes(payload)
 
     table = parquet.read_table(files, columns=list(cols))
-    if table.num_rows != rows:
+    if table.num_rows < skip + rows or (not windowed
+                                        and table.num_rows != rows):
         raise HyperspaceException(
-            f"Born-sharded read expected {rows} rows, decoded "
-            f"{table.num_rows} — footer metadata and data disagree.")
+            f"Born-sharded read expected {rows} rows (skip {skip}), "
+            f"decoded {table.num_rows} — footer metadata and data "
+            f"disagree.")
+    if skip or table.num_rows != rows:
+        table = table.slice(skip, rows)
     from hyperspace_tpu.io import columnar
     host = columnar.from_arrow(table, out_schema, device=False)
     if global_dicts:
@@ -650,6 +803,58 @@ def string_remap_tables(lcol: DeviceColumn, rcol: DeviceColumn,
     return payload["l"], payload["r"]
 
 
+def string_like_mask(col: DeviceColumn, pattern_regex: str, conf=None):
+    """THE device-side LIKE lane for dictionary-encoded strings: a
+    boolean membership mask over the column's sorted dictionary —
+    mask[code] == pattern matches dictionary[code] — computed ONCE on
+    the host (anchored regex over the distinct values, O(dictionary)),
+    shipped over the link once, and cached content-keyed in the segment
+    cache exactly like the PR-13 rank-remap tables. The jitted filter
+    program then evaluates LIKE as one `take(mask, codes)` — warm
+    repeats serve the mask straight from HBM
+    (`spmd.strings.like_mask_cache_hits`) with zero host regex work and
+    zero link traffic, instead of round-tripping every evaluation
+    through the generic host regex + fresh code-list H2D."""
+    import re as _re
+
+    from hyperspace_tpu import telemetry
+    from hyperspace_tpu.io import segcache, transfer
+
+    key = ("spmd-like", _dict_fingerprint(col.dictionary), pattern_regex)
+    filled: List[bool] = []
+
+    def fill():
+        filled.append(True)
+        rx = _re.compile(pattern_regex, _re.DOTALL)
+        d = np.asarray(col.dictionary)
+        mask = np.asarray([rx.fullmatch(str(v)) is not None for v in d],
+                          dtype=bool)
+        return {"mask": mask}, max(int(mask.nbytes), 1)
+
+    payload = segcache.get_cache().get_or_fill(key, fill, conf=conf)
+    if not filled:
+        telemetry.get_registry().counter(
+            "spmd.strings.like_mask_cache_hits").inc()
+    dev = payload.get("dev")
+    if dev is not None:
+        return dev  # a CONCRETE cached array is a safe trace constant
+    import jax
+
+    try:
+        tracing = not jax.core.trace_state_clean()
+    except Exception:
+        tracing = True
+    if tracing:
+        # Inside a jit trace the engine's chunked put would itself be
+        # TRACED and the resulting tracer would escape into the cache
+        # (a leak); the host mask constant-folds into the program
+        # instead, and the next eager caller promotes it below.
+        return payload["mask"]
+    dev = transfer.get_engine().put(payload["mask"])
+    payload["dev"] = dev
+    return dev
+
+
 def _string_key_plan(left: "ShardedBatch", right: "ShardedBatch",
                      left_keys: Sequence[str],
                      right_keys: Sequence[str], need_hashes: bool,
@@ -703,12 +908,15 @@ def _side_lane_chain(datas):
     return lanes
 
 
-def _route_local(arrs, dest, n_peers: int, capacity: int):
+def _route_local(arrs, dest, n_peers: int, capacity: int,
+                 axis: str = SHARD_AXIS):
     """Route local rows to their destination peers through ONE
-    all_to_all over the shard axis (shard_map-local shapes): stable sort
-    by dest, scatter into the [n_peers, capacity] send buffer, swap.
+    all_to_all over the named mesh `axis` (shard_map-local shapes):
+    stable sort by dest, scatter into the [n_peers, capacity] send
+    buffer, swap. The collective is CONFINED to the axis's device
+    groups — within-slice hops ride ICI, cross-slice hops ride DCN.
     Returns (routed arrays [n_peers*capacity, ...], overflow count).
-    Mirrors `parallel/build._route_stage` for flat (1-axis) meshes."""
+    Mirrors `parallel/build._route_stage`."""
     import jax
     import jax.numpy as jnp
 
@@ -732,27 +940,93 @@ def _route_local(arrs, dest, n_peers: int, capacity: int):
         buf = buf.at[slot].set(src, mode="drop")
         send = buf[:n_peers * capacity].reshape(
             (n_peers, capacity) + src.shape[1:])
-        recv = jax.lax.all_to_all(send, SHARD_AXIS, split_axis=0,
+        recv = jax.lax.all_to_all(send, axis, split_axis=0,
                                   concat_axis=0, tiled=False)
         return recv.reshape((n_peers * capacity,) + src.shape[1:])
 
     return [route(a) for a in arrs], overflow
 
 
+def _route_slabs(mesh, route_capacity: int):
+    """Static slab geometry of one in-program repartition on `mesh`:
+    (per-shard routed rows, cap_ici, cap_dcn). Flat mesh: one
+    all_to_all over all S peers. 2-axis mesh: two axis-confined hops —
+    ICI to the owner's position within the source slice, then DCN to
+    the owner slice (the build's `_shard_step` discipline) — each with
+    its own per-peer capacity; cap_dcn sizes from the stage-1 output
+    with the same headroom factor, and the caller's overflow-retry
+    doubling grows both together."""
+    S = total_shards(mesh)
+    d = dcn_size(mesh)
+    if d == 1:
+        return S * route_capacity, route_capacity, 0
+    # Stage 1 fans over n_ici peers (not S), so its per-peer slab is d
+    # times the flat per-peer slab for the same expected row volume.
+    # Stage 2 receives at most n_ici * cap_ici rows per shard and fans
+    # over d slice peers; cap_ici already carries the headroom factor,
+    # so stage 2 inherits it rather than compounding it (a second
+    # factor would double the slab memory AND make the DCN byte share
+    # a statement about the headroom constant instead of the routing —
+    # each row crosses DCN at most once, so the share must sit ~1/2).
+    # Cross-slice skew beyond the inherited headroom lands in the
+    # overflow-retry doubling like every other capacity here.
+    ici = ici_size(mesh)
+    cap_ici = route_capacity * d
+    cap_dcn = max(16, -(-ici * cap_ici // d))
+    return d * cap_dcn, cap_ici, cap_dcn
+
+
+def _record_repartition_bytes(mesh, route_capacity: int,
+                              per_row_bytes: int) -> None:
+    """Attribute one repartition dispatch's exchange volume to the
+    link that carries it: `spmd.repartition.ici.bytes` for the
+    within-slice hop, `spmd.repartition.dcn.bytes` for the cross-slice
+    hop. The figure is the full send-buffer volume across the mesh
+    (capacity slabs, padding included) — a static upper bound the
+    regression differ can compare round over round, not a measured
+    wire count."""
+    from hyperspace_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    S = total_shards(mesh)
+    d = dcn_size(mesh)
+    _rows, cap_ici, cap_dcn = _route_slabs(mesh, route_capacity)
+    if d == 1:
+        reg.counter("spmd.repartition.ici.bytes").inc(
+            S * S * cap_ici * per_row_bytes)
+        return
+    ici = ici_size(mesh)
+    reg.counter("spmd.repartition.ici.bytes").inc(
+        S * ici * cap_ici * per_row_bytes)
+    reg.counter("spmd.repartition.dcn.bytes").inc(
+        S * d * cap_dcn * per_row_bytes)
+
+
 def _repartition_lanes(lanes, hash_lanes, null, valid, gid,
                        num_buckets_to: int, mesh, route_capacity: int):
-    """In-program ICI re-bucket of one side's KEY LANES (+ null/valid
-    masks and original-row ids): each row moves to the shard owning its
+    """In-program re-bucket of one side's KEY LANES (+ null/valid masks
+    and original-row ids): each row moves to the shard owning its
     bucket under the TARGET bucket count. `hash_lanes` carry the BUCKET
     identity (the build's value-hash lanes — for string keys the
     gathered dictionary value hashes, NOT the rank lanes used for
     matching) and are consumed for routing only, never routed. Runs as
     a shard_map stage inside the caller's jitted program — payload
-    never routes, nothing touches the host. Returns ([S*C'] lanes...,
-    null, valid, gid, route_overflow)."""
+    never routes, nothing touches the host.
+
+    Topology-aware: on a flat mesh the route is ONE all_to_all over
+    ICI; on a 2-axis (dcn, shard) mesh it is TWO axis-confined hops —
+    stage 1 over ICI to the owner's position within the source slice,
+    stage 2 over DCN to the owner slice, carrying the owner id along
+    (the build exchange's `_shard_step` discipline: each hop changes
+    exactly one mesh coordinate, and the heavy fan-out stays on the
+    fast axis). Returns ([S*C'] lanes..., null, valid, gid,
+    route_overflow); C' comes from `_route_slabs`."""
     import jax.numpy as jnp
 
     n_shards = total_shards(mesh)
+    n_dcn = dcn_size(mesh)
+    n_ici = ici_size(mesh)
+    _rows, cap_ici, cap_dcn = _route_slabs(mesh, route_capacity)
     rows_spec = row_spec(mesh)
     k = len(lanes)
     kh = len(hash_lanes)
@@ -769,11 +1043,26 @@ def _repartition_lanes(lanes, hash_lanes, null, valid, gid,
         bucket = (h % jnp.uint32(num_buckets_to)).astype(jnp.int64)
         owner = bucket_owner(bucket, num_buckets_to,
                              n_shards).astype(jnp.int32)
-        dest = jnp.where(valid_l, owner, jnp.int32(n_shards))
-        routed, overflow = _route_local(
-            lanes_l + [null_l, valid_l, gid_l], dest, n_shards,
-            route_capacity)
-        return tuple(routed) + (overflow.reshape(1),)
+        if n_dcn == 1:
+            dest = jnp.where(valid_l, owner, jnp.int32(n_shards))
+            routed, overflow = _route_local(
+                lanes_l + [null_l, valid_l, gid_l], dest, n_shards,
+                cap_ici)
+            return tuple(routed) + (overflow.reshape(1),)
+        # Stage 1 (ICI): to the owner's position within THIS slice,
+        # owner id riding along for stage 2.
+        dest1 = jnp.where(valid_l, owner % n_ici, jnp.int32(n_ici))
+        routed1, ovf1 = _route_local(
+            lanes_l + [null_l, valid_l, gid_l, owner], dest1, n_ici,
+            cap_ici, axis=SHARD_AXIS)
+        valid1 = routed1[k + 1]
+        owner1 = routed1[-1]
+        # Stage 2 (DCN): to the owner slice; empty stage-1 slots carry
+        # valid=False (zero-init buffers) and drop here.
+        dest2 = jnp.where(valid1, owner1 // n_ici, jnp.int32(n_dcn))
+        routed, ovf2 = _route_local(routed1[:-1], dest2, n_dcn,
+                                    cap_dcn, axis=DCN_AXIS)
+        return tuple(routed) + ((ovf1 + ovf2).reshape(1),)
 
     flat_in = tuple(lanes) + tuple(hash_lanes) + (null, valid, gid)
     out = compat_shard_map(
@@ -895,6 +1184,50 @@ def _match_expand(l_lanes2d, r_lanes2d, l_null, r_null, l_pad, r_pad,
             un_gid_sorted, un_counts, is_left, matchable, rights, pos_s)
 
 
+# Per-device-set dispatch serialization on EMULATED meshes: the CPU
+# backend drives every virtual device from one shared runtime, and two
+# concurrent multi-device programs over the SAME device set can
+# interleave their per-device tasks into a collective-rendezvous
+# inversion (A's device-0 step waits on A's device-1 step queued behind
+# B's device-1 step waiting on B's device-0 — a deadlock real hardware
+# cannot hit because each device's queue serializes executions). One
+# lock per device SET is exactly the device-queue semantic: programs on
+# disjoint replica slices still run concurrently — which is the whole
+# scale-out story — while same-mesh dispatches serialize. Real (non-CPU)
+# backends skip the lock: their device queues already provide it, and
+# host-side pipelining across queries must not be lost.
+_MESH_LOCKS: Dict[tuple, object] = {}
+_MESH_LOCKS_GUARD = None
+
+
+def dispatch_guard(mesh):
+    """THE per-device-set dispatch lock (reentrant; see comment above).
+    Callers driving multi-device work OUTSIDE this module's entry
+    points (`assemble_join_output` gathers, result materialization of a
+    concurrent serving loop) hold it around the whole query's device
+    section; on non-CPU backends it is a no-op."""
+    import contextlib
+    import threading
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return contextlib.nullcontext()
+    global _MESH_LOCKS_GUARD
+    if _MESH_LOCKS_GUARD is None:
+        _MESH_LOCKS_GUARD = threading.Lock()
+    tag = mesh_device_tag(mesh)
+    with _MESH_LOCKS_GUARD:
+        lock = _MESH_LOCKS.get(tag)
+        if lock is None:
+            lock = threading.RLock()
+            _MESH_LOCKS[tag] = lock
+    return lock
+
+
+_dispatch_guard = dispatch_guard
+
+
 # Program cache: jax.Mesh hashes by value (devices + axis names), so the
 # per-query `distribution_mesh()` reconstruction still HITS here — a warm
 # repeat join re-dispatches the already-compiled program instead of
@@ -979,7 +1312,7 @@ def _join_program(mesh, n_keys: int, Cl: int, Cr: int, cap: int,
                     _repartition_lanes(r_lanes, r_hash_lanes, r_null_f,
                                        r_valid, r_gid_f, repartition_to,
                                        mesh, route_capacity)
-                Cr_eff = S * route_capacity
+                Cr_eff = _route_slabs(mesh, route_capacity)[0]
             else:
                 r_valid_f = r_valid
                 Cr_eff = Cr
@@ -1028,19 +1361,42 @@ def _prefix_index(counts, width: int) -> np.ndarray:
     ) if counts.sum() else np.zeros(0, dtype=np.int64)
 
 
-def _gather_prefixes(arrays, counts, width: int):
+_prefix_gather_jit = None
+_prefix_gather_i32_jit = None
+
+
+def _gather_prefixes(arrays, counts, width: int, as_int32: bool = False):
     """ONE fused device gather of the per-shard prefixes (the output
     sides stay device-resident; only the [S] count vector came to the
-    host)."""
+    host). The flatten, the take, and — with `as_int32` — the output
+    cast all trace into a SINGLE jitted dispatch: on the warm serving
+    path every eager primitive here was a measurable per-query python
+    dispatch (reshape x2 + take + astype x2 ~ a third of a tiny warm
+    join's wall), and fusing them lifts the concurrent-QPS ceiling of
+    small replica-routed queries."""
+    global _prefix_gather_jit, _prefix_gather_i32_jit
     import jax.numpy as jnp
-
-    from hyperspace_tpu.io.columnar import _fused_take
 
     idx = _prefix_index(counts, width)
     if not len(idx):
-        return tuple(jnp.zeros(0, dtype=a.dtype) for a in arrays)
-    return _fused_take(tuple(a.reshape(-1) for a in arrays),
-                       jnp.asarray(idx))
+        dt = jnp.int32 if as_int32 else None
+        return tuple(jnp.zeros(0, dtype=dt or a.dtype) for a in arrays)
+    if _prefix_gather_jit is None:
+        from hyperspace_tpu.telemetry import instrumented_jit
+
+        @instrumented_jit("mesh.spmd_gather")
+        def _take_flat(arrs, ix):
+            return tuple(jnp.take(a.reshape(-1), ix) for a in arrs)
+
+        @instrumented_jit("mesh.spmd_gather_i32")
+        def _take_flat_i32(arrs, ix):
+            return tuple(jnp.take(a.reshape(-1), ix).astype(jnp.int32)
+                         for a in arrs)
+
+        _prefix_gather_jit = _take_flat
+        _prefix_gather_i32_jit = _take_flat_i32
+    fn = _prefix_gather_i32_jit if as_int32 else _prefix_gather_jit
+    return fn(tuple(arrays), idx)
 
 
 # Working-capacity memo: a warm repeat of the same join shape starts at
@@ -1111,13 +1467,13 @@ def _check_one_mesh(left: ShardedBatch, right: ShardedBatch):
 
 
 def _repartition_target(left: ShardedBatch, right: ShardedBatch):
+    """(target bucket count, first-attempt route capacity) when the
+    right side must re-bucket in-program; (None, 16) for co-bucketed
+    sides. Works on flat AND 2-axis meshes — `_repartition_lanes`
+    routes hierarchically (ICI within the slice, one DCN hop across)
+    on the latter."""
     if right.num_buckets == left.num_buckets:
         return None, 16
-    if dcn_size(left.mesh) > 1:
-        raise HyperspaceException(
-            "in-program repartition supports flat (single-slice) meshes; "
-            "re-bucket through parallel.build.distributed_build on "
-            "multi-slice topologies.")
     return left.num_buckets, _route_cap(right)
 
 
@@ -1144,6 +1500,11 @@ def sharded_join_indices(left: ShardedBatch, right: ShardedBatch,
         raise HyperspaceException(
             f"sharded join supports inner/left_outer/full_outer; "
             f"got {how}.")
+    if left.split_plan is not None and how == "full_outer":
+        # Replicated right rows break per-shard unmatched-right
+        # uniqueness; callers route full_outer off the sub-shard lane.
+        raise HyperspaceException(
+            "virtual sub-shard joins support inner/left_outer only.")
     _check_one_mesh(left, right)
     mesh = left.mesh
     S = total_shards(mesh)
@@ -1165,64 +1526,75 @@ def sharded_join_indices(left: ShardedBatch, right: ShardedBatch,
     reg = telemetry.get_registry()
     tracer = telemetry.tracer()
     span_ts = tracer.now_us() if tracer is not None else 0.0
-    while True:
-        program = _join_program(mesh, len(left_keys), left.rows_per_shard,
-                                right.rows_per_shard, cap, left_outer,
-                                need_right, repartition_to,
-                                route_capacity, remap_idx=remap_idx)
-        with telemetry.span("mesh:join:spmd", "mesh", how=how, shards=S,
-                            cap=cap):
-            (li, ri, counts_d, un_gid, un_counts_d, expand_ovf,
-             route_ovf) = program(*l_in, *r_in, l_remaps, r_remaps,
-                                  r_hashes)
-            t0 = _time.perf_counter()
-            # THE one host readback per attempt: the tiny per-shard
-            # count vectors + overflow scalars together, after
-            # everything (match AND expansion AND compaction) has
-            # dispatched — not a sizing sync in the middle.
-            counts, un_counts, e_ovf, r_ovf = jax.device_get(
-                (counts_d, un_counts_d, expand_ovf, route_ovf))
-            sync_s = _time.perf_counter() - t0
-        reg.counter("mesh.join.sync_s").inc(sync_s)
-        telemetry.add_seconds("mesh.sync_s", sync_s)
-        if int(e_ovf) == 0 and int(r_ovf) == 0:
-            if len(_CAP_MEMO) > 256:
-                _CAP_MEMO.clear()
-            _CAP_MEMO[memo_key] = cap
-            break
-        reg.counter("mesh.spmd.overflow_retries").inc()
-        if int(e_ovf):
-            cap *= 2
-        if int(r_ovf):
-            route_capacity *= 2
+    with _dispatch_guard(mesh):
+        while True:
+            program = _join_program(mesh, len(left_keys),
+                                    left.rows_per_shard,
+                                    right.rows_per_shard, cap, left_outer,
+                                    need_right, repartition_to,
+                                    route_capacity, remap_idx=remap_idx)
+            if repartition_to is not None:
+                # Slab-volume attribution of this attempt's in-program
+                # exchange, split by the link that carries each hop.
+                _record_repartition_bytes(
+                    mesh, route_capacity, 8 * len(right_keys) + 10)
+            with telemetry.span("mesh:join:spmd", "mesh", how=how,
+                                shards=S, cap=cap):
+                (li, ri, counts_d, un_gid, un_counts_d, expand_ovf,
+                 route_ovf) = program(*l_in, *r_in, l_remaps, r_remaps,
+                                      r_hashes)
+                t0 = _time.perf_counter()
+                # THE one host readback per attempt: the tiny per-shard
+                # count vectors + overflow scalars together, after
+                # everything (match AND expansion AND compaction) has
+                # dispatched — not a sizing sync in the middle.
+                counts, un_counts, e_ovf, r_ovf = jax.device_get(
+                    (counts_d, un_counts_d, expand_ovf, route_ovf))
+                sync_s = _time.perf_counter() - t0
+            reg.counter("mesh.join.sync_s").inc(sync_s)
+            telemetry.add_seconds("mesh.sync_s", sync_s)
+            if int(e_ovf) == 0 and int(r_ovf) == 0:
+                if len(_CAP_MEMO) > 256:
+                    _CAP_MEMO.clear()
+                _CAP_MEMO[memo_key] = cap
+                break
+            reg.counter("mesh.spmd.overflow_retries").inc()
+            if int(e_ovf):
+                cap *= 2
+            if int(r_ovf):
+                route_capacity *= 2
 
-    total = int(np.asarray(counts).sum())
-    extra = int(np.asarray(un_counts).sum()) if need_right else 0
-    reg.counter("mesh.join.execs").inc()
-    reg.counter("mesh.spmd.join_execs").inc()
-    shard_rows_attr = _shard_rows_attribution(left, right)
-    for rows in shard_rows_attr:
-        reg.histogram("mesh.join.shard_rows").observe(rows)
-    telemetry.event("mesh", "join", how=how, shards=S, pairs=total,
-                    lane="spmd", shard_rows=shard_rows_attr)
-    if tracer is not None:
-        tracer.device_spans("join", span_ts,
-                            [int(c) for c in np.asarray(counts)],
-                            how=how)
-    if total == 0:
-        li_f = jnp.zeros(0, dtype=jnp.int64)
-        ri_f = jnp.zeros(0, dtype=jnp.int64)
-    else:
-        # The valid pairs are contiguous per-shard prefixes by
-        # construction: ONE fused gather materializes both sides.
-        li_f, ri_f = _gather_prefixes((li, ri), counts, cap)
-    if extra:
-        (ugid,) = _gather_prefixes((un_gid,), un_counts,
-                                   un_gid.shape[1])
-        li_f = jnp.concatenate([li_f, jnp.full(extra, -1,
-                                               dtype=jnp.int64)])
-        ri_f = jnp.concatenate([ri_f, ugid])
-    return li_f.astype(jnp.int32), ri_f.astype(jnp.int32)
+        total = int(np.asarray(counts).sum())
+        extra = int(np.asarray(un_counts).sum()) if need_right else 0
+        reg.counter("mesh.join.execs").inc()
+        reg.counter("mesh.spmd.join_execs").inc()
+        shard_rows_attr = _shard_rows_attribution(left, right)
+        reg.histogram("mesh.join.shard_rows").observe_many(
+            shard_rows_attr)
+        telemetry.event("mesh", "join", how=how, shards=S, pairs=total,
+                        lane="spmd", shard_rows=shard_rows_attr)
+        if tracer is not None:
+            tracer.device_spans("join", span_ts,
+                                [int(c) for c in np.asarray(counts)],
+                                how=how)
+        if total == 0:
+            li_f = jnp.zeros(0, dtype=jnp.int64)
+            ri_f = jnp.zeros(0, dtype=jnp.int64)
+        elif not extra:
+            # The valid pairs are contiguous per-shard prefixes by
+            # construction: ONE fused gather (incl. the int32 output
+            # cast) materializes both sides in a single dispatch.
+            return _gather_prefixes((li, ri), counts, cap,
+                                    as_int32=True)
+        else:
+            li_f, ri_f = _gather_prefixes((li, ri), counts, cap)
+        if extra:
+            (ugid,) = _gather_prefixes((un_gid,), un_counts,
+                                       un_gid.shape[1])
+            li_f = jnp.concatenate([li_f, jnp.full(extra, -1,
+                                                   dtype=jnp.int64)])
+            ri_f = jnp.concatenate([ri_f, ugid])
+        return li_f.astype(jnp.int32), ri_f.astype(jnp.int32)
 
 
 def sharded_semi_anti_indices(left: ShardedBatch, right: ShardedBatch,
@@ -1248,37 +1620,43 @@ def sharded_semi_anti_indices(left: ShardedBatch, right: ShardedBatch,
         need_hashes=repartition_to is not None, conf=conf)
 
     reg = telemetry.get_registry()
-    while True:
-        program = _join_program(mesh, len(left_keys), left.rows_per_shard,
-                                right.rows_per_shard, 16,
-                                left_outer=True, need_right=False,
-                                repartition_to=repartition_to,
-                                route_capacity=route_capacity,
-                                membership="anti" if anti else "semi",
-                                remap_idx=remap_idx)
-        li_sorted, hit_counts_d, route_ovf = program(
-            *_join_inputs(left, left_keys),
-            *_join_inputs(right, right_keys),
-            l_remaps, r_remaps, r_hashes)
-        hit_counts, r_ovf = jax.device_get((hit_counts_d, route_ovf))
-        if repartition_to is None or int(r_ovf) == 0:
-            break
-        reg.counter("mesh.spmd.overflow_retries").inc()
-        route_capacity *= 2
+    with _dispatch_guard(mesh):
+        while True:
+            program = _join_program(mesh, len(left_keys),
+                                    left.rows_per_shard,
+                                    right.rows_per_shard, 16,
+                                    left_outer=True, need_right=False,
+                                    repartition_to=repartition_to,
+                                    route_capacity=route_capacity,
+                                    membership="anti" if anti else "semi",
+                                    remap_idx=remap_idx)
+            if repartition_to is not None:
+                _record_repartition_bytes(
+                    mesh, route_capacity, 8 * len(right_keys) + 10)
+            li_sorted, hit_counts_d, route_ovf = program(
+                *_join_inputs(left, left_keys),
+                *_join_inputs(right, right_keys),
+                l_remaps, r_remaps, r_hashes)
+            hit_counts, r_ovf = jax.device_get((hit_counts_d, route_ovf))
+            if repartition_to is None or int(r_ovf) == 0:
+                break
+            reg.counter("mesh.spmd.overflow_retries").inc()
+            route_capacity *= 2
 
-    total = int(np.asarray(hit_counts).sum())
-    shard_rows_attr = _shard_rows_attribution(left, right)
-    for rows in shard_rows_attr:
-        reg.histogram("mesh.join.shard_rows").observe(rows)
-    telemetry.event("mesh", "join", how=("anti" if anti else "semi"),
-                    shards=S, lane="spmd", shard_rows=shard_rows_attr)
-    reg.counter("mesh.join.execs").inc()
-    reg.counter("mesh.spmd.join_execs").inc()
-    if total == 0:
-        return jnp.zeros(0, dtype=jnp.int32)
-    (li,) = _gather_prefixes((li_sorted,), hit_counts,
-                             li_sorted.shape[1])
-    return li.astype(jnp.int32)
+        total = int(np.asarray(hit_counts).sum())
+        shard_rows_attr = _shard_rows_attribution(left, right)
+        reg.histogram("mesh.join.shard_rows").observe_many(
+            shard_rows_attr)
+        telemetry.event("mesh", "join", how=("anti" if anti else "semi"),
+                        shards=S, lane="spmd",
+                        shard_rows=shard_rows_attr)
+        reg.counter("mesh.join.execs").inc()
+        reg.counter("mesh.spmd.join_execs").inc()
+        if total == 0:
+            return jnp.zeros(0, dtype=jnp.int32)
+        (li,) = _gather_prefixes((li_sorted,), hit_counts,
+                                 li_sorted.shape[1], as_int32=True)
+        return li
 
 
 # ---------------------------------------------------------------------------
@@ -1291,11 +1669,15 @@ def repartition_sharded(batch: ColumnBatch, key_columns: Sequence[str],
                         capacity_factor: float = CAPACITY_FACTOR
                         ) -> ShardedBatch:
     """Re-bucket a DEVICE-resident batch (e.g. a join output feeding the
-    next join) into a born-sharded layout over ICI: hash, contiguous-
-    range owner, one all_to_all — all inside one jitted program, with
-    the routed per-shard layout RETURNED AS-IS (padded + valid mask, no
-    global compaction), so no per-bucket histogram and no row data ever
-    touch the host between stages. Only the overflow scalar syncs."""
+    next join) into a born-sharded layout: hash, contiguous-range
+    owner, then the topology-aware exchange — ONE all_to_all over ICI
+    on a flat mesh, or the two axis-confined hops (ICI within the
+    slice, one DCN hop across) on a 2-axis mesh — all inside one jitted
+    program, with the routed per-shard layout RETURNED AS-IS (padded +
+    valid mask, no global compaction), so no per-bucket histogram and
+    no row data ever touch the host between stages. Only the overflow
+    scalar syncs. Exchange volume lands in
+    `spmd.repartition.{ici,dcn}.bytes` per dispatch."""
     import jax
     import jax.numpy as jnp
 
@@ -1304,11 +1686,9 @@ def repartition_sharded(batch: ColumnBatch, key_columns: Sequence[str],
     from hyperspace_tpu.io.columnar import batch_to_tree, tree_to_batch
     from hyperspace_tpu.telemetry import instrumented_jit
 
-    if dcn_size(mesh) > 1:
-        raise HyperspaceException(
-            "repartition_sharded supports flat meshes; use "
-            "parallel.build.distributed_build on multi-slice topologies.")
     n_shards = total_shards(mesh)
+    n_dcn = dcn_size(mesh)
+    n_ici = ici_size(mesh)
     n = batch.num_rows
     local = -(-n // n_shards)
     padded = local * n_shards
@@ -1342,9 +1722,11 @@ def repartition_sharded(batch: ColumnBatch, key_columns: Sequence[str],
     factor = capacity_factor
     while True:
         capacity = max(16, int(local / n_shards * factor))
+        _rows_out, cap_ici, cap_dcn = _route_slabs(mesh, capacity)
         rows_spec = row_spec(mesh)
 
-        def make_step(capacity=capacity):
+        def make_step(capacity=capacity, cap_ici=cap_ici,
+                      cap_dcn=cap_dcn):
             def step(t):
                 def body(tt):
                     from hyperspace_tpu.ops.build import _tree_hash_lanes
@@ -1360,7 +1742,6 @@ def repartition_sharded(batch: ColumnBatch, key_columns: Sequence[str],
                         .astype(jnp.int64)
                     owner = bucket_owner(bucket, num_buckets,
                                          n_shards).astype(jnp.int32)
-                    dest = jnp.where(valid_l, owner, jnp.int32(n_shards))
                     # Route data/validity leaves; dictionary hash tables
                     # stay shard-local (replicated), like the build.
                     to_route = []
@@ -1373,8 +1754,29 @@ def repartition_sharded(batch: ColumnBatch, key_columns: Sequence[str],
                         if "validity" in entry:
                             spec.append((nm, "validity"))
                             to_route.append(entry["validity"])
-                    routed, overflow = _route_local(
-                        to_route + [valid_l], dest, n_shards, capacity)
+                    if n_dcn == 1:
+                        dest = jnp.where(valid_l, owner,
+                                         jnp.int32(n_shards))
+                        routed, overflow = _route_local(
+                            to_route + [valid_l], dest, n_shards,
+                            capacity)
+                    else:
+                        # Two axis-confined hops (build discipline):
+                        # ICI to the owner's slice position, DCN to the
+                        # owner slice, owner id riding along.
+                        dest1 = jnp.where(valid_l, owner % n_ici,
+                                          jnp.int32(n_ici))
+                        routed1, ovf1 = _route_local(
+                            to_route + [valid_l, owner], dest1, n_ici,
+                            cap_ici, axis=SHARD_AXIS)
+                        valid1 = routed1[-2]
+                        owner1 = routed1[-1]
+                        dest2 = jnp.where(valid1, owner1 // n_ici,
+                                          jnp.int32(n_dcn))
+                        routed, ovf2 = _route_local(
+                            routed1[:-1], dest2, n_dcn, cap_dcn,
+                            axis=DCN_AXIS)
+                        overflow = ovf1 + ovf2
                     out_t = {nm: dict(entry) for nm, entry in tt.items()
                              if nm != "__valid__"}
                     for (nm, part), arr in zip(spec, routed[:-1]):
@@ -1396,14 +1798,20 @@ def repartition_sharded(batch: ColumnBatch, key_columns: Sequence[str],
             ("repartition", mesh, key_names, num_buckets, capacity),
             lambda: instrumented_jit("mesh.spmd_repartition",
                                      make_step()))
-        routed_tree = program(in_tree)
-        overflow = int(jnp.sum(routed_tree["__overflow__"]["data"]))
+        per_row = sum(
+            int(np.dtype(getattr(e["data"], "dtype", np.int64)).itemsize)
+            + (1 if "validity" in e else 0)
+            for nm, e in in_tree.items() if nm != "__valid__") + 1
+        _record_repartition_bytes(mesh, capacity, per_row)
+        with _dispatch_guard(mesh):
+            routed_tree = program(in_tree)
+            overflow = int(jnp.sum(routed_tree["__overflow__"]["data"]))
         if overflow == 0:
             break
         reg.counter("mesh.spmd.overflow_retries").inc()
         factor *= 2
 
-    C = n_shards * capacity
+    C = _route_slabs(mesh, capacity)[0]
     row_valid = routed_tree["__valid__"]["data"]
     out_tree = {}
     for name, entry in routed_tree.items():
@@ -1447,7 +1855,7 @@ def sharded_filter(sh: ShardedBatch, expression) -> ColumnBatch:
         return compile_predicate(expression, b) & valid
 
     with telemetry.span("mesh:filter", "mesh", rows=sh.num_rows,
-                        shards=sh.n_shards):
+                        shards=sh.n_shards), _dispatch_guard(sh.mesh):
         try:
             mask = instrumented_jit("mesh.spmd_filter", step)(
                 tree, sh.row_valid)
@@ -1480,9 +1888,10 @@ def sharded_group_aggregate(sh: ShardedBatch,
     [n_shards, G] partial tables cross for the host combine."""
     from hyperspace_tpu.parallel.aggregate import distributed_group_aggregate
 
-    return distributed_group_aggregate(
-        sh.batch, group_columns, aggregates, out_schema, sh.mesh,
-        pre_sharded=(sh.batch, sh.row_valid))
+    with _dispatch_guard(sh.mesh):
+        return distributed_group_aggregate(
+            sh.batch, group_columns, aggregates, out_schema, sh.mesh,
+            pre_sharded=(sh.batch, sh.row_valid))
 
 
 # ---------------------------------------------------------------------------

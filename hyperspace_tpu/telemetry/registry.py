@@ -120,6 +120,23 @@ class Histogram:
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
 
+    def observe_many(self, values) -> None:
+        """Batch observation under ONE lock acquisition — per-shard
+        attribution vectors land per dispatch on serving hot paths
+        (mesh join shard_rows), where a lock per element is measurable
+        python on a sub-millisecond warm query."""
+        values = [float(v) for v in values]
+        if not values:
+            return
+        with self._lock:
+            for v in values:
+                exp = self._exp(v)
+                self._buckets[exp] = self._buckets.get(exp, 0) + 1
+                self.count += 1
+                self.sum += v
+                self.min = v if self.min is None else min(self.min, v)
+                self.max = v if self.max is None else max(self.max, v)
+
     def to_dict(self) -> dict:
         buckets = {("0" if exp is None else repr(float(2 ** exp))): n
                    for exp, n in sorted(

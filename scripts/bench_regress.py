@@ -247,6 +247,75 @@ def compare_multichip(old: dict, new: dict, threshold: float):
     if bi is not None:
         rows.append(("bit_identical", 1.0, 1.0 if bi else 0.0,
                      0.0 if bi else -1.0, not bi))
+    rows.extend(_multislice_rows(o, n, threshold))
+    return rows
+
+
+# Replica routing balance bar: at steady state no replica may take
+# more than this share of routed queries (least-loaded routing that
+# degenerates to one slice is replication paying HBM for nothing).
+REPLICA_MAX_SHARE = 0.70
+# Cross-slice byte-share bar: under the two-hop hierarchy each routed
+# row crosses DCN at most once and ICI at most once, so the DCN share
+# of a full re-bucket sits near 1/2 by construction (slab rounding adds
+# a little). A share past this bar means the heavy fan-out inverted
+# onto the slow axis — stage order or capacity sizing regressed.
+DCN_BYTE_SHARE_MAX = 0.60
+
+
+def _multislice_rows(o: dict, n: dict, threshold: float):
+    """Multi-slice + replication gate rows (the scale-OUT section of
+    the MULTICHIP artifact):
+
+    - `multislice_qps_ratio` — concurrent-client aggregate QPS of the
+      replicated multi-slice topology over the flat whole-mesh
+      topology; absolute floor 1.0 (replication that loses to the flat
+      mesh is the regression) plus the usual ratio-vs-previous-round;
+    - `replica_max_share` — no replica may take > REPLICA_MAX_SHARE of
+      routed queries at steady state (absolute);
+    - `dcn_byte_share` — cross-slice DCN bytes /
+      (ICI + DCN) of the in-program repartitions must stay under
+      DCN_BYTE_SHARE_MAX (absolute — the hierarchy's point is that the
+      heavy hop rides ICI);
+    - `multislice_warm_h2d` / `multislice_spmd_fallbacks` /
+      `multislice_bit_identical` — the flat-lane absolutes, re-asserted
+      on the replicated grid. Rounds predating the section gate
+      nothing."""
+    om = o.get("multislice") or {}
+    nm = n.get("multislice") or {}
+    rows = []
+    if not nm:
+        return rows
+    ratio = nm.get("qps_ratio")
+    if isinstance(ratio, (int, float)):
+        rows.append(("multislice_qps_floor", 1.0, ratio, ratio - 1.0,
+                     ratio < 1.0))
+        old_r = om.get("qps_ratio")
+        if isinstance(old_r, (int, float)) and old_r > 0:
+            change = ratio / old_r - 1.0
+            rows.append(("multislice_qps_ratio", old_r, ratio, change,
+                         change < -threshold))
+    share = nm.get("replica_max_share")
+    if isinstance(share, (int, float)):
+        rows.append(("replica_max_share", REPLICA_MAX_SHARE, share,
+                     share - REPLICA_MAX_SHARE,
+                     share > REPLICA_MAX_SHARE))
+    dcn = nm.get("dcn_byte_share")
+    if isinstance(dcn, (int, float)):
+        rows.append(("dcn_byte_share", DCN_BYTE_SHARE_MAX, dcn,
+                     dcn - DCN_BYTE_SHARE_MAX, dcn > DCN_BYTE_SHARE_MAX))
+    wh = nm.get("warm_h2d_chunks")
+    if isinstance(wh, (int, float)):
+        rows.append(("multislice_warm_h2d", 0.0, float(wh), float(wh),
+                     wh > 0))
+    fb = nm.get("spmd_fallbacks")
+    if isinstance(fb, (int, float)):
+        rows.append(("multislice_spmd_fallbacks", 0.0, float(fb),
+                     float(fb), fb > 0))
+    bi = nm.get("bit_identical")
+    if bi is not None:
+        rows.append(("multislice_bit_identical", 1.0,
+                     1.0 if bi else 0.0, 0.0 if bi else -1.0, not bi))
     return rows
 
 

@@ -352,16 +352,24 @@ def check_sketch_seam(package_dir: str):
 # bucket_ranges) — the born-sharded on-disk layout, the per-device cache
 # residency, and the SPMD collectives all derive from that ONE map, and a
 # raw construction elsewhere is a layout that can silently drift from it.
+# SLICE TOPOLOGY rides the same seam: constructing a `jax.sharding.Mesh`
+# (flat or hierarchical), reshaping a device grid, or spelling the DCN
+# axis name as a literal anywhere else is a (slice, device) topology the
+# bucket-range hierarchy (`slice_bucket_ranges`), the replica router,
+# and the two-hop repartition cannot see — topology construction stays
+# inside parallel/mesh.py (`make_mesh` / `slice_submesh`).
 _RAW_SHARDING_RE = re.compile(
     r"NamedSharding\s*\(|PartitionSpec\s*\(|(?<!compat_)shard_map\s*\(|"
     r"from\s+jax\.sharding\s+import|from\s+jax\.experimental\s+import\s+"
-    r"shard_map|from\s+jax\.experimental\.shard_map\s+import")
+    r"shard_map|from\s+jax\.experimental\.shard_map\s+import|"
+    r"(?<![\w.])Mesh\s*\(|jax\.sharding\.Mesh|create_device_mesh\s*\(|"
+    r"[\"']dcn[\"']")
 _SHARDING_ALLOWED = os.path.join("parallel", "mesh.py")
 
 
 def check_sharding_seam(package_dir: str):
-    """Source lint: no raw NamedSharding/PartitionSpec/shard_map
-    construction outside parallel/mesh.py."""
+    """Source lint: no raw NamedSharding/PartitionSpec/shard_map/Mesh/
+    device-grid/slice-topology construction outside parallel/mesh.py."""
     failures = []
     for root, _dirs, files in os.walk(package_dir):
         if "__pycache__" in root:

@@ -49,6 +49,17 @@ from hyperspace_tpu import telemetry
 # per-query hot path and the ring only changes as queries finish.
 _MINE_INTERVAL_S = 1.0
 
+# Halve every mined per-bucket count this often, dropping zeros:
+# hotness then tracks RECENT traffic (a bucket hot last hour but idle
+# now decays back to cold/unclassified) and the count map cannot grow
+# without bound on a long-lived serving process. Halving preserves the
+# ratios the hot-fraction bar compares.
+_DECAY_INTERVAL_S = 60.0
+
+# Hard backstop on the count map between decay sweeps: past this many
+# (root, bucket) entries, the coldest half is dropped immediately.
+_MAX_TRACKED_BUCKETS = 65536
+
 
 class ReplicaRouter:
     """Process-wide replica router (one per process, `get_router()`).
@@ -61,6 +72,7 @@ class ReplicaRouter:
         self._counts: Dict[Tuple[str, int], int] = {}
         self._routed: Dict[int, int] = {}
         self._last_mine_t = 0.0
+        self._last_decay_t = time.monotonic()
 
     # -- hot-bucket mining ------------------------------------------------
 
@@ -69,6 +81,10 @@ class ReplicaRouter:
         if now - self._last_mine_t < _MINE_INTERVAL_S:
             return
         self._last_mine_t = now
+        if now - self._last_decay_t >= _DECAY_INTERVAL_S:
+            self._last_decay_t = now
+            self._counts = {k: c // 2 for k, c in self._counts.items()
+                            if c // 2 > 0}
         recorder = telemetry.flight.get_recorder()
         fresh, self._since_seq = recorder.snapshot(self._since_seq)
         for metrics in fresh:
@@ -82,6 +98,9 @@ class ReplicaRouter:
                 for b in buckets:
                     key = (root, int(b))
                     self._counts[key] = self._counts.get(key, 0) + 1
+        if len(self._counts) > _MAX_TRACKED_BUCKETS:
+            keep = sorted(self._counts.items(), key=lambda kv: -kv[1])
+            self._counts = dict(keep[:_MAX_TRACKED_BUCKETS // 2])
 
     def hot_buckets(self, root: str, hot_fraction: float) -> set:
         """Bucket ids of `root` at or above `hot_fraction` of the
@@ -149,7 +168,14 @@ class ReplicaRouter:
             hot = self.hot_buckets(root, frac)
             if not hot or any(b in hot for b in ids):
                 return None  # hot or unclassified: fan out
+            # Slice ownership is a contiguous bucket range, so the min
+            # and max hinted ids bound every hinted bucket's owner —
+            # a single root whose buckets straddle a range boundary
+            # must fan out too, not pin to the first bucket's slice.
             owner = int(bucket_owner(min(ids), num_buckets, n_slices))
+            hi_owner = int(bucket_owner(max(ids), num_buckets, n_slices))
+            if owner != hi_owner:
+                return None  # spans home slices within one root: fan out
             if home is None:
                 home = owner
             elif home != owner:
@@ -182,6 +208,7 @@ class ReplicaRouter:
             self._counts.clear()
             self._routed.clear()
             self._last_mine_t = 0.0
+            self._last_decay_t = time.monotonic()
 
 
 def _plan_buckets(plan) -> Optional[dict]:

@@ -488,6 +488,18 @@ def _remap_to_global(host: ColumnBatch, global_dicts: dict) -> ColumnBatch:
     return host
 
 
+def _files_digest(files) -> str:
+    """Compact stable identity of an ordered file tuple for sub-shard
+    cache key tags."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for f in files:
+        h.update(str(f).encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:16]
+
+
 def read_sharded(per_shard_files: List[List[str]], lengths,
                  columns: Sequence[str], schema, mesh,
                  base_ref=None, conf=None, budget=None,
@@ -527,7 +539,14 @@ def read_sharded(per_shard_files: List[List[str]], lengths,
             raise HyperspaceException(
                 f"shard_specs covers {len(shard_specs)} shards; the mesh "
                 f"has {n_shards}.")
-        key_tags = [("spmd-sub", spec[1], spec[2], n_shards, s)
+        # The windowed (skip, rows) coordinates alone do not say WHICH
+        # bucket-range files shard s's window slices — the skew/aligned
+        # plans depend on the OTHER join side's histogram, so two joins
+        # of the same root+version can hand shard s identical window
+        # geometry over DIFFERENT bucket spans. The file-tuple digest
+        # pins the key to the covered bytes.
+        key_tags = [("spmd-sub", spec[1], spec[2], n_shards, s,
+                     _files_digest(spec[0]))
                     for s, spec in enumerate(shard_specs)]
         out_lengths = None
         windowed = True
@@ -831,13 +850,11 @@ def string_like_mask(col: DeviceColumn, pattern_regex: str, conf=None):
                           dtype=bool)
         return {"mask": mask}, max(int(mask.nbytes), 1)
 
-    payload = segcache.get_cache().get_or_fill(key, fill, conf=conf)
+    cache = segcache.get_cache()
+    payload = cache.get_or_fill(key, fill, conf=conf)
     if not filled:
         telemetry.get_registry().counter(
             "spmd.strings.like_mask_cache_hits").inc()
-    dev = payload.get("dev")
-    if dev is not None:
-        return dev  # a CONCRETE cached array is a safe trace constant
     import jax
 
     try:
@@ -850,9 +867,18 @@ def string_like_mask(col: DeviceColumn, pattern_regex: str, conf=None):
         # (a leak); the host mask constant-folds into the program
         # instead, and the next eager caller promotes it below.
         return payload["mask"]
-    dev = transfer.get_engine().put(payload["mask"])
-    payload["dev"] = dev
-    return dev
+    # The device copy is its OWN cache entry, sized by the device bytes
+    # — it rides the cache's fill/accounting/eviction machinery rather
+    # than being patched onto the host entry's payload (which would
+    # leave its HBM bytes uncharged and race concurrent readers).
+    host_mask = payload["mask"]
+
+    def fill_dev():
+        dev = transfer.get_engine().put(host_mask)
+        return {"dev": dev}, max(int(dev.nbytes), 1)
+
+    return cache.get_or_fill(("spmd-like-dev",) + key[1:], fill_dev,
+                             conf=conf)["dev"]
 
 
 def _string_key_plan(left: "ShardedBatch", right: "ShardedBatch",
@@ -1184,24 +1210,29 @@ def _match_expand(l_lanes2d, r_lanes2d, l_null, r_null, l_pad, r_pad,
             un_gid_sorted, un_counts, is_left, matchable, rights, pos_s)
 
 
-# Per-device-set dispatch serialization on EMULATED meshes: the CPU
+# Per-device dispatch serialization on EMULATED meshes: the CPU
 # backend drives every virtual device from one shared runtime, and two
-# concurrent multi-device programs over the SAME device set can
+# concurrent multi-device programs whose device sets OVERLAP can
 # interleave their per-device tasks into a collective-rendezvous
 # inversion (A's device-0 step waits on A's device-1 step queued behind
 # B's device-1 step waiting on B's device-0 — a deadlock real hardware
 # cannot hit because each device's queue serializes executions). One
-# lock per device SET is exactly the device-queue semantic: programs on
-# disjoint replica slices still run concurrently — which is the whole
-# scale-out story — while same-mesh dispatches serialize. Real (non-CPU)
+# lock per DEVICE, acquired in sorted device-id order, is exactly the
+# device-queue semantic: programs on disjoint replica slices still run
+# concurrently — which is the whole scale-out story — while any two
+# dispatches sharing a device serialize (including a full-mesh program
+# — a build, repartition, or the replica-exempt batched lane — against
+# a replica-pinned slice program: their sets overlap without being
+# equal, so a per-SET lock would not order them). Sorted-order
+# acquisition makes the multi-lock hold cycle-free. Real (non-CPU)
 # backends skip the lock: their device queues already provide it, and
 # host-side pipelining across queries must not be lost.
-_MESH_LOCKS: Dict[tuple, object] = {}
-_MESH_LOCKS_GUARD = None
+_DEVICE_LOCKS: Dict[int, object] = {}
+_DEVICE_LOCKS_GUARD = None
 
 
 def dispatch_guard(mesh):
-    """THE per-device-set dispatch lock (reentrant; see comment above).
+    """THE per-device dispatch lock set (reentrant; see comment above).
     Callers driving multi-device work OUTSIDE this module's entry
     points (`assemble_join_output` gathers, result materialization of a
     concurrent serving loop) hold it around the whole query's device
@@ -1213,16 +1244,27 @@ def dispatch_guard(mesh):
 
     if jax.default_backend() != "cpu":
         return contextlib.nullcontext()
-    global _MESH_LOCKS_GUARD
-    if _MESH_LOCKS_GUARD is None:
-        _MESH_LOCKS_GUARD = threading.Lock()
+    global _DEVICE_LOCKS_GUARD
+    if _DEVICE_LOCKS_GUARD is None:
+        _DEVICE_LOCKS_GUARD = threading.Lock()
     tag = mesh_device_tag(mesh)
-    with _MESH_LOCKS_GUARD:
-        lock = _MESH_LOCKS.get(tag)
-        if lock is None:
-            lock = threading.RLock()
-            _MESH_LOCKS[tag] = lock
-    return lock
+    with _DEVICE_LOCKS_GUARD:
+        locks = []
+        for did in sorted(set(tag)):
+            lock = _DEVICE_LOCKS.get(did)
+            if lock is None:
+                lock = threading.RLock()
+                _DEVICE_LOCKS[did] = lock
+            locks.append(lock)
+
+    @contextlib.contextmanager
+    def hold():
+        with contextlib.ExitStack() as stack:
+            for lock in locks:
+                stack.enter_context(lock)
+            yield
+
+    return hold()
 
 
 _dispatch_guard = dispatch_guard

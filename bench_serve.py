@@ -27,6 +27,14 @@ Three phases, one artifact:
    p50/p95/p99, and the headline `qps_at_p99_slo`: the highest
    achieved rate whose p99 meets BENCH_SERVE_SLO_MS.
 
+Operations plane (PR 15): the background timeseries sampler runs for
+the whole bench — the closed loop commits `window_p99_s` (the
+sampler's sliding-window p99 over exactly the timed population, gated
+for agreement with the client-measured percentile) and `slo` (the
+burn-rate story, SLO window reset at the timed-loop start so it is a
+steady-state compliance number), and the open-loop phase commits the
+per-second QPS/p50/p99 `timeline` section from the sampler ring.
+
 The workload is the serving shape the batch lane exists for: point
 lookups and range/IN filters over a fact table (differing only in
 literals — one execution signature each), plus a join and an aggregate
@@ -283,6 +291,16 @@ def closed_loop(workload, expected):
             outcomes[k] = 0
     next_q[0] = 0
     budget[0] = TOTAL_QUERIES
+    # Steady-state SLO + sliding-window baseline: reset the burn window
+    # (the cold AOT/oracle phases' walls are warm-up, not serving
+    # compliance) and pin a timeseries sample at the loop start so the
+    # committed window-p99 covers exactly the timed population.
+    from hyperspace_tpu.engine.scheduler import get_scheduler
+    from hyperspace_tpu.telemetry import timeseries
+    get_scheduler().slo.reset()
+    sampler = timeseries.get_sampler()
+    sampler.tick()
+    t_loop0 = time.time()
     batch0 = {k: _counter(f"serve.batch.{k}")
               for k in ("invocations", "members", "fallbacks", "solo")}
     threads = [threading.Thread(target=client, args=(c,),
@@ -308,6 +326,15 @@ def closed_loop(workload, expected):
              for k in batch0}
     batch["occupancy"] = (round(batch["members"] / batch["invocations"],
                                 3) if batch["invocations"] else None)
+    # Sliding-window cross-check: the sampler's p99 over exactly the
+    # timed population (log2-bucket upper bound) next to the
+    # client-measured percentile — `bench_regress.py --serve` gates
+    # their agreement.
+    sampler.tick()
+    window_p99 = sampler.window_quantile("query.wall_s", 0.99,
+                                         since_t=t_loop0)
+    slo = get_scheduler().slo_snapshot()
+    slo["p99_target_s"] = SLO_MS / 1e3
     latencies.sort()
     qps = outcomes["ok"] / loop_wall if loop_wall else 0.0
     return {
@@ -318,6 +345,8 @@ def closed_loop(workload, expected):
         "p95_s": round(_percentile(latencies, 0.95) or 0, 5),
         "p99_s": round(_percentile(latencies, 0.99) or 0, 5),
         "max_s": round(latencies[-1], 5) if latencies else None,
+        "window_p99_s": window_p99,
+        "slo": slo,
         "outcomes": outcomes,
         "reject_rate": round(outcomes["rejected"] / TOTAL_QUERIES, 5),
         "timeout_rate": round(outcomes["deadline"] / TOTAL_QUERIES, 5),
@@ -329,6 +358,11 @@ def open_loop(workload, expected, serial_qps):
     """Phase 3: Poisson arrivals swept across rates. Open-loop latency
     counts from the SCHEDULED arrival time — a saturated server shows
     its queueing delay instead of silently slowing the clients."""
+    from hyperspace_tpu.telemetry import timeseries
+
+    sampler = timeseries.get_sampler()
+    sampler.tick()
+    t_open0 = time.time()
     rng = np.random.default_rng(23)
     sweep = []
     for frac in RATES:
@@ -403,12 +437,30 @@ def open_loop(workload, expected, serial_qps):
     meeting = [e for e in sweep if e["p99_s"] <= slo_s
                and e["outcomes"]["ok"] > 0]
     qps_at_slo = max((e["achieved_qps"] for e in meeting), default=None)
+    # Per-second arrival-rate timeline from the timeseries ring: what
+    # the open-loop phase actually looked like over time (QPS from the
+    # queries.total rate, per-interval p50/p99 from the query.wall_s
+    # histogram deltas — log2-bucket upper bounds).
+    sampler.tick()
+    timeline = []
+    for s in sampler.samples(since_t=t_open0):
+        iv = (s.get("interval") or {}).get("query.wall_s") or {}
+        timeline.append({
+            "t": s["t"],
+            "dt_s": s["dt_s"],
+            "qps": round((s.get("rates") or {}).get("queries.total",
+                                                    0.0), 2),
+            "queries": iv.get("count", 0),
+            "p50_s": iv.get("p50"),
+            "p99_s": iv.get("p99"),
+        })
     return {
         "slo_p99_ms": SLO_MS,
         "seconds_per_rate": OPEN_SECONDS,
         "workers": OPEN_WORKERS,
         "sweep": sweep,
         "qps_at_p99_slo": qps_at_slo,
+        "timeline": timeline,
     }
 
 
@@ -455,7 +507,16 @@ def main():
             "hyperspace.warehouse.dir": os.path.join(work, "wh"),
             "spark.hyperspace.serve.hbm.budget.bytes": str(BUDGET_BYTES),
             "spark.hyperspace.serve.queue.depth": str(QUEUE_DEPTH),
+            # SLO tracking on: burn window + violations accumulate so
+            # the committed round carries its own compliance story
+            # (shedding stays at its off default — a bench must
+            # measure the knee, not flinch from it).
+            "spark.hyperspace.serve.slo.p99.seconds": str(SLO_MS / 1e3),
         }))
+        # Background per-second sampler: the open-loop timeline and the
+        # sliding-window p99 cross-check both read its ring.
+        from hyperspace_tpu.telemetry import timeseries
+        timeseries.get_sampler().start()
         workload = build_workload(session, data_dir)
 
         # Phase 1 while the process is cold: AOT warm-start proof.
@@ -495,13 +556,19 @@ def main():
                                           "cache.segments.shared."))},
             "slow_decile": slow_decile_attribution(),
         })
+        timeline = serve["open_loop"].pop("timeline", [])
         result = telemetry.artifact.make_artifact(
             driver="bench_serve.py",
             metric="serve_closed_loop_qps",
             value=qps,
             unit="queries/s",
             vs_baseline=round(qps / serial_qps, 3) if serial_qps else None,
-            extra={"serve": serve, "link_probe": link_probe()})
+            extra={"serve": serve,
+                   "timeline": {"source": "open_loop",
+                                "interval_s":
+                                    timeseries.get_sampler().interval_s,
+                                "samples": timeline},
+                   "link_probe": link_probe()})
         print(json.dumps(result))
     finally:
         shutil.rmtree(work, ignore_errors=True)

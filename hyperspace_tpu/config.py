@@ -364,6 +364,68 @@ class HyperspaceConf:
             str(constants.SERVE_BREAKER_COOLDOWN_SECONDS_DEFAULT)))
 
     @property
+    def serve_slo_p99_seconds(self) -> float:
+        """Sliding-window SLO target: 99% of queries must finish under
+        this many seconds. 0 (default) disables SLO tracking."""
+        return float(self.get(constants.SERVE_SLO_P99_SECONDS,
+                              str(constants.SERVE_SLO_P99_SECONDS_DEFAULT)))
+
+    @property
+    def serve_slo_window_seconds(self) -> float:
+        """Span of the sliding window the burn rate is computed over
+        (also the default trailing window of the timeseries sampler's
+        `window.*` quantile gauges)."""
+        return float(self.get(
+            constants.SERVE_SLO_WINDOW_SECONDS,
+            str(constants.SERVE_SLO_WINDOW_SECONDS_DEFAULT)))
+
+    @property
+    def serve_slo_shed_enabled(self) -> bool:
+        """Opt-in load shedding: while the SLO burn rate exceeds 1.0
+        the admission wait queue is tightened to half its configured
+        depth (`serve.slo.shed` counts queries the tightening
+        rejected). Off by default — tracking alone never sheds."""
+        return (self.get(constants.SERVE_SLO_SHED_ENABLED,
+                         constants.SERVE_SLO_SHED_ENABLED_DEFAULT)
+                or "false").lower() == "true"
+
+    @property
+    def telemetry_ops_port(self) -> Optional[int]:
+        """Operations-plane HTTP port (`telemetry/ops_server.py`):
+        unset (default) = no server; 0 = bind an ephemeral port; any
+        other value = bind that port. Setting it also starts the
+        background timeseries sampler."""
+        value = self.get(constants.TELEMETRY_OPS_PORT)
+        if value is None or value == "":
+            return None
+        return int(value)
+
+    @property
+    def telemetry_ops_host(self) -> str:
+        """Bind address of the ops server — localhost by default (the
+        endpoints are unauthenticated; exposing them wider is an
+        explicit decision)."""
+        return self.get(constants.TELEMETRY_OPS_HOST,
+                        constants.TELEMETRY_OPS_HOST_DEFAULT) \
+            or constants.TELEMETRY_OPS_HOST_DEFAULT
+
+    @property
+    def timeseries_interval_seconds(self) -> float:
+        """Fixed sampling interval of the background timeseries
+        sampler (`telemetry/timeseries.py`)."""
+        return float(self.get(
+            constants.TELEMETRY_TIMESERIES_INTERVAL_SECONDS,
+            str(constants.TELEMETRY_TIMESERIES_INTERVAL_SECONDS_DEFAULT)))
+
+    @property
+    def timeseries_capacity(self) -> int:
+        """Bound on the sampler's ring (samples retained; older samples
+        rotate out)."""
+        return self.get_int(
+            constants.TELEMETRY_TIMESERIES_CAPACITY,
+            constants.TELEMETRY_TIMESERIES_CAPACITY_DEFAULT)
+
+    @property
     def slowlog_seconds(self) -> float:
         """Slow-query dump threshold for the flight recorder
         (`telemetry/flight.py`): any query whose wall exceeds this many

@@ -174,6 +174,47 @@ SERVE_BREAKER_COOLDOWN_SECONDS = \
     "spark.hyperspace.serve.breaker.cooldown.seconds"
 SERVE_BREAKER_COOLDOWN_SECONDS_DEFAULT = 30.0
 
+# Sliding-window SLO tracking (`engine/scheduler.py`): when
+# `slo.p99.seconds` > 0, every completed query's wall is folded into a
+# sliding window of `slo.window.seconds`, queries over the target count
+# as `serve.slo.violations`, and the `serve.slo.burn_rate` gauge is the
+# observed violation fraction over the 1% a p99 objective allows
+# (burn 1.0 = burning the error budget exactly as fast as allowed; > 1
+# = the SLO is failing). `slo.shed.enabled` (OFF by default) arms the
+# shedding hook: while the burn rate exceeds 1.0, the admission wait
+# queue is tightened to half its configured depth, and each query
+# rejected by the tightened (rather than the configured) depth counts
+# `serve.slo.shed` — controlled load shedding at the admission door
+# instead of queue collapse under sustained overload.
+SERVE_SLO_P99_SECONDS = "spark.hyperspace.serve.slo.p99.seconds"
+SERVE_SLO_P99_SECONDS_DEFAULT = 0.0
+SERVE_SLO_WINDOW_SECONDS = "spark.hyperspace.serve.slo.window.seconds"
+SERVE_SLO_WINDOW_SECONDS_DEFAULT = 60.0
+SERVE_SLO_SHED_ENABLED = "spark.hyperspace.serve.slo.shed.enabled"
+SERVE_SLO_SHED_ENABLED_DEFAULT = "false"
+
+# Operations plane (`telemetry/timeseries.py`, `telemetry/ops_server.py`):
+# the background sampler snapshots selected registry series every
+# `timeseries.interval.seconds` into a bounded ring of
+# `timeseries.capacity` samples, deriving counter rates and sliding-
+# window quantiles (`window.<series>.*` gauges). Setting `ops.port`
+# starts the in-process HTTP server (and the sampler with it) serving
+# `/metrics` (Prometheus text), `/healthz` (scheduler/breaker/cache/
+# replica state as JSON), and `/timeseries` (the ring as JSON). The
+# server binds `ops.host` — 127.0.0.1 by default: the endpoints are
+# unauthenticated operational surfaces, so exposing them beyond
+# localhost is an explicit decision. Port 0 binds an ephemeral port
+# (read it back from `get_server().port`); unset = no server.
+TELEMETRY_OPS_PORT = "spark.hyperspace.telemetry.ops.port"
+TELEMETRY_OPS_HOST = "spark.hyperspace.telemetry.ops.host"
+TELEMETRY_OPS_HOST_DEFAULT = "127.0.0.1"
+TELEMETRY_TIMESERIES_INTERVAL_SECONDS = \
+    "spark.hyperspace.telemetry.timeseries.interval.seconds"
+TELEMETRY_TIMESERIES_INTERVAL_SECONDS_DEFAULT = 1.0
+TELEMETRY_TIMESERIES_CAPACITY = \
+    "spark.hyperspace.telemetry.timeseries.capacity"
+TELEMETRY_TIMESERIES_CAPACITY_DEFAULT = 600
+
 # Crash recovery lease: a maintenance action that finds the op log's
 # latest entry in a TRANSIENT state (CREATING/REFRESHING/...) treats the
 # in-flight writer as crashed once the entry is older than this many

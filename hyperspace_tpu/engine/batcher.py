@@ -53,6 +53,7 @@ solo}`, plus `compile.aot.*` and the segment cache's
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -264,7 +265,8 @@ _WAITING, _DONE, _FAILED, _ABANDONED = range(4)
 
 
 class _Member:
-    __slots__ = ("sig", "deadline", "state", "result", "cohort_size")
+    __slots__ = ("sig", "deadline", "state", "result", "cohort_size",
+                 "cohort_id")
 
     def __init__(self, sig: BatchSignature, deadline):
         self.sig = sig
@@ -272,6 +274,7 @@ class _Member:
         self.state = _WAITING
         self.result = None
         self.cohort_size = 0
+        self.cohort_id: Optional[str] = None
 
 
 class _Cohort:
@@ -311,6 +314,10 @@ class QueryBatcher:
         # traffic shift re-enables batching within a few queries.
         self._solo_streak: Dict[tuple, int] = {}
         self._warmed: set = set()
+        # Cohort ids: one per batched invocation, stamped on every
+        # member's QueryMetrics (`metrics.cohort`) so the flight ring
+        # can group a cohort's members post-hoc.
+        self._cohort_ids = itertools.count(1)
 
     # -- entry point (called by QueryScheduler.collect) -------------------
 
@@ -434,11 +441,13 @@ class QueryBatcher:
                 if self._running.get(cohort.key) is cohort:
                     del self._running[cohort.key]
                 self._cv.notify_all()  # wake the successor's leader
+        cohort_id = f"c-{next(self._cohort_ids)}"
         with self._cv:
             for m, out in results.items():
                 if m.state == _WAITING:
                     m.result = out
                     m.cohort_size = len(results)
+                    m.cohort_id = cohort_id
                     m.state = _DONE
             # Anyone not sliced (joined too late to matter): fall back.
             for m in members:
@@ -446,8 +455,12 @@ class QueryBatcher:
                     m.state = _FAILED
             self._cv.notify_all()
         telemetry.event("serve", "batched", cohort=len(results),
-                        leader=True)
+                        cohort_id=cohort_id, leader=True)
         telemetry.add_count("serve.batch.member")
+        rec = telemetry.current()
+        if rec is not None:
+            rec.cohort = {"id": cohort_id, "size": len(results),
+                          "leader": True}
         return results[me]
 
     def _fail(self, cohort: _Cohort, me: _Member) -> None:
@@ -496,8 +509,11 @@ class QueryBatcher:
                 op.detail["cohort"] = me.cohort_size
                 rec.finish_operator(op, rows_out=me.result.num_rows)
             telemetry.event("serve", "batched", cohort=me.cohort_size,
-                            leader=False)
+                            cohort_id=me.cohort_id, leader=False)
             telemetry.add_count("serve.batch.member")
+            if rec is not None:
+                rec.cohort = {"id": me.cohort_id,
+                              "size": me.cohort_size, "leader": False}
             return me.result
         # Batch lane failed for this cohort: per-query fallback.
         if op is not None:

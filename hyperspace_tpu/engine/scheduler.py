@@ -83,8 +83,9 @@ from hyperspace_tpu.exceptions import (HyperspaceException,
                                        QueryRejectedError,
                                        QueryServingError)
 
-__all__ = ["Deadline", "QueryScheduler", "BreakerBoard", "get_scheduler",
-           "set_scheduler", "reset_scheduler", "SERVING_ERROR_COUNTERS"]
+__all__ = ["Deadline", "QueryScheduler", "BreakerBoard", "SloTracker",
+           "get_scheduler", "set_scheduler", "reset_scheduler",
+           "SERVING_ERROR_COUNTERS", "SLO_SHED_BURN_THRESHOLD"]
 
 logger = logging.getLogger(__name__)
 
@@ -151,6 +152,98 @@ class Deadline:
                 f"query {self.query_id or '?'} exceeded its "
                 f"{self.timeout_s:.3f}s deadline (during {phase})",
                 query_id=self.query_id, phase=phase)
+
+
+# ---------------------------------------------------------------------------
+# Sliding-window SLO tracking
+# ---------------------------------------------------------------------------
+
+# A p99 objective allows 1% of queries over the target; the burn rate
+# is the observed violation fraction over that allowance (1.0 = burning
+# the error budget exactly as fast as allowed).
+_SLO_ALLOWED_FRACTION = 0.01
+# Shedding engages while the burn rate exceeds this (the error budget
+# is being consumed faster than the objective allows).
+SLO_SHED_BURN_THRESHOLD = 1.0
+
+
+class SloTracker:
+    """Sliding window of completed-query walls vs the SLO target.
+
+    The window is the scheduler's OWN deque of (monotonic t, violated)
+    events rather than a view over the timeseries sampler: burn-rate
+    decisions (shedding!) must be exact and available whether or not
+    the background sampler is running; the sampler's `window.*` gauges
+    are the derived, scrapeable view of the same story."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: deque = deque()  # (monotonic t, violated: bool)
+        self._violations_in_window = 0
+
+    def _prune(self, now: float, window: float) -> None:
+        # Caller holds the lock.
+        while self._events and self._events[0][0] < now - window:
+            _t, violated = self._events.popleft()
+            if violated:
+                self._violations_in_window -= 1
+
+    def record(self, wall_s: float, conf) -> None:
+        """Fold one completed query into the window (no-op when SLO
+        tracking is off). Publishes `serve.slo.{violations,burn_rate}`."""
+        target = conf.serve_slo_p99_seconds if conf is not None else 0.0
+        if target <= 0 or wall_s is None:
+            return
+        window = max(conf.serve_slo_window_seconds, 1e-3)
+        violated = wall_s > target
+        now = time.monotonic()
+        with self._lock:
+            self._events.append((now, violated))
+            if violated:
+                self._violations_in_window += 1
+            self._prune(now, window)
+            total = len(self._events)
+            violations = self._violations_in_window
+        reg = telemetry.get_registry()
+        if violated:
+            reg.counter("serve.slo.violations").inc()
+        burn = ((violations / total) / _SLO_ALLOWED_FRACTION
+                if total else 0.0)
+        reg.gauge("serve.slo.burn_rate").set(burn)
+        reg.gauge("serve.slo.window_queries").set(total)
+
+    def burn_rate(self, conf) -> float:
+        """Current burn rate over the trailing window (0.0 = off or no
+        traffic). Pruned on read so a quiet period decays the burn."""
+        target = conf.serve_slo_p99_seconds if conf is not None else 0.0
+        if target <= 0:
+            return 0.0
+        window = max(conf.serve_slo_window_seconds, 1e-3)
+        with self._lock:
+            self._prune(time.monotonic(), window)
+            total = len(self._events)
+            violations = self._violations_in_window
+        return (violations / total) / _SLO_ALLOWED_FRACTION \
+            if total else 0.0
+
+    def snapshot(self, conf=None) -> dict:
+        with self._lock:
+            total = len(self._events)
+            violations = self._violations_in_window
+        out = {"window_queries": total,
+               "window_violations": violations,
+               "burn_rate": ((violations / total) / _SLO_ALLOWED_FRACTION
+                             if total else 0.0)}
+        if conf is not None:
+            out["p99_target_s"] = conf.serve_slo_p99_seconds
+            out["window_s"] = conf.serve_slo_window_seconds
+            out["shed_enabled"] = conf.serve_slo_shed_enabled
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._violations_in_window = 0
 
 
 # ---------------------------------------------------------------------------
@@ -315,6 +408,7 @@ class QueryScheduler:
         self._ids = itertools.count(1)
         self.peak_admitted_bytes = 0
         self._breakers = BreakerBoard()
+        self._slo = SloTracker()
         # Per-replica load (replica routing, `parallel/replica.py`):
         # admitted bytes + in-flight counts keyed by replica slice.
         # The router reads these to pick the least-loaded replica; the
@@ -361,6 +455,14 @@ class QueryScheduler:
     @property
     def breakers(self) -> BreakerBoard:
         return self._breakers
+
+    @property
+    def slo(self) -> SloTracker:
+        return self._slo
+
+    def slo_snapshot(self, conf=None) -> dict:
+        """SLO window state for `/healthz` and the bench drivers."""
+        return self._slo.snapshot(conf)
 
     # -- cancellation -----------------------------------------------------
 
@@ -450,14 +552,31 @@ class QueryScheduler:
                 self._grant(ent, reg)
                 reg.histogram("serve.queue_wait_s").observe(0.0)
                 return 0.0
-            depth = conf.serve_queue_depth if conf is not None else 0
-            if len(self._waiters) >= max(0, depth):
+            depth = max(0, conf.serve_queue_depth
+                        if conf is not None else 0)
+            # SLO shedding (opt-in): while the burn rate says the error
+            # budget is being consumed faster than the p99 objective
+            # allows, tighten the wait queue to HALF its configured
+            # depth — controlled backpressure at the admission door
+            # instead of a queue whose tail is guaranteed to violate.
+            # A query rejected by the tightened (not the configured)
+            # depth counts `serve.slo.shed` exactly once.
+            effective = depth
+            if conf is not None and conf.serve_slo_shed_enabled \
+                    and self._slo.burn_rate(conf) \
+                    > SLO_SHED_BURN_THRESHOLD:
+                effective = depth // 2
+            if len(self._waiters) >= effective:
+                if effective < depth and len(self._waiters) < depth:
+                    reg.counter("serve.slo.shed").inc()
                 raise QueryRejectedError(
                     f"query {ent.query_id} rejected: projected "
                     f"{ent.footprint} B does not fit the serving "
                     f"budget ({budget} B, {self._admitted_bytes} B "
                     f"admitted) and the wait queue is full "
-                    f"({len(self._waiters)}/{depth})",
+                    f"({len(self._waiters)}/{effective}"
+                    + (" — SLO shedding active"
+                       if effective < depth else "") + ")",
                     query_id=ent.query_id, phase="queue")
             t0 = time.perf_counter()
             self._waiters.append(ent)
@@ -696,6 +815,10 @@ class QueryScheduler:
         description = ", ".join(df.schema.names[:6])
         metrics = telemetry.QueryMetrics(description=description)
         metrics.query_id = query_id  # cancel/log correlation handle
+        # Routed-replica dimension: flight-ring consumers (slow-decile
+        # attribution, /healthz's by-replica grouping) can now group
+        # entries by the slice that served them; None = unrouted.
+        metrics.replica = ent.replica
         # The SOURCE (pre-optimization) logical plan rides the recorder
         # into the flight ring: the index advisor's what-if scorer
         # replays exactly this plan against hypothetical indexes
@@ -807,6 +930,16 @@ class QueryScheduler:
         reg.counter("queries.total").inc()
         reg.counter("queries.seconds").inc(metrics.wall_s)
         reg.histogram("query.wall_s").observe(metrics.wall_s)
+        # Sliding-window SLO: fold this wall into the burn window
+        # (no-op while `serve.slo.p99.seconds` is 0).
+        self._slo.record(metrics.wall_s, conf)
+        # Per-index rule-usage mining (the drop advisor's raw signal):
+        # one process counter per index a rule actually SERVED this
+        # query from — `Hyperspace.index_usage()` joins these against
+        # the flight ring to name indexes nothing selects anymore.
+        for use in metrics.index_usage():
+            if use.get("name"):
+                reg.counter(f"rules.served.{use['name']}").inc()
         # Flight recorder: the finished recorder joins the always-on
         # ring of recent queries; a wall past the session's slowlog
         # threshold also persists a self-contained dump (metric tree +

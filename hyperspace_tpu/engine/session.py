@@ -39,6 +39,12 @@ class HyperspaceSession:
         # instead of tracing (no-op when the knob is unset).
         from hyperspace_tpu.telemetry import compilation
         compilation.configure_persistent_cache(self.conf)
+        # Operations plane: `spark.hyperspace.telemetry.ops.port`
+        # starts the background timeseries sampler and the pull-based
+        # /metrics | /healthz | /timeseries HTTP server (localhost by
+        # default; no-op when the knob is unset).
+        from hyperspace_tpu.telemetry import ops_server
+        ops_server.configure(self.conf)
 
     # -- serving plane ----------------------------------------------------
 

@@ -117,6 +117,45 @@ class Hyperspace:
 
     # -- observability ----------------------------------------------------
 
+    def index_usage(self, last_n: Optional[int] = None):
+        """Per-index rule-usage report — the drop advisor's raw
+        material (ROADMAP: "storage is a budget too"). For every index
+        in this session's catalog: how many queries a rewrite rule
+        served from it over the PROCESS lifetime
+        (`rules.served.<index>` counters) and within the last `last_n`
+        flight-ring entries (None = the whole ring), plus an `unused`
+        flag for indexes no ring entry selected. Report only — nothing
+        is vacuumed; an index idle here may still serve a workload that
+        rotated out of the bounded ring, so treat `unused` as a
+        candidate list, not a verdict."""
+        from hyperspace_tpu import telemetry
+
+        counters = telemetry.get_registry().counters_dict()
+        ring = telemetry.get_recorder().queries(last_n)
+        ring_counts: dict = {}
+        for qm in ring:
+            try:
+                for use in qm.index_usage():
+                    name = use.get("name")
+                    if name:
+                        ring_counts[name] = ring_counts.get(name, 0) + 1
+            except Exception:
+                continue  # a foreign recorder shape never breaks the report
+        out = []
+        for entry in self._manager.indexes():
+            name = entry.name
+            served_ring = ring_counts.get(name, 0)
+            out.append({
+                "index": name,
+                "state": entry.state,
+                "served_total": int(
+                    counters.get(f"rules.served.{name}", 0)),
+                "served_in_ring": served_ring,
+                "ring_entries": len(ring),
+                "unused": served_ring == 0,
+            })
+        return out
+
     def metrics_registry(self):
         """The process-wide metrics registry (delegates to the
         session; see `HyperspaceSession.metrics_registry`)."""

@@ -75,6 +75,8 @@ from hyperspace_tpu.telemetry import compilation  # noqa: F401
 from hyperspace_tpu.telemetry import artifact  # noqa: F401
 from hyperspace_tpu.telemetry import diff  # noqa: F401
 from hyperspace_tpu.telemetry import flight  # noqa: F401
+from hyperspace_tpu.telemetry import timeseries  # noqa: F401
+from hyperspace_tpu.telemetry import ops_server  # noqa: F401
 from hyperspace_tpu.telemetry.compilation import instrumented_jit
 from hyperspace_tpu.telemetry.flight import (FlightRecorder,
                                              get_recorder)
@@ -91,6 +93,7 @@ __all__ = [
     "memory", "compilation", "instrumented_jit", "artifact", "diff",
     "flight", "FlightRecorder", "get_recorder",
     "DeviceMemoryAccountant", "get_accountant",
+    "timeseries", "ops_server",
 ]
 
 
@@ -300,6 +303,13 @@ class QueryMetrics:
         # never touched a device (pure host lane).
         self.peak_hbm_bytes: int = 0
         self.peak_hbm_per_device: Dict[str, int] = {}
+        # Serving dimensions, stamped by the scheduler and the batch
+        # lane: the routed replica slice (None = unrouted) and the
+        # batched-execution cohort this query rode ({"id", "size"},
+        # None = solo). The flight ring inherits both, so post-hoc
+        # tail diagnosis can group by replica and cohort.
+        self.replica = None
+        self.cohort: Optional[dict] = None
         self._lock = threading.Lock()
         self._ids = itertools.count()
         self._tls = threading.local()
@@ -407,6 +417,33 @@ class QueryMetrics:
                 float(self.counters.get("compile.seconds", 0.0)), 6),
         }
 
+    @property
+    def roofline(self) -> dict:
+        """This query's device cost story, from the XLA cost analyses
+        `instrumented_jit` captured at trace time and the per-dispatch
+        measured walls: modeled flops and bytes accessed, the measured
+        warm-dispatch seconds, the device share of the query's wall
+        (the device-bound-vs-overhead split — a low share says the
+        bottleneck is host orchestration, not the chip), and the
+        arithmetic intensity that places the work on a roofline plot.
+        Walls on async backends are dispatch-side unless an operator
+        syncs, so achieved flops/s is a floor estimate."""
+        flops = float(self.counters.get("device.flops", 0.0))
+        nbytes = float(self.counters.get("device.bytes_accessed", 0.0))
+        disp = float(self.counters.get("device.dispatch_s", 0.0))
+        wall = self.wall_s
+        return {
+            "flops": round(flops, 1),
+            "bytes_accessed": round(nbytes, 1),
+            "dispatch_s": round(disp, 6),
+            "device_share": (round(min(disp / wall, 1.0), 4)
+                             if wall else None),
+            "intensity_flops_per_byte": (round(flops / nbytes, 4)
+                                         if nbytes else None),
+            "achieved_flops_per_s": (round(flops / disp, 1)
+                                     if disp > 0 else None),
+        }
+
     def events_of(self, category: str, name: Optional[str] = None
                   ) -> List[dict]:
         return [e for e in self.events
@@ -459,7 +496,7 @@ class QueryMetrics:
         return out
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "description": self.description,
             "started_at": self.started_at,
             "wall_s": (round(self.wall_s, 6)
@@ -472,7 +509,13 @@ class QueryMetrics:
             "peak_hbm_bytes": self.peak_hbm_bytes,
             "peak_hbm_per_device": dict(self.peak_hbm_per_device),
             "compile": self.compile,
+            "roofline": self.roofline,
         }
+        if self.replica is not None:
+            out["replica"] = self.replica
+        if self.cohort is not None:
+            out["cohort"] = dict(self.cohort)
+        return out
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.to_dict(), indent=indent, sort_keys=False,
@@ -516,6 +559,7 @@ class QueryMetrics:
             "index_usage": self.index_usage(),
             "peak_hbm_bytes": self.peak_hbm_bytes,
             "compile": self.compile,
+            "roofline": self.roofline,
         }
 
     def format_tree(self) -> str:
@@ -576,6 +620,17 @@ class QueryMetrics:
             lines.append(f"Compile: {comp['traces']} traces, "
                          f"{comp['cache_hits']} cache hits, "
                          f"{comp['seconds']:.4f}s")
+        roof = self.roofline
+        if roof["flops"] or roof["dispatch_s"]:
+            bits = [f"{roof['flops']:.0f} flops",
+                    f"{roof['bytes_accessed']:.0f} B accessed",
+                    f"{roof['dispatch_s']:.4f}s dispatch"]
+            if roof["device_share"] is not None:
+                bits.append(f"device share {roof['device_share']:.1%}")
+            if roof["intensity_flops_per_byte"] is not None:
+                bits.append(
+                    f"{roof['intensity_flops_per_byte']:.2f} flops/B")
+            lines.append("Device: " + ", ".join(bits))
         return "\n".join(lines)
 
     def __repr__(self) -> str:

@@ -104,6 +104,35 @@ def segments_digest() -> dict:
     }
 
 
+def device_cost_digest() -> dict:
+    """Process-lifetime roofline digest: modeled device cost (XLA
+    cost_analysis, captured per trace and charged per dispatch by
+    `instrumented_jit`) next to the measured warm-dispatch wall, plus
+    the per-entry-point cost memo — so a committed round carries
+    whether the work was device-bound or overhead-bound, not just how
+    long it took."""
+    from hyperspace_tpu.telemetry import compilation
+    from hyperspace_tpu.telemetry import registry as _registry
+
+    c = _registry.get_registry().counters_dict()
+    flops = float(c.get("device.flops", 0.0))
+    nbytes = float(c.get("device.bytes_accessed", 0.0))
+    disp = float(c.get("device.dispatch.seconds", 0.0))
+    return {
+        "flops": round(flops, 1),
+        "bytes_accessed": round(nbytes, 1),
+        "dispatch_seconds": round(disp, 6),
+        "intensity_flops_per_byte": (round(flops / nbytes, 4)
+                                     if nbytes else None),
+        "achieved_flops_per_s": (round(flops / disp, 1)
+                                 if disp > 0 else None),
+        "per_entry_point": {
+            name: {"flops": round(f, 1), "bytes_accessed": round(b, 1)}
+            for name, (f, b)
+            in sorted(compilation.entry_point_costs().items())},
+    }
+
+
 def query_metrics_block(qm) -> dict:
     """Per-query telemetry block: `summary()` (the compact rollup
     earlier rounds embedded) plus the full `to_dict()` operator tree
@@ -146,6 +175,7 @@ def make_artifact(*, driver: str, metric: str, value, unit: str,
     doc["transfer"] = transfer_digest()
     doc["process_metrics"] = telemetry.get_registry().counters_dict()
     doc["memory"] = telemetry.memory.artifact_section()
+    doc["device_cost"] = device_cost_digest()
     return doc
 
 

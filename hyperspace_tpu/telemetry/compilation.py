@@ -40,10 +40,25 @@ from typing import Dict, Optional
 from hyperspace_tpu.telemetry import registry as _registry
 
 __all__ = ["instrumented_jit", "REGISTRY", "configure_persistent_cache",
-           "persistent_cache_dir", "aot_warmup", "reset_aot_memo"]
+           "persistent_cache_dir", "aot_warmup", "reset_aot_memo",
+           "entry_point_costs"]
+
+
+def entry_point_costs() -> Dict[str, tuple]:
+    """{entry point name: (flops, bytes_accessed)} of the last traced
+    program per instrumented jit (the memo every dispatch charges)."""
+    with _sig_lock:
+        return dict(_costs)
 
 # name -> instrumented wrapper (the coverage lint audits the stamps).
 REGISTRY: Dict[str, object] = {}
+
+# name -> (flops, bytes_accessed) of the last traced program: XLA's
+# own cost analysis, captured at trace time (where the lowering is
+# already paid for) and charged on every subsequent dispatch of the
+# entry point — the modeled-device-cost half of roofline attribution
+# (`QueryMetrics.roofline`; measured wall is the other half).
+_costs: Dict[str, tuple] = {}
 
 # name -> last traced signature, PROCESS-wide (not per wrapper): entry
 # points that rebuild their jit per call (the mesh step factories) must
@@ -223,6 +238,34 @@ class _Frame:
         self.traced = False
 
 
+def _capture_cost(name: str, jfn, args, kwargs) -> Optional[tuple]:
+    """XLA cost analysis of the program just traced: re-lower with the
+    same arguments (the trace path already paid once; observability
+    rides the slow path, never the warm one) and read the modeled
+    flops / bytes accessed. Best-effort — any backend or shape that
+    cannot be lowered out-of-line returns None and the dispatch
+    proceeds uncounted. Re-entrancy guard: the re-lower re-runs the
+    wrapped body, and a NESTED instrumented jit called from it must
+    not count phantom traces or recurse into its own capture."""
+    if getattr(_tls, "in_cost_capture", False):
+        return None
+    _tls.in_cost_capture = True
+    try:
+        lowered = jfn.lower(*args, **kwargs)
+        ca = lowered.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            return None
+        flops = float(ca.get("flops") or 0.0)
+        nbytes = float(ca.get("bytes accessed") or 0.0)
+        return (flops, nbytes)
+    except Exception:
+        return None
+    finally:
+        _tls.in_cost_capture = False
+
+
 def instrumented_jit(name: str, fn=None, **jit_kwargs):
     """`jax.jit` with compile observability. Use exactly like jit:
 
@@ -258,6 +301,11 @@ def instrumented_jit(name: str, fn=None, **jit_kwargs):
     def call(*args, **kwargs):
         from hyperspace_tpu import telemetry
 
+        if getattr(_tls, "in_cost_capture", False):
+            # Nested dispatch under a cost-analysis re-lower: execute
+            # without instrumentation (the outer capture would
+            # otherwise pollute trace counters and recurse).
+            return jfn(*args, **kwargs)
         frames = _frames()
         frame = _Frame()
         frames.append(frame)
@@ -279,6 +327,17 @@ def instrumented_jit(name: str, fn=None, **jit_kwargs):
             reg.counter("compile.traces").inc()
             reg.counter("compile.seconds").inc(elapsed)
             reg.counter(f"compile.{name}.traces").inc()
+            # Device cost attribution: capture XLA's modeled flops /
+            # bytes for THIS program while the trace is already the
+            # slow path; every later dispatch charges the memoized
+            # cost (per-query and process-wide).
+            cost = _capture_cost(name, jfn, args, kwargs)
+            if cost is not None:
+                with _sig_lock:
+                    _costs[name] = cost
+                reg.counter(f"compile.{name}.flops").inc(cost[0])
+                reg.counter(
+                    f"compile.{name}.bytes_accessed").inc(cost[1])
             telemetry.memory.cache_miss("jit")
             entries = cache_size()
             if entries is not None:
@@ -298,6 +357,19 @@ def instrumented_jit(name: str, fn=None, **jit_kwargs):
             reg.counter("compile.cache_hits").inc()
             telemetry.memory.cache_hit("jit")
             telemetry.add_count("compile.cache_hits")
+            # Warm dispatch wall = measured device-side seconds (the
+            # traced path's elapsed is compile time and stays in the
+            # compile bucket). Dispatch-side on async backends.
+            reg.counter("device.dispatch.seconds").inc(elapsed)
+            telemetry.add_seconds("device.dispatch_s", elapsed)
+        cost = _costs.get(name)
+        if cost is not None:
+            # The device executed this program either way: charge the
+            # modeled cost per dispatch, per-query and process-wide.
+            reg.counter("device.flops").inc(cost[0])
+            reg.counter("device.bytes_accessed").inc(cost[1])
+            telemetry.add_seconds("device.flops", cost[0])
+            telemetry.add_seconds("device.bytes_accessed", cost[1])
         return out
 
     call.__compile_span_instrumented__ = True

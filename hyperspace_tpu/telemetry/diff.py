@@ -11,8 +11,14 @@ PRs. This module is that consumer. Given an old and a new artifact
 and operator nodes by tree path, and splits every query's wall delta
 into:
 
-- `compute`   — per-operator self-time movement net of link/compile
-                (node-level deltas ride in the bucket detail);
+- `compute`   — per-operator self-time movement net of link/compile/
+                device-dispatch (node-level deltas ride in the bucket
+                detail) — i.e. host-side OVERHEAD;
+- `device_bound` — measured warm jit-dispatch seconds
+                (`device.dispatch_s`, the device half of the
+                device-bound-vs-overhead split), with the modeled XLA
+                cost movement (`device.{flops,bytes_accessed}`) as
+                evidence;
 - `link`      — H2D/D2H seconds from the per-query `link.{h2d,d2h}_s`
                 counters (the transfer engine's chunk counters ride
                 along as evidence);
@@ -212,17 +218,21 @@ class QueryDiff:
 def _attribute_from_rollups(qd: QueryDiff, old: Optional[dict],
                             new: Optional[dict]) -> None:
     """Telemetry-based decomposition. Sums exactly:
-    delta = plan + compute + link + compile + residual (compute is the
-    operator self-time movement net of the link/compile seconds that
-    happened inside operators — no double counting)."""
+    delta = plan + compute + link + compile + device_bound + residual
+    (compute is the operator self-time movement net of the link/
+    compile/device-dispatch seconds that happened inside operators —
+    no double counting; what remains in `compute` is host-side
+    overhead, the other half of the device-bound-vs-overhead split)."""
     link_d = (_counter(new, "link.h2d_s", "link.d2h_s")
               - _counter(old, "link.h2d_s", "link.d2h_s"))
     compile_d = (_counter(new, "compile.seconds")
                  - _counter(old, "compile.seconds"))
+    device_d = (_counter(new, "device.dispatch_s")
+                - _counter(old, "device.dispatch_s"))
     plan_d = _counter(new, "plan_s") - _counter(old, "plan_s")
     self_d = (sum((new or {}).get("per_op", {}).values())
               - sum((old or {}).get("per_op", {}).values()))
-    compute_d = self_d - link_d - compile_d
+    compute_d = self_d - link_d - compile_d - device_d
     delta = qd.delta if qd.delta is not None else self_d + plan_d
     residual = delta - plan_d - self_d
 
@@ -259,9 +269,21 @@ def _attribute_from_rollups(qd: QueryDiff, old: Optional[dict],
             {"target": e.get("target"), "cause": e.get("cause")}
             for e in retraces[:5]]
 
+    # Device-bound vs overhead: the measured warm-dispatch seconds the
+    # instrumented jits charged (`device.dispatch_s`) move in their own
+    # bucket, with the MODELED cost movement (XLA cost_analysis flops /
+    # bytes) as evidence — "the chip did 2x the flops" and "the chip
+    # did the same flops slower" are different regressions.
+    device_detail: dict = {}
+    for k in ("device.flops", "device.bytes_accessed"):
+        d = _counter(new, k) - _counter(old, k)
+        if d:
+            device_detail[k] = round(d, 1)
+
     qd.buckets.append(Bucket("compute", compute_d, compute_detail))
     qd.buckets.append(Bucket("link", link_d, link_detail))
     qd.buckets.append(Bucket("compile", compile_d, compile_detail))
+    qd.buckets.append(Bucket("device_bound", device_d, device_detail))
     qd.buckets.append(Bucket("plan", plan_d))
     qd.buckets.append(Bucket("residual", residual))
 

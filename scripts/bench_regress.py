@@ -376,7 +376,11 @@ def compare_serve(old: dict, new: dict, threshold: float):
       of exactly 1 means the lane ran but never coalesced anything);
     - `aot_warm_traces` — the AOT-warmed replica phase must record
       ZERO new `compile.traces` (absolute, like the warm-H2D rows:
-      the healthy value is 0 and nothing ratio-gates against zero).
+      the healthy value is 0 and nothing ratio-gates against zero);
+    - `window_p99_agreement` / `slo_burn` — operations-plane rounds
+      (PR 15): the sampler's sliding-window p99 must agree with the
+      closed-loop percentile within the log2-bucket + population
+      slack, and the steady-state SLO burn rate must not exceed 1.0.
 
     Absolute rows gate on the NEW artifact alone; rounds predating the
     sections are not gated on them."""
@@ -416,6 +420,29 @@ def compare_serve(old: dict, new: dict, threshold: float):
     if isinstance(wt, (int, float)):
         rows.append(("aot_warm_traces", 0.0, float(wt), float(wt),
                      wt > 0))
+    # Operations-plane gates (rounds predating the sections skip):
+    # - `window_p99_agreement` — the timeseries sampler's sliding-
+    #   window p99 over the timed closed loop must agree with the
+    #   client-measured percentile. The window value is a log2-bucket
+    #   UPPER bound (within 2x above the truth by construction), and
+    #   the two populations differ slightly (server walls vs client
+    #   walls), so the gate allows 4x each way: outside that, the
+    #   window math or the sampling itself broke.
+    # - `slo_burn` — the closed loop ran with the SLO window reset at
+    #   the timed-loop start, so a burn rate above 1.0 means the
+    #   steady-state serving round violated its own p99 objective
+    #   (absolute — the healthy value is ~0 and nothing ratio-gates
+    #   against zero).
+    wp, cp = n.get("window_p99_s"), n.get("p99_s")
+    if isinstance(wp, (int, float)) and isinstance(cp, (int, float)) \
+            and wp > 0 and cp > 0:
+        ratio = wp / cp
+        rows.append(("window_p99_agreement", cp, wp, ratio - 1.0,
+                     not (0.25 <= ratio <= 4.0)))
+    burn = (n.get("slo") or {}).get("burn_rate")
+    if isinstance(burn, (int, float)):
+        rows.append(("slo_burn", 1.0, float(burn), float(burn) - 1.0,
+                     burn > 1.0))
     ol = n.get("open_loop") or {}
     slo_qps = ol.get("qps_at_p99_slo")
     oslo = (old.get("serve") or {}).get("open_loop") or {}

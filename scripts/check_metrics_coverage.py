@@ -543,6 +543,100 @@ def check_string_remap_seam(package_dir: str):
     return failures
 
 
+# Doc drift: every counter/gauge/histogram NAME LITERAL registered in
+# the package must have a row in docs/telemetry.md. Dynamic names
+# (f-strings — per-index, per-entry-point series) are exempt by
+# construction: the regex requires a plain string literal as the first
+# argument. A metric that ships without its doc row is a series an
+# operator cannot interpret from the scrape alone.
+_METRIC_NAME_RE = re.compile(
+    r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"([^"]+)"')
+
+
+def _expand_braces(token: str):
+    """`a.{x,y}.b` -> `a.x.b`, `a.y.b` (multiple groups expand
+    cross-product) — the doc table's compact spelling for metric
+    families."""
+    m = re.search(r"\{([^{}]*)\}", token)
+    if m is None:
+        yield token
+        return
+    for alt in m.group(1).split(","):
+        yield from _expand_braces(token[:m.start()] + alt
+                                  + token[m.end():])
+
+
+def check_metric_doc_rows(package_dir: str, repo_root: str):
+    """Source lint: every literal metric name must appear in
+    docs/telemetry.md (plainly, or inside a backticked
+    `family.{a,b}`-style brace pattern)."""
+    doc_path = os.path.join(repo_root, "docs", "telemetry.md")
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            doc = f.read()
+    except OSError:
+        return [f"{doc_path}: missing — the metrics reference lives "
+                "there"]
+    documented = set()
+    for token in re.findall(r"`([^`\s]+)`", doc):
+        if "{" in token:
+            documented.update(_expand_braces(token))
+    names = {}
+    for root, dirs, files in os.walk(package_dir):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            for m in _METRIC_NAME_RE.finditer(src):
+                names.setdefault(m.group(1), f"hyperspace_tpu/{rel}")
+    failures = []
+    for name in sorted(names):
+        if name not in doc and name not in documented:
+            failures.append(
+                f"{names[name]}: metric {name!r} has no row in "
+                "docs/telemetry.md — document the series before "
+                "shipping it")
+    return failures
+
+
+# The ONE sanctioned HTTP surface: the operations endpoint
+# (`telemetry/ops_server.py` — localhost-bound by default, counted,
+# error-guarded). A raw `http.server` anywhere else is a listening
+# socket the ops-plane knobs don't govern and the security note
+# doesn't cover.
+_RAW_HTTP_RE = re.compile(
+    r"http\.server|ThreadingHTTPServer|BaseHTTPRequestHandler")
+_HTTP_ALLOWED = os.path.join("telemetry", "ops_server.py")
+
+
+def check_http_server_seam(package_dir: str):
+    """Source lint: no `http.server` use outside telemetry/ops_server.py."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _HTTP_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_HTTP_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: raw "
+                            "http.server use outside the ops endpoint "
+                            "— serve it through telemetry/ops_server.py "
+                            "(bind policy, counters, error guards)")
+    return failures
+
+
 # The ONE sanctioned backoff point: every storage retry routes through
 # the policy in utils/retry.py (typed classification, conf-driven
 # backoff, io.retries/io.giveups counters, fault-injection coverage).
@@ -668,6 +762,11 @@ def main() -> int:
     failures.extend(check_string_remap_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_bench_artifact_seam(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    failures.extend(check_http_server_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_metric_doc_rows(
+        os.path.dirname(hyperspace_tpu.__file__),
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
     if import_errors:

@@ -1,0 +1,373 @@
+"""Ops endpoint: urllib round-trips of /metrics | /healthz |
+/timeseries, Prometheus exposition conformance of the window series,
+the SLO burn -> shed -> recovery cycle with exact counter agreement,
+and cost-analysis counters after a warm TPC-DS-shaped query on the CPU
+backend."""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import telemetry
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.engine import scheduler as sched_mod
+from hyperspace_tpu.engine.scheduler import (Deadline, QueryScheduler,
+                                             _QueryEntry)
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.exceptions import QueryRejectedError
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.telemetry import ops_server, timeseries
+
+
+@pytest.fixture
+def fresh_scheduler():
+    sch = sched_mod.set_scheduler(QueryScheduler())
+    yield sch
+    sched_mod.set_scheduler(QueryScheduler())
+
+
+@pytest.fixture
+def server():
+    """An ephemeral-port ops server (the process singleton, stopped on
+    teardown so suites never leak a listener)."""
+    srv = ops_server.start_server(port=0)
+    yield srv
+    ops_server.stop_server()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), \
+            resp.read().decode("utf-8")
+
+
+def _tpcds_shaped_session(tmp_path):
+    """A store_sales-shaped fact + date-dim pair, device lane forced so
+    the warm query dispatches instrumented jits on the CPU backend."""
+    rng = np.random.default_rng(5)
+    n, n_dim = 4000, 365
+    fact = tmp_path / "store_sales"
+    dim = tmp_path / "date_dim"
+    fact.mkdir()
+    dim.mkdir()
+    pq.write_table(pa.table({
+        "ss_sold_date_sk": rng.integers(0, n_dim, n).astype(np.int64),
+        "ss_quantity": rng.integers(1, 100, n).astype(np.int64),
+        "ss_net_paid": rng.random(n) * 500,
+    }), str(fact / "part-0.parquet"))
+    pq.write_table(pa.table({
+        "d_date_sk": np.arange(n_dim, dtype=np.int64),
+        "d_moy": (np.arange(n_dim, dtype=np.int64) % 12) + 1,
+    }), str(dim / "part-0.parquet"))
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "spark.hyperspace.execution.min.device.rows": "0",
+    }))
+    q = (sess.read_parquet(str(fact))
+         .filter(col("ss_quantity") > lit(5))
+         .join(sess.read_parquet(str(dim)),
+               on=col("ss_sold_date_sk") == col("d_date_sk"))
+         .group_by("d_moy")
+         .agg(("sum", "ss_net_paid", "revenue"), cnt=("count", "*")))
+    return sess, q
+
+
+# ---------------------------------------------------------------------------
+# Endpoint round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_endpoints_round_trip_and_cost_counters(tmp_path, server):
+    sess, q = _tpcds_shaped_session(tmp_path)
+    q.collect()                      # trace (cost captured here)
+    table, m = q.collect(with_metrics=True)   # warm dispatch
+    assert table.num_rows > 0
+    assert m.compile["traces"] == 0  # genuinely warm
+    timeseries.get_sampler().tick()
+
+    # /metrics: Prometheus text with the window gauges and the
+    # cost-analysis counters of the warm TPC-DS-shaped query.
+    status, ctype, body = _get(server, "/metrics")
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    assert "hs_window_query_wall_s_p99" in body
+    assert re.search(r"hs_compile_\w+_flops \d", body)
+    assert "hs_device_dispatch_seconds" in body
+
+    # Cost attribution landed registry- AND query-side.
+    counters = telemetry.get_registry().counters_dict()
+    flops = {k: v for k, v in counters.items()
+             if k.startswith("compile.") and k.endswith(".flops")}
+    assert flops and all(v > 0 for v in flops.values())
+    assert counters.get("device.flops", 0) > 0
+    roof = m.roofline
+    assert roof["flops"] > 0
+    assert roof["bytes_accessed"] > 0
+    assert roof["dispatch_s"] > 0
+    assert 0 < roof["device_share"] <= 1.0
+    assert m.to_dict()["roofline"]["flops"] == roof["flops"]
+
+    # /healthz: one JSON doc of serving state.
+    status, ctype, body = _get(server, "/healthz")
+    assert status == 200
+    assert ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["status"] == "ok"
+    for key in ("scheduler", "breakers", "segments", "replicas",
+                "flight"):
+        assert key in doc, key
+    assert "slo" in doc["scheduler"]
+    assert "queue_depth" in doc["scheduler"]
+    assert "by_replica" in doc["flight"]
+
+    # /timeseries: the ring as JSON.
+    status, ctype, body = _get(server, "/timeseries")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["samples"], "sampler ring empty"
+    assert "interval_s" in doc and "window_s" in doc
+
+    # Unknown path: 404, not a stack trace.
+    status, _ctype, _body = _get_allow_404(server, "/nope")
+    assert status == 404
+
+
+def _get_allow_404(srv, path):
+    try:
+        return _get(srv, path)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.headers.get("Content-Type"), ""
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition conformance of the window series
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_SAMPLE_RE = re.compile(r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ")
+
+
+def test_metrics_exposition_conformance_with_window_series(server):
+    """The full /metrics payload — window gauges included — obeys the
+    exposition format: HELP then TYPE per family, legal names, no
+    repeated TYPE, cumulative histogram buckets."""
+    reg = telemetry.get_registry()
+    reg.histogram("query.wall_s").observe(0.004)
+    reg.histogram("query.wall_s").observe(0.050)
+    timeseries.get_sampler().tick()
+    _status, _ctype, text = _get(server, "/metrics")
+    assert text.endswith("\n")
+    seen_type, seen_help = {}, set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert _NAME_RE.fullmatch(name), line
+            assert name not in seen_help
+            seen_help.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            assert kind in ("counter", "gauge", "histogram"), line
+            assert name not in seen_type, f"duplicate TYPE: {line}"
+            assert name in seen_help, f"TYPE before HELP: {line}"
+            seen_type[name] = kind
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        family = re.sub(r"_(bucket|sum|count)$", "", m.group(1))
+        assert family in seen_type or m.group(1) in seen_type, line
+    # The window series are exported as gauges under legal names.
+    window_families = [n for n, k in seen_type.items()
+                       if n.startswith("hs_window_")]
+    assert window_families
+    assert all(seen_type[n] == "gauge" for n in window_families)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn -> shed -> recovery
+# ---------------------------------------------------------------------------
+
+
+def test_slo_burn_trip_shed_and_recovery(fresh_scheduler):
+    """Trip the burn window, watch the tightened queue shed with EXACT
+    counter agreement (every rejection while burning is a shed, and
+    only those), then watch the window slide and the full depth
+    return."""
+    sch = fresh_scheduler
+    conf = HyperspaceConf({
+        "spark.hyperspace.serve.hbm.budget.bytes": "100",
+        "spark.hyperspace.serve.queue.depth": "2",
+        "spark.hyperspace.serve.slo.p99.seconds": "0.01",
+        "spark.hyperspace.serve.slo.window.seconds": "1.5",
+        "spark.hyperspace.serve.slo.shed.enabled": "true",
+    })
+    reg = telemetry.get_registry()
+    shed0 = reg.counters_dict().get("serve.slo.shed", 0)
+    viol0 = reg.counters_dict().get("serve.slo.violations", 0)
+
+    # Trip: every recorded wall violates the 10ms target.
+    for _ in range(5):
+        sch.slo.record(0.05, conf)
+    assert sch.slo.burn_rate(conf) > sched_mod.SLO_SHED_BURN_THRESHOLD
+    counters = reg.counters_dict()
+    assert counters.get("serve.slo.violations", 0) - viol0 == 5
+    assert reg.to_dict()["gauges"]["serve.slo.burn_rate"] > 1.0
+    snap = sch.slo_snapshot(conf)
+    assert snap["window_violations"] == 5
+    assert snap["shed_enabled"] is True
+
+    # Occupy the budget, queue ONE waiter (fills the tightened depth
+    # 2 // 2 = 1 but not the configured 2).
+    hold = _QueryEntry("hold", Deadline("hold"), 100, None)
+    assert sch._admit(hold, conf) == 0.0
+    admitted = threading.Event()
+
+    def waiter():
+        e = _QueryEntry("w1", Deadline("w1"), 60, None)
+        sch._admit(e, conf)
+        admitted.set()
+        sch._release(e)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    for _ in range(400):
+        if sch.queue_depth() == 1:
+            break
+        time.sleep(0.005)
+    assert sch.queue_depth() == 1
+
+    # Re-trip just before the arrivals so a slow machine cannot let
+    # the window slide mid-assert.
+    for _ in range(5):
+        sch.slo.record(0.05, conf)
+    # Shed: each arrival is rejected by the TIGHTENED depth (1 waiter
+    # >= shed depth 1, but < configured depth 2) and counts
+    # serve.slo.shed exactly once.
+    shed_rejects = 0
+    for i in range(3):
+        with pytest.raises(QueryRejectedError) as ei:
+            sch._admit(_QueryEntry(f"s{i}", Deadline(f"s{i}"), 60,
+                                   None), conf)
+        assert "SLO shedding active" in str(ei.value)
+        shed_rejects += 1
+    assert reg.counters_dict().get("serve.slo.shed", 0) - shed0 \
+        == shed_rejects == 3
+
+    # Recovery: the window slides past the violations, burn decays to
+    # zero, and the SAME arrival now queues instead of shedding.
+    time.sleep(1.6)
+    assert sch.slo.burn_rate(conf) == 0.0
+    admitted2 = threading.Event()
+
+    def waiter2():
+        e = _QueryEntry("w2", Deadline("w2"), 60, None)
+        sch._admit(e, conf)
+        admitted2.set()
+        sch._release(e)
+
+    t2 = threading.Thread(target=waiter2)
+    t2.start()
+    for _ in range(400):
+        if sch.queue_depth() == 2:
+            break
+        time.sleep(0.005)
+    assert sch.queue_depth() == 2  # full depth back: w2 queued, no shed
+    assert reg.counters_dict().get("serve.slo.shed", 0) - shed0 == 3
+
+    sch._release(hold)
+    assert admitted.wait(5.0) and admitted2.wait(5.0)
+    t.join(5)
+    t2.join(5)
+
+
+def test_slo_off_by_default_records_nothing(fresh_scheduler):
+    sch = fresh_scheduler
+    conf = HyperspaceConf({})
+    sch.slo.record(10.0, conf)  # way over any target — but SLO is off
+    assert sch.slo.burn_rate(conf) == 0.0
+    assert sch.slo_snapshot(conf)["window_queries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Replica / cohort dimensions on the flight ring
+# ---------------------------------------------------------------------------
+
+
+def test_flight_snapshot_replica_filter():
+    rec = telemetry.FlightRecorder(capacity=8)
+    for i, rep in enumerate((0, 1, None, 1)):
+        qm = telemetry.QueryMetrics(description=f"q{i}")
+        qm.finish()
+        qm.replica = rep
+        rec.record(qm)
+    all_entries, last = rec.snapshot()
+    assert len(all_entries) == 4
+    rep1, last1 = rec.snapshot(replica=1)
+    assert [m.description for m in rep1] == ["q1", "q3"]
+    assert last1 == last  # the cursor stays global under the filter
+    # Incremental + filtered compose.
+    later, _ = rec.snapshot(since_seq=all_entries[1].flight_seq,
+                            replica=1)
+    assert [m.description for m in later] == ["q3"]
+
+
+def test_metrics_dimensions_serialize():
+    qm = telemetry.QueryMetrics(description="dims")
+    qm.finish()
+    assert "replica" not in qm.to_dict()  # unrouted stays undimensioned
+    qm.replica = 2
+    qm.cohort = {"id": "c-7", "size": 4, "leader": False}
+    d = qm.to_dict()
+    assert d["replica"] == 2
+    assert d["cohort"]["id"] == "c-7"
+    assert json.loads(qm.to_json())["cohort"]["size"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Per-index rule-usage mining (the drop advisor's raw signal)
+# ---------------------------------------------------------------------------
+
+
+def test_rules_served_counters_and_index_usage_report(tmp_path):
+    from hyperspace_tpu import Hyperspace, IndexConfig
+
+    rng = np.random.default_rng(9)
+    src = tmp_path / "src"
+    src.mkdir()
+    pq.write_table(pa.table({
+        "k": rng.integers(0, 50, 2000).astype(np.int64),
+        "v": rng.random(2000),
+        "w": rng.random(2000),
+    }), str(src / "part-0.parquet"))
+    sess = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh")}))
+    hs = Hyperspace(sess)
+    df = sess.read_parquet(str(src))
+    hs.create_index(df, IndexConfig("ops_hot", ["k"], ["v"]))
+    hs.create_index(df, IndexConfig("ops_cold", ["w"], ["v"]))
+    sess.enable_hyperspace()
+    reg = telemetry.get_registry()
+    served0 = reg.counters_dict().get("rules.served.ops_hot", 0)
+    for _ in range(3):
+        df.filter(col("k") == lit(7)).select("k", "v").collect()
+    counters = reg.counters_dict()
+    assert counters.get("rules.served.ops_hot", 0) - served0 == 3
+    # The report names the index nothing selected as unused.
+    usage = {row["index"]: row for row in hs.index_usage()}
+    assert usage["ops_hot"]["served_in_ring"] >= 3
+    assert usage["ops_hot"]["served_total"] >= 3
+    assert usage["ops_hot"]["unused"] is False
+    assert usage["ops_cold"]["served_in_ring"] == 0
+    assert usage["ops_cold"]["unused"] is True
+    # last_n narrows the ring window the report mines.
+    narrowed = {row["index"]: row for row in hs.index_usage(last_n=1)}
+    assert narrowed["ops_hot"]["ring_entries"] == 1

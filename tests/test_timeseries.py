@@ -1,0 +1,194 @@
+"""Timeseries sampler: window math vs a brute-force oracle (under
+concurrent observers), ring bounds, counter rates, window gauges, and
+the sampler thread's start/drain lifecycle."""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu import telemetry
+from hyperspace_tpu.telemetry import timeseries
+from hyperspace_tpu.telemetry.timeseries import (TimeSeriesSampler,
+                                                 delta_buckets,
+                                                 quantile_from_buckets)
+
+
+@pytest.fixture
+def sampler():
+    s = TimeSeriesSampler(interval_s=0.02, capacity=64, window_s=60.0)
+    yield s
+    s.drain()
+
+
+def _observe(name, values):
+    h = telemetry.get_registry().histogram(name)
+    for v in values:
+        h.observe(v)
+
+
+def _oracle_quantile(values, q):
+    """The brute-force definition the bucket walk must agree with: the
+    ceil(q*n)-th order statistic (1-based)."""
+    s = sorted(values)
+    return s[max(1, math.ceil(q * len(s))) - 1]
+
+
+def test_quantile_from_buckets_bound_vs_oracle():
+    """For every q, the bucket quantile is the log2 UPPER bound of the
+    bucket holding the q-th observation: oracle <= reported <
+    2 * oracle (exact when the oracle value is a power of two)."""
+    rng = np.random.default_rng(11)
+    values = list(rng.lognormal(mean=-3.0, sigma=2.0, size=500)) \
+        + [0.25, 1.0, 4.0]  # exact powers of two hit the bound
+    buckets = {}
+    for v in values:
+        exp = math.ceil(math.log2(v)) if v > 0 else None
+        buckets[exp] = buckets.get(exp, 0) + 1
+    for q in (0.01, 0.25, 0.50, 0.90, 0.99, 1.0):
+        oracle = _oracle_quantile(values, q)
+        reported = quantile_from_buckets(buckets, q)
+        assert reported >= oracle
+        assert reported < 2 * oracle
+
+
+def test_quantile_nonpositive_bucket_and_empty():
+    assert quantile_from_buckets({}, 0.99) is None
+    assert quantile_from_buckets({None: 5}, 0.5) == 0.0
+    # Non-positive observations sort below every finite bucket.
+    assert quantile_from_buckets({None: 99, 0: 1}, 0.5) == 0.0
+    assert quantile_from_buckets({None: 1, 0: 99}, 0.99) == 1.0
+
+
+def test_window_quantile_vs_oracle_under_concurrent_observes(sampler):
+    """The E2E oracle test: N threads observe into one registry
+    histogram while the sampler ticks; the window quantile computed
+    from merged bucket deltas must bracket the brute-force quantile of
+    exactly the observed values."""
+    name = "query.wall_s"
+    reg = telemetry.get_registry()
+    base = reg.histogram(name).bucket_state()  # pre-test pollution
+    sampler.tick()
+    rng = np.random.default_rng(7)
+    per_thread = [list(rng.lognormal(-4.0, 1.5, size=200))
+                  for _ in range(4)]
+    stop = threading.Event()
+
+    def ticker():
+        while not stop.is_set():
+            sampler.tick()
+            time.sleep(0.002)
+
+    tick_thread = threading.Thread(target=ticker)
+    tick_thread.start()
+    threads = [threading.Thread(target=_observe, args=(name, vals))
+               for vals in per_thread]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    tick_thread.join()
+    sampler.tick()
+
+    observed = [v for vals in per_thread for v in vals]
+    # Window covering the whole run = cumulative now minus the
+    # pre-test baseline: check the merged deltas count every observe.
+    merged = delta_buckets(reg.histogram(name).bucket_state(), base)
+    assert sum(merged.values()) == len(observed)
+    for q in (0.50, 0.90, 0.99):
+        oracle = _oracle_quantile(observed, q)
+        reported = quantile_from_buckets(merged, q)
+        assert oracle <= reported < 2 * oracle
+    # The sampler's own trailing window (everything is recent) must
+    # agree with the merged-delta answer.
+    win = sampler.window_quantile(name, 0.99, window_s=3600.0)
+    assert win is not None and win > 0
+
+
+def test_ring_bounds_and_samples_order():
+    s = TimeSeriesSampler(interval_s=0.01, capacity=8)
+    for i in range(50):
+        s.tick(t=1000.0 + i)
+    assert len(s) == 8
+    samples = s.samples()
+    assert [x["t"] for x in samples] == sorted(x["t"] for x in samples)
+    assert samples[0]["t"] == pytest.approx(1042.0)
+    # since_t filters strictly-after.
+    assert len(s.samples(since_t=1045.0)) == 4
+    s.drain()
+
+
+def test_counter_rates_and_window_rate():
+    reg = telemetry.get_registry()
+    c = reg.counter("serve.admitted")
+    s = TimeSeriesSampler(interval_s=0.01, capacity=16)
+    s.tick(t=100.0)
+    c.inc(10)
+    sample = s.tick(t=102.0)
+    assert sample["rates"]["serve.admitted"] == pytest.approx(5.0)
+    c.inc(30)
+    s.tick(t=104.0)
+    # Window rate over the trailing 4s: 40 increments / 4s.
+    assert s.window_rate("serve.admitted", window_s=4.0) \
+        == pytest.approx(10.0)
+    s.drain()
+
+
+def test_window_gauges_published(sampler):
+    reg = telemetry.get_registry()
+    reg.histogram("query.wall_s").observe(0.01)
+    reg.counter("queries.total").inc()
+    sampler.tick()
+    reg.histogram("query.wall_s").observe(0.02)
+    reg.counter("queries.total").inc()
+    sampler.tick()
+    gauges = reg.to_dict()["gauges"]
+    assert gauges.get("window.query.wall_s.p99", 0) > 0
+    assert gauges.get("window.query.wall_s.count", 0) >= 1
+    assert "window.queries.total.rate" in gauges
+    assert gauges.get("timeseries.samples", 0) >= 2
+
+
+def test_sampler_thread_start_tick_drain():
+    s = TimeSeriesSampler(interval_s=0.01, capacity=32)
+    assert s.start() is True
+    assert s.start() is False  # already running
+    deadline = time.time() + 5.0
+    while len(s) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(s) >= 3
+    s.drain()
+    assert not s.running
+    n = len(s)
+    time.sleep(0.05)
+    assert len(s) == n  # genuinely stopped
+    s.drain()  # idempotent
+    # Restartable after drain.
+    assert s.start() is True
+    s.drain()
+
+
+def test_process_sampler_singleton_and_reset():
+    a = timeseries.get_sampler()
+    assert timeseries.get_sampler() is a
+    fresh = TimeSeriesSampler(interval_s=0.5)
+    assert timeseries.set_sampler(fresh) is fresh
+    assert timeseries.get_sampler() is fresh
+    timeseries.reset_sampler()
+    assert timeseries.get_sampler() is not fresh
+
+
+def test_sample_to_dict_is_json_able(sampler):
+    import json
+
+    reg = telemetry.get_registry()
+    reg.histogram("query.wall_s").observe(0.005)
+    reg.histogram("query.wall_s").observe(-1.0)  # the None bucket
+    sampler.tick()
+    doc = sampler.snapshot()
+    text = json.dumps(doc)
+    assert "-inf" in text  # the non-positive bucket key serializes
+    assert doc["samples"][-1]["histograms"]["query.wall_s"]["count"] >= 2

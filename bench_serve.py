@@ -45,13 +45,21 @@ Prints exactly ONE JSON line (canonical schema via
 gates scaling ratio + floor, QPS, p50/p99 growth, reject/timeout
 rates, batch occupancy, and the AOT warm-trace zero).
 
+PR 16 adds the opt-in `--tenants` chaos rung (phase 4): a hot
+point-query victim tenant laps solo and then co-located with a greedy
+cold-scan tenant (quota-capped) and an unmeetable-deadline tenant;
+the artifact's `serve.tenants` section carries both p99s, the
+mismatch/deadlock story, and the `tenant_report()` chargeback
+exactness flag — all gated by `bench_regress.py --serve`.
+
 Env knobs: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_QUERIES (240 total),
 BENCH_SERVE_ROWS (50000), BENCH_SERVE_BUDGET_BYTES (0 = unlimited),
 BENCH_SERVE_TIMEOUT_S (0 = none), BENCH_SERVE_QUEUE_DEPTH (32),
 BENCH_SERVE_OPEN_SECONDS (6 per rate; minutes-long soaks raise it),
 BENCH_SERVE_OPEN_WORKERS (64 logical clients), BENCH_SERVE_SLO_MS
 (150), BENCH_SERVE_RATES (comma fractions of serial QPS,
-"0.5,0.75,1.0,1.25,1.5").
+"0.5,0.75,1.0,1.25,1.5"), BENCH_SERVE_TENANT_QUERIES (240 per
+victim lap on the `--tenants` rung).
 """
 
 import json
@@ -496,6 +504,197 @@ def slow_decile_attribution():
     return out
 
 
+def tenants_phase(session, workload, expected):
+    """`--tenants` adversarial chaos rung (the ROADMAP multi-tenant
+    mix): three tenants co-located on one scheduler —
+
+    - **hot** (the victim): point lookups, the latency-sensitive
+      tenant whose p99 the round is about;
+    - **cold** (the greedy tenant): scans/joins/aggregates issued
+      back-to-back under a deliberately tiny HBM fraction, so it
+      saturates its quota and lives in the weighted-fair queue;
+    - **doomed**: queries carrying an unmeetable deadline — every one
+      must die with the TYPED deadline error, never a hang or a poison
+      of another tenant's slot.
+
+    The victim runs one lap SOLO and one lap co-located with the
+    chaos; the committed numbers are both p99s. `bench_regress.py
+    --serve` gates the ratio (co-located <= 2x solo), zero mismatches
+    (bit-identical results under chaos), zero deadlocks (every thread
+    joins), and the chargeback exactness flag from
+    `Hyperspace.tenant_report()`."""
+    from hyperspace_tpu import Hyperspace
+    from hyperspace_tpu.exceptions import (QueryDeadlineExceededError,
+                                           QueryRejectedError)
+
+    conf = session.conf
+    sched = session.scheduler()
+    hot = [(n, df) for n, df in workload if n.startswith("point_")]
+    cold = [(n, df) for n, df in workload
+            if not n.startswith("point_")]
+    hot_clients = 2
+    hot_queries = int(os.environ.get("BENCH_SERVE_TENANT_QUERIES", 240))
+
+    def hot_lap():
+        """One victim lap: `hot_clients` closed-loop threads drain
+        `hot_queries` point queries as tenant "hot". Results are kept
+        and oracle-checked AFTER the lap (same reasoning as the closed
+        loop: the timed path pays no canonicalize+compare)."""
+        lats, produced, errors = [], [], []
+        idx = [0]
+        lock = threading.Lock()
+
+        def client(cid: int):
+            while True:
+                with lock:
+                    if idx[0] >= hot_queries:
+                        return
+                    qi = idx[0]
+                    idx[0] += 1
+                name, df = hot[qi % len(hot)]
+                t1 = time.perf_counter()
+                try:
+                    table = df.collect(tenant="hot")
+                except Exception as exc:
+                    with lock:
+                        errors.append(f"{name}: {exc!r}")
+                    continue
+                wall = time.perf_counter() - t1
+                with lock:
+                    lats.append(wall)
+                    produced.append((name, table))
+
+        threads = [threading.Thread(target=client, args=(c,),
+                                    name=f"tenant-hot-{c}")
+                   for c in range(hot_clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        stuck = any(th.is_alive() for th in threads)
+        mism = list(errors)
+        for name, table in produced:
+            if not canonical(table).equals(expected[name]):
+                mism.append(f"{name}: result differs from serial run")
+        lats.sort()
+        return lats, mism, stuck
+
+    # Quota/weight knobs for the rung. The global budget is sized off
+    # the peak the earlier phases actually admitted, so it never binds
+    # on the victim; the cold tenant's 5% fraction DOES bind the
+    # moment it has one scan in flight — that is the "greedy tenant
+    # saturating its quota" the gate is about. Restored afterwards.
+    knobs = {
+        "spark.hyperspace.serve.hbm.budget.bytes":
+            str(max(int(sched.peak_admitted_bytes), 64 << 20)),
+        "spark.hyperspace.serve.tenant.hot.weight": "4",
+        "spark.hyperspace.serve.tenant.cold.weight": "1",
+        "spark.hyperspace.serve.tenant.cold.hbm.fraction": "0.05",
+        "spark.hyperspace.serve.tenant.cold.queue.depth": "4",
+        "spark.hyperspace.serve.tenant.doomed.queue.depth": "2",
+    }
+    saved = {k: conf.get(k) for k in knobs}
+    for k, v in knobs.items():
+        conf.set(k, v)
+
+    stop = threading.Event()
+    chaos = {"cold_ok": 0, "cold_rejected": 0, "cold_deadline": 0,
+             "doomed_deadline": 0, "doomed_ok": 0, "unexpected": 0}
+    chaos_lock = threading.Lock()
+
+    def cold_client(cid: int):
+        i = cid
+        while not stop.is_set():
+            _name, df = cold[i % len(cold)]
+            i += 1
+            try:
+                df.collect(tenant="cold", timeout=5.0)
+                key = "cold_ok"
+            except QueryRejectedError:
+                key = "cold_rejected"
+            except QueryDeadlineExceededError:
+                key = "cold_deadline"
+            except Exception:
+                key = "unexpected"
+            with chaos_lock:
+                chaos[key] += 1
+
+    def doomed_client():
+        i = 0
+        while not stop.is_set():
+            _name, df = hot[i % len(hot)]
+            i += 1
+            try:
+                # 1 microsecond: expired before the first checkpoint.
+                df.collect(tenant="doomed", timeout=1e-6)
+                key = "doomed_ok"
+            except (QueryRejectedError, QueryDeadlineExceededError):
+                key = "doomed_deadline"
+            except Exception:
+                key = "unexpected"
+            with chaos_lock:
+                chaos[key] += 1
+            time.sleep(0.01)
+
+    tenant_counter_names = [
+        f"serve.tenant.{t}.{k}"
+        for t in ("hot", "cold", "doomed")
+        for k in ("admitted", "rejected", "queued")]
+    try:
+        solo_lats, solo_mism, solo_stuck = hot_lap()
+
+        before = telemetry.get_registry().counters_dict()
+        # One cold client: its 5% HBM fraction already serializes the
+        # greedy tenant to one scan in flight, so a second thread
+        # would only deepen its own queue — and on a small host the
+        # co-located p99 must reflect scheduler isolation, not raw
+        # core starvation the admission plane cannot govern.
+        chaos_threads = [
+            threading.Thread(target=cold_client, args=(0,),
+                             name="tenant-cold-0"),
+            threading.Thread(target=doomed_client,
+                             name="tenant-doomed")]
+        for th in chaos_threads:
+            th.start()
+        coloc_lats, coloc_mism, coloc_stuck = hot_lap()
+        stop.set()
+        for th in chaos_threads:
+            th.join(timeout=60)
+        deadlock = (solo_stuck or coloc_stuck
+                    or any(th.is_alive() for th in chaos_threads))
+        after = telemetry.get_registry().counters_dict()
+    finally:
+        for k, v in saved.items():
+            conf.set(k, v) if v is not None else conf.unset(k)
+
+    rep = Hyperspace(session).tenant_report()
+    solo_p99 = _percentile(solo_lats, 0.99)
+    coloc_p99 = _percentile(coloc_lats, 0.99)
+    return {
+        "hot_clients": hot_clients,
+        "hot_queries": hot_queries,
+        "victim_solo_p50_s": round(_percentile(solo_lats, 0.50), 6),
+        "victim_solo_p99_s": round(solo_p99, 6),
+        "victim_coloc_p50_s": round(_percentile(coloc_lats, 0.50), 6),
+        "victim_coloc_p99_s": round(coloc_p99, 6),
+        "victim_isolation_x": (round(coloc_p99 / solo_p99, 3)
+                               if solo_p99 else None),
+        "mismatches": len(solo_mism) + len(coloc_mism),
+        "mismatch_detail": (solo_mism + coloc_mism)[:10],
+        "deadlock": deadlock,
+        "chaos": chaos,
+        "tenant_counters": {
+            name: round(after.get(name, 0) - before.get(name, 0), 6)
+            for name in tenant_counter_names},
+        "chargeback": {
+            "exact": rep["exact"],
+            "totals": {k: round(v, 6) for k, v in rep["totals"].items()},
+            "global": {k: round(v, 6) for k, v in rep["global"].items()},
+            "tenants": sorted(rep["tenants"]),
+        },
+    }
+
+
 def main():
     from hyperspace_tpu import HyperspaceConf, HyperspaceSession
 
@@ -539,6 +738,18 @@ def main():
 
         # Phase 3: open loop to the knee.
         serve["open_loop"] = open_loop(workload, expected, serial_qps)
+
+        # Phase 4 (opt-in): multi-tenant chaos rung.
+        if "--tenants" in sys.argv:
+            serve["tenants"] = tenants_phase(session, workload, expected)
+            tn = serve["tenants"]
+            log(f"tenants: victim p99 solo "
+                f"{tn['victim_solo_p99_s'] * 1e3:.1f} ms -> co-located "
+                f"{tn['victim_coloc_p99_s'] * 1e3:.1f} ms "
+                f"(x{tn['victim_isolation_x']}), "
+                f"{tn['mismatches']} mismatches, "
+                f"deadlock={tn['deadlock']}, "
+                f"chargeback exact={tn['chargeback']['exact']}")
 
         sched = session.scheduler()
         counters = telemetry.get_registry().counters_dict()

@@ -20,7 +20,11 @@ Gates, in order, per run:
 2. **build budget** — summed ESTIMATED index bytes per run stay under
    `spark.hyperspace.advisor.build.budget.bytes`
    (`advisor.rejected_budget` past it) and at most
-   `spark.hyperspace.advisor.max.builds` builds start.
+   `spark.hyperspace.advisor.max.builds` builds start. Signatures are
+   keyed by tenant (`advisor/miner.py`), and each tenant's share of the
+   run additionally stays under its own
+   `spark.hyperspace.advisor.tenant.<id>.budget.bytes` when set —
+   one chatty tenant cannot monopolize the build pool.
 3. **the lease path** — a lost OCC race or an index that appeared
    since scoring is a clean `conflict` decision (`advisor.
    build_conflicts`), not an error: somebody else built it, the
@@ -112,10 +116,12 @@ class AdvisorExecutor:
         budget = self.conf.advisor_build_budget_bytes
         max_builds = max(0, self.conf.advisor_max_builds)
         spent = 0
+        tenant_spent: dict = {}
         builds = 0
         for cand in candidates:
+            tenant = getattr(cand.signature, "tenant", None) or "default"
             decision = {"name": cand.name, "kind": cand.kind,
-                        "score": cand.score,
+                        "score": cand.score, "tenant": tenant,
                         "est_index_bytes": cand.est_index_bytes,
                         "decided_at": round(time.time(), 3)}
             if builds + len(cand.configs) > max_builds:
@@ -133,6 +139,26 @@ class AdvisorExecutor:
                            f"({spent} B already committed this run)")
                 decisions.append(decision)
                 continue
+            # Per-tenant build budget: the miner keys signatures by
+            # tenant, so each candidate bills exactly one tenant;
+            # `advisor.tenant.<id>.budget.bytes` caps what one tenant's
+            # workload can spend per run without starving the others
+            # out of the shared `build.budget.bytes` pool (0 = no
+            # per-tenant cap; the global budget still applies).
+            t_budget = self.conf.advisor_tenant_budget_bytes(tenant)
+            t_spent = tenant_spent.get(tenant, 0)
+            if t_budget > 0 and t_spent + cand.est_index_bytes > t_budget:
+                reg.counter("advisor.rejected_budget").inc()
+                reg.counter(
+                    f"advisor.tenant.{tenant}.rejected_budget").inc()
+                decision.update(
+                    action="rejected_budget",
+                    reason=f"estimated {cand.est_index_bytes} B would "
+                           f"exceed tenant '{tenant}'s {t_budget} B "
+                           f"build budget ({t_spent} B already "
+                           "committed this run)")
+                decisions.append(decision)
+                continue
             try:
                 built_names = []
                 for config, scan in zip(cand.configs, cand.scans):
@@ -145,6 +171,7 @@ class AdvisorExecutor:
                     builds += 1
                     built_names.append(config.index_name)
                 spent += cand.est_index_bytes
+                tenant_spent[tenant] = t_spent + cand.est_index_bytes
                 if built_names:
                     reg.counter("advisor.builds").inc(len(built_names))
                     decision.update(action="built", indexes=built_names)

@@ -46,11 +46,16 @@ class WorkloadSignature:
                  "eq_columns", "project_columns", "join_columns",
                  "right_join_columns", "referenced_columns",
                  "right_referenced_columns", "count", "total_scan_bytes",
-                 "last_seq", "plan")
+                 "last_seq", "plan", "tenant")
 
     def __init__(self, kind: str, key: tuple):
         self.kind = kind
         self.key = key
+        # The tenant whose queries exhibit the shape: signatures are
+        # KEYED by tenant, so two tenants' identical misses stay
+        # separate candidates — the executor budgets each against its
+        # own `advisor.tenant.<id>.budget.bytes`.
+        self.tenant: str = "default"
         self.roots: Tuple[str, ...] = ()
         self.right_roots: Tuple[str, ...] = ()
         self.filter_columns: Tuple[str, ...] = ()
@@ -81,6 +86,7 @@ class WorkloadSignature:
             "count": self.count,
             "total_scan_bytes": self.total_scan_bytes,
             "last_seq": self.last_seq,
+            "tenant": self.tenant,
         }
 
 
@@ -141,6 +147,7 @@ class WorkloadMiner:
             return
         seq = getattr(metrics, "flight_seq", 0)
         plan = getattr(metrics, "logical_plan", None)
+        tenant = getattr(metrics, "tenant", None) or "default"
         bytes_by_root = _scan_bytes_by_root(metrics)
         # One observation per (relation, predicate) per QUERY: the
         # filter rule declines both the outer Project(Filter(Scan))
@@ -162,24 +169,26 @@ class WorkloadMiner:
                     filters[k] = e
             elif e.get("name") == "JoinIndexRule" \
                     and e.get("left_roots") and e.get("right_roots"):
-                self._fold_join(e, seq, plan, bytes_by_root)
+                self._fold_join(e, seq, plan, bytes_by_root, tenant)
         for e in filters.values():
-            self._fold_filter(e, seq, plan, bytes_by_root)
+            self._fold_filter(e, seq, plan, bytes_by_root, tenant)
 
     @staticmethod
     def _cols(e, key) -> Tuple[str, ...]:
         return tuple(sorted({str(c).lower() for c in (e.get(key) or ())}))
 
-    def _fold_filter(self, e, seq, plan, bytes_by_root) -> None:
+    def _fold_filter(self, e, seq, plan, bytes_by_root,
+                     tenant: str = "default") -> None:
         roots = tuple(e["roots"])
         filter_cols = self._cols(e, "filter_columns")
         if not filter_cols:
             return
         project_cols = self._cols(e, "project_columns")
-        key = ("filter", roots, filter_cols, project_cols)
+        key = ("filter", tenant, roots, filter_cols, project_cols)
         sig = self._signatures.get(key)
         if sig is None:
             sig = self._signatures[key] = WorkloadSignature("filter", key)
+            sig.tenant = tenant
             sig.roots = roots
             sig.filter_columns = filter_cols
             sig.project_columns = project_cols
@@ -188,7 +197,8 @@ class WorkloadMiner:
         self._observe(sig, seq, plan,
                       sum(bytes_by_root.get(r, 0) for r in roots))
 
-    def _fold_join(self, e, seq, plan, bytes_by_root) -> None:
+    def _fold_join(self, e, seq, plan, bytes_by_root,
+                   tenant: str = "default") -> None:
         left_roots = tuple(e["left_roots"])
         right_roots = tuple(e["right_roots"])
         left_cols = tuple(str(c).lower()
@@ -197,10 +207,12 @@ class WorkloadMiner:
                            for c in (e.get("right_join_columns") or ()))
         if not left_cols or len(left_cols) != len(right_cols):
             return
-        key = ("join", left_roots, right_roots, left_cols, right_cols)
+        key = ("join", tenant, left_roots, right_roots, left_cols,
+               right_cols)
         sig = self._signatures.get(key)
         if sig is None:
             sig = self._signatures[key] = WorkloadSignature("join", key)
+            sig.tenant = tenant
             sig.roots = left_roots
             sig.right_roots = right_roots
             sig.join_columns = left_cols

@@ -389,6 +389,47 @@ class HyperspaceConf:
                          constants.SERVE_SLO_SHED_ENABLED_DEFAULT)
                 or "false").lower() == "true"
 
+    # -- multi-tenant serving (tenant id embedded in the conf key) -----
+
+    def serve_tenant_weight(self, tenant: str) -> float:
+        """Deficit-round-robin dequeue weight for `tenant` (default
+        1.0). Relative: a weight-2 tenant drains its wait queue twice
+        as fast as a weight-1 tenant under contention."""
+        v = self.get(f"{constants.SERVE_TENANT_PREFIX}{tenant}.weight")
+        try:
+            w = float(v) if v is not None else \
+                constants.SERVE_TENANT_WEIGHT_DEFAULT
+        except ValueError:
+            w = constants.SERVE_TENANT_WEIGHT_DEFAULT
+        return w if w > 0 else constants.SERVE_TENANT_WEIGHT_DEFAULT
+
+    def serve_tenant_hbm_fraction(self, tenant: str) -> float:
+        """Fraction of `serve.hbm.budget.bytes` the tenant may hold
+        admitted concurrently (0, the default, = unlimited)."""
+        v = self.get(
+            f"{constants.SERVE_TENANT_PREFIX}{tenant}.hbm.fraction")
+        try:
+            f = float(v) if v is not None else \
+                constants.SERVE_TENANT_HBM_FRACTION_DEFAULT
+        except ValueError:
+            f = constants.SERVE_TENANT_HBM_FRACTION_DEFAULT
+        return min(max(f, 0.0), 1.0)
+
+    def serve_tenant_queue_depth(self, tenant: str) -> int:
+        """Per-tenant cap on WAITING queries (0, the default, = only
+        the global `serve.queue.depth` applies)."""
+        return self.get_int(
+            f"{constants.SERVE_TENANT_PREFIX}{tenant}.queue.depth",
+            constants.SERVE_TENANT_QUEUE_DEPTH_DEFAULT)
+
+    def advisor_tenant_budget_bytes(self, tenant: str) -> int:
+        """Per-tenant cap on summed estimated index bytes the advisor
+        may auto-build for candidates mined from that tenant's queries
+        (0, the default, = only the global advisor budget applies)."""
+        return self.get_int(
+            f"{constants.ADVISOR_TENANT_PREFIX}{tenant}.budget.bytes",
+            constants.ADVISOR_TENANT_BUDGET_BYTES_DEFAULT)
+
     @property
     def telemetry_ops_port(self) -> Optional[int]:
         """Operations-plane HTTP port (`telemetry/ops_server.py`):

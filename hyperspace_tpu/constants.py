@@ -193,6 +193,28 @@ SERVE_SLO_WINDOW_SECONDS_DEFAULT = 60.0
 SERVE_SLO_SHED_ENABLED = "spark.hyperspace.serve.slo.shed.enabled"
 SERVE_SLO_SHED_ENABLED_DEFAULT = "false"
 
+# Multi-tenant serving (`engine/scheduler.py`): tenant-keyed knobs
+# embed the tenant id in the conf key —
+# `serve.tenant.<id>.weight` (float, default 1.0) is the tenant's
+# deficit-round-robin share of the admission dequeue; a tenant with
+# weight 2 drains its wait queue twice as fast as a weight-1 tenant
+# under contention. `serve.tenant.<id>.hbm.fraction` (float in (0, 1],
+# default 0 = unlimited) caps the tenant's concurrently-admitted
+# footprint at that fraction of `serve.hbm.budget.bytes`;
+# `serve.tenant.<id>.queue.depth` (int, default 0 = share the global
+# depth) caps how many of the tenant's queries may WAIT at once. The
+# default tenant is unlimited unless explicitly configured — existing
+# single-tenant deployments see no behavior change.
+# `advisor.tenant.<id>.budget.bytes` (default 0 = share the global
+# advisor budget) caps auto-built index bytes attributed to that
+# tenant's mined candidates.
+SERVE_TENANT_PREFIX = "spark.hyperspace.serve.tenant."
+SERVE_TENANT_WEIGHT_DEFAULT = 1.0
+SERVE_TENANT_HBM_FRACTION_DEFAULT = 0.0
+SERVE_TENANT_QUEUE_DEPTH_DEFAULT = 0
+ADVISOR_TENANT_PREFIX = "spark.hyperspace.advisor.tenant."
+ADVISOR_TENANT_BUDGET_BYTES_DEFAULT = 0
+
 # Operations plane (`telemetry/timeseries.py`, `telemetry/ops_server.py`):
 # the background sampler snapshots selected registry series every
 # `timeseries.interval.seconds` into a bounded ring of

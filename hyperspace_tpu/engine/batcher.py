@@ -266,7 +266,7 @@ _WAITING, _DONE, _FAILED, _ABANDONED = range(4)
 
 class _Member:
     __slots__ = ("sig", "deadline", "state", "result", "cohort_size",
-                 "cohort_id")
+                 "cohort_id", "tenant", "cohort_tenants")
 
     def __init__(self, sig: BatchSignature, deadline):
         self.sig = sig
@@ -275,6 +275,15 @@ class _Member:
         self.result = None
         self.cohort_size = 0
         self.cohort_id: Optional[str] = None
+        # The member's serving tenant, captured on its OWN thread at
+        # join time. Chargeback is leader-pays: `_execute` runs on the
+        # leader's thread under the leader's tenant scope, so the whole
+        # cohort's device dispatch bills the leader's tenant — the
+        # exactness contract (per-tenant sums == global counters) holds
+        # because every charge lands on exactly one tenant. The cohort
+        # report records every member tenant so the subsidy is visible.
+        self.tenant: str = telemetry.current_tenant()
+        self.cohort_tenants: tuple = ()
 
 
 class _Cohort:
@@ -442,12 +451,14 @@ class QueryBatcher:
                     del self._running[cohort.key]
                 self._cv.notify_all()  # wake the successor's leader
         cohort_id = f"c-{next(self._cohort_ids)}"
+        cohort_tenants = tuple(sorted({m.tenant for m in results}))
         with self._cv:
             for m, out in results.items():
                 if m.state == _WAITING:
                     m.result = out
                     m.cohort_size = len(results)
                     m.cohort_id = cohort_id
+                    m.cohort_tenants = cohort_tenants
                     m.state = _DONE
             # Anyone not sliced (joined too late to matter): fall back.
             for m in members:
@@ -460,7 +471,9 @@ class QueryBatcher:
         rec = telemetry.current()
         if rec is not None:
             rec.cohort = {"id": cohort_id, "size": len(results),
-                          "leader": True}
+                          "leader": True,
+                          "tenants": list(cohort_tenants),
+                          "tenant_pays": me.tenant}
         return results[me]
 
     def _fail(self, cohort: _Cohort, me: _Member) -> None:
@@ -513,7 +526,8 @@ class QueryBatcher:
             telemetry.add_count("serve.batch.member")
             if rec is not None:
                 rec.cohort = {"id": me.cohort_id,
-                              "size": me.cohort_size, "leader": False}
+                              "size": me.cohort_size, "leader": False,
+                              "tenants": list(me.cohort_tenants)}
             return me.result
         # Batch lane failed for this cohort: per-query fallback.
         if op is not None:

@@ -173,7 +173,8 @@ class DataFrame:
         return self.session.conf if self.session is not None else None
 
     def collect(self, with_metrics: bool = False,
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None,
+                tenant: Optional[str] = None):
         """Execute and return an Arrow table. `with_metrics=True` returns
         `(table, telemetry.QueryMetrics)` instead — per-operator timings
         and row counts, optimizer-rule and fusion-lane decision events,
@@ -191,9 +192,16 @@ class DataFrame:
         `session.cancel(query_id)` raises typed
         `QueryDeadlineExceededError` / `QueryCancelledError` at the
         next cooperative checkpoint — and the per-index degradation
-        circuit breaker around the index-fallback path."""
+        circuit breaker around the index-fallback path.
+
+        `tenant` names the billing identity this query charges
+        (admission quotas, weighted-fair dequeue, per-tenant SLO
+        window, and the `tenant.<id>.*` chargeback counters); default
+        None uses the session's sticky `session.tenant(...)` choice,
+        else the "default" tenant."""
         from hyperspace_tpu.engine.scheduler import get_scheduler
-        table, metrics = get_scheduler().collect(self, timeout=timeout)
+        table, metrics = get_scheduler().collect(self, timeout=timeout,
+                                                 tenant=tenant)
         return (table, metrics) if with_metrics else table
 
     def to_pandas(self):

@@ -1280,9 +1280,36 @@ class SortMergeJoinExec(PhysicalNode):
             return None
         if align is None and rsh.split_plan is not None:
             # Right-side-only skew: the counting layout would need the
-            # LEFT replicated; swapping sides is only sound for inner.
-            spmd.spmd_fallback("subshard-right")
-            return None
+            # LEFT replicated, which breaks unmatched-left uniqueness
+            # (outer) and duplicates membership take indices (semi /
+            # anti). INNER has no unmatched-row semantics on either
+            # side, so swap roles instead of declining: re-read the
+            # left ALIGNED to the right's split (each right row lives
+            # on exactly one shard; intersecting left buckets replicate
+            # per covering shard) and run the counting match with the
+            # right as the preserved side — bit-identical inner output,
+            # one extra left read instead of a full lane miss.
+            if self.how != "inner":
+                spmd.spmd_fallback("subshard-right")
+                return None
+            lsh = self.left.execute_sharded(self.num_buckets, mesh,
+                                            align_plan=rsh.split_plan)
+            if lsh is None:
+                spmd.spmd_fallback("subshard-right")
+                return None
+            telemetry.get_registry().counter(
+                "mesh.spmd.side_swapped").inc()
+            telemetry.annotate(lane="spmd")
+            from hyperspace_tpu.ops.bucketed_join import (
+                assemble_join_output)
+            factor = (self.conf.distribution_capacity_factor
+                      if self.conf is not None else None)
+            ri, li = spmd.sharded_join_indices(
+                rsh, lsh, self.right_keys, self.left_keys, how="inner",
+                capacity_factor=factor, conf=self.conf)
+            return assemble_join_output(lsh.batch, rsh.batch, li, ri,
+                                        how="inner",
+                                        columns=self.out_columns)
         telemetry.annotate(lane="spmd")
         if self.how in ("left_semi", "left_anti"):
             idx = spmd.sharded_semi_anti_indices(

@@ -174,9 +174,14 @@ class SloTracker:
     events rather than a view over the timeseries sampler: burn-rate
     decisions (shedding!) must be exact and available whether or not
     the background sampler is running; the sampler's `window.*` gauges
-    are the derived, scrapeable view of the same story."""
+    are the derived, scrapeable view of the same story.
 
-    def __init__(self):
+    `prefix` names the published series family: the global tracker
+    publishes `serve.slo.*`; per-tenant trackers publish
+    `serve.tenant.<id>.slo.*` — same window math, same knobs."""
+
+    def __init__(self, prefix: str = "serve.slo"):
+        self.prefix = prefix
         self._lock = threading.Lock()
         self._events: deque = deque()  # (monotonic t, violated: bool)
         self._violations_in_window = 0
@@ -206,11 +211,11 @@ class SloTracker:
             violations = self._violations_in_window
         reg = telemetry.get_registry()
         if violated:
-            reg.counter("serve.slo.violations").inc()
+            reg.counter(f"{self.prefix}.violations").inc()
         burn = ((violations / total) / _SLO_ALLOWED_FRACTION
                 if total else 0.0)
-        reg.gauge("serve.slo.burn_rate").set(burn)
-        reg.gauge("serve.slo.window_queries").set(total)
+        reg.gauge(f"{self.prefix}.burn_rate").set(burn)
+        reg.gauge(f"{self.prefix}.window_queries").set(total)
 
     def burn_rate(self, conf) -> float:
         """Current burn rate over the trailing window (0.0 = off or no
@@ -373,7 +378,7 @@ class BreakerBoard:
 
 class _QueryEntry:
     __slots__ = ("query_id", "deadline", "footprint", "session_id",
-                 "admitted", "replica", "n_replicas")
+                 "admitted", "replica", "n_replicas", "tenant", "shed")
 
     def __init__(self, query_id: str, deadline: Deadline, footprint: int,
                  session_id: Optional[int]):
@@ -389,6 +394,12 @@ class _QueryEntry:
         # others' admission headroom.
         self.replica: Optional[int] = None
         self.n_replicas: int = 0
+        # Billing identity: the tenant this query charges (default
+        # tenant when no tenant scope is active — never None, so every
+        # query always has someone to bill) and the shed flag the SLO
+        # shedder sets to evict this WAITING entry from the queue.
+        self.tenant: str = telemetry.DEFAULT_TENANT
+        self.shed = False
 
 
 class QueryScheduler:
@@ -401,7 +412,7 @@ class QueryScheduler:
     def __init__(self):
         self._cv = threading.Condition()
         self._active: Dict[str, _QueryEntry] = {}
-        self._waiters: deque = deque()  # _QueryEntry FIFO
+        self._waiters: deque = deque()  # all waiting _QueryEntry
         self._admitted_bytes = 0
         self._inflight = 0
         self._idle_baseline = 0  # accountant live bytes at idle
@@ -415,6 +426,24 @@ class QueryScheduler:
         # gauges `serve.replica.<i>.admitted_bytes` mirror them.
         self._replica_bytes: Dict[int, int] = {}
         self._replica_inflight: Dict[int, int] = {}
+        # Multi-tenant state. The wait queue is weighted-fair
+        # deficit-round-robin across per-tenant FIFOs (one burst cannot
+        # starve the long tail): `_tenant_queues` holds each tenant's
+        # waiters in arrival order, `_drr_order` rotates the tenants,
+        # `_drr_deficit` accumulates each tenant's configured weight
+        # per round and spends 1.0 per dequeue, and `_drr_next` pins
+        # the selected head until it admits or leaves (selection must
+        # be stable across cv wakeups or waiters livelock). Admission
+        # quotas charge `_tenant_bytes`/`_tenant_inflight`; per-tenant
+        # `SloTracker`s publish `serve.tenant.<id>.slo.*` and name the
+        # burning tenant the shed hook evicts first.
+        self._tenant_queues: Dict[str, deque] = {}
+        self._drr_order: deque = deque()  # tenant ids, round-robin
+        self._drr_deficit: Dict[str, float] = {}
+        self._drr_next: Optional[_QueryEntry] = None
+        self._tenant_bytes: Dict[str, int] = {}
+        self._tenant_inflight: Dict[str, int] = {}
+        self._tenant_slo: Dict[str, SloTracker] = {}
 
     # -- introspection ----------------------------------------------------
 
@@ -463,6 +492,51 @@ class QueryScheduler:
     def slo_snapshot(self, conf=None) -> dict:
         """SLO window state for `/healthz` and the bench drivers."""
         return self._slo.snapshot(conf)
+
+    def _tenant_slo_for(self, tenant: str) -> SloTracker:
+        """The tenant's own SLO window (created on first use),
+        publishing `serve.tenant.<id>.slo.*`. Lock-free on the hit
+        path: this runs once per COMPLETED query, and taking the
+        scheduler cv here would put every finisher in line behind
+        admission traffic."""
+        trk = self._tenant_slo.get(tenant)  # atomic dict read
+        if trk is not None:
+            return trk
+        with self._cv:
+            trk = self._tenant_slo.get(tenant)
+            if trk is None:
+                trk = SloTracker(prefix=f"serve.tenant.{tenant}.slo")
+                self._tenant_slo[tenant] = trk
+            return trk
+
+    def tenant_snapshot(self, conf=None) -> dict:
+        """Per-tenant serving state for `/healthz` and
+        `Hyperspace.tenant_report()`: admitted bytes, in-flight and
+        queued counts, the tenant's SLO window, and its configured
+        scheduling knobs."""
+        with self._cv:
+            tenants = (set(self._tenant_bytes)
+                       | set(self._tenant_inflight)
+                       | set(self._tenant_queues)
+                       | set(self._tenant_slo))
+            out = {t: {"admitted_bytes": self._tenant_bytes.get(t, 0),
+                       "inflight": self._tenant_inflight.get(t, 0),
+                       "queued": len(self._tenant_queues.get(t, ()))}
+                   for t in sorted(tenants)}
+            trackers = dict(self._tenant_slo)
+        for t, d in out.items():
+            trk = trackers.get(t)
+            if trk is not None:
+                d["slo"] = trk.snapshot(conf)
+            if conf is not None:
+                d["weight"] = conf.serve_tenant_weight(t)
+                frac = conf.serve_tenant_hbm_fraction(t)
+                if frac > 0:
+                    d["hbm_fraction"] = frac
+                tdepth = conf.serve_tenant_queue_depth(t)
+                if tdepth > 0:
+                    d["queue_depth"] = tdepth
+        return out
 
     # -- cancellation -----------------------------------------------------
 
@@ -516,12 +590,22 @@ class QueryScheduler:
         except Exception:
             return 0
 
-    def _fits(self, ent: "_QueryEntry", budget: int) -> bool:
+    def _fits(self, ent: "_QueryEntry", budget: int, conf=None) -> bool:
         # Caller holds the cv lock. Progress guarantee: with nothing in
         # flight a query larger than the whole budget still admits —
         # the budget bounds CONCURRENCY, it must never wedge serving.
         if self._inflight == 0:
             return True
+        # Per-tenant HBM quota (`serve.tenant.<id>.hbm.fraction`): a
+        # configured tenant may hold at most its fraction of the budget
+        # admitted concurrently, with the same progress guarantee — a
+        # tenant with nothing in flight always admits one query.
+        frac = (conf.serve_tenant_hbm_fraction(ent.tenant)
+                if conf is not None else 0.0)
+        if frac > 0 and self._tenant_inflight.get(ent.tenant, 0) > 0 \
+                and self._tenant_bytes.get(ent.tenant, 0) \
+                + ent.footprint > int(budget * frac):
+            return False
         if ent.replica is not None and ent.n_replicas > 1:
             # Per-replica admission: the query charges its SLICE's
             # share of the budget, with the same per-replica progress
@@ -537,66 +621,209 @@ class QueryScheduler:
                    live - self._idle_baseline if live else 0)
         return used + ent.footprint <= budget
 
+    # -- weighted-fair wait queue (deficit round robin) -------------------
+
+    def _enqueue_waiter(self, ent: _QueryEntry) -> None:
+        # Caller holds the cv lock.
+        self._waiters.append(ent)
+        q = self._tenant_queues.setdefault(ent.tenant, deque())
+        q.append(ent)
+        if ent.tenant not in self._drr_order:
+            self._drr_order.append(ent.tenant)
+
+    def _remove_waiter(self, ent: _QueryEntry) -> None:
+        # Caller holds the cv lock. Safe to call when not queued.
+        try:
+            self._waiters.remove(ent)
+        except ValueError:
+            pass
+        q = self._tenant_queues.get(ent.tenant)
+        if q is not None:
+            try:
+                q.remove(ent)
+            except ValueError:
+                pass
+            if not q:
+                self._tenant_queues.pop(ent.tenant, None)
+        if self._drr_next is ent:
+            self._drr_next = None
+
+    def _drr_select(self, conf) -> Optional[_QueryEntry]:
+        """The waiter that admits next, by weighted-fair deficit round
+        robin over the per-tenant FIFOs: each visited tenant banks its
+        configured weight and a dequeue spends 1.0, so a weight-2
+        tenant drains twice as fast as a weight-1 tenant under
+        contention — and a one-tenant burst cannot starve the others'
+        heads the way the old global FIFO could. The pick is PINNED
+        (`_drr_next`) until that entry admits or leaves: selection must
+        be stable across cv wakeups or waiters spin past each other.
+        Caller holds the cv lock."""
+        if self._drr_next is not None:
+            return self._drr_next
+        if not self._waiters:
+            return None
+        for _ in range(4096):  # weights are clamped > 0: bounded spin
+            if not self._drr_order:
+                self._drr_order.extend(self._tenant_queues)
+                if not self._drr_order:
+                    break
+            t = self._drr_order[0]
+            q = self._tenant_queues.get(t)
+            if not q:
+                self._drr_order.popleft()
+                self._drr_deficit.pop(t, None)
+                continue
+            d = self._drr_deficit.get(t, 0.0)
+            if d < 1.0:
+                # Bank the weight only when broke: deficits stay
+                # bounded in [0, max(w, 1)) instead of accumulating
+                # credit a tenant could never spend.
+                d += (conf.serve_tenant_weight(t)
+                      if conf is not None else 1.0)
+            if d >= 1.0:
+                self._drr_deficit[t] = d - 1.0
+                if d - 1.0 < 1.0:
+                    # Deficit spent: this tenant's turn ends. While
+                    # credit remains it stays at the head — a weight-2
+                    # tenant dequeues twice per visit, which is what
+                    # makes the weights mean drain RATE.
+                    self._drr_order.rotate(-1)
+                self._drr_next = q[0]
+                return self._drr_next
+            self._drr_deficit[t] = d
+            self._drr_order.rotate(-1)
+        self._drr_next = self._waiters[0]  # defensive: degrade to FIFO
+        return self._drr_next
+
+    def _shed_victim(self, arriving: _QueryEntry, conf) \
+            -> Optional[_QueryEntry]:
+        """While shedding is active, the BURNING tenant's queue sheds
+        first: the waiter shed to make room is the newest queued entry
+        of the tenant whose own SLO window burns hottest — not the
+        arriving query, unless the arriver IS the burning tenant (or
+        no burning tenant has anything queued). Caller holds the cv
+        lock; returns None when the arriving query should be rejected
+        instead (the pre-tenant behavior)."""
+        burning, worst = None, SLO_SHED_BURN_THRESHOLD
+        for t, trk in self._tenant_slo.items():
+            if t == arriving.tenant:
+                continue
+            q = self._tenant_queues.get(t)
+            if not q:
+                continue
+            burn = trk.burn_rate(conf)
+            if burn > worst:
+                burning, worst = t, burn
+        if burning is None:
+            return None
+        return self._tenant_queues[burning][-1]
+
     def _admit(self, ent: _QueryEntry, conf) -> float:
-        """Admit `ent` (blocking in FIFO order when over budget).
-        Returns seconds spent queued. Raises QueryRejectedError when
-        the wait queue is full, or the entry's own deadline error when
-        it expires/cancels while queued."""
+        """Admit `ent` (blocking, weighted-fair across tenants, when
+        over budget). Returns seconds spent queued. Raises
+        QueryRejectedError when the wait queue is full (globally or for
+        the entry's tenant), or the entry's own deadline error when it
+        expires/cancels while queued."""
         from hyperspace_tpu.utils import faults
         faults.fire("scheduler.admit")
         reg = telemetry.get_registry()
         budget = conf.serve_hbm_budget_bytes if conf is not None else 0
         with self._cv:
             if budget <= 0 or (not self._waiters
-                               and self._fits(ent, budget)):
+                               and self._fits(ent, budget, conf)):
                 self._grant(ent, reg)
                 reg.histogram("serve.queue_wait_s").observe(0.0)
                 return 0.0
             depth = max(0, conf.serve_queue_depth
                         if conf is not None else 0)
+            # Per-tenant queue-depth quota: a configured tenant may
+            # hold at most `serve.tenant.<id>.queue.depth` WAITING
+            # queries — its burst backpressures itself before it can
+            # occupy the shared queue.
+            tdepth = (conf.serve_tenant_queue_depth(ent.tenant)
+                      if conf is not None else 0)
+            tqueued = len(self._tenant_queues.get(ent.tenant, ()))
+            if tdepth > 0 and tqueued >= tdepth:
+                reg.counter(f"serve.tenant.{ent.tenant}.rejected").inc()
+                raise QueryRejectedError(
+                    f"query {ent.query_id} rejected: tenant "
+                    f"'{ent.tenant}' wait queue is full "
+                    f"({tqueued}/{tdepth})",
+                    query_id=ent.query_id, phase="queue")
             # SLO shedding (opt-in): while the burn rate says the error
             # budget is being consumed faster than the p99 objective
             # allows, tighten the wait queue to HALF its configured
             # depth — controlled backpressure at the admission door
             # instead of a queue whose tail is guaranteed to violate.
             # A query rejected by the tightened (not the configured)
-            # depth counts `serve.slo.shed` exactly once.
+            # depth counts `serve.slo.shed` exactly once. With tenants
+            # in play the shed targets the BURNING tenant's queue
+            # first: its newest waiter is evicted to make room for the
+            # arriver, so one tenant burning its budget cannot convert
+            # tightened depth into rejections for everyone else.
             effective = depth
             if conf is not None and conf.serve_slo_shed_enabled \
                     and self._slo.burn_rate(conf) \
                     > SLO_SHED_BURN_THRESHOLD:
                 effective = depth // 2
             if len(self._waiters) >= effective:
-                if effective < depth and len(self._waiters) < depth:
-                    reg.counter("serve.slo.shed").inc()
-                raise QueryRejectedError(
-                    f"query {ent.query_id} rejected: projected "
-                    f"{ent.footprint} B does not fit the serving "
-                    f"budget ({budget} B, {self._admitted_bytes} B "
-                    f"admitted) and the wait queue is full "
-                    f"({len(self._waiters)}/{effective}"
-                    + (" — SLO shedding active"
-                       if effective < depth else "") + ")",
-                    query_id=ent.query_id, phase="queue")
+                shed_mode = effective < depth \
+                    and len(self._waiters) < depth
+                if shed_mode:
+                    victim = self._shed_victim(ent, conf)
+                    if victim is not None and not victim.shed:
+                        victim.shed = True
+                        reg.counter("serve.slo.shed").inc()
+                        reg.counter(
+                            f"serve.tenant.{victim.tenant}.rejected"
+                        ).inc()
+                        self._cv.notify_all()
+                    else:
+                        reg.counter("serve.slo.shed").inc()
+                        reg.counter(
+                            f"serve.tenant.{ent.tenant}.rejected").inc()
+                        raise QueryRejectedError(
+                            f"query {ent.query_id} rejected: projected "
+                            f"{ent.footprint} B does not fit the "
+                            f"serving budget ({budget} B, "
+                            f"{self._admitted_bytes} B admitted) and "
+                            f"the wait queue is full "
+                            f"({len(self._waiters)}/{effective} — SLO "
+                            f"shedding active)",
+                            query_id=ent.query_id, phase="queue")
+                else:
+                    reg.counter(
+                        f"serve.tenant.{ent.tenant}.rejected").inc()
+                    raise QueryRejectedError(
+                        f"query {ent.query_id} rejected: projected "
+                        f"{ent.footprint} B does not fit the serving "
+                        f"budget ({budget} B, {self._admitted_bytes} B "
+                        f"admitted) and the wait queue is full "
+                        f"({len(self._waiters)}/{effective})",
+                        query_id=ent.query_id, phase="queue")
             t0 = time.perf_counter()
-            self._waiters.append(ent)
+            self._enqueue_waiter(ent)
             reg.counter("serve.queued").inc()
+            reg.counter(f"serve.tenant.{ent.tenant}.queued").inc()
             reg.gauge("serve.queue_depth").set(len(self._waiters))
             try:
-                while not (self._waiters[0] is ent
-                           and self._fits(ent, budget)):
+                while not (self._drr_select(conf) is ent
+                           and self._fits(ent, budget, conf)):
+                    if ent.shed:
+                        raise QueryRejectedError(
+                            f"query {ent.query_id} shed from the wait "
+                            f"queue: tenant '{ent.tenant}' is burning "
+                            f"its SLO error budget",
+                            query_id=ent.query_id, phase="queue")
                     ent.deadline.check("queue")
                     rem = ent.deadline.remaining()
                     self._cv.wait(timeout=(_WAIT_QUANTUM_S if rem is None
                                            else min(rem + 1e-3,
                                                     _WAIT_QUANTUM_S)))
-                self._waiters.popleft()
+                self._remove_waiter(ent)
                 self._grant(ent, reg)
             finally:
-                try:
-                    self._waiters.remove(ent)
-                except ValueError:
-                    pass  # admitted (popleft) — the normal path
+                self._remove_waiter(ent)  # no-op when admitted above
                 reg.gauge("serve.queue_depth").set(len(self._waiters))
                 self._cv.notify_all()
             wait_s = time.perf_counter() - t0
@@ -611,8 +838,13 @@ class QueryScheduler:
         if self._admitted_bytes > self.peak_admitted_bytes:
             self.peak_admitted_bytes = self._admitted_bytes
         reg.counter("serve.admitted").inc()
+        reg.counter(f"serve.tenant.{ent.tenant}.admitted").inc()
         reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
         reg.gauge("serve.active").set(self._inflight)
+        self._tenant_bytes[ent.tenant] = \
+            self._tenant_bytes.get(ent.tenant, 0) + ent.footprint
+        self._tenant_inflight[ent.tenant] = \
+            self._tenant_inflight.get(ent.tenant, 0) + 1
         if ent.replica is not None:
             r = ent.replica
             self._replica_bytes[r] = (self._replica_bytes.get(r, 0)
@@ -642,6 +874,8 @@ class QueryScheduler:
                 return 0
             ent.footprint -= delta
             self._admitted_bytes -= delta
+            self._tenant_bytes[ent.tenant] = max(
+                0, self._tenant_bytes.get(ent.tenant, 0) - delta)
             reg = telemetry.get_registry()
             reg.counter("serve.footprint_credit_bytes").inc(delta)
             reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
@@ -661,6 +895,11 @@ class QueryScheduler:
             if ent.admitted:
                 self._admitted_bytes -= ent.footprint
                 self._inflight -= 1
+                self._tenant_bytes[ent.tenant] = max(
+                    0, self._tenant_bytes.get(ent.tenant, 0)
+                    - ent.footprint)
+                self._tenant_inflight[ent.tenant] = max(
+                    0, self._tenant_inflight.get(ent.tenant, 0) - 1)
                 if ent.replica is not None:
                     r = ent.replica
                     self._replica_bytes[r] = max(
@@ -676,6 +915,8 @@ class QueryScheduler:
                     self._admitted_bytes = 0
                     self._replica_bytes.clear()
                     self._replica_inflight.clear()
+                    self._tenant_bytes.clear()
+                    self._tenant_inflight.clear()
                     self._idle_baseline = self._live_device_bytes()
                 reg.gauge("serve.admitted_bytes").set(self._admitted_bytes)
                 reg.gauge("serve.active").set(self._inflight)
@@ -776,10 +1017,15 @@ class QueryScheduler:
 
     # -- the collect pipeline ---------------------------------------------
 
-    def collect(self, df, timeout: Optional[float] = None):
+    def collect(self, df, timeout: Optional[float] = None,
+                tenant: Optional[str] = None):
         """Execute a DataFrame end to end under serving control.
         Returns `(arrow_table, QueryMetrics)` — `DataFrame.collect`
-        owns the user-facing return shape."""
+        owns the user-facing return shape. `tenant` (else the
+        session's sticky `session.tenant(...)` default, else the
+        DEFAULT tenant) is the billing identity the query charges:
+        admission quotas, DRR dequeue weight, SLO window, and every
+        chargeback counter key on it."""
         from hyperspace_tpu.io.columnar import to_arrow
         from hyperspace_tpu.plan import footprint as _footprint
         from hyperspace_tpu.utils import faults
@@ -789,6 +1035,9 @@ class QueryScheduler:
         if session is not None and getattr(session, "_closed", False):
             raise HyperspaceException(
                 "Session is closed; create a new HyperspaceSession.")
+        if tenant is None and session is not None:
+            tenant = getattr(session, "_default_tenant", None)
+        eff_tenant = str(tenant) if tenant else telemetry.DEFAULT_TENANT
         query_id = f"q-{next(self._ids)}"
         if timeout is None and conf is not None:
             timeout = conf.serve_deadline_seconds or None
@@ -796,6 +1045,7 @@ class QueryScheduler:
         ent = _QueryEntry(query_id, deadline,
                           _footprint.projected_bytes(df.plan),
                           id(session) if session is not None else None)
+        ent.tenant = eff_tenant
         # Replica routing (`parallel/replica.py`): on a multi-slice
         # topology with replication on, pin this query's fills +
         # execution to the least-loaded replica slice (cold-range
@@ -819,6 +1069,11 @@ class QueryScheduler:
         # attribution, /healthz's by-replica grouping) can now group
         # entries by the slice that served them; None = unrouted.
         metrics.replica = ent.replica
+        # Tenant dimension: stamped on the recorder (flight-ring
+        # `tenant=` filter, /healthz by-tenant grouping) — always the
+        # EFFECTIVE tenant, "default" included, so post-hoc grouping
+        # never needs a null branch.
+        metrics.tenant = eff_tenant
         # The SOURCE (pre-optimization) logical plan rides the recorder
         # into the flight ring: the index advisor's what-if scorer
         # replays exactly this plan against hypothetical indexes
@@ -837,6 +1092,7 @@ class QueryScheduler:
             try:
                 with telemetry.recording(metrics), \
                         telemetry.deadline_scope(deadline), \
+                        telemetry.tenant_scope(eff_tenant), \
                         telemetry.span("query", "query",
                                        description=description):
                     metrics.event("serve", "admitted",
@@ -930,9 +1186,17 @@ class QueryScheduler:
         reg.counter("queries.total").inc()
         reg.counter("queries.seconds").inc(metrics.wall_s)
         reg.histogram("query.wall_s").observe(metrics.wall_s)
+        # Tenant-dimensioned wall: the sampler windows this histogram
+        # like `query.wall_s`, so per-tenant window p50/p99 land on
+        # `/metrics` and `/timeseries` beside the global series.
+        reg.histogram(f"tenant.{eff_tenant}.query_wall_s").observe(
+            metrics.wall_s)
         # Sliding-window SLO: fold this wall into the burn window
-        # (no-op while `serve.slo.p99.seconds` is 0).
+        # (no-op while `serve.slo.p99.seconds` is 0) — globally AND
+        # into the tenant's own window (`serve.tenant.<id>.slo.*`),
+        # which the shed hook reads to name the burning tenant.
         self._slo.record(metrics.wall_s, conf)
+        self._tenant_slo_for(eff_tenant).record(metrics.wall_s, conf)
         # Per-index rule-usage mining (the drop advisor's raw signal):
         # one process counter per index a rule actually SERVED this
         # query from — `Hyperspace.index_usage()` joins these against

@@ -27,6 +27,7 @@ class HyperspaceSession:
         self._hyperspace_enabled = False
         self._views: dict = {}
         self._last_query_metrics = None
+        self._default_tenant = None
         self._closed = False
         # Session knobs -> the process-wide pipelined transfer engine
         # (io.transfer.{chunk,inflight,threads}); refreshed again at
@@ -56,6 +57,22 @@ class HyperspaceSession:
         Sessions share it, same caveat as the transfer engine."""
         from hyperspace_tpu.engine.scheduler import get_scheduler
         return get_scheduler()
+
+    def tenant(self, tenant=None) -> "HyperspaceSession":
+        """Set this session's STICKY billing tenant: every subsequent
+        `collect` through this session charges `tenant` — admission
+        quotas, weighted-fair dequeue weight, per-tenant SLO window,
+        and the `tenant.<id>.*` chargeback counters all key on it.
+        `collect(tenant=...)` overrides per call; `tenant(None)`
+        reverts to the "default" tenant. This (with the scheduler it
+        feeds) is the ONE sanctioned tenant seam — the metrics-coverage
+        lint bans raw tenant-contextvar writes elsewhere. Returns self
+        for chaining: `session.tenant("acme").read_parquet(...)`."""
+        self._default_tenant = str(tenant) if tenant else None
+        if self._default_tenant is not None:
+            from hyperspace_tpu import telemetry
+            telemetry._note_tenant(self._default_tenant)
+        return self
 
     def active_queries(self) -> List[str]:
         """Ids of queries currently queued or running (process-wide) —

@@ -161,6 +161,47 @@ class Hyperspace:
         session; see `HyperspaceSession.metrics_registry`)."""
         return self.session.metrics_registry()
 
+    def tenant_report(self) -> dict:
+        """Per-tenant usage/cost chargeback report: for every tenant
+        seen since process start, the device cost it was billed
+        (modeled flops + bytes accessed and measured dispatch-seconds
+        from `instrumented_jit`'s per-dispatch charges), the link bytes
+        it moved, the segment-cache fills it paid for, and its serving
+        state (admitted bytes, in-flight/queued counts, SLO window,
+        configured quota knobs). EXACT by construction: every charge
+        site mirrors its global counter inc onto the active tenant's
+        `tenant.<id>.*` series at the same line, so `totals` (the
+        per-tenant sums) equals `global` (the process counters) to the
+        bit — the contract `bench_regress.py --serve` gates. Unscoped
+        work bills the "default" tenant; nothing is ever dropped."""
+        from hyperspace_tpu import telemetry
+
+        usage = telemetry.tenant_digest()
+        counters = telemetry.get_registry().counters_dict()
+        totals = {name: sum(u.get(name, 0) for u in usage.values())
+                  for name in telemetry.TENANT_CHARGE_COUNTERS}
+        global_ = {name: counters.get(name, 0)
+                   for name in telemetry.TENANT_CHARGE_COUNTERS}
+        sched = self.session.scheduler()
+        serving = sched.tenant_snapshot(self.session.conf)
+        tenants = {}
+        for t in sorted(set(usage) | set(serving)):
+            tenants[t] = {"usage": usage.get(t, {})}
+            if t in serving:
+                tenants[t]["serving"] = serving[t]
+        return {
+            "tenants": tenants,
+            "totals": totals,
+            "global": global_,
+            # Byte/flop/fill counters are integer-valued and sum
+            # exactly; dispatch-seconds is the one genuinely fractional
+            # series, where float summation order costs at most a few
+            # ulps — hence the relative epsilon instead of ==.
+            "exact": all(abs(totals[n] - global_[n])
+                         <= 1e-9 * max(1.0, abs(global_[n]))
+                         for n in totals),
+        }
+
     def export_trace(self, path: str) -> dict:
         """Export collected spans as Chrome trace-event JSON (requires
         a prior `telemetry.enable_tracing()`); loads in

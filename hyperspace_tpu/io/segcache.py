@@ -517,6 +517,10 @@ class SegmentCache:
                                 index=(ref.index_name if ref else None),
                                 files=len(paths)):
                 reg.counter("cache.segments.fills").inc()
+                # Tenant chargeback: the filler's tenant pays for the
+                # fill (coalesced waiters ride it free — same contract
+                # as the batch lane's leader-pays cohort accounting).
+                telemetry.charge_tenant("cache.segments.fills")
                 batch, nbytes = self._fill(key, fill, paths, cols,
                                            schema, stamps, ref, conf,
                                            budget)
@@ -580,6 +584,7 @@ class SegmentCache:
             with telemetry.span("segcache.fill", "cache",
                                 index=(ref.index_name if ref else None)):
                 reg.counter("cache.segments.fills").inc()
+                telemetry.charge_tenant("cache.segments.fills")
                 payload, nbytes = fill_fn()
                 budget_eff = self._effective_budget(conf, budget)
                 if budget_eff > 0 and nbytes <= budget_eff:

@@ -87,6 +87,8 @@ __all__ = [
     "QueryMetrics", "OperatorRecord", "current", "recording",
     "propagating", "event", "annotate", "add_seconds", "add_count",
     "current_deadline", "deadline_scope", "check_deadline",
+    "DEFAULT_TENANT", "current_tenant", "tenant_scope", "charge_tenant",
+    "known_tenants", "tenant_digest", "TENANT_CHARGE_COUNTERS",
     "MetricsRegistry", "get_registry", "Tracer", "enable_tracing",
     "disable_tracing", "tracing_enabled", "tracer", "span",
     "link_transfer", "record_link_transfer", "export_trace",
@@ -111,6 +113,28 @@ _current: contextvars.ContextVar[Optional["QueryMetrics"]] = \
 # features are off, the same always-off contract as the recorder.
 _deadline: contextvars.ContextVar = \
     contextvars.ContextVar("hyperspace_query_deadline", default=None)
+
+# The active TENANT identity rides the same contextvar scoping as the
+# recorder and deadline: set by the scheduler/session seam
+# (`session.tenant(...)` / `collect(tenant=...)` — raw writes anywhere
+# else are banned by `scripts/check_metrics_coverage.py`), carried
+# across pool threads by `propagating(...)`, read by every chargeback
+# site (`compilation.instrumented_jit`, `trace.record_link_transfer`,
+# the segment-cache fill paths) to mirror global counters onto
+# `tenant.<id>.*`. Unset means the DEFAULT tenant — charges never go
+# unattributed, so summing `tenant.<id>.*` over all tenants (including
+# "default") equals the global counters EXACTLY.
+_tenant: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("hyperspace_query_tenant", default=None)
+
+DEFAULT_TENANT = "default"
+
+# Tenants observed by any chargeback/scope since process start, so the
+# report/healthz surfaces can enumerate `tenant.<id>.*` families
+# without parsing metric names (tenant ids may themselves contain
+# dots). Guarded by its own lock; never pruned (ids are few).
+_known_tenants: set = {DEFAULT_TENANT}
+_known_tenants_lock = threading.Lock()
 
 
 def current() -> Optional["QueryMetrics"]:
@@ -147,6 +171,81 @@ def check_deadline(phase: str) -> None:
         d.check(phase)
 
 
+def current_tenant() -> str:
+    """The tenant the calling context charges to — the contextvar if a
+    tenant scope is active, else the DEFAULT tenant. Never None:
+    chargeback sites must always have someone to bill."""
+    return _tenant.get() or DEFAULT_TENANT
+
+
+def known_tenants() -> List[str]:
+    """Sorted ids of every tenant observed since process start."""
+    with _known_tenants_lock:
+        return sorted(_known_tenants)
+
+
+def _note_tenant(tenant: str) -> None:
+    if tenant not in _known_tenants:  # racy pre-check; set add is safe
+        with _known_tenants_lock:
+            _known_tenants.add(tenant)
+
+
+@contextmanager
+def tenant_scope(tenant: Optional[str]):
+    """Make `tenant` the active billing identity for the calling
+    context (None keeps the surrounding scope — a no-op carrier). This
+    is the ONE sanctioned write seam besides `propagating`; the
+    metrics-coverage lint bans raw `_tenant.set(...)` elsewhere."""
+    if tenant is None:
+        yield None
+        return
+    tenant = str(tenant)
+    _note_tenant(tenant)
+    token = _tenant.set(tenant)
+    try:
+        yield tenant
+    finally:
+        _tenant.reset(token)
+
+
+# Every counter family the chargeback sites mirror per-tenant. The
+# digest (and `Hyperspace.tenant_report()`) reads exactly these, and
+# the exactness contract is: for each name here, the sum of
+# `tenant.<id>.<name>` over ALL known tenants equals the global
+# counter of the same name.
+TENANT_CHARGE_COUNTERS = (
+    "device.flops", "device.bytes_accessed", "device.dispatch.seconds",
+    "link.h2d.bytes", "link.d2h.bytes", "cache.segments.fills",
+)
+
+
+def tenant_digest() -> Dict[str, Dict[str, float]]:
+    """{tenant: {charge counter: value}} for every known tenant, read
+    from the registry's `tenant.<id>.*` mirrors. Tenants with zero
+    usage are included (the default tenant always appears), so a
+    consumer can verify the exactness contract by summing columns."""
+    counters = get_registry().counters_dict()
+    out: Dict[str, Dict[str, float]] = {}
+    for t in known_tenants():
+        out[t] = {name: counters.get(f"tenant.{t}.{name}", 0)
+                  for name in TENANT_CHARGE_COUNTERS}
+    return out
+
+
+def charge_tenant(name: str, amount: float = 1.0,
+                  tenant: Optional[str] = None) -> str:
+    """Mirror a global-counter increment onto the active tenant's
+    `tenant.<id>.<name>` series. Call this at the SAME site as the
+    global `reg.counter(name).inc(amount)` so per-tenant sums stay
+    exactly equal to the global counters (the chargeback exactness
+    contract `Hyperspace.tenant_report()` asserts). Returns the tenant
+    charged."""
+    t = tenant if tenant is not None else current_tenant()
+    _note_tenant(t)
+    get_registry().counter(f"tenant.{t}.{name}").inc(amount)
+    return t
+
+
 @contextmanager
 def recording(metrics: "QueryMetrics"):
     """Make `metrics` the active recorder for the calling context."""
@@ -165,16 +264,19 @@ def propagating(fn):
     operator that forked the work (e.g. the bucketed join reading its
     two sides concurrently). The active Deadline rides along too: a
     cancelled query's pool-side subtree hits the same cooperative
-    checkpoints its main thread does."""
+    checkpoints its main thread does, and the active TENANT rides along
+    so pool-side device dispatches charge the right bill."""
     rec = _current.get()
     deadline = _deadline.get()
-    if rec is None and deadline is None:
+    tenant = _tenant.get()
+    if rec is None and deadline is None and tenant is None:
         return fn
     parent = rec._current_op_id() if rec is not None else None
 
     def run(*args, **kwargs):
         token = _current.set(rec)
         dtoken = _deadline.set(deadline)
+        ttoken = _tenant.set(tenant)
         if rec is not None:
             rec._adopt_parent(parent)
         try:
@@ -182,6 +284,7 @@ def propagating(fn):
         finally:
             if rec is not None:
                 rec._clear_adoption()
+            _tenant.reset(ttoken)
             _deadline.reset(dtoken)
             _current.reset(token)
 
@@ -306,10 +409,13 @@ class QueryMetrics:
         # Serving dimensions, stamped by the scheduler and the batch
         # lane: the routed replica slice (None = unrouted) and the
         # batched-execution cohort this query rode ({"id", "size"},
-        # None = solo). The flight ring inherits both, so post-hoc
-        # tail diagnosis can group by replica and cohort.
+        # None = solo), plus the tenant billed for the query (None =
+        # default tenant / no tenant scope). The flight ring inherits
+        # all three, so post-hoc tail diagnosis can group by replica,
+        # cohort, and tenant.
         self.replica = None
         self.cohort: Optional[dict] = None
+        self.tenant: Optional[str] = None
         self._lock = threading.Lock()
         self._ids = itertools.count()
         self._tls = threading.local()
@@ -515,6 +621,8 @@ class QueryMetrics:
             out["replica"] = self.replica
         if self.cohort is not None:
             out["cohort"] = dict(self.cohort)
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
         return out
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -548,7 +656,7 @@ class QueryMetrics:
         for e in self.events_of("rule"):
             key = f"{e['name']}:{e.get('action', '?')}"
             rules[key] = rules.get(key, 0) + 1
-        return {
+        out = {
             "wall_s": (round(self.wall_s, 4)
                        if self.wall_s is not None else None),
             "operators": per_op,
@@ -561,6 +669,9 @@ class QueryMetrics:
             "compile": self.compile,
             "roofline": self.roofline,
         }
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        return out
 
     def format_tree(self) -> str:
         """Operator tree with runtime numbers — the companion view to

@@ -133,6 +133,32 @@ def device_cost_digest() -> dict:
     }
 
 
+def tenant_cost_digest() -> dict:
+    """Per-tenant chargeback digest: each known tenant's billed device
+    cost, link bytes, and cache fills (`telemetry.tenant_digest()`),
+    plus the exactness check — per-tenant sums vs the global counters.
+    Attached to every artifact so a committed round records WHO spent
+    the device-seconds, not just that they were spent."""
+    from hyperspace_tpu import telemetry
+
+    usage = telemetry.tenant_digest()
+    counters = telemetry.get_registry().counters_dict()
+    totals = {name: sum(u.get(name, 0) for u in usage.values())
+              for name in telemetry.TENANT_CHARGE_COUNTERS}
+    global_ = {name: counters.get(name, 0)
+               for name in telemetry.TENANT_CHARGE_COUNTERS}
+    return {
+        "tenants": usage,
+        "totals": {k: round(v, 6) if isinstance(v, float) else v
+                   for k, v in totals.items()},
+        "global": {k: round(v, 6) if isinstance(v, float) else v
+                   for k, v in global_.items()},
+        "exact": all(abs(totals[n] - global_[n])
+                     <= 1e-9 * max(1.0, abs(global_[n]))
+                     for n in totals),
+    }
+
+
 def query_metrics_block(qm) -> dict:
     """Per-query telemetry block: `summary()` (the compact rollup
     earlier rounds embedded) plus the full `to_dict()` operator tree
@@ -176,6 +202,7 @@ def make_artifact(*, driver: str, metric: str, value, unit: str,
     doc["process_metrics"] = telemetry.get_registry().counters_dict()
     doc["memory"] = telemetry.memory.artifact_section()
     doc["device_cost"] = device_cost_digest()
+    doc["tenant_cost"] = tenant_cost_digest()
     return doc
 
 

@@ -361,13 +361,20 @@ def instrumented_jit(name: str, fn=None, **jit_kwargs):
             # traced path's elapsed is compile time and stays in the
             # compile bucket). Dispatch-side on async backends.
             reg.counter("device.dispatch.seconds").inc(elapsed)
+            telemetry.charge_tenant("device.dispatch.seconds", elapsed)
             telemetry.add_seconds("device.dispatch_s", elapsed)
         cost = _costs.get(name)
         if cost is not None:
             # The device executed this program either way: charge the
-            # modeled cost per dispatch, per-query and process-wide.
+            # modeled cost per dispatch — per-query, process-wide, AND
+            # to the active tenant's `tenant.<id>.device.*` bill at the
+            # same site, so per-tenant sums equal the globals exactly
+            # (the chargeback contract `Hyperspace.tenant_report()`
+            # asserts).
             reg.counter("device.flops").inc(cost[0])
             reg.counter("device.bytes_accessed").inc(cost[1])
+            telemetry.charge_tenant("device.flops", cost[0])
+            telemetry.charge_tenant("device.bytes_accessed", cost[1])
             telemetry.add_seconds("device.flops", cost[0])
             telemetry.add_seconds("device.bytes_accessed", cost[1])
         return out

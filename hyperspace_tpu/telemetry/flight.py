@@ -107,7 +107,7 @@ class FlightRecorder:
             out = list(self._ring)
         return out if n is None else out[-n:]
 
-    def snapshot(self, since_seq: int = 0, replica=None):
+    def snapshot(self, since_seq: int = 0, replica=None, tenant=None):
         """Incremental, lock-light poll: `(new_entries, last_seq)` where
         `new_entries` are the ring's completed `QueryMetrics` with
         `flight_seq > since_seq`, oldest first, and `last_seq` is the
@@ -115,21 +115,27 @@ class FlightRecorder:
         `since_seq`). `replica` narrows to entries the scheduler routed
         to that replica slice (the per-replica dimension stamped as
         `metrics.replica`; pass it to ask "what has slice 2 served
-        lately" — `last_seq` still advances over the skipped entries,
-        so a per-replica consumer's cursor stays global). The lock is
-        held only for the ring copy — the filter runs outside it, and
-        a consumer polling with its previous `last_seq` re-reads
-        nothing. Entries that rotated out of the ring between polls are
-        simply gone (the ring is a bounded diagnosis buffer, not a
-        durable log): `last_seq` still advances past them, so a slow
-        consumer skips rather than stalls."""
+        lately"); `tenant` narrows to entries billed to that tenant
+        (the dimension stamped as `metrics.tenant` — every scheduled
+        query carries one, "default" included). The filters COMPOSE:
+        `snapshot(seq, replica=2, tenant="acme")` is acme's traffic on
+        slice 2. `last_seq` still advances over skipped entries, so a
+        filtered consumer's cursor stays global. The lock is held only
+        for the ring copy — the filter runs outside it, and a consumer
+        polling with its previous `last_seq` re-reads nothing. Entries
+        that rotated out of the ring between polls are simply gone (the
+        ring is a bounded diagnosis buffer, not a durable log):
+        `last_seq` still advances past them, so a slow consumer skips
+        rather than stalls."""
         with self._lock:
             entries = list(self._ring)
             last = self._record_seq
         fresh = [m for m in entries
                  if getattr(m, "flight_seq", 0) > since_seq
                  and (replica is None
-                      or getattr(m, "replica", None) == replica)]
+                      or getattr(m, "replica", None) == replica)
+                 and (tenant is None
+                      or getattr(m, "tenant", None) == tenant)]
         return fresh, last
 
     @property

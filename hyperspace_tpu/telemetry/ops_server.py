@@ -96,18 +96,31 @@ def healthz_doc() -> dict:
         rec = flight.get_recorder()
         entries = rec.queries()
         by_replica: dict = {}
+        by_tenant: dict = {}
         for qm in entries:
             key = getattr(qm, "replica", None)
             key = "unrouted" if key is None else str(key)
             by_replica[key] = by_replica.get(key, 0) + 1
+            t = getattr(qm, "tenant", None) or "default"
+            by_tenant[t] = by_tenant.get(t, 0) + 1
         return {"ring": len(entries), "last_seq": rec.last_seq,
-                "by_replica": by_replica}
+                "by_replica": by_replica, "by_tenant": by_tenant}
+
+    def _tenants():
+        from hyperspace_tpu.engine.scheduler import get_scheduler
+        from hyperspace_tpu.telemetry import tenant_digest
+        sched = get_scheduler()
+        out = sched.tenant_snapshot()
+        for t, usage in tenant_digest().items():
+            out.setdefault(t, {})["usage"] = usage
+        return out
 
     section("scheduler", _scheduler)
     section("breakers", _breakers)
     section("segments", _segments)
     section("replicas", _replicas)
     section("flight", _flight)
+    section("tenants", _tenants)
     return doc
 
 

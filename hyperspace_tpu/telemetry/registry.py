@@ -163,7 +163,12 @@ def _prom_name(name: str) -> str:
     `[a-zA-Z_:][a-zA-Z0-9_:]*` (exposition format): every illegal
     character becomes `_`, and the `hs_` prefix both namespaces the
     export and guarantees a legal first character."""
-    out = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    # ASCII ranges, not str.isalnum(): isalnum() accepts Unicode
+    # letters/digits (tenant ids are user strings), which the grammar
+    # does not.
+    out = "".join(c if ("a" <= c <= "z" or "A" <= c <= "Z"
+                        or "0" <= c <= "9" or c == "_") else "_"
+                  for c in name)
     return "hs_" + out
 
 

@@ -53,9 +53,15 @@ __all__ = ["TimeSeriesSampler", "get_sampler", "set_sampler",
 # consumer); WINDOW_RATE_COUNTERS additionally publish a
 # `window.<name>.rate` gauge each tick.
 DEFAULT_HISTOGRAMS = ("query.wall_s", "serve.queue_wait_s")
+# Histogram names are dynamic when a dimension is embedded in them
+# (`tenant.<id>.query_wall_s`): prefixes select those the same way the
+# counter prefixes do, since the exact names cannot be enumerated ahead
+# of the tenants existing.
+DEFAULT_HISTOGRAM_PREFIXES = ("tenant.",)
 DEFAULT_COUNTER_PREFIXES = ("queries.", "serve.", "compile.", "link.",
                             "cache.segments.", "resilience.", "flight.",
-                            "device.", "rules.served.", "spmd.")
+                            "device.", "rules.served.", "spmd.",
+                            "tenant.")
 WINDOW_RATE_COUNTERS = ("queries.total", "serve.admitted",
                         "serve.rejected", "serve.slo.violations",
                         "serve.slo.shed", "compile.traces")
@@ -166,10 +172,13 @@ class TimeSeriesSampler:
                  counter_prefixes: Tuple[str, ...]
                  = DEFAULT_COUNTER_PREFIXES,
                  gauge_prefixes: Tuple[str, ...]
-                 = DEFAULT_GAUGE_PREFIXES):
+                 = DEFAULT_GAUGE_PREFIXES,
+                 histogram_prefixes: Tuple[str, ...]
+                 = DEFAULT_HISTOGRAM_PREFIXES):
         self.interval_s = max(0.01, float(interval_s))
         self.window_s = max(self.interval_s, float(window_s))
         self.histograms = tuple(histograms)
+        self.histogram_prefixes = tuple(histogram_prefixes)
         self.counter_prefixes = tuple(counter_prefixes)
         self.gauge_prefixes = tuple(gauge_prefixes)
         self._ring: deque = deque(maxlen=max(2, int(capacity)))
@@ -236,7 +245,9 @@ class TimeSeriesSampler:
                   if k.startswith(self.gauge_prefixes)
                   and not k.startswith("window.")}
         hists = {k: v for k, v in snap["histograms"].items()
-                 if k in self.histograms}
+                 if k in self.histograms
+                 or (self.histogram_prefixes
+                     and k.startswith(self.histogram_prefixes))}
         return counters, gauges, hists
 
     def tick(self, t: Optional[float] = None) -> dict:
@@ -353,7 +364,14 @@ class TimeSeriesSampler:
 
     def _publish_window_gauges(self, now: float) -> None:
         reg = _registry.get_registry()
-        for name in self.histograms:
+        latest = self._latest()
+        # The static selection plus whatever dynamic (prefix-selected,
+        # e.g. per-tenant) histograms the latest tick actually saw.
+        names = list(self.histograms)
+        if latest is not None:
+            names.extend(k for k in latest.hists
+                         if k not in self.histograms)
+        for name in names:
             buckets, _cov = self.window_buckets(name)
             count = sum(buckets.values())
             if not count:

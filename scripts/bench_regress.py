@@ -380,7 +380,12 @@ def compare_serve(old: dict, new: dict, threshold: float):
     - `window_p99_agreement` / `slo_burn` — operations-plane rounds
       (PR 15): the sampler's sliding-window p99 must agree with the
       closed-loop percentile within the log2-bucket + population
-      slack, and the steady-state SLO burn rate must not exceed 1.0.
+      slack, and the steady-state SLO burn rate must not exceed 1.0;
+    - `tenant_victim_p99_x` / `tenant_mismatches` / `tenant_deadlock`
+      / `tenant_chargeback_exact` — multi-tenant rounds (PR 16): the
+      victim tenant's co-located p99 stays <= 2x solo, chaos costs no
+      correctness or liveness, and per-tenant chargeback sums equal
+      the global counters exactly.
 
     Absolute rows gate on the NEW artifact alone; rounds predating the
     sections are not gated on them."""
@@ -443,6 +448,37 @@ def compare_serve(old: dict, new: dict, threshold: float):
     if isinstance(burn, (int, float)):
         rows.append(("slo_burn", 1.0, float(burn), float(burn) - 1.0,
                      burn > 1.0))
+    # Multi-tenant gates (PR 16; rounds predating `--tenants` skip the
+    # section rows, but chargeback exactness gates on ANY new artifact
+    # that carries the `tenant_cost` digest):
+    # - `tenant_victim_p99_x` — the victim tenant's p99 co-located
+    #   with the greedy + doomed tenants must stay <= 2x its solo p99
+    #   (absolute: the isolation promise the weighted-fair queue and
+    #   per-tenant quotas exist for);
+    # - `tenant_mismatches` / `tenant_deadlock` — chaos must not cost
+    #   correctness or liveness (healthy values 0/false);
+    # - `tenant_chargeback_exact` — per-tenant chargeback sums must
+    #   equal the global device/link/cache counters exactly.
+    tn = n.get("tenants") or {}
+    solo = tn.get("victim_solo_p99_s")
+    coloc = tn.get("victim_coloc_p99_s")
+    if isinstance(solo, (int, float)) and solo > 0 \
+            and isinstance(coloc, (int, float)):
+        x = coloc / solo
+        rows.append(("tenant_victim_p99_x", 2.0, round(x, 3),
+                     x - 2.0, x > 2.0))
+    mm = tn.get("mismatches")
+    if isinstance(mm, (int, float)):
+        rows.append(("tenant_mismatches", 0.0, float(mm), float(mm),
+                     mm > 0))
+    dl = tn.get("deadlock")
+    if isinstance(dl, bool):
+        rows.append(("tenant_deadlock", 0.0, float(dl), float(dl), dl))
+    cb = tn.get("chargeback") or new.get("tenant_cost") or {}
+    exact = cb.get("exact")
+    if isinstance(exact, bool):
+        rows.append(("tenant_chargeback_exact", 1.0, float(exact),
+                     float(exact) - 1.0, not exact))
     ol = n.get("open_loop") or {}
     slo_qps = ol.get("qps_at_p99_slo")
     oslo = (old.get("serve") or {}).get("open_loop") or {}

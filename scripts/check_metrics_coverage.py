@@ -603,6 +603,53 @@ def check_metric_doc_rows(package_dir: str, repo_root: str):
     return failures
 
 
+# The ONE sanctioned tenant-attribution seam: the serving tenant rides
+# a telemetry contextvar (`telemetry._tenant`) that the chargeback
+# mirror (`charge_tenant`) and the flight/SLO attribution all read.
+# The ONLY writers are the declared seam: `telemetry.tenant_scope`
+# (the contextvar owner), `HyperspaceSession.tenant` (the sticky
+# session default), and the scheduler's collect() (which resolves the
+# effective tenant and opens the scope around execution). A raw
+# `_tenant.set(...)` — or even a `tenant_scope(...)` entered anywhere
+# else in the package — is a query whose device/link/cache charges
+# land on a tenant the admission plane never admitted, silently
+# breaking the chargeback exactness contract
+# (`bench_regress.py --serve` gates per-tenant sums == globals).
+_RAW_TENANT_RE = re.compile(r"\b_tenant\s*\.\s*set\s*\(|"
+                            r"\btenant_scope\s*\(")
+_TENANT_ALLOWED = (os.path.join("telemetry", "__init__.py"),
+                   os.path.join("engine", "scheduler.py"),
+                   os.path.join("engine", "session.py"))
+
+
+def check_tenant_seam(package_dir: str):
+    """Source lint: no tenant contextvar writes (`_tenant.set` /
+    `tenant_scope`) outside the telemetry owner, the session setter,
+    and the scheduler's collect seam."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel in _TENANT_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_TENANT_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: tenant "
+                            "contextvar write outside the sanctioned "
+                            "seam — set the tenant via "
+                            "session.tenant()/collect(tenant=...) so "
+                            "admission and chargeback see the same "
+                            "identity")
+    return failures
+
+
 # The ONE sanctioned HTTP surface: the operations endpoint
 # (`telemetry/ops_server.py` — localhost-bound by default, counted,
 # error-guarded). A raw `http.server` anywhere else is a listening
@@ -764,6 +811,8 @@ def main() -> int:
     failures.extend(check_bench_artifact_seam(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
     failures.extend(check_http_server_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_tenant_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_metric_doc_rows(
         os.path.dirname(hyperspace_tpu.__file__),

@@ -916,6 +916,78 @@ def test_skewed_key_subshard_join_bit_identity(tmp_path):
         assert len(idx) == exp, f"anti={anti}"
 
 
+def test_smj_right_only_skew_side_swap(tmp_path):
+    """Right-side-ONLY skew (ISSUE 16 satellite): the planner-selected
+    bucketed SMJ used to decline the SPMD lane when only the RIGHT
+    scan's hot bucket tripped `pad_blowup` (replicating the left breaks
+    outer/membership semantics). INNER has no unmatched-row semantics
+    on either side, so the engine now swaps roles — re-reads the left
+    aligned to the right's split and keeps the lane — bit-identical to
+    rules-off, `mesh.spmd.side_swapped` pinned; a left_outer over the
+    same shape still declines (`spmd.fallbacks`), identically correct."""
+    import pyarrow.parquet as pq
+
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.engine.session import HyperspaceSession
+    from hyperspace_tpu.facade import Hyperspace
+    from hyperspace_tpu.index.index_config import IndexConfig
+
+    rng = np.random.default_rng(19)
+    left_dir = tmp_path / "left"
+    right_dir = tmp_path / "right"
+    left_dir.mkdir()
+    right_dir.mkdir()
+    pq.write_table(pa.table({
+        "k": rng.integers(0, 4096, 2000).astype(np.int64),
+        "v": rng.random(2000),
+    }), str(left_dir / "part-0.parquet"))
+    n = 24_000  # 90% on one hot key: C*S far past PAD_BLOWUP_FACTOR*n
+    hot = np.where(rng.random(n) < 0.9, 7,
+                   rng.integers(0, 4096, n)).astype(np.int64)
+    pq.write_table(pa.table({
+        "k": hot, "w": rng.random(n),
+    }), str(right_dir / "part-0.parquet"))
+
+    session = HyperspaceSession(HyperspaceConf({
+        "hyperspace.warehouse.dir": str(tmp_path / "wh"),
+        "hyperspace.index.num.buckets": 8,
+        "hyperspace.distribution.enabled": "true",
+        "hyperspace.broadcast.threshold": -1,
+    }))
+    hs = Hyperspace(session)
+    left = session.read_parquet(str(left_dir))
+    right = session.read_parquet(str(right_dir))
+    hs.create_index(left, IndexConfig("swl", ["k"], ["v"]))
+    hs.create_index(right, IndexConfig("swr", ["k"], ["w"]))
+    reg = telemetry.get_registry()
+    sort_cols = ["k", "v", "w"]
+
+    def run(how):
+        q = left.join(right, on="k", how=how)
+        session.disable_hyperspace()
+        plain = q.to_pandas().sort_values(sort_cols) \
+            .reset_index(drop=True)
+        session.enable_hyperspace()
+        got = q.to_pandas().sort_values(sort_cols) \
+            .reset_index(drop=True)
+        session.disable_hyperspace()
+        session.enable_hyperspace()
+        return plain, got
+
+    c0 = reg.counters_dict().get("mesh.spmd.side_swapped", 0)
+    plain, got = run("inner")
+    c1 = reg.counters_dict().get("mesh.spmd.side_swapped", 0)
+    assert c1 > c0, "inner right-skew join did not swap sides"
+    pd.testing.assert_frame_equal(plain, got)
+
+    f0 = reg.counters_dict().get("spmd.fallbacks", 0)
+    plain, got = run("left")
+    c2 = reg.counters_dict().get("mesh.spmd.side_swapped", 0)
+    assert c2 == c1, "left_outer must not take the swapped lane"
+    assert reg.counters_dict().get("spmd.fallbacks", 0) > f0
+    pd.testing.assert_frame_equal(plain, got)
+
+
 # ---------------------------------------------------------------------------
 # String LIKE on the SPMD lane — PR 14
 # ---------------------------------------------------------------------------

@@ -362,11 +362,116 @@ def closed_loop(workload, expected):
     }
 
 
+def _rate_critpath(seg0: dict, wall0: float, q0: float, seq0: int):
+    """The knee-attribution block for one finished arrival rate:
+    counter-delta segment shares over the rate's whole window, plus
+    the stamped decomposition of the rate's p99 query (by SERVER wall
+    — the flight ring's measured wall, which is what the sum-exactness
+    contract is against; the client-side open-loop latency additionally
+    counts dispatch queueing outside the server)."""
+    from hyperspace_tpu.telemetry import critical_path, flight
+
+    wall_d = _counter("critpath.wall.seconds") - wall0
+    q_d = _counter("critpath.queries") - q0
+    shares = {}
+    for seg in critical_path.SEGMENTS:
+        d = _counter(f"critpath.{seg}.seconds") - seg0[seg]
+        shares[seg] = round(d / wall_d, 4) if wall_d > 0 else 0.0
+    out = {
+        "queries": int(q_d),
+        "wall_seconds": round(wall_d, 4),
+        "shares": shares,
+        "dominant": (max(shares, key=shares.get)
+                     if wall_d > 0 else None),
+    }
+    # The ring holds the newest 64 entries — a sample of the rate's
+    # tail, which is exactly where the p99 lives.
+    fresh, _last = flight.get_recorder().snapshot(seq0)
+    stamped = sorted((m for m in fresh
+                      if getattr(m, "critical_path", None) is not None
+                      and m.wall_s is not None),
+                     key=lambda m: m.wall_s)
+    if stamped:
+        cp = _percentile(stamped, 0.99).critical_path
+        out["p99_wall_s"] = cp["wall_s"]
+        out["p99_segments"] = cp["segments"]
+        out["p99_dominant"] = cp["dominant"]
+        out["p99_sum_error_s"] = round(
+            abs(cp["sum_s"] - cp["wall_s"]), 9)
+        out["ring_sampled"] = len(stamped)
+    return out
+
+
+def profiler_overhead_phase(workload):
+    """Phase 2.5: the price of always-on visibility. The same
+    closed-loop lap with the sampling profiler OFF and ON, interleaved
+    (off, on, off, on, ...) so machine drift lands on both sides
+    equally; median of three each. `bench_regress.py --serve` gates
+    the QPS delta at 2%."""
+    from hyperspace_tpu.telemetry import profiler
+
+    lap_queries = max(CLIENTS * 16, 160)
+
+    def lap() -> float:
+        next_q = [0]
+        take = threading.Lock()
+
+        def client():
+            while True:
+                with take:
+                    if next_q[0] >= lap_queries:
+                        return
+                    qi = next_q[0]
+                    next_q[0] += 1
+                _name, df = workload[qi % len(workload)]
+                df.collect()
+
+        threads = [threading.Thread(target=client,
+                                    name=f"prof-lap-{c}")
+                   for c in range(CLIENTS)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return lap_queries / (time.perf_counter() - t0)
+
+    hz = profiler.DEFAULT_HZ
+    off_laps, on_laps, samples = [], [], 0
+    for _rep in range(3):
+        off_laps.append(lap())
+        p = profiler.start_profiler(hz)
+        try:
+            on_laps.append(lap())
+        finally:
+            samples += sum(p.snapshot().values())
+            profiler.stop_profiler()
+    qps_off = sorted(off_laps)[1]
+    qps_on = sorted(on_laps)[1]
+    overhead = ((qps_off - qps_on) / qps_off) if qps_off else 0.0
+    out = {
+        "hz": hz,
+        "lap_queries": lap_queries,
+        "qps_off_laps": [round(q, 2) for q in off_laps],
+        "qps_on_laps": [round(q, 2) for q in on_laps],
+        "qps_off": round(qps_off, 2),
+        "qps_on": round(qps_on, 2),
+        "overhead_fraction": round(overhead, 4),
+        "samples": samples,
+    }
+    log(f"profiler overhead @ {hz:.0f} Hz: off {out['qps_off']:.1f} "
+        f"QPS vs on {out['qps_on']:.1f} QPS = "
+        f"{overhead * 100:+.2f}% ({samples} stack samples)")
+    return out
+
+
 def open_loop(workload, expected, serial_qps):
     """Phase 3: Poisson arrivals swept across rates. Open-loop latency
     counts from the SCHEDULED arrival time — a saturated server shows
-    its queueing delay instead of silently slowing the clients."""
-    from hyperspace_tpu.telemetry import timeseries
+    its queueing delay instead of silently slowing the clients. Each
+    rate's entry embeds its critical-path decomposition — the sweep
+    states numerically what eats p99 as the offered rate climbs."""
+    from hyperspace_tpu.telemetry import critical_path, flight, timeseries
 
     sampler = timeseries.get_sampler()
     sampler.tick()
@@ -375,6 +480,11 @@ def open_loop(workload, expected, serial_qps):
     sweep = []
     for frac in RATES:
         rate = max(1.0, frac * serial_qps)
+        seg0 = {seg: _counter(f"critpath.{seg}.seconds")
+                for seg in critical_path.SEGMENTS}
+        wall0 = _counter("critpath.wall.seconds")
+        q0 = _counter("critpath.queries")
+        seq0 = flight.get_recorder().last_seq
         horizon = OPEN_SECONDS
         gaps = rng.exponential(1.0 / rate, size=int(rate * horizon * 1.2)
                                + 16)
@@ -432,12 +542,15 @@ def open_loop(workload, expected, serial_qps):
             "p95_s": round(_percentile(latencies, 0.95) or 0, 5),
             "p99_s": round(_percentile(latencies, 0.99) or 0, 5),
             "outcomes": outcomes,
+            "critical_path": _rate_critpath(seg0, wall0, q0, seq0),
         }
         sweep.append(entry)
+        cp = entry["critical_path"]
         log(f"open loop @ {rate:7.1f}/s offered: "
             f"{achieved:7.1f}/s achieved, "
             f"p50 {entry['p50_s'] * 1e3:6.1f} ms, "
-            f"p99 {entry['p99_s'] * 1e3:6.1f} ms")
+            f"p99 {entry['p99_s'] * 1e3:6.1f} ms, "
+            f"dominant {cp['dominant']}")
         if entry["outcomes"]["mismatch"]:
             log("CORRECTNESS FAILURES in the open loop")
             raise SystemExit(1)
@@ -445,6 +558,29 @@ def open_loop(workload, expected, serial_qps):
     meeting = [e for e in sweep if e["p99_s"] <= slo_s
                and e["outcomes"]["ok"] > 0]
     qps_at_slo = max((e["achieved_qps"] for e in meeting), default=None)
+    # Knee attribution: the HIGHEST rate still meeting the p99 SLO is
+    # the knee; its dominant critical-path segment names what the
+    # serving plane runs out of first. No rate meeting the SLO = the
+    # knee sits below the sweep; attribute the lowest rate instead.
+    knee_entry = (max(meeting, key=lambda e: e["achieved_qps"])
+                  if meeting else (sweep[0] if sweep else None))
+    knee = None
+    if knee_entry is not None:
+        kcp = knee_entry["critical_path"]
+        knee = {
+            "offered_qps": knee_entry["offered_qps"],
+            "offered_fraction_of_serial":
+                knee_entry["offered_fraction_of_serial"],
+            "achieved_qps": knee_entry["achieved_qps"],
+            "p99_s": knee_entry["p99_s"],
+            "dominant_segment": kcp.get("dominant"),
+            "p99_dominant_segment": kcp.get("p99_dominant"),
+            "shares": kcp.get("shares"),
+            "below_sweep": not meeting,
+        }
+        log(f"knee @ {knee['offered_qps']}/s offered: dominant "
+            f"segment {knee['dominant_segment']}"
+            + (" (below sweep)" if not meeting else ""))
     # Per-second arrival-rate timeline from the timeseries ring: what
     # the open-loop phase actually looked like over time (QPS from the
     # queries.total rate, per-interval p50/p99 from the query.wall_s
@@ -468,6 +604,7 @@ def open_loop(workload, expected, serial_qps):
         "workers": OPEN_WORKERS,
         "sweep": sweep,
         "qps_at_p99_slo": qps_at_slo,
+        "knee": knee,
         "timeline": timeline,
     }
 
@@ -735,6 +872,9 @@ def main():
             f"p50 {serve['p50_s'] * 1e3:.1f} ms, "
             f"p99 {serve['p99_s'] * 1e3:.1f} ms, "
             f"batch occupancy {serve['batch']['occupancy']}")
+
+        # Phase 2.5: sampling-profiler overhead, measured not assumed.
+        serve["profiler"] = profiler_overhead_phase(workload)
 
         # Phase 3: open loop to the knee.
         serve["open_loop"] = open_loop(workload, expected, serial_qps)

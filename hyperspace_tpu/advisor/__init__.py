@@ -131,6 +131,7 @@ class IndexAdvisor:
                 "signatures": [s.to_dict() for s in signatures],
                 "recommendations": [c.to_dict() for c in recommended],
                 "decisions": decisions,
+                "skipping_drift": self.skipping_drift(),
             }
             self._persist(summary)
         telemetry.event("advisor", "run",
@@ -139,6 +140,37 @@ class IndexAdvisor:
                         built=sum(1 for d in decisions
                                   if d.get("action") == "built"))
         return summary
+
+    def skipping_drift(self) -> dict:
+        """How far reality drifted from the what-if scorer's blind
+        constant: the scorer assumes every skipping index prunes
+        `spark.hyperspace.advisor.skipping.prune.fraction` of a scan,
+        while `FilterIndexRule` records the MEASURED fraction of every
+        served query (`skipping.measured_prune_fraction` histogram +
+        per-index gauges). Report-only — the scoring model is
+        unchanged; a later PR can close the loop on this number."""
+        from hyperspace_tpu import telemetry
+
+        assumed = self.conf.advisor_skipping_prune_fraction
+        out: dict = {"assumed_fraction": assumed,
+                     "measured_mean_fraction": None,
+                     "queries_measured": 0, "drift": None,
+                     "per_index": {}}
+        snap = telemetry.get_registry().series_snapshot()
+        hist = snap.get("histograms", {}).get(
+            "skipping.measured_prune_fraction")
+        if hist and hist.get("count"):
+            mean = hist["sum"] / hist["count"]
+            out["measured_mean_fraction"] = round(mean, 4)
+            out["queries_measured"] = hist["count"]
+            out["drift"] = round(mean - assumed, 4)
+        for name, value in snap.get("gauges", {}).items():
+            if name.startswith("skipping.") and \
+                    name.endswith(".measured_prune_fraction"):
+                index = name[len("skipping."):
+                             -len(".measured_prune_fraction")]
+                out["per_index"][index] = round(value, 4)
+        return out
 
     # -- persisted state ---------------------------------------------------
 

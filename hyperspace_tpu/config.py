@@ -491,6 +491,59 @@ class HyperspaceConf:
                             constants.TELEMETRY_SLOWLOG_KEEP_DEFAULT)
 
     @property
+    def critpath_enabled(self) -> bool:
+        """Per-query critical-path stamping
+        (`telemetry/critical_path.py`): "false" skips the decomposition
+        at query finish (the per-segment source counters still
+        record)."""
+        return (self.get(constants.TELEMETRY_CRITPATH_ENABLED,
+                         constants.TELEMETRY_CRITPATH_ENABLED_DEFAULT)
+                or "true").lower() == "true"
+
+    @property
+    def profiler_enabled(self) -> bool:
+        """Host sampling profiler (`telemetry/profiler.py`): "true"
+        starts the stack-sampling daemon at session init."""
+        return (self.get(constants.TELEMETRY_PROFILER_ENABLED,
+                         constants.TELEMETRY_PROFILER_ENABLED_DEFAULT)
+                or "false").lower() == "true"
+
+    @property
+    def profiler_hz(self) -> float:
+        """Stack-sampling rate of the host profiler (samples/second;
+        the default sits off the 10/100 Hz grid to avoid aliasing
+        periodic work)."""
+        return float(self.get(
+            constants.TELEMETRY_PROFILER_HZ,
+            str(constants.TELEMETRY_PROFILER_HZ_DEFAULT)))
+
+    @property
+    def profiler_capture_seconds(self) -> float:
+        """Length of a TRIGGERED device-trace capture (SLO burn or a
+        slowlog dump fires one). 0 (the default) disarms triggered
+        capture."""
+        return float(self.get(
+            constants.TELEMETRY_PROFILER_CAPTURE_SECONDS,
+            str(constants.TELEMETRY_PROFILER_CAPTURE_SECONDS_DEFAULT)))
+
+    @property
+    def profiler_capture_keep(self) -> int:
+        """How many triggered `profile-*` capture directories to
+        retain next to the slow-query dumps (oldest pruned)."""
+        return self.get_int(
+            constants.TELEMETRY_PROFILER_CAPTURE_KEEP,
+            constants.TELEMETRY_PROFILER_CAPTURE_KEEP_DEFAULT)
+
+    @property
+    def profiler_capture_min_interval_s(self) -> float:
+        """Rate limit between triggered captures — a sustained SLO
+        burn produces a trickle of profiles, not a flood."""
+        return float(self.get(
+            constants.TELEMETRY_PROFILER_CAPTURE_MIN_INTERVAL_SECONDS,
+            str(constants
+                .TELEMETRY_PROFILER_CAPTURE_MIN_INTERVAL_SECONDS_DEFAULT)))
+
+    @property
     def skipping_enabled(self) -> bool:
         """Query-side gate on data-skipping pruning (`plan/rules/
         skipping.py`): "false" stops FilterIndexRule consulting sketch

@@ -422,6 +422,40 @@ TELEMETRY_SLOWLOG_DIR = "spark.hyperspace.telemetry.slowlog.dir"
 TELEMETRY_SLOWLOG_KEEP = "spark.hyperspace.telemetry.slowlog.keep"
 TELEMETRY_SLOWLOG_KEEP_DEFAULT = 20
 
+# Critical-path decomposition (`telemetry/critical_path.py`): every
+# scheduled query's wall is decomposed into the closed segment set
+# (queue_wait/batch_window/.../host_python residual), stamped onto its
+# QueryMetrics, and published as `critpath.<segment>.seconds` counters.
+# "false" skips the per-query stamp (the source counters still record).
+TELEMETRY_CRITPATH_ENABLED = "spark.hyperspace.telemetry.critpath.enabled"
+TELEMETRY_CRITPATH_ENABLED_DEFAULT = "true"
+
+# Sampling profiler (`telemetry/profiler.py`): when enabled, a daemon
+# thread samples every live thread's stack at `profiler.hz` and
+# aggregates host time by collapsed stack (served at `/profile`).
+# Off by default; the overhead when on is gated (<2% closed-loop QPS)
+# by `bench_regress.py --serve`.
+TELEMETRY_PROFILER_ENABLED = "spark.hyperspace.telemetry.profiler.enabled"
+TELEMETRY_PROFILER_ENABLED_DEFAULT = "false"
+TELEMETRY_PROFILER_HZ = "spark.hyperspace.telemetry.profiler.hz"
+TELEMETRY_PROFILER_HZ_DEFAULT = 19.0
+
+# Triggered device-trace capture: when `capture.seconds` > 0, SLO burn
+# crossing 1.0 or a slowlog dump fires a background device-trace
+# capture of that many seconds of device activity, written as a
+# `profile-*` directory next to the slow-query dumps (atomic rename;
+# only the newest `capture.keep` retained; at most one capture per
+# `capture.min.interval.seconds`). 0 (the default) disarms capture.
+TELEMETRY_PROFILER_CAPTURE_SECONDS = \
+    "spark.hyperspace.telemetry.profiler.capture.seconds"
+TELEMETRY_PROFILER_CAPTURE_SECONDS_DEFAULT = 0.0
+TELEMETRY_PROFILER_CAPTURE_KEEP = \
+    "spark.hyperspace.telemetry.profiler.capture.keep"
+TELEMETRY_PROFILER_CAPTURE_KEEP_DEFAULT = 4
+TELEMETRY_PROFILER_CAPTURE_MIN_INTERVAL_SECONDS = \
+    "spark.hyperspace.telemetry.profiler.capture.min.interval.seconds"
+TELEMETRY_PROFILER_CAPTURE_MIN_INTERVAL_SECONDS_DEFAULT = 30.0
+
 # Adaptive host/device execution lane: batches below this row count are
 # evaluated with host numpy, larger batches run on the accelerator. The
 # default is tuned for a high-latency (tunneled) device link where each

@@ -417,8 +417,13 @@ class QueryBatcher:
                     del self._cohorts[cohort.key]
                 members = list(cohort.members)
                 self._running[cohort.key] = cohort
-            reg.histogram("serve.batch.window_wait_s").observe(
-                time.perf_counter() - t0)
+            gather_s = time.perf_counter() - t0
+            reg.histogram("serve.batch.window_wait_s").observe(gather_s)
+            # Critical-path source: the leader's gather window is wall
+            # this query spent collecting its cohort
+            # (`telemetry/critical_path.py` classifies it
+            # `batch_window`).
+            telemetry.add_seconds("serve.batch.window_s", gather_s)
             live = [m for m in members
                     if m.state == _WAITING and m is not me]
             if not live:
@@ -499,6 +504,7 @@ class QueryBatcher:
         rec = telemetry.current()
         op = rec.start_operator("BatchedQuery") if rec is not None \
             else None
+        t_wait0 = time.perf_counter()
         try:
             with telemetry.span("serve.batch.member", "serve.batch"):
                 with self._cv:
@@ -514,9 +520,17 @@ class QueryBatcher:
                             raise
                         self._cv.wait(timeout=_WAIT_QUANTUM_S)
         except BaseException as exc:
+            telemetry.add_seconds("serve.batch.window_s",
+                                  time.perf_counter() - t_wait0)
             if op is not None:
                 rec.finish_operator(op, error=repr(exc))
             raise
+        # Critical-path source: a member's whole blocked-on-cohort wait
+        # — gather window AND the shared execution — is classified
+        # `batch_window` (the member can't tell the phases apart, and
+        # from its side the distinction doesn't matter: it was parked).
+        telemetry.add_seconds("serve.batch.window_s",
+                              time.perf_counter() - t_wait0)
         if me.state == _DONE:
             if op is not None:
                 op.detail["cohort"] = me.cohort_size

@@ -5,7 +5,6 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import itertools
-import threading
 import uuid
 
 import hyperspace_tpu.engine  # noqa: F401  (x64 config)
@@ -13,11 +12,12 @@ from hyperspace_tpu.engine.physical import PhysicalNode, plan_physical
 from hyperspace_tpu.io.columnar import ColumnBatch
 from hyperspace_tpu.plan.nodes import LogicalPlan
 
-# Profiler capture naming/serialization: jax permits one active profiler
-# session per process, and fast queries can share a wall-clock stamp.
+# Profiler capture naming: fast back-to-back queries can share a
+# wall-clock stamp, so names carry a process-unique counter. The
+# capture itself serializes inside `telemetry.profiler.device_trace`
+# (jax permits one active profiler session per process).
 _trace_seq = itertools.count()
 _trace_run_id = uuid.uuid4().hex[:8]
-_trace_lock = threading.Lock()
 
 
 def compile_plan(plan: LogicalPlan,
@@ -133,23 +133,22 @@ def execute_plan(plan: LogicalPlan,
         return physical.execute()
     # Native tracing (SURVEY §5): one XLA profiler capture per executed
     # query — device compute, transfers, and host gaps land in the same
-    # timeline; inspect with TensorBoard/XProf or Perfetto. Capture names
-    # use a process-unique counter (wall-clock ms collide for fast
-    # back-to-back queries, and jax allows one active profiler session).
-    import jax
+    # timeline; inspect with TensorBoard/XProf or Perfetto. The capture
+    # routes through the ONE device-profiler seam
+    # (`telemetry/profiler.py`), which serializes concurrent sessions.
+    from hyperspace_tpu.telemetry import profiler
 
     seq = next(_trace_seq)
     capture = f"{trace_dir.rstrip('/')}/query-{_trace_run_id}-{seq:05d}"
     telemetry.event("profiler", "capture", path=capture)
-    with _trace_lock:
-        with jax.profiler.trace(capture):
-            out = physical.execute()
-            # Materialize ALL device work inside the capture window —
-            # validity masks and dictionary hashes included, or their
-            # compute/transfers land after the capture closes.
-            for col in out.columns.values():
-                for arr in (col.data, col.validity,
-                            *(col.dict_hashes or ())):
-                    if hasattr(arr, "block_until_ready"):
-                        arr.block_until_ready()
+    with profiler.device_trace(capture):
+        out = physical.execute()
+        # Materialize ALL device work inside the capture window —
+        # validity masks and dictionary hashes included, or their
+        # compute/transfers land after the capture closes.
+        for col in out.columns.values():
+            for arr in (col.data, col.validity,
+                        *(col.dict_hashes or ())):
+                if hasattr(arr, "block_until_ready"):
+                    arr.block_until_ready()
     return out

@@ -1085,7 +1085,17 @@ class QueryScheduler:
         reg = telemetry.get_registry()
         try:
             try:
+                t_admit0 = time.perf_counter()
                 wait_s = self._admit(ent, conf)
+                # Critical-path sources: the recorder's wall started at
+                # construction (before admission), so queue wait and the
+                # admission bookkeeping around it are genuine wall
+                # segments — stamp both as per-query counters for
+                # `telemetry/critical_path.py` to classify.
+                metrics.add_seconds("serve.queue_wait_s", wait_s)
+                metrics.add_seconds(
+                    "serve.admission_s",
+                    max(time.perf_counter() - t_admit0 - wait_s, 0.0))
             except QueryServingError as exc:
                 self._record_serving_error(exc, None, conf)
                 raise
@@ -1182,6 +1192,18 @@ class QueryScheduler:
         finally:
             self._release(ent)
         metrics.finish()
+        # Latency anatomy: decompose the finished wall into the closed
+        # segment set and stamp it on the recorder BEFORE the flight
+        # ring sees it, so ring entries and slow-query dumps carry
+        # their own anatomy. Decomposition failure never fails the
+        # query it explains.
+        if conf is None or conf.critpath_enabled:
+            try:
+                from hyperspace_tpu.telemetry import critical_path
+                critical_path.stamp(metrics)
+            except Exception:
+                logger.debug("critical-path stamp failed",
+                             exc_info=True)
         # Process-lifetime aggregates next to the per-query recorder.
         reg.counter("queries.total").inc()
         reg.counter("queries.seconds").inc(metrics.wall_s)
@@ -1197,6 +1219,18 @@ class QueryScheduler:
         # which the shed hook reads to name the burning tenant.
         self._slo.record(metrics.wall_s, conf)
         self._tenant_slo_for(eff_tenant).record(metrics.wall_s, conf)
+        # Triggered device capture: a burn rate past 1.0 grabs a
+        # device profile of the incident while it is happening (armed
+        # only when `telemetry.profiler.capture.seconds` > 0; the
+        # capture itself rides the profiler's background lane).
+        if conf is not None and conf.profiler_capture_seconds > 0:
+            try:
+                from hyperspace_tpu.telemetry import profiler
+                profiler.maybe_capture_on_burn(
+                    conf, self._slo.burn_rate(conf))
+            except Exception:
+                logger.debug("burn-triggered capture failed",
+                             exc_info=True)
         # Per-index rule-usage mining (the drop advisor's raw signal):
         # one process counter per index a rule actually SERVED this
         # query from — `Hyperspace.index_usage()` joins these against

@@ -497,9 +497,16 @@ class SegmentCache:
             # Another thread owns the fill: wait on IT, not the link —
             # deadline-checkpointed so a cancelled waiter leaves the
             # queue promptly (the filler keeps going for its own query).
-            while not fill.event.is_set():
-                telemetry.check_deadline("cache.fill")
-                fill.event.wait(_FILL_WAIT_QUANTUM_S)
+            # The wait is a critical-path source: wall blocked on
+            # someone else's fill classifies `cache_fill_wait`.
+            t_wait0 = time.perf_counter()
+            try:
+                while not fill.event.is_set():
+                    telemetry.check_deadline("cache.fill")
+                    fill.event.wait(_FILL_WAIT_QUANTUM_S)
+            finally:
+                telemetry.add_seconds("cache.fill_wait_s",
+                                      time.perf_counter() - t_wait0)
             if fill.error is None and fill.batch is not None:
                 # Coalesced: one decode+H2D served K waiters the SAME
                 # batch object (bit-identical by construction).
@@ -569,9 +576,14 @@ class SegmentCache:
                                  else None)
                     self._fills[key] = fill
                     break
-            while not fill.event.is_set():
-                telemetry.check_deadline("cache.fill")
-                fill.event.wait(_FILL_WAIT_QUANTUM_S)
+            t_wait0 = time.perf_counter()
+            try:
+                while not fill.event.is_set():
+                    telemetry.check_deadline("cache.fill")
+                    fill.event.wait(_FILL_WAIT_QUANTUM_S)
+            finally:
+                telemetry.add_seconds("cache.fill_wait_s",
+                                      time.perf_counter() - t_wait0)
             if fill.error is None and fill.batch is not None:
                 _mem.cache_hit("segments")
                 telemetry.add_count("cache.segments.coalesced")

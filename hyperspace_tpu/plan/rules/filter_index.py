@@ -166,6 +166,16 @@ class FilterIndexRule(Rule):
         reg.counter("skipping.bytes_pruned").inc(bytes_pruned)
         telemetry.add_count("skipping.files_pruned", len(pruned))
         telemetry.add_count("skipping.bytes_pruned", bytes_pruned)
+        # The MEASURED prune fraction, per served query: the advisor's
+        # what-if scorer assumes the blind constant
+        # `advisor.skipping.prune.fraction` — this histogram (and the
+        # per-index gauge) is what `Hyperspace.advisor()` reports
+        # drift against.
+        frac = (len(pruned) / files_total) if files_total else 0.0
+        reg.histogram("skipping.measured_prune_fraction").observe(frac)
+        reg.gauge(
+            f"skipping.{entry.name}.measured_prune_fraction").set(
+            round(frac, 6))
         telemetry.event(
             "rule", "FilterIndexRule", action="applied",
             indexes=[{"name": entry.name, "root": scan_roots[0],

@@ -77,6 +77,8 @@ from hyperspace_tpu.telemetry import diff  # noqa: F401
 from hyperspace_tpu.telemetry import flight  # noqa: F401
 from hyperspace_tpu.telemetry import timeseries  # noqa: F401
 from hyperspace_tpu.telemetry import ops_server  # noqa: F401
+from hyperspace_tpu.telemetry import critical_path  # noqa: F401
+from hyperspace_tpu.telemetry import profiler  # noqa: F401
 from hyperspace_tpu.telemetry.compilation import instrumented_jit
 from hyperspace_tpu.telemetry.flight import (FlightRecorder,
                                              get_recorder)
@@ -95,7 +97,7 @@ __all__ = [
     "memory", "compilation", "instrumented_jit", "artifact", "diff",
     "flight", "FlightRecorder", "get_recorder",
     "DeviceMemoryAccountant", "get_accountant",
-    "timeseries", "ops_server",
+    "timeseries", "ops_server", "critical_path", "profiler",
 ]
 
 
@@ -416,6 +418,11 @@ class QueryMetrics:
         self.replica = None
         self.cohort: Optional[dict] = None
         self.tenant: Optional[str] = None
+        # Latency anatomy, stamped at query finish by
+        # `telemetry/critical_path.py`: the wall decomposed into the
+        # closed segment set ({wall_s, segments, dominant, ...}),
+        # segments summing exactly to wall_s. None until stamped.
+        self.critical_path: Optional[dict] = None
         self._lock = threading.Lock()
         self._ids = itertools.count()
         self._tls = threading.local()
@@ -623,6 +630,8 @@ class QueryMetrics:
             out["cohort"] = dict(self.cohort)
         if self.tenant is not None:
             out["tenant"] = self.tenant
+        if self.critical_path is not None:
+            out["critical_path"] = dict(self.critical_path)
         return out
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -671,6 +680,8 @@ class QueryMetrics:
         }
         if self.tenant is not None:
             out["tenant"] = self.tenant
+        if self.critical_path is not None:
+            out["critical_path"] = dict(self.critical_path)
         return out
 
     def format_tree(self) -> str:

@@ -159,6 +159,35 @@ def tenant_cost_digest() -> dict:
     }
 
 
+def critpath_digest() -> dict:
+    """Process-lifetime latency anatomy: total seconds attributed to
+    each critical-path segment across every stamped query
+    (`telemetry/critical_path.py`), their share of total query wall,
+    and the dominant segment. Attached to every artifact so a
+    committed round records WHERE the wall went, not just how long it
+    was."""
+    from hyperspace_tpu.telemetry import critical_path
+    from hyperspace_tpu.telemetry import registry as _registry
+
+    c = _registry.get_registry().counters_dict()
+    wall = float(c.get("critpath.wall.seconds", 0.0))
+    seconds = {seg: round(float(
+        c.get(f"critpath.{seg}.seconds", 0.0)), 6)
+        for seg in critical_path.SEGMENTS}
+    out = {
+        "queries": int(c.get("critpath.queries", 0)),
+        "wall_seconds": round(wall, 6),
+        "seconds": seconds,
+        "shares": {seg: (round(v / wall, 4) if wall else 0.0)
+                   for seg, v in seconds.items()},
+        "overlap_seconds": round(float(
+            c.get("critpath.overlap.seconds", 0.0)), 6),
+    }
+    out["dominant"] = (max(seconds, key=seconds.get)
+                       if wall else None)
+    return out
+
+
 def query_metrics_block(qm) -> dict:
     """Per-query telemetry block: `summary()` (the compact rollup
     earlier rounds embedded) plus the full `to_dict()` operator tree
@@ -203,6 +232,7 @@ def make_artifact(*, driver: str, metric: str, value, unit: str,
     doc["memory"] = telemetry.memory.artifact_section()
     doc["device_cost"] = device_cost_digest()
     doc["tenant_cost"] = tenant_cost_digest()
+    doc["critical_path"] = critpath_digest()
     return doc
 
 

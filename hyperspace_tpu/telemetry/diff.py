@@ -363,15 +363,38 @@ def _entry_block(entry: dict):
     return entry.get("tree") or entry.get("metrics")
 
 
+def _tree_critpath(tree) -> Optional[dict]:
+    if isinstance(tree, dict):
+        return tree.get("critical_path")
+    return getattr(tree, "critical_path", None)
+
+
 def diff_trees(old_tree, new_tree, name: str = "query") -> QueryDiff:
     """Diff two `QueryMetrics` trees (instances or `to_dict()` dicts)
-    directly — e.g. a flight-recorder dump against a live re-run."""
+    directly — e.g. a flight-recorder dump against a live re-run.
+    When both trees carry a stamped critical-path decomposition
+    (`telemetry/critical_path.py`), the biggest segment movements ride
+    along as a note: the differ's bucket attribution and the anatomy's
+    closed-set view of the same delta, side by side."""
     old_roll = _rollup(old_tree)
     new_roll = _rollup(new_tree)
     qd = QueryDiff(name,
                    (old_roll or {}).get("wall"),
                    (new_roll or {}).get("wall"))
     _attribute_from_rollups(qd, old_roll, new_roll)
+    old_cp, new_cp = _tree_critpath(old_tree), _tree_critpath(new_tree)
+    if old_cp and new_cp:
+        deltas = {
+            seg: (new_cp.get("segments", {}).get(seg, 0.0)
+                  - old_cp.get("segments", {}).get(seg, 0.0))
+            for seg in (set(old_cp.get("segments", {}))
+                        | set(new_cp.get("segments", {})))}
+        movers = sorted(deltas.items(), key=lambda kv: -abs(kv[1]))[:3]
+        if movers and any(abs(d) > 1e-9 for _, d in movers):
+            qd.notes.append(
+                "critical path moved: " + ", ".join(
+                    f"{seg} {d:+.4f}s" for seg, d in movers
+                    if abs(d) > 1e-9))
     return qd
 
 

@@ -208,6 +208,23 @@ class FlightRecorder:
             "metrics": metrics.to_dict(),
             "registry": _registry.get_registry().to_dict(),
         }
+        # Latency anatomy: the stamped decomposition makes the dump
+        # self-diagnosing — where the wall went, without a live re-run.
+        cp = getattr(metrics, "critical_path", None)
+        if cp is not None:
+            doc["critical_path"] = cp
+        # A slow query is exactly when a device profile is worth its
+        # cost: fire a triggered capture (armed only when
+        # `telemetry.profiler.capture.seconds` > 0; rate-limited) and
+        # record where it will land so the dump points at it.
+        try:
+            from hyperspace_tpu.telemetry import profiler
+            capture = profiler.request_capture(conf, reason="slowlog")
+            if capture is not None:
+                doc["device_profile"] = capture
+        except Exception:
+            logger.debug("slowlog-triggered capture failed",
+                         exc_info=True)
         trace_slice = self._trace_slice(metrics)
         if trace_slice is not None:
             doc["trace"] = trace_slice

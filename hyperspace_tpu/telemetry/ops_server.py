@@ -6,7 +6,7 @@ agent, no push gateway, no sidecar. When
 `spark.hyperspace.telemetry.ops.port` is set, a stdlib
 `ThreadingHTTPServer` starts inside the engine process (the ONE
 sanctioned `http.server` use — `scripts/check_metrics_coverage.py`
-bans it anywhere else) and serves three read-only endpoints:
+bans it anywhere else) and serves five read-only endpoints:
 
 - **`/metrics`** — the registry's Prometheus text exposition
   (`MetricsRegistry.to_text()`), including the sampler's
@@ -22,6 +22,14 @@ bans it anywhere else) and serves three read-only endpoints:
 - **`/timeseries`** — the sampler's ring as JSON (the raw material of
   the `/metrics` window gauges, for dashboards that want the history
   rather than the trailing point).
+- **`/critpath`** — the latency anatomy
+  (`telemetry/critical_path.py`): trailing-window segment shares of
+  query wall plus the per-query decompositions of the flight ring's
+  recent entries.
+- **`/profile`** — the sampling profiler (`telemetry/profiler.py`):
+  host-time tables, flamegraph JSON (or `?format=collapsed` for the
+  flamegraph.pl/speedscope text form), and the recent triggered
+  device captures.
 
 Security: the server binds `telemetry.ops.host` — 127.0.0.1 by
 default. The endpoints are unauthenticated, read-only operational
@@ -42,7 +50,7 @@ from hyperspace_tpu.telemetry import registry as _registry
 from hyperspace_tpu.telemetry import timeseries as _timeseries
 
 __all__ = ["OpsServer", "get_server", "start_server", "stop_server",
-           "configure", "healthz_doc"]
+           "configure", "healthz_doc", "critpath_doc"]
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -124,6 +132,29 @@ def healthz_doc() -> dict:
     return doc
 
 
+def critpath_doc(recent: int = 10) -> dict:
+    """The `/critpath` payload: trailing-window segment shares (the
+    sampler's view) plus the stamped per-query decompositions of the
+    flight ring's newest entries — totals AND exemplars in one read."""
+    from hyperspace_tpu.telemetry import critical_path, flight
+    doc: dict = {"window": critical_path.window_shares()}
+    entries = []
+    for qm in flight.get_recorder().queries(n=recent):
+        cp = getattr(qm, "critical_path", None)
+        if cp is None:
+            continue
+        entries.append({"description": qm.description,
+                        "flight_seq": getattr(qm, "flight_seq", None),
+                        "tenant": getattr(qm, "tenant", None),
+                        "critical_path": cp})
+    doc["recent"] = entries
+    reg = _registry.get_registry()
+    totals = reg.counters_dict()
+    doc["totals"] = {k: round(v, 6) for k, v in totals.items()
+                    if k.startswith("critpath.")}
+    return doc
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "hyperspace-ops/1"
 
@@ -155,9 +186,27 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(_timeseries.get_sampler().snapshot(),
                                   default=str).encode("utf-8")
                 self._send(200, "application/json", body)
+            elif path == "/critpath":
+                self._fresh_tick()
+                body = json.dumps(critpath_doc(),
+                                  default=str).encode("utf-8")
+                self._send(200, "application/json", body)
+            elif path == "/profile":
+                from hyperspace_tpu.telemetry import profiler
+                query = self.path.partition("?")[2]
+                if "format=collapsed" in query:
+                    p = profiler.get_profiler()
+                    text = p.collapsed() if p is not None else ""
+                    self._send(200, "text/plain; charset=utf-8",
+                               text.encode("utf-8"))
+                else:
+                    body = json.dumps(profiler.profile_doc(),
+                                      default=str).encode("utf-8")
+                    self._send(200, "application/json", body)
             else:
                 self._send(404, "text/plain; charset=utf-8",
-                           b"not found: /metrics /healthz /timeseries\n")
+                           b"not found: /metrics /healthz /timeseries "
+                           b"/critpath /profile\n")
             reg.counter("ops.http.requests").inc()
         except Exception:
             reg.counter("ops.http.errors").inc()
@@ -268,6 +317,13 @@ def configure(conf) -> Optional[OpsServer]:
     start the sampler and the server; unset = no-op. Failures degrade
     to a warning — the operations plane is an observability feature,
     never a startup failure."""
+    # The sampling profiler configures independently of the ops port —
+    # an operator can profile without exposing HTTP (and vice versa).
+    try:
+        from hyperspace_tpu.telemetry import profiler as _profiler
+        _profiler.configure(conf)
+    except Exception:
+        pass  # profiler.configure logs its own failures
     try:
         port = conf.telemetry_ops_port if conf is not None else None
     except Exception:

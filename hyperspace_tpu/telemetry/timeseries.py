@@ -61,7 +61,7 @@ DEFAULT_HISTOGRAM_PREFIXES = ("tenant.",)
 DEFAULT_COUNTER_PREFIXES = ("queries.", "serve.", "compile.", "link.",
                             "cache.segments.", "resilience.", "flight.",
                             "device.", "rules.served.", "spmd.",
-                            "tenant.")
+                            "tenant.", "critpath.")
 WINDOW_RATE_COUNTERS = ("queries.total", "serve.admitted",
                         "serve.rejected", "serve.slo.violations",
                         "serve.slo.shed", "compile.traces")

@@ -113,6 +113,14 @@ def _rung1_link_share(doc: dict):
 # healthy value is 0, and nothing ratio-gates against zero.
 RATE_SLACK = 0.02
 
+# Latency-anatomy absolutes (PR 17). The critical-path decomposition
+# is sum-exact BY CONSTRUCTION (the host_python residual absorbs the
+# unattributed remainder), so the only honest tolerance is rounding:
+# segments round to 1 µs, nine of them. The profiler bound is the
+# tentpole promise: "continuous" means cheap enough to leave on.
+CRITPATH_EPSILON_S = 1e-4
+PROFILER_OVERHEAD_MAX = 0.02
+
 
 def _segment_rows(old: dict, new: dict, threshold: float):
     """Warm-rung gate rows from the `segments` block bench.py embeds:
@@ -385,7 +393,12 @@ def compare_serve(old: dict, new: dict, threshold: float):
       / `tenant_chargeback_exact` — multi-tenant rounds (PR 16): the
       victim tenant's co-located p99 stays <= 2x solo, chaos costs no
       correctness or liveness, and per-tenant chargeback sums equal
-      the global counters exactly.
+      the global counters exactly;
+    - `critpath_sum_exact` / `profiler_overhead` — latency-anatomy
+      rounds (PR 17): every sweep rate's stamped p99 decomposition
+      sums to its measured wall within CRITPATH_EPSILON_S, and the
+      sampling profiler costs <= PROFILER_OVERHEAD_MAX of closed-loop
+      QPS.
 
     Absolute rows gate on the NEW artifact alone; rounds predating the
     sections are not gated on them."""
@@ -487,6 +500,30 @@ def compare_serve(old: dict, new: dict, threshold: float):
         if not isinstance(oslo.get("qps_at_p99_slo"), (int, float)):
             rows.append(("qps_at_p99_slo_floor", 0.0, float(slo_qps),
                          float(slo_qps), slo_qps <= 0))
+    # Latency-anatomy gates (PR 17; rounds predating the sections skip):
+    # - `critpath_sum_exact` — every sweep rate's stamped p99 query
+    #   must satisfy the sum-exactness contract (segments sum to the
+    #   measured wall within CRITPATH_EPSILON_S — absolute: the
+    #   decomposition's one invariant, and a nonzero error means a
+    #   segment was double-counted or dropped);
+    # - `profiler_overhead` — the closed-loop QPS with the sampling
+    #   profiler ON must stay within PROFILER_OVERHEAD_MAX of
+    #   profiler-off (absolute: the price of always-on visibility is
+    #   part of the contract, not a footnote).
+    errs = [e["critical_path"]["p99_sum_error_s"]
+            for e in (ol.get("sweep") or [])
+            if isinstance((e.get("critical_path") or {})
+                          .get("p99_sum_error_s"), (int, float))]
+    if errs:
+        worst = max(errs)
+        rows.append(("critpath_sum_exact", CRITPATH_EPSILON_S, worst,
+                     worst - CRITPATH_EPSILON_S,
+                     worst > CRITPATH_EPSILON_S))
+    ovh = (n.get("profiler") or {}).get("overhead_fraction")
+    if isinstance(ovh, (int, float)):
+        rows.append(("profiler_overhead", PROFILER_OVERHEAD_MAX,
+                     float(ovh), ovh - PROFILER_OVERHEAD_MAX,
+                     ovh > PROFILER_OVERHEAD_MAX))
     return rows
 
 

@@ -737,6 +737,72 @@ def check_retry_seams(package_dir: str):
     return failures
 
 
+# The ONE sanctioned profiling seam: `telemetry/profiler.py` owns both
+# instruments — the host stack sampler and the `jax.profiler` device
+# capture (jax allows one active trace session per process; the seam's
+# lock serializes them, and triggered captures inherit its rate limit
+# and keep-N pruning). A raw `jax.profiler` / `cProfile` /
+# `sys.setprofile` anywhere else is profiling the overhead gate does
+# not measure and the capture policy does not govern.
+_RAW_PROFILER_RE = re.compile(
+    r"jax\s*\.\s*profiler|\bcProfile\b|sys\s*\.\s*setprofile")
+_PROFILER_ALLOWED = os.path.join("telemetry", "profiler.py")
+
+
+def check_profiler_seam(package_dir: str):
+    """Source lint: no jax.profiler / cProfile / sys.setprofile use
+    outside telemetry/profiler.py."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel == _PROFILER_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_PROFILER_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: raw "
+                            "profiler use outside the profiling seam — "
+                            "route it through telemetry/profiler.py "
+                            "(device_trace / the sampling profiler)")
+    return failures
+
+
+def check_critpath_doc_rows(repo_root: str):
+    """Doc-drift lint for the critical-path family: the per-segment
+    counters are emitted with an f-string
+    (`critpath.<segment>.seconds`), so the generic literal-name lint
+    cannot see them — require a docs/telemetry.md row for every
+    segment in the closed set explicitly."""
+    from hyperspace_tpu.telemetry import critical_path
+    doc_path = os.path.join(repo_root, "docs", "telemetry.md")
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            doc = f.read()
+    except OSError:
+        return [f"{doc_path}: missing — the metrics reference lives "
+                "there"]
+    documented = set(re.findall(r"`([^`\s]+)`", doc))
+    for token in list(documented):
+        if "{" in token:
+            documented.update(_expand_braces(token))
+    failures = []
+    for segment in critical_path.SEGMENTS:
+        name = f"critpath.{segment}.seconds"
+        if name not in doc and name not in documented:
+            failures.append(
+                f"hyperspace_tpu/telemetry/critical_path.py: segment "
+                f"counter {name!r} has no row in docs/telemetry.md — "
+                "every segment of the closed set must be documented")
+    return failures
+
+
 def main() -> int:
     import hyperspace_tpu
 
@@ -816,6 +882,10 @@ def main() -> int:
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_metric_doc_rows(
         os.path.dirname(hyperspace_tpu.__file__),
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    failures.extend(check_profiler_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_critpath_doc_rows(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
     if import_errors:

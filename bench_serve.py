@@ -308,6 +308,12 @@ def closed_loop(workload, expected):
     get_scheduler().slo.reset()
     sampler = timeseries.get_sampler()
     sampler.tick()
+    # The false-positive gate's window: a clean closed-loop lap must
+    # fire ZERO incidents (`bench_regress.py --serve` gates the delta
+    # absolutely). Counted over exactly the timed loop — the open-loop
+    # sweep deliberately saturates past the knee, where a burn incident
+    # is the alert plane working, not a false positive.
+    alerts_fired0 = _counter("alerts.fired")
     t_loop0 = time.time()
     batch0 = {k: _counter(f"serve.batch.{k}")
               for k in ("invocations", "members", "fallbacks", "solo")}
@@ -359,6 +365,8 @@ def closed_loop(workload, expected):
         "reject_rate": round(outcomes["rejected"] / TOTAL_QUERIES, 5),
         "timeout_rate": round(outcomes["deadline"] / TOTAL_QUERIES, 5),
         "batch": batch,
+        "alerts_fired_timed_loop":
+            int(_counter("alerts.fired") - alerts_fired0),
     }
 
 
@@ -890,6 +898,18 @@ def main():
                 f"{tn['mismatches']} mismatches, "
                 f"deadlock={tn['deadlock']}, "
                 f"chargeback exact={tn['chargeback']['exact']}")
+
+        # Incident digest: the whole bench's alert story (the open-loop
+        # saturation rates MAY legitimately fire), with the clean-run
+        # number scoped to the timed closed loop.
+        from hyperspace_tpu.telemetry import alerts as alerts_mod
+        alerts_digest = alerts_mod.get_manager().digest()
+        alerts_digest["clean_run_fired"] = serve.pop(
+            "alerts_fired_timed_loop")
+        serve["alerts"] = alerts_digest
+        log(f"alerts: {alerts_digest['fired']} fired over the bench "
+            f"({alerts_digest['clean_run_fired']} during the clean "
+            f"closed loop), {alerts_digest['evaluations']} evaluations")
 
         sched = session.scheduler()
         counters = telemetry.get_registry().counters_dict()

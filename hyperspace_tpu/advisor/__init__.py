@@ -147,14 +147,21 @@ class IndexAdvisor:
         `spark.hyperspace.advisor.skipping.prune.fraction` of a scan,
         while `FilterIndexRule` records the MEASURED fraction of every
         served query (`skipping.measured_prune_fraction` histogram +
-        per-index gauges). Report-only — the scoring model is
-        unchanged; a later PR can close the loop on this number."""
+        per-index gauges). The loop is CLOSED: `whatif.py` now scores
+        skipping candidates with the measured fraction (per-index
+        gauge first, then the global mean) and falls back to the
+        assumption only before anything has been measured —
+        `scoring_source` here says which one the next scoring pass
+        will use, and each candidate's
+        `detail["prune_fraction_source"]` records which one it DID
+        use."""
         from hyperspace_tpu import telemetry
 
         assumed = self.conf.advisor_skipping_prune_fraction
         out: dict = {"assumed_fraction": assumed,
                      "measured_mean_fraction": None,
                      "queries_measured": 0, "drift": None,
+                     "scoring_source": "assumed",
                      "per_index": {}}
         snap = telemetry.get_registry().series_snapshot()
         hist = snap.get("histograms", {}).get(
@@ -164,6 +171,7 @@ class IndexAdvisor:
             out["measured_mean_fraction"] = round(mean, 4)
             out["queries_measured"] = hist["count"]
             out["drift"] = round(mean - assumed, 4)
+            out["scoring_source"] = "measured"
         for name, value in snap.get("gauges", {}).items():
             if name.startswith("skipping.") and \
                     name.endswith(".measured_prune_fraction"):
@@ -171,6 +179,35 @@ class IndexAdvisor:
                              -len(".measured_prune_fraction")]
                 out["per_index"][index] = round(value, 4)
         return out
+
+    def report(self) -> dict:
+        """One human-facing advisor report: the latest ranked
+        recommendations and decisions, the skipping-drift story, and
+        the per-index usage rows (`Hyperspace.index_usage`) with their
+        `unused` drop candidates — each section error-isolated, so a
+        mid-teardown subsystem degrades to an `{"error": ...}` stub
+        instead of failing the whole read. Report-only: nothing is
+        built or vacuumed by asking."""
+        doc: dict = {"generated_at": round(time.time(), 3)}
+
+        def section(name, fn):
+            try:
+                doc[name] = fn()
+            except Exception as exc:
+                doc[name] = {"error": repr(exc)}
+
+        def _usage():
+            from hyperspace_tpu.facade import Hyperspace
+            rows = Hyperspace(self.session).index_usage()
+            return {"indexes": rows,
+                    "unused": [r["index"] for r in rows if r["unused"]]}
+
+        section("recommendations",
+                lambda: [c.to_dict() for c in self.recommendations()])
+        section("decisions", self.decisions)
+        section("skipping_drift", self.skipping_drift)
+        section("index_usage", _usage)
+        return doc
 
     # -- persisted state ---------------------------------------------------
 

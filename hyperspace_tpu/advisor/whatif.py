@@ -38,7 +38,7 @@ from typing import List, Optional, Sequence
 from hyperspace_tpu.utils.hashing import md5_hex
 
 __all__ = ["Candidate", "score_signatures", "hypothetical_entry",
-           "replay_plan"]
+           "replay_plan", "measured_prune_fraction"]
 
 # Approximate decoded bytes per value per logical dtype — only RATIOS
 # matter (index width over relation width).
@@ -108,6 +108,37 @@ def _candidate_name(kind: str, root: str, indexed, included) -> str:
     digest = md5_hex("|".join((kind, root, ",".join(indexed),
                                ",".join(included))))[:10]
     return f"adv_{kind}_{digest}"
+
+
+def measured_prune_fraction(conf, index_name: Optional[str] = None):
+    """The skipping prune fraction the scorer should assume, as
+    `(fraction, source)` — closing the advisor's blind-constant loop:
+    prefer the MEASURED per-index gauge for `index_name` (candidate
+    names are deterministic, so a signature re-proposing an index the
+    advisor already built reads that index's own recorded reality),
+    then the global measured mean (`skipping.measured_prune_fraction`
+    histogram over every served skipping query), and only then the
+    `advisor.skipping.prune.fraction` conf assumption. `source` is one
+    of "measured:index" / "measured:global" / "assumed" — candidates
+    carry it in `detail["prune_fraction_source"]` and the drift report
+    says when measurement overrode the assumption."""
+    from hyperspace_tpu import telemetry
+
+    def clamp(v):
+        return min(max(float(v), 0.0), 1.0)
+
+    snap = telemetry.get_registry().series_snapshot()
+    if index_name is not None:
+        v = snap.get("gauges", {}).get(
+            f"skipping.{index_name}.measured_prune_fraction")
+        if v is not None:
+            return clamp(v), "measured:index"
+    hist = snap.get("histograms", {}).get(
+        "skipping.measured_prune_fraction")
+    count = (hist or {}).get("count") or 0
+    if count:
+        return clamp(hist["sum"] / count), "measured:global"
+    return clamp(conf.advisor_skipping_prune_fraction), "assumed"
 
 
 def _single_scan(plan, roots) -> Optional[object]:
@@ -283,12 +314,13 @@ def _filter_candidates(session, sig, conf, system_path) -> List[Candidate]:
     # Data-skipping candidate: cheap to build and store (per-file
     # sketches), prunes whole files instead of narrowing rows. The
     # rules cannot replay sketches that do not exist — estimate-only,
-    # with the conservative prune-fraction constant.
-    prune_frac = min(max(conf.advisor_skipping_prune_fraction, 0.0), 1.0)
+    # scored with the MEASURED prune fraction when the rules have
+    # recorded one (per-index first, then the global mean), and only
+    # the conf assumption when nothing has been measured yet.
+    sk_name = _candidate_name("skip", root, list(sig.filter_columns), [])
+    prune_frac, prune_src = measured_prune_fraction(conf, sk_name)
     sk_avoided = int(src_bytes * prune_frac)
     if sk_avoided > 0 and sig.filter_columns:
-        sk_name = _candidate_name("skip", root,
-                                  list(sig.filter_columns), [])
         sk_cfg = DataSkippingIndexConfig(sk_name,
                                          list(sig.filter_columns))
         out.append(Candidate(
@@ -299,7 +331,8 @@ def _filter_candidates(session, sig, conf, system_path) -> List[Candidate]:
             replayed=False, replay_applied=None,
             detail={"root": root,
                     "skip_by": list(sig.filter_columns),
-                    "prune_fraction": prune_frac}))
+                    "prune_fraction": prune_frac,
+                    "prune_fraction_source": prune_src}))
     return out
 
 

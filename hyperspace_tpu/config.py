@@ -544,6 +544,67 @@ class HyperspaceConf:
                 .TELEMETRY_PROFILER_CAPTURE_MIN_INTERVAL_SECONDS_DEFAULT)))
 
     @property
+    def telemetry_history_enabled(self) -> bool:
+        """Durable on-lake telemetry history (`telemetry/history.py`):
+        "true" makes the sampler's tick hook flush periodic history
+        segments under `telemetry_history_dir`. Off by default — the
+        history store writes to the warehouse, which is an explicit
+        operator decision."""
+        return (self.get(constants.TELEMETRY_HISTORY_ENABLED,
+                         constants.TELEMETRY_HISTORY_ENABLED_DEFAULT)
+                or "false").lower() == "true"
+
+    @property
+    def telemetry_history_dir(self) -> str:
+        """History segment directory; defaults to
+        `constants.TELEMETRY_HISTORY_DIRNAME` under the warehouse
+        (telemetry history is metadata, and metadata lives on the
+        lake)."""
+        configured = self.get(constants.TELEMETRY_HISTORY_DIR)
+        if configured:
+            return configured
+        return os.path.join(self.warehouse_dir,
+                            constants.TELEMETRY_HISTORY_DIRNAME)
+
+    @property
+    def telemetry_history_interval_seconds(self) -> float:
+        """Minimum seconds between periodic history flushes (incident
+        flushes are immediate and ignore this)."""
+        return float(self.get(
+            constants.TELEMETRY_HISTORY_INTERVAL_SECONDS,
+            str(constants.TELEMETRY_HISTORY_INTERVAL_SECONDS_DEFAULT)))
+
+    @property
+    def telemetry_history_keep_seconds(self) -> float:
+        """Age past which history segments are pruned (0 = keep by
+        byte budget only)."""
+        return float(self.get(
+            constants.TELEMETRY_HISTORY_KEEP_SECONDS,
+            str(constants.TELEMETRY_HISTORY_KEEP_SECONDS_DEFAULT)))
+
+    @property
+    def telemetry_history_keep_bytes(self) -> int:
+        """Total byte budget of the history directory; oldest segments
+        pruned beyond it (0 = no byte bound)."""
+        return self.get_int(constants.TELEMETRY_HISTORY_KEEP_BYTES,
+                            constants.TELEMETRY_HISTORY_KEEP_BYTES_DEFAULT)
+
+    @property
+    def alerts_enabled(self) -> bool:
+        """Rule-driven alerting (`telemetry/alerts.py`): "false" skips
+        rule evaluation on sampler ticks entirely."""
+        return (self.get(constants.TELEMETRY_ALERTS_ENABLED,
+                         constants.TELEMETRY_ALERTS_ENABLED_DEFAULT)
+                or "true").lower() == "true"
+
+    def alert_rule_override(self, rule: str, knob: str) -> Optional[str]:
+        """Per-rule alert override (`telemetry.alerts.rule.<rule>.
+        <knob>`), or None when unset. Knobs: `enabled`, `threshold`,
+        `clear`, `sustain.seconds`, `window.seconds`."""
+        return self.get(
+            f"{constants.TELEMETRY_ALERTS_RULE_PREFIX}{rule}.{knob}")
+
+    @property
     def skipping_enabled(self) -> bool:
         """Query-side gate on data-skipping pruning (`plan/rules/
         skipping.py`): "false" stops FilterIndexRule consulting sketch

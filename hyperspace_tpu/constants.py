@@ -456,6 +456,43 @@ TELEMETRY_PROFILER_CAPTURE_MIN_INTERVAL_SECONDS = \
     "spark.hyperspace.telemetry.profiler.capture.min.interval.seconds"
 TELEMETRY_PROFILER_CAPTURE_MIN_INTERVAL_SECONDS_DEFAULT = 30.0
 
+# Durable on-lake telemetry history (`telemetry/history.py`): when
+# enabled, the sampler's tick hook periodically flushes the registry
+# snapshot, the new ring samples, SLO/burn state, and a flight-ring
+# digest as append-only schema-versioned segment files under
+# `history.dir` (default `<warehouse>/.hyperspace_telemetry` — history
+# is metadata, and metadata lives on the lake). Segments older than
+# `keep.seconds` or beyond `keep.bytes` total are pruned oldest-first;
+# a crash-torn final segment is skipped on read.
+TELEMETRY_HISTORY_ENABLED = "spark.hyperspace.telemetry.history.enabled"
+TELEMETRY_HISTORY_ENABLED_DEFAULT = "false"
+TELEMETRY_HISTORY_DIR = "spark.hyperspace.telemetry.history.dir"
+# The one place the on-lake history directory NAME is spelled —
+# `scripts/check_metrics_coverage.py` bans the literal everywhere but
+# here and `telemetry/history.py`, so every segment write routes
+# through the history seam.
+TELEMETRY_HISTORY_DIRNAME = ".hyperspace_telemetry"
+TELEMETRY_HISTORY_INTERVAL_SECONDS = \
+    "spark.hyperspace.telemetry.history.interval.seconds"
+TELEMETRY_HISTORY_INTERVAL_SECONDS_DEFAULT = 60.0
+TELEMETRY_HISTORY_KEEP_SECONDS = \
+    "spark.hyperspace.telemetry.history.keep.seconds"
+TELEMETRY_HISTORY_KEEP_SECONDS_DEFAULT = 7 * 24 * 3600.0
+TELEMETRY_HISTORY_KEEP_BYTES = \
+    "spark.hyperspace.telemetry.history.keep.bytes"
+TELEMETRY_HISTORY_KEEP_BYTES_DEFAULT = 64 * 1024 * 1024
+
+# Rule-driven alerting (`telemetry/alerts.py`): declarative rules over
+# the sampler's windowed series, evaluated on every tick. A firing
+# rule opens a structured incident with an attached evidence bundle
+# (served at `/alerts`, persisted into the history store). Per-rule
+# overrides live under `alerts.rule.<name>.{enabled,threshold,clear,
+# sustain.seconds,window.seconds}`; `alerts.enabled=false` disables
+# evaluation entirely.
+TELEMETRY_ALERTS_ENABLED = "spark.hyperspace.telemetry.alerts.enabled"
+TELEMETRY_ALERTS_ENABLED_DEFAULT = "true"
+TELEMETRY_ALERTS_RULE_PREFIX = "spark.hyperspace.telemetry.alerts.rule."
+
 # Adaptive host/device execution lane: batches below this row count are
 # evaluated with host numpy, larger batches run on the accelerator. The
 # default is tuned for a high-latency (tunneled) device link where each

@@ -231,6 +231,24 @@ class SloTracker:
         return (violations / total) / _SLO_ALLOWED_FRACTION \
             if total else 0.0
 
+    def refresh(self, conf) -> float:
+        """Prune the window and RE-PUBLISH the burn gauges — the alert
+        plane's feed. `record()` only publishes when a query completes,
+        so after traffic stops `serve.slo.burn_rate` would freeze at
+        its last (possibly burning) value and a burn incident could
+        never resolve; the sampler-tick evaluation reads the burn
+        through here so the published gauge always reflects the decayed
+        window. Returns the current burn rate."""
+        burn = self.burn_rate(conf)
+        target = conf.serve_slo_p99_seconds if conf is not None else 0.0
+        if target > 0:
+            with self._lock:
+                total = len(self._events)
+            reg = telemetry.get_registry()
+            reg.gauge(f"{self.prefix}.burn_rate").set(burn)
+            reg.gauge(f"{self.prefix}.window_queries").set(total)
+        return burn
+
     def snapshot(self, conf=None) -> dict:
         with self._lock:
             total = len(self._events)

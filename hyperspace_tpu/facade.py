@@ -29,6 +29,40 @@ class HyperspaceContext:
         self.index_collection_manager = CachingIndexCollectionManager(session.conf)
 
 
+def index_usage_report(manager, last_n: Optional[int] = None):
+    """Per-index rule-usage rows for `manager`'s catalog (the body of
+    `Hyperspace.index_usage`, module-level so the `/healthz`
+    `index_usage` section can render the same report from a bare
+    conf-built manager — an HTTP handler thread has no facade)."""
+    from hyperspace_tpu import telemetry
+
+    counters = telemetry.get_registry().counters_dict()
+    ring = telemetry.get_recorder().queries(last_n)
+    ring_counts: dict = {}
+    for qm in ring:
+        try:
+            for use in qm.index_usage():
+                name = use.get("name")
+                if name:
+                    ring_counts[name] = ring_counts.get(name, 0) + 1
+        except Exception:
+            continue  # a foreign recorder shape never breaks the report
+    out = []
+    for entry in manager.indexes():
+        name = entry.name
+        served_ring = ring_counts.get(name, 0)
+        out.append({
+            "index": name,
+            "state": entry.state,
+            "served_total": int(
+                counters.get(f"rules.served.{name}", 0)),
+            "served_in_ring": served_ring,
+            "ring_entries": len(ring),
+            "unused": served_ring == 0,
+        })
+    return out
+
+
 class Hyperspace:
     # Weak keys: a dropped session must not be pinned by its context.
     _contexts: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
@@ -128,33 +162,17 @@ class Hyperspace:
         is vacuumed; an index idle here may still serve a workload that
         rotated out of the bounded ring, so treat `unused` as a
         candidate list, not a verdict."""
-        from hyperspace_tpu import telemetry
+        return index_usage_report(self._manager, last_n)
 
-        counters = telemetry.get_registry().counters_dict()
-        ring = telemetry.get_recorder().queries(last_n)
-        ring_counts: dict = {}
-        for qm in ring:
-            try:
-                for use in qm.index_usage():
-                    name = use.get("name")
-                    if name:
-                        ring_counts[name] = ring_counts.get(name, 0) + 1
-            except Exception:
-                continue  # a foreign recorder shape never breaks the report
-        out = []
-        for entry in self._manager.indexes():
-            name = entry.name
-            served_ring = ring_counts.get(name, 0)
-            out.append({
-                "index": name,
-                "state": entry.state,
-                "served_total": int(
-                    counters.get(f"rules.served.{name}", 0)),
-                "served_in_ring": served_ring,
-                "ring_entries": len(ring),
-                "unused": served_ring == 0,
-            })
-        return out
+    def incidents(self, active_only: bool = False):
+        """The incident plane's structured incidents (rule-driven
+        alerting, `telemetry/alerts.py`): each carries its rule, fire
+        and resolve times, breaching value, and the evidence bundle
+        captured at fire time. `active_only` keeps the still-firing
+        ones. The same documents the `/alerts` ops endpoint serves."""
+        from hyperspace_tpu.telemetry import alerts
+
+        return alerts.get_manager().incidents(active_only=active_only)
 
     def metrics_registry(self):
         """The process-wide metrics registry (delegates to the

@@ -6,7 +6,7 @@ agent, no push gateway, no sidecar. When
 `spark.hyperspace.telemetry.ops.port` is set, a stdlib
 `ThreadingHTTPServer` starts inside the engine process (the ONE
 sanctioned `http.server` use — `scripts/check_metrics_coverage.py`
-bans it anywhere else) and serves five read-only endpoints:
+bans it anywhere else) and serves six read-only endpoints:
 
 - **`/metrics`** — the registry's Prometheus text exposition
   (`MetricsRegistry.to_text()`), including the sampler's
@@ -21,7 +21,11 @@ bans it anywhere else) and serves five read-only endpoints:
   by routed replica.
 - **`/timeseries`** — the sampler's ring as JSON (the raw material of
   the `/metrics` window gauges, for dashboards that want the history
-  rather than the trailing point).
+  rather than the trailing point). `?since=<seq>` returns only ticks
+  newer than the caller's cursor — the flight recorder's
+  `snapshot(since_seq)` contract, so incremental scrapers stop
+  re-downloading the whole ring; `last_seq` in the payload is the next
+  cursor.
 - **`/critpath`** — the latency anatomy
   (`telemetry/critical_path.py`): trailing-window segment shares of
   query wall plus the per-query decompositions of the flight ring's
@@ -30,6 +34,10 @@ bans it anywhere else) and serves five read-only endpoints:
   host-time tables, flamegraph JSON (or `?format=collapsed` for the
   flamegraph.pl/speedscope text form), and the recent triggered
   device captures.
+- **`/alerts`** — the incident plane (`telemetry/alerts.py`): the
+  conf-resolved rule table, active and recent incidents with their
+  evidence bundles, and the exact
+  `alerts.{evaluations,fired,resolved,suppressed}` counters.
 
 Security: the server binds `telemetry.ops.host` — 127.0.0.1 by
 default. The endpoints are unauthenticated, read-only operational
@@ -53,6 +61,11 @@ __all__ = ["OpsServer", "get_server", "start_server", "stop_server",
            "configure", "healthz_doc", "critpath_doc"]
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# The last conf handed to configure(): healthz sections that need conf
+# context (the index-usage report) read it, because an HTTP handler
+# thread has no session in hand.
+_conf = None
 
 
 def healthz_doc() -> dict:
@@ -123,12 +136,38 @@ def healthz_doc() -> dict:
             out.setdefault(t, {})["usage"] = usage
         return out
 
+    def _incidents():
+        from hyperspace_tpu.telemetry import alerts
+        m = alerts.get_manager()
+        counters = _registry.get_registry().counters_dict()
+        return {
+            "active": [
+                {k: i.get(k) for k in ("id", "rule", "series", "state",
+                                       "opened_at", "value",
+                                       "threshold", "description")}
+                for i in m.incidents(active_only=True)],
+            "fired": int(counters.get("alerts.fired", 0)),
+            "resolved": int(counters.get("alerts.resolved", 0)),
+        }
+
+    def _index_usage():
+        if _conf is None:
+            return {"skipped": "no configured session in this process"}
+        from hyperspace_tpu.facade import index_usage_report
+        from hyperspace_tpu.index.manager import \
+            CachingIndexCollectionManager
+        rows = index_usage_report(CachingIndexCollectionManager(_conf))
+        return {"indexes": rows,
+                "unused": [r["index"] for r in rows if r["unused"]]}
+
     section("scheduler", _scheduler)
     section("breakers", _breakers)
     section("segments", _segments)
     section("replicas", _replicas)
     section("flight", _flight)
     section("tenants", _tenants)
+    section("incidents", _incidents)
+    section("index_usage", _index_usage)
     return doc
 
 
@@ -183,7 +222,14 @@ class _Handler(BaseHTTPRequestHandler):
                                   default=str).encode("utf-8")
                 self._send(200, "application/json", body)
             elif path == "/timeseries":
-                body = json.dumps(_timeseries.get_sampler().snapshot(),
+                since = self._since_param()
+                body = json.dumps(
+                    _timeseries.get_sampler().snapshot(since_seq=since),
+                    default=str).encode("utf-8")
+                self._send(200, "application/json", body)
+            elif path == "/alerts":
+                from hyperspace_tpu.telemetry import alerts
+                body = json.dumps(alerts.alerts_doc(),
                                   default=str).encode("utf-8")
                 self._send(200, "application/json", body)
             elif path == "/critpath":
@@ -206,7 +252,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(404, "text/plain; charset=utf-8",
                            b"not found: /metrics /healthz /timeseries "
-                           b"/critpath /profile\n")
+                           b"/critpath /profile /alerts\n")
             reg.counter("ops.http.requests").inc()
         except Exception:
             reg.counter("ops.http.errors").inc()
@@ -215,6 +261,18 @@ class _Handler(BaseHTTPRequestHandler):
                            b"internal error\n")
             except Exception:
                 pass  # client gone mid-write
+
+    def _since_param(self) -> Optional[int]:
+        """The `?since=<seq>` cursor, or None when absent/malformed (a
+        bad cursor degrades to the full ring, never a 4xx — same
+        lenience as the flight recorder's filters)."""
+        from urllib.parse import parse_qs
+        query = self.path.partition("?")[2]
+        try:
+            values = parse_qs(query).get("since")
+            return int(values[0]) if values else None
+        except (ValueError, TypeError):
+            return None
 
     @staticmethod
     def _fresh_tick() -> None:
@@ -317,13 +375,27 @@ def configure(conf) -> Optional[OpsServer]:
     start the sampler and the server; unset = no-op. Failures degrade
     to a warning — the operations plane is an observability feature,
     never a startup failure."""
-    # The sampling profiler configures independently of the ops port —
-    # an operator can profile without exposing HTTP (and vice versa).
+    global _conf
+    if conf is not None:
+        _conf = conf
+    # The sampling profiler, alert manager, and history writer all
+    # configure independently of the ops port — an operator can alert
+    # and persist history without exposing HTTP (and vice versa).
     try:
         from hyperspace_tpu.telemetry import profiler as _profiler
         _profiler.configure(conf)
     except Exception:
         pass  # profiler.configure logs its own failures
+    try:
+        from hyperspace_tpu.telemetry import alerts as _alerts
+        _alerts.configure(conf)
+    except Exception:
+        pass  # alerts.configure logs its own failures
+    try:
+        from hyperspace_tpu.telemetry import history as _history
+        _history.configure(conf)
+    except Exception:
+        pass  # history.configure logs its own failures
     try:
         port = conf.telemetry_ops_port if conf is not None else None
     except Exception:

@@ -120,10 +120,10 @@ class _Sample:
     per-interval derivations against the previous tick."""
 
     __slots__ = ("t", "dt", "counters", "gauges", "hists", "rates",
-                 "interval")
+                 "interval", "seq")
 
     def __init__(self, t: float, dt: Optional[float], counters, gauges,
-                 hists, rates, interval):
+                 hists, rates, interval, seq: int = 0):
         self.t = t
         self.dt = dt
         self.counters = counters   # {name: cumulative value}
@@ -131,6 +131,7 @@ class _Sample:
         self.hists = hists         # {name: bucket_state()}
         self.rates = rates         # {name: per-second rate this interval}
         self.interval = interval   # {name: {count, p50, p99, sum_s}}
+        self.seq = seq             # monotonic per-sampler tick number
 
     def to_dict(self) -> dict:
         hists = {}
@@ -145,6 +146,7 @@ class _Sample:
                                                 else kv[0]))}}
         return {
             "t": round(self.t, 3),
+            "seq": self.seq,
             "dt_s": round(self.dt, 6) if self.dt is not None else None,
             "counters": {k: round(v, 6)
                          for k, v in sorted(self.counters.items())},
@@ -186,6 +188,9 @@ class TimeSeriesSampler:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._prev: Optional[_Sample] = None
+        self._seq = 0              # advances on every tick, never rewinds
+        self.conf = None           # set by configure(); the tick hooks'
+        #                            conf (alerts/history need one)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -279,12 +284,32 @@ class TimeSeriesSampler:
                         "p50": quantile_from_buckets(db, 0.50),
                         "p99": quantile_from_buckets(db, 0.99),
                     }
+            self._seq += 1
             sample = _Sample(now, dt, counters, gauges, hists, rates,
-                             interval)
+                             interval, seq=self._seq)
             self._ring.append(sample)
             self._prev = sample
         self._publish_window_gauges(now)
+        self._post_tick_hooks(now)
         return sample.to_dict()
+
+    def _post_tick_hooks(self, now: float) -> None:
+        """Fan the fresh tick out to the incident plane — alert rule
+        evaluation and the interval-gated history flush — OUTSIDE the
+        sampler lock (both re-enter the window math). A hook failure
+        never breaks sampling: counted `timeseries.hook_errors` and
+        dropped."""
+        reg = _registry.get_registry()
+        try:
+            from hyperspace_tpu.telemetry import alerts as _alerts
+            _alerts.on_tick(self, now=now)
+        except Exception:
+            reg.counter("timeseries.hook_errors").inc()
+        try:
+            from hyperspace_tpu.telemetry import history as _history
+            _history.on_tick(conf=self.conf, now=now)
+        except Exception:
+            reg.counter("timeseries.hook_errors").inc()
 
     # -- window math -----------------------------------------------------
 
@@ -357,6 +382,26 @@ class TimeSeriesSampler:
             return None
         return max(0.0, now_v - then_v) / elapsed
 
+    def window_delta(self, name: str,
+                     window_s: Optional[float] = None,
+                     since_t: Optional[float] = None
+                     ) -> Tuple[float, float]:
+        """(raw counter delta, covered seconds) of counter `name` over
+        the trailing window — the absolute-change primitive the alert
+        rules' delta/ratio/trend predicates are built on (a rate hides
+        "exactly one breaker opened"). covered == 0 means the ring had
+        nothing to diff against."""
+        latest = self._latest()
+        if latest is None:
+            return 0.0, 0.0
+        t0 = since_t if since_t is not None \
+            else latest.t - (window_s or self.window_s)
+        base = self._baseline(t0)
+        now_v = latest.counters.get(name, 0.0)
+        then_v = base.counters.get(name, 0.0) if base is not None else 0.0
+        elapsed = latest.t - (base.t if base is not None else t0)
+        return max(0.0, now_v - then_v), max(elapsed, 0.0)
+
     def window_count(self, name: str,
                      window_s: Optional[float] = None) -> int:
         buckets, _cov = self.window_buckets(name, window_s=window_s)
@@ -392,24 +437,40 @@ class TimeSeriesSampler:
 
     # -- export ----------------------------------------------------------
 
-    def samples(self, since_t: Optional[float] = None) -> List[dict]:
-        """The ring as JSON-able dicts, oldest first (`since_t` keeps
-        only samples strictly after it — the bench drivers' phase
-        isolation)."""
+    @property
+    def last_seq(self) -> int:
+        """Highest tick sequence assigned so far (advances even past
+        samples the ring has since rotated out — the same global-cursor
+        contract as the flight recorder's `last_seq`)."""
+        with self._lock:
+            return self._seq
+
+    def samples(self, since_t: Optional[float] = None,
+                since_seq: Optional[int] = None) -> List[dict]:
+        """The ring as JSON-able dicts, oldest first. `since_t` keeps
+        only samples strictly after that time (the bench drivers'
+        phase isolation); `since_seq` keeps only ticks with a strictly
+        greater sequence (the incremental-scraper cursor)."""
         with self._lock:
             entries = list(self._ring)
         return [s.to_dict() for s in entries
-                if since_t is None or s.t > since_t]
+                if (since_t is None or s.t > since_t)
+                and (since_seq is None or s.seq > since_seq)]
 
-    def snapshot(self) -> dict:
-        """The `/timeseries` payload: sampler config + the ring."""
+    def snapshot(self, since_seq: Optional[int] = None) -> dict:
+        """The `/timeseries` payload: sampler config + the ring.
+        `since_seq` (the `?since=` query parameter) returns only ticks
+        newer than the caller's cursor; `last_seq` in the payload is
+        the cursor to hand back next poll — the flight recorder's
+        `snapshot(since_seq)` contract, applied to the sampler ring."""
         return {
             "interval_s": self.interval_s,
             "window_s": self.window_s,
             "capacity": self._ring.maxlen,
             "running": self.running,
             "histograms": list(self.histograms),
-            "samples": self.samples(),
+            "last_seq": self.last_seq,
+            "samples": self.samples(since_seq=since_seq),
         }
 
     def __len__(self) -> int:
@@ -464,6 +525,7 @@ def configure(conf) -> Optional[TimeSeriesSampler]:
         if conf is None or conf.telemetry_ops_port is None:
             return None
         sampler = get_sampler()
+        sampler.conf = conf
         if not sampler.running:
             sampler.interval_s = max(0.01,
                                      conf.timeseries_interval_seconds)

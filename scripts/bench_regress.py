@@ -398,7 +398,10 @@ def compare_serve(old: dict, new: dict, threshold: float):
       rounds (PR 17): every sweep rate's stamped p99 decomposition
       sums to its measured wall within CRITPATH_EPSILON_S, and the
       sampling profiler costs <= PROFILER_OVERHEAD_MAX of closed-loop
-      QPS.
+      QPS;
+    - `clean_run_incidents` — incident-plane rounds (PR 18): zero
+      alert incidents fired during the timed closed loop (the
+      false-positive gate on the default rule set).
 
     Absolute rows gate on the NEW artifact alone; rounds predating the
     sections are not gated on them."""
@@ -524,6 +527,17 @@ def compare_serve(old: dict, new: dict, threshold: float):
         rows.append(("profiler_overhead", PROFILER_OVERHEAD_MAX,
                      float(ovh), ovh - PROFILER_OVERHEAD_MAX,
                      ovh > PROFILER_OVERHEAD_MAX))
+    # Incident-plane gate (PR 18; rounds predating the `alerts` digest
+    # skip): `clean_run_incidents` — the timed closed loop is a clean,
+    # correctly-sized lap, so ANY incident fired during it is a false
+    # positive of the alert rules (absolute: the healthy value is 0 and
+    # nothing ratio-gates against zero). The open-loop sweep past the
+    # knee may legitimately fire; those land in the digest but do not
+    # gate.
+    cf = (n.get("alerts") or {}).get("clean_run_fired")
+    if isinstance(cf, (int, float)):
+        rows.append(("clean_run_incidents", 0.0, float(cf), float(cf),
+                     cf > 0))
     return rows
 
 

@@ -803,6 +803,89 @@ def check_critpath_doc_rows(repo_root: str):
     return failures
 
 
+def check_alert_rule_doc_rows(repo_root: str):
+    """Doc-drift lint for the default alert rules: every series a
+    shipped rule reads must have a docs/telemetry.md row (the
+    `hit_ratio` kind reads the `<series>.{hits,misses}` counter family;
+    warm gates read their counter too). An alert an operator cannot
+    trace to a documented series is an incident nobody can interpret —
+    and each rule's NAME must appear in the default-rule table so its
+    conf override knobs are discoverable."""
+    from hyperspace_tpu.telemetry import alerts
+    doc_path = os.path.join(repo_root, "docs", "telemetry.md")
+    try:
+        with open(doc_path, encoding="utf-8") as f:
+            doc = f.read()
+    except OSError:
+        return [f"{doc_path}: missing — the metrics reference lives "
+                "there"]
+    documented = set(re.findall(r"`([^`\s]+)`", doc))
+    for token in list(documented):
+        if "{" in token:
+            documented.update(_expand_braces(token))
+    failures = []
+    for rule in alerts.DEFAULT_RULES:
+        series = ([f"{rule.series}.hits", f"{rule.series}.misses"]
+                  if rule.kind == "hit_ratio" else
+                  [rule.series] if rule.series else [])
+        if rule.warm_counter:
+            series.append(rule.warm_counter)
+        for name in series:
+            if name not in doc and name not in documented:
+                failures.append(
+                    f"hyperspace_tpu/telemetry/alerts.py: default rule "
+                    f"{rule.name!r} reads series {name!r} which has no "
+                    "row in docs/telemetry.md — an undocumented series "
+                    "cannot anchor an alert")
+        if rule.name not in doc:
+            failures.append(
+                f"hyperspace_tpu/telemetry/alerts.py: default rule "
+                f"{rule.name!r} missing from the docs/telemetry.md "
+                "rule table — its conf override knobs are "
+                "undiscoverable")
+    return failures
+
+
+# The ONE sanctioned telemetry-history writer: durable segments under
+# `<warehouse>/.hyperspace_telemetry/` are written only by
+# telemetry/history.py (atomic publish, schema version, age/byte
+# pruning, torn-segment skipping on read). The directory-name literal
+# is defined once in constants.py (TELEMETRY_HISTORY_DIRNAME); spelling
+# it anywhere else in the package is a history file the reader's merge
+# and the pruner's budget do not govern.
+_RAW_HISTORY_RE = re.compile(r"\.hyperspace_telemetry")
+_HISTORY_ALLOWED = ("constants.py",
+                    os.path.join("telemetry", "history.py"))
+
+
+def check_history_write_seam(package_dir: str):
+    """Source lint: the telemetry-history directory literal appears
+    only in constants.py (the definition) and telemetry/history.py
+    (the writer)."""
+    failures = []
+    for root, _dirs, files in os.walk(package_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            if rel in _HISTORY_ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_HISTORY_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: telemetry-"
+                            "history directory literal outside the "
+                            "sanctioned writer — history segments are "
+                            "written only by telemetry/history.py "
+                            "(reference constants."
+                            "TELEMETRY_HISTORY_DIRNAME)")
+    return failures
+
+
 def main() -> int:
     import hyperspace_tpu
 
@@ -887,6 +970,10 @@ def main() -> int:
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_critpath_doc_rows(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    failures.extend(check_alert_rule_doc_rows(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    failures.extend(check_history_write_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
 
     if import_errors:
         print("check_metrics_coverage: module import failures "

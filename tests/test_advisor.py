@@ -435,3 +435,55 @@ def test_compile_cache_dir_wires_persistent_cache(tmp_path, monkeypatch):
     finally:
         jax.config.update("jax_compilation_cache_dir", before)
         monkeypatch.setattr(compilation, "_persistent_dir", None)
+
+
+# ---------------------------------------------------------------------------
+# Measured prune fraction closes the what-if loop (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_measured_prune_fraction_drives_skipping_rank(workload_env):
+    """The measured per-index prune gauge overrides the conf
+    assumption and deterministically flips the skipping candidate's
+    rank against the covering candidate for the same signature."""
+    sess, facts, _dims = workload_env
+    _run_filter_workload(sess, facts)
+    from hyperspace_tpu.advisor import score_signatures
+    adv = IndexAdvisor(sess)
+    adv.observe()
+    sigs = adv.miner.recurring()
+
+    def ranked():
+        cands = score_signatures(sess, sigs, sess.conf)
+        return cands, [c.name for c in cands]
+
+    cands, _names = ranked()
+    sk = next(c for c in cands if c.kind == "skipping")
+    cov = next(c for c in cands if c.kind == "covering")
+    # Nothing measured for THIS index yet (the suite's global
+    # histogram may already hold other workloads' measurements).
+    assert sk.detail["prune_fraction_source"] in ("assumed",
+                                                  "measured:global")
+
+    gauge = telemetry.get_registry().gauge(
+        f"skipping.{sk.name}.measured_prune_fraction")
+
+    # Reality says the sketches prune (nearly) everything: skipping
+    # outranks the replay-verified covering index.
+    gauge.set(1.0)
+    cands, names = ranked()
+    sk_hi = next(c for c in cands if c.kind == "skipping")
+    assert sk_hi.detail["prune_fraction_source"] == "measured:index"
+    assert sk_hi.detail["prune_fraction"] == 1.0
+    assert names.index(sk_hi.name) < names.index(cov.name)
+    assert sk_hi.est_bytes_avoided_per_query > \
+        cov.est_bytes_avoided_per_query
+
+    # Reality says they barely prune: the SAME candidate sinks below
+    # the covering index instead.
+    gauge.set(0.001)
+    cands, names = ranked()
+    sk_lo = next(c for c in cands if c.kind == "skipping")
+    assert sk_lo.detail["prune_fraction_source"] == "measured:index"
+    assert names.index(sk_lo.name) > names.index(cov.name)
+    assert sk_lo.score < cov.score

@@ -371,3 +371,75 @@ def test_rules_served_counters_and_index_usage_report(tmp_path):
     # last_n narrows the ring window the report mines.
     narrowed = {row["index"]: row for row in hs.index_usage(last_n=1)}
     assert narrowed["ops_hot"]["ring_entries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Incident plane endpoints: /alerts, /healthz sections, /timeseries?since=
+# ---------------------------------------------------------------------------
+
+
+def test_alerts_endpoint_round_trip(server):
+    """GET /alerts serves the conf-resolved rule table and the exact
+    alert counters as JSON."""
+    from hyperspace_tpu.telemetry import alerts
+
+    status, ctype, body = _get(server, "/alerts")
+    assert status == 200
+    assert ctype.startswith("application/json")
+    doc = json.loads(body)
+    assert doc["enabled"] is True
+    rule_names = {r["name"] for r in doc["rules"]}
+    assert rule_names >= {r.name for r in alerts.DEFAULT_RULES}
+    assert isinstance(doc["active"], list)
+    for key in ("alerts.evaluations", "alerts.fired",
+                "alerts.resolved", "alerts.suppressed"):
+        assert key in doc["counters"]
+
+
+def test_healthz_serves_incidents_and_index_usage(server):
+    """/healthz carries the incident section (active list + exact
+    fired/resolved counters) and the per-index usage report section."""
+    status, _ctype, body = _get(server, "/healthz")
+    assert status == 200
+    doc = json.loads(body)
+    inc = doc["incidents"]
+    assert isinstance(inc["active"], list)
+    assert inc["fired"] >= inc["resolved"] >= 0
+    # No configured session in this bare server process: the section
+    # degrades to a skip marker, never an error.
+    usage = doc["index_usage"]
+    assert "indexes" in usage or "skipped" in usage or "error" in usage
+
+
+def test_timeseries_since_cursor_round_trip(server):
+    """`?since=<seq>` returns only ticks newer than the cursor;
+    `last_seq` is the next cursor; a malformed cursor degrades to the
+    full ring."""
+    s = timeseries.set_sampler(
+        timeseries.TimeSeriesSampler(interval_s=1.0, capacity=64))
+    try:
+        s.tick(t=100.0)
+        _status, _ctype, body = _get(server, "/timeseries")
+        full = json.loads(body)
+        assert full["samples"]
+        cursor = full["last_seq"]
+        assert cursor == full["samples"][-1]["seq"]
+
+        s.tick(t=101.0)
+        s.tick(t=102.0)
+        _status, _ctype, body = _get(server, f"/timeseries?since={cursor}")
+        doc = json.loads(body)
+        assert len(doc["samples"]) == 2
+        assert all(smp["seq"] > cursor for smp in doc["samples"])
+        assert doc["last_seq"] == cursor + 2
+
+        # Caught-up cursor: empty delta, cursor unchanged.
+        _status, _ctype, body = _get(
+            server, f"/timeseries?since={doc['last_seq']}")
+        assert json.loads(body)["samples"] == []
+
+        # Malformed cursor: full ring, not a 4xx.
+        _status, _ctype, body = _get(server, "/timeseries?since=abc")
+        assert len(json.loads(body)["samples"]) == 3
+    finally:
+        timeseries.reset_sampler()

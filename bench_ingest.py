@@ -1,0 +1,473 @@
+"""Continuous-ingest benchmark — the staleness-vs-p99 frontier.
+
+The first rung where the full write path meets the full serve path:
+micro-batch appends land and incremental refresh runs (bucketed delta
+for the covering index, sketch-append for the skipping index) WHILE an
+8-client closed loop serves — lease-coordinated, pressure-gated, every
+concurrent answer bit-checked against its serial oracle.
+
+The workload is append-invariant by construction: queries filter the
+LOW key range (g 0..7 on facts; e < 6000 on events) and every appended
+file carries only HIGH-range rows (g >= 16; e >= 100000), so the
+correct answer never changes while the index version flips under the
+readers — any drift is a real snapshot-isolation bug, not churn. A
+separate freshness count over the appended range (fresh reader each
+time) proves appends actually become visible. Clients build their
+DataFrame fresh per query so the scan re-lists the growing source:
+hybrid scan serves the unindexed remainder between refreshes, with the
+skipping index's delta sketches thinning it.
+
+Phases, one artifact:
+
+1. **quiet lap** — closed-loop p99 with no ingest: the baseline.
+2. **append-rate sweep** — a bench-owned ticker thread drives
+   `IngestCoordinator.run_once` at each rate (one appended file per
+   source per tick, then incremental refresh of both indexes) while
+   the clients serve. Per rate: p99, staleness gauge max/mean,
+   refreshes/conflicts/deferred, segment-cache warm hit rate +
+   `cache.segments.rekeyed` delta. The committed operating point is
+   the HIGHEST swept rate that still holds the warm-hit-rate floor —
+   rates past the knee stay in the sweep as the frontier's far edge
+   but are not what the regression gates defend.
+3. **chaos** — crash injection at refresh phase boundaries for BOTH
+   incremental actions plus transient storage faults, under full
+   client load with the maintenance lease shrunk so the next tick's
+   lease recovery heals the op log. Green = zero mismatches, zero
+   stuck clients, zero non-ACTIVE op-log leftovers, staleness drains
+   to 0 after quiesce.
+
+Prints exactly ONE JSON line (canonical schema via
+`telemetry.artifact.make_artifact`; gated by
+`scripts/bench_regress.py --ingest`).
+
+Env knobs: BENCH_INGEST_CLIENTS (8), BENCH_INGEST_ROWS (16000 initial
+facts rows), BENCH_INGEST_LAP_SECONDS (6 per lap),
+BENCH_INGEST_RATES (appends/s per source, "0.5,1.0,2.0"),
+BENCH_INGEST_APPEND_ROWS (400 rows per appended file),
+BENCH_INGEST_CHAOS_SECONDS (8).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+CLIENTS = int(os.environ.get("BENCH_INGEST_CLIENTS", 8))
+ROWS = int(os.environ.get("BENCH_INGEST_ROWS", 16_000))
+LAP_SECONDS = float(os.environ.get("BENCH_INGEST_LAP_SECONDS", 6))
+RATES = [float(r) for r in os.environ.get(
+    "BENCH_INGEST_RATES", "0.5,1.0,2.0").split(",")]
+APPEND_ROWS = int(os.environ.get("BENCH_INGEST_APPEND_ROWS", 400))
+CHAOS_SECONDS = float(os.environ.get("BENCH_INGEST_CHAOS_SECONDS", 8))
+
+from bench_common import link_probe, log  # noqa: E402
+from hyperspace_tpu import telemetry  # noqa: E402
+
+
+def _percentile(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _counter(name: str) -> float:
+    return telemetry.get_registry().counters_dict().get(name, 0)
+
+
+def canonical(table):
+    return table.sort_by([(n, "ascending") for n in table.column_names])
+
+
+def generate(data_dir: str):
+    """facts: 8 files, g in 0..15 (low range). events: 6 files, e in
+    disjoint low blocks. Appends later use g >= 16 / e >= 100000."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(11)
+    facts = os.path.join(data_dir, "facts")
+    events = os.path.join(data_dir, "events")
+    os.makedirs(facts)
+    os.makedirs(events)
+    per = max(1, ROWS // 8)
+    for i in range(8):
+        k = np.arange(i * per, (i + 1) * per, dtype=np.int64)
+        pq.write_table(pa.table({
+            "k": k, "g": k % 16,
+            "v": rng.random(per).astype(np.float64)}),
+            os.path.join(facts, f"f{i:03d}.parquet"))
+    for i in range(6):
+        e = np.arange(i * 1000, (i + 1) * 1000, dtype=np.int64)
+        pq.write_table(pa.table({
+            "e": e, "w": rng.random(1000).astype(np.float64)}),
+            os.path.join(events, f"e{i:03d}.parquet"))
+    return facts, events
+
+
+class Appender:
+    """Atomic micro-batch producer: each call writes one HIGH-range
+    file into facts and events (tmp + rename so a concurrent listing
+    never sees a partial file) and returns the new paths. Each facts
+    file carries ONE g value, so a refresh touches at most one bucket
+    and the warm-set story is measurable."""
+
+    def __init__(self, facts: str, events: str):
+        self.facts = facts
+        self.events = events
+        self.n = 0
+        self.rows_appended = 0
+        self.rng = np.random.default_rng(23)
+
+    def _write(self, table, directory: str, name: str) -> str:
+        import pyarrow.parquet as pq
+        tmp = os.path.join(directory, f".tmp.{name}")
+        out = os.path.join(directory, name)
+        pq.write_table(table, tmp)
+        os.replace(tmp, out)
+        return out
+
+    def __call__(self):
+        import pyarrow as pa
+        i = self.n
+        self.n += 1
+        g = np.int64(16 + (i % 8))
+        k = np.arange(ROWS + i * APPEND_ROWS,
+                      ROWS + (i + 1) * APPEND_ROWS, dtype=np.int64)
+        f1 = self._write(pa.table({
+            "k": k, "g": np.full(APPEND_ROWS, g, dtype=np.int64),
+            "v": self.rng.random(APPEND_ROWS).astype(np.float64)}),
+            self.facts, f"a{i:05d}.parquet")
+        e = np.arange(100_000 + i * APPEND_ROWS,
+                      100_000 + (i + 1) * APPEND_ROWS, dtype=np.int64)
+        f2 = self._write(pa.table({
+            "e": e, "w": self.rng.random(APPEND_ROWS).astype(np.float64)}),
+            self.events, f"a{i:05d}.parquet")
+        self.rows_appended += 2 * APPEND_ROWS
+        return [f1, f2]
+
+
+def build_queries(session, facts: str, events: str):
+    """(name, build_fn) pairs; build_fn returns a FRESH DataFrame so
+    the scan re-lists the growing source every execution."""
+    from hyperspace_tpu.plan.expr import col, lit
+
+    queries = []
+    for g in range(8):
+        def q(g=g):
+            return (session.read_parquet(facts)
+                    .filter(col("g") == lit(g)).select("k", "g", "v"))
+        queries.append((f"point_g{g}", q))
+    for lo, hi in ((0, 1000), (2500, 3500), (4000, 6000)):
+        def q(lo=lo, hi=hi):
+            return (session.read_parquet(events)
+                    .filter(col("e") >= lit(lo))
+                    .filter(col("e") < lit(hi)).select("e", "w"))
+        queries.append((f"range_e{lo}", q))
+    return queries
+
+
+def serve_lap(session, queries, oracles, seconds: float, clients: int):
+    """Closed loop: each client builds + runs queries round-robin until
+    the deadline, checking every answer against the serial oracle.
+    Returns (latencies sorted, ok, mismatches, errors, stuck)."""
+    lock = threading.Lock()
+    latencies, errors = [], []
+    counts = {"ok": 0, "mismatch": 0}
+    deadline = time.time() + seconds
+
+    def client(cid: int):
+        i = cid
+        while time.time() < deadline:
+            name, build = queries[i % len(queries)]
+            i += clients
+            t0 = time.perf_counter()
+            try:
+                out = build().collect()
+            except Exception as exc:
+                with lock:
+                    errors.append(f"{name}: {exc!r}")
+                continue
+            dt = time.perf_counter() - t0
+            good = canonical(out).equals(oracles[name])
+            with lock:
+                latencies.append(dt)
+                counts["ok" if good else "mismatch"] += 1
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    stuck = 0
+    for t in threads:
+        t.join(timeout=seconds + 60)
+        if t.is_alive():
+            stuck += 1
+    return (sorted(latencies), counts["ok"], counts["mismatch"],
+            errors, stuck)
+
+
+class Ticker:
+    """Bench-owned coordinator driver (the coordinator itself is
+    caller-threaded by design): ticks `run_once` at `interval_s`,
+    sampling the staleness gauge after each tick. Injected crashes are
+    caught HERE — the ticker models the supervised process that dies
+    and restarts; the next tick's lease recovery heals the log."""
+
+    def __init__(self, coord, interval_s: float):
+        self.coord = coord
+        self.interval_s = interval_s
+        self.staleness_samples = []
+        self.crashes = 0
+        self.tick_errors = []
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _loop(self):
+        while not self._stop.is_set():
+            t0 = time.time()
+            try:
+                self.coord.run_once()
+            except BaseException as exc:  # noqa: BLE001 - injected crash
+                self.crashes += 1
+                self.tick_errors.append(repr(exc))
+            self.staleness_samples.append(self.coord.staleness_s())
+            elapsed = time.time() - t0
+            self._stop.wait(max(0.01, self.interval_s - elapsed))
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="bench-ingest-ticker")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+
+
+def drain(coord, timeout_s: float = 30.0) -> float:
+    """Tick until staleness reaches 0 (all appends indexed)."""
+    end = time.time() + timeout_s
+    while time.time() < end:
+        try:
+            coord.run_once()
+        except BaseException:
+            pass
+        if coord.staleness_s() <= 0.0:
+            return 0.0
+    return coord.staleness_s()
+
+
+def stranded_entries(session) -> int:
+    """Non-ACTIVE latest op-log entries after recovery = stranded."""
+    from hyperspace_tpu.facade import Hyperspace
+    manager = Hyperspace.get_context(session).index_collection_manager
+    if hasattr(manager, "clear_cache"):
+        manager.clear_cache()
+    bad = 0
+    for entry in manager.get_indexes():
+        if entry.state != "ACTIVE":
+            bad += 1
+    return bad
+
+
+def main():
+    from hyperspace_tpu.config import HyperspaceConf
+    from hyperspace_tpu.engine.session import HyperspaceSession
+    from hyperspace_tpu.facade import Hyperspace
+    from hyperspace_tpu.index.index_config import (DataSkippingIndexConfig,
+                                                   IndexConfig)
+    from hyperspace_tpu.plan.expr import col, lit
+    from hyperspace_tpu.telemetry.artifact import make_artifact
+    from hyperspace_tpu.utils import faults
+
+    tmp = tempfile.mkdtemp(prefix="hs_bench_ingest_")
+    try:
+        data_dir = os.path.join(tmp, "data")
+        os.makedirs(data_dir)
+        facts, events = generate(data_dir)
+        session = HyperspaceSession(HyperspaceConf({
+            "hyperspace.warehouse.dir": os.path.join(tmp, "wh"),
+            "spark.hyperspace.index.num.buckets": "8",
+            "spark.hyperspace.index.lineage.enabled": "true",
+            "spark.hyperspace.index.hybridscan.enabled": "true",
+            "spark.hyperspace.execution.min.device.rows": "0",
+            "spark.hyperspace.distribution.enabled": "false",
+            "spark.hyperspace.serve.queue.depth": "64",
+            # Small lease so chaos-phase crash recovery lands on the
+            # next tick, not 10 minutes later. The coordinator is the
+            # only writer outside chaos, so no live writer can be
+            # mistaken for a stale one.
+            "spark.hyperspace.maintenance.lease.seconds": "2",
+            "spark.hyperspace.io.retry.base.ms": "5",
+            "spark.hyperspace.io.retry.max.ms": "40",
+        }))
+        hs = Hyperspace(session)
+        log("bench_ingest: building indexes")
+        hs.create_index(session.read_parquet(facts),
+                        IndexConfig("cov", ["g"], ["k", "v"]))
+        hs.create_index(session.read_parquet(events),
+                        DataSkippingIndexConfig("sk", ["e"]))
+
+        queries = build_queries(session, facts, events)
+        oracles = {}
+        for name, build in queries:
+            oracles[name] = canonical(build().collect())
+        session.enable_hyperspace()
+        # Warm lap: settle jit/segment caches before timing anything.
+        for name, build in queries:
+            out = canonical(build().collect())
+            assert out.equals(oracles[name]), f"warm mismatch: {name}"
+
+        log(f"bench_ingest: quiet lap ({CLIENTS} clients, "
+            f"{LAP_SECONDS:.0f}s)")
+        lat, ok, mism, errs, stuck = serve_lap(
+            session, queries, oracles, LAP_SECONDS, CLIENTS)
+        quiet = {"p50_s": _percentile(lat, 0.50),
+                 "p99_s": _percentile(lat, 0.99),
+                 "qps": round(len(lat) / LAP_SECONDS, 2),
+                 "queries": len(lat), "mismatches": mism,
+                 "errors": len(errs), "stuck_threads": stuck}
+        assert mism == 0 and stuck == 0, (mism, stuck, errs[:3])
+
+        appender = Appender(facts, events)
+        coord = hs.ingest(producer=appender, indexes=["cov", "sk"])
+        sweep = []
+        for rate in RATES:
+            interval = 1.0 / max(rate, 1e-6)
+            c0 = telemetry.get_registry().counters_dict()
+            ticker = Ticker(coord, interval)
+            log(f"bench_ingest: sweep rate={rate}/s "
+                f"(tick every {interval:.2f}s)")
+            ticker.start()
+            lat, ok, mism, errs, stuck = serve_lap(
+                session, queries, oracles, LAP_SECONDS, CLIENTS)
+            ticker.stop()
+            c1 = telemetry.get_registry().counters_dict()
+
+            def delta(name):
+                return c1.get(name, 0) - c0.get(name, 0)
+
+            hits, misses = delta("cache.segments.hits"), delta(
+                "cache.segments.misses")
+            samples = ticker.staleness_samples or [0.0]
+            sweep.append({
+                "rate_files_per_s": rate,
+                "p50_s": _percentile(lat, 0.50),
+                "p99_s": _percentile(lat, 0.99),
+                "qps": round(len(lat) / LAP_SECONDS, 2),
+                "queries": len(lat),
+                "mismatches": mism, "errors": len(errs),
+                "stuck_threads": stuck,
+                "staleness_max_s": round(max(samples), 3),
+                "staleness_mean_s": round(sum(samples) / len(samples), 3),
+                "appends": delta("ingest.appends"),
+                "refreshes": delta("ingest.refreshes"),
+                "conflicts": delta("ingest.conflicts"),
+                "deferred": delta("ingest.deferred"),
+                "failures": delta("ingest.failures"),
+                "segcache": {
+                    "hits": hits, "misses": misses,
+                    "warm_hit_rate": round(hits / (hits + misses), 4)
+                    if hits + misses else None,
+                    "rekeyed": delta("cache.segments.rekeyed"),
+                },
+            })
+            assert mism == 0 and stuck == 0, (rate, mism, stuck, errs[:3])
+        # Operating point: highest rate that holds the warm-hit floor.
+        # Past-the-knee rates stay in the sweep as the frontier's far
+        # edge; gates defend the rate we'd actually run at.
+        sustainable = [s for s in sweep
+                       if (s["segcache"]["warm_hit_rate"] or 0.0) >= 0.5]
+        committed = (sustainable[-1] if sustainable else sweep[-1])
+
+        # -- chaos: crash + transient mid-refresh under full load ------
+        log("bench_ingest: chaos lap (crash + transient mid-refresh)")
+        recoveries0 = _counter("resilience.recoveries")
+        injector = faults.FaultInjector([
+            faults.FaultRule("action.RefreshIncrementalAction.op",
+                             kind="crash", nth=2, times=1),
+            faults.FaultRule("action.RefreshSkippingAppendAction.op",
+                             kind="crash", nth=3, times=1),
+            faults.FaultRule("action.RefreshIncrementalAction.end",
+                             kind="crash", nth=6, times=1),
+            faults.FaultRule("file.write", kind="transient", times=2,
+                             path="*indexes*"),
+        ], seed=7)
+        faults.install(injector)
+        chaos_ticker = Ticker(coord, 0.6)
+        chaos_ticker.start()
+        try:
+            lat, ok, mism, errs, stuck = serve_lap(
+                session, queries, oracles, CHAOS_SECONDS, CLIENTS)
+        finally:
+            chaos_ticker.stop()
+            faults.uninstall()
+        injected = injector.fired("*")
+        # Quiesce: drain the backlog, then the log must be fully healed.
+        final_staleness = drain(coord)
+        stranded = stranded_entries(session)
+        chaos = {
+            "seconds": CHAOS_SECONDS,
+            "queries": len(lat),
+            "mismatches": mism,
+            "errors": len(errs),
+            "stuck_threads": stuck,
+            "deadlock": stuck > 0,
+            "crashes_caught": chaos_ticker.crashes,
+            "injections_fired": injected,
+            "recoveries": _counter("resilience.recoveries") - recoveries0,
+            "stranded_entries": stranded,
+            "final_staleness_s": final_staleness,
+            "p99_s": _percentile(lat, 0.99),
+        }
+
+        # -- freshness: every appended row is indexed + visible --------
+        fresh = session.read_parquet(facts).filter(col("g") >= lit(16))
+        visible = fresh.collect().num_rows
+        expected = appender.n * APPEND_ROWS
+        freshness = {"appended_files": appender.n * 2,
+                     "appended_rows_facts": expected,
+                     "visible_rows_facts": visible,
+                     "final_staleness_s": final_staleness}
+
+        p99_degradation = (committed["p99_s"] / quiet["p99_s"]
+                           if quiet["p99_s"] else None)
+        doc = make_artifact(
+            driver="bench_ingest.py",
+            metric="ingest_p99_s",
+            value=committed["p99_s"],
+            unit="s",
+            vs_baseline=round(p99_degradation, 4)
+            if p99_degradation else None,
+            extra={"ingest": {
+                "clients": CLIENTS,
+                "rows_initial": ROWS,
+                "append_rows_per_file": APPEND_ROWS,
+                "lap_seconds": LAP_SECONDS,
+                "quiet": quiet,
+                "sweep": sweep,
+                "committed_rate": committed,
+                "p99_degradation_x": round(p99_degradation, 4)
+                if p99_degradation else None,
+                "segcache": committed["segcache"],
+                "chaos": chaos,
+                "freshness": freshness,
+            }},
+        )
+        doc["link_probe"] = link_probe()
+        print(json.dumps(doc))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -141,9 +141,10 @@ class RefreshIncrementalAction(RefreshAction):
         super().validate()
         if self._is_skipping():
             raise HyperspaceException(
-                "Incremental refresh does not apply to data-skipping "
-                "indexes; use mode='full' — per-file sketches make a "
-                "full re-sketch cheap.")
+                "The bucketed incremental-refresh path applies to "
+                "covering indexes only; data-skipping indexes take the "
+                "sketch-append delta path (mode='incremental' via the "
+                "collection manager dispatches there by kind).")
         self.source_delta()  # raises on un-servable deltas
         if self.lineage_enabled():
             return  # classify_current verified every survivor per file

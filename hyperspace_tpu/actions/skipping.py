@@ -20,9 +20,14 @@ DATA the op writes:
 
 `RefreshAction` (full rebuild) dispatches through the same build
 functions when the previous entry's kind is DataSkippingIndex —
-per-file sketches make a full re-sketch cheap. Incremental refresh and
-optimize decline skipping entries with a typed error (nothing
-incremental to carry, nothing compacted to merge).
+per-file sketches make a full re-sketch cheap. Under continuous ingest
+the streaming path is `RefreshSkippingAppendAction` below (the
+collection manager routes mode='incremental' there by kind): re-sketch
+only appended/rewritten files, carry the previous blob's rows forward
+(`index/sketch.append_file_sketches`), drop vanished files. Optimize
+still declines skipping entries with a typed error (nothing compacted
+to merge), as does the bucketed covering-delta path on direct
+construction.
 
 Commit also sweeps the SOURCE roots' host caches + footprint size
 cache (`segcache.invalidate_source_paths`) — not just the index root
@@ -47,6 +52,7 @@ from hyperspace_tpu.index.log_entry import (Content, DataSkippingIndex,
                                             Signature, Source)
 from hyperspace_tpu.index.log_manager import IndexLogManager
 from hyperspace_tpu.actions.create import CreateActionBase
+from hyperspace_tpu.actions.refresh import RefreshAction
 from hyperspace_tpu.plan.nodes import Scan
 from hyperspace_tpu.plan.serde import plan_to_json
 
@@ -231,6 +237,60 @@ class CreateSkippingIndexAction(CreateActionBase):
         detail = build_skipping_data(self.df, self.index_config,
                                      self.index_data_path, self.conf)
         self.annotate_report(**detail)
+        self.commit_data_version()
+        self.annotate_report(source_roots_swept=sweep_source_caches(self.df))
+        self.stamp_stats()
+
+
+class RefreshSkippingAppendAction(RefreshAction):
+    """Streaming refresh for data-skipping indexes: REFRESHING ->
+    ACTIVE through the same FSM as every other maintenance action, but
+    the op writes a DELTA blob build — re-sketch only the source files
+    that appeared or were rewritten since the previous version, carry
+    every still-identical file's row forward from the previous blob,
+    drop rows for vanished files (per-file sketches make deletions
+    trivially servable). The merged blob lands in the next `v__=N+1`
+    version dir; in-flight pinned readers keep the old one.
+
+    Z-ordered configs decline with a typed error: the clustered copy's
+    zones are tight only over the FULL row set, so appends require a
+    re-cluster — `mode='full'` — not a carry.
+    """
+
+    def validate(self) -> None:
+        super().validate()
+        if not self._is_skipping():
+            raise HyperspaceException(
+                "Sketch-append refresh only applies to data-skipping "
+                "indexes; covering indexes take the bucketed delta path "
+                "(the collection manager dispatches mode='incremental' "
+                "by kind).")
+        if self.index_config.zorder_by:
+            raise HyperspaceException(
+                "Sketch-append refresh does not apply to Z-ordered "
+                "skipping indexes — the clustered copy must be "
+                "re-clustered over the full row set; use mode='full'.")
+
+    def op(self) -> None:
+        from hyperspace_tpu.index import sketch as sketch_io
+        from hyperspace_tpu.utils import file_utils
+
+        cfg = self.index_config
+        skipped = _resolve(self.df.schema, cfg.skipping_columns)
+        source_files: List[str] = []
+        for leaf in self.df.plan.collect_leaves():
+            if isinstance(leaf, Scan):
+                source_files.extend(leaf.files())
+        out_dir = self.index_data_path
+        file_utils.create_directory(out_dir)
+        sketches, detail = sketch_io.append_file_sketches(
+            self.previous_entry.content.root, source_files, skipped,
+            self.df.schema, self.conf)
+        blob_bytes = sketch_io.write_sketches(
+            out_dir, sketches, skipped, self.df.schema, cfg.sketch_types)
+        self.annotate_report(source_files=len(source_files),
+                             sketched_columns=len(skipped),
+                             sketch_blob_bytes=blob_bytes, **detail)
         self.commit_data_version()
         self.annotate_report(source_roots_swept=sweep_source_caches(self.df))
         self.stamp_stats()

@@ -698,6 +698,29 @@ class HyperspaceConf:
                             constants.ADVISOR_MIN_REPEATS_DEFAULT)
 
     @property
+    def ingest_interval_seconds(self) -> float:
+        """Cadence between ingest-coordinator micro-batch ticks; the
+        caller's loop sleeps this long between `run_once` calls (the
+        coordinator never owns a thread)."""
+        return float(self.get(constants.INGEST_INTERVAL_SECONDS,
+                              str(constants.INGEST_INTERVAL_SECONDS_DEFAULT)))
+
+    @property
+    def ingest_serve_headroom(self) -> float:
+        """Fraction of `serve.hbm.budget.bytes` that may be admitted
+        before the ingest coordinator defers index refresh (appends
+        still land; refresh never starves admission)."""
+        return float(self.get(constants.INGEST_SERVE_HEADROOM,
+                              str(constants.INGEST_SERVE_HEADROOM_DEFAULT)))
+
+    @property
+    def ingest_conflict_attempts(self) -> int:
+        """Total refresh tries per tick when the coordinator loses the
+        op-log race to a manual refresher, before it concedes."""
+        return self.get_int(constants.INGEST_CONFLICT_ATTEMPTS,
+                            constants.INGEST_CONFLICT_ATTEMPTS_DEFAULT)
+
+    @property
     def maintenance_lease_seconds(self) -> int:
         """Age past which a transient op-log entry is treated as a crashed
         writer and auto-recovered (Cancel FSM) by the next maintenance

@@ -404,6 +404,25 @@ ADVISOR_SKIPPING_PRUNE_FRACTION_DEFAULT = 0.5
 ADVISOR_MIN_REPEATS = "spark.hyperspace.advisor.min.repeats"
 ADVISOR_MIN_REPEATS_DEFAULT = 2
 
+# Continuous-ingest coordinator (`engine/ingest.py`): cadence between
+# micro-batch ticks when the caller drives `run_once` on a timer. The
+# coordinator itself never spawns threads (the engine thread seam keeps
+# background threads in `scheduler.py`); this is the interval the
+# owning loop should sleep between ticks.
+INGEST_INTERVAL_SECONDS = "spark.hyperspace.ingest.interval.seconds"
+INGEST_INTERVAL_SECONDS_DEFAULT = 5.0
+# Serving-pressure gate, same shape as the advisor's: refresh work is
+# deferred while queries wait for admission, or while admitted bytes
+# exceed this fraction of `serve.hbm.budget.bytes`. Appends still land
+# (the source is append-only either way); only index refresh yields.
+INGEST_SERVE_HEADROOM = "spark.hyperspace.ingest.serve.headroom"
+INGEST_SERVE_HEADROOM_DEFAULT = 0.5
+# Total tries the coordinator makes when a refresh loses the op-log
+# race to a manual refresher (typed conflict → bounded jittered backoff
+# via `utils/retry.py`, then a clean concession — never an error).
+INGEST_CONFLICT_ATTEMPTS = "spark.hyperspace.ingest.conflict.attempts"
+INGEST_CONFLICT_ATTEMPTS_DEFAULT = 3
+
 # XLA profiler integration: when set to a directory, every executed
 # query is captured as a profiler trace under it (one subdirectory per
 # query), viewable in TensorBoard/XProf/Perfetto. Empty (default) = off.

@@ -302,6 +302,16 @@ class ScanExec(PhysicalNode):
                 index_name=name) from exc
 
     def execute(self, bucket: Optional[int] = None) -> columnar.ColumnBatch:
+        if self.scan.index_name is not None \
+                and self.scan.pinned_version is not None:
+            # Snapshot-pinned index read: hold the version directories
+            # pinned for the read's duration so a concurrent vacuum
+            # defers its delete instead of yanking files mid-read
+            # (index/pins.py). If a delete wins anyway, the guard below
+            # still converts the failure into the typed fallback.
+            from hyperspace_tpu.index import pins
+            with pins.pinned(self.scan.root_paths):
+                return self._guard_index_read(lambda: self._execute(bucket))
         return self._guard_index_read(lambda: self._execute(bucket))
 
     def _per_bucket_files(self) -> dict:

@@ -149,6 +149,19 @@ class Hyperspace:
             self._advisor = IndexAdvisor(self.session)
         return self._advisor
 
+    def ingest(self, producer=None, indexes=()):
+        """A continuous-ingest coordinator (`engine/ingest.py`) bound
+        to this session: each `run_once()` tick lands `producer`'s
+        micro-batch appends, defers under serve pressure, and drives
+        mode='incremental' refresh of `indexes` through the lease-gated
+        manager path with typed conflict concession. Caller-threaded —
+        drive it on `spark.hyperspace.ingest.interval.seconds`; the
+        coordinator never owns a thread. Fresh instance per call (the
+        staleness ledger belongs to one append stream)."""
+        from hyperspace_tpu.engine.ingest import IngestCoordinator
+        return IngestCoordinator(self.session, producer=producer,
+                                 indexes=indexes)
+
     # -- observability ----------------------------------------------------
 
     def index_usage(self, last_n: Optional[int] = None):

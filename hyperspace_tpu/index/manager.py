@@ -174,13 +174,27 @@ class IndexCollectionManager(IndexManager):
 
     def vacuum(self, index_name: str) -> None:
         log_manager, data_manager = self._managers(index_name)
-        VacuumAction(log_manager, data_manager).run()
+        VacuumAction(log_manager, data_manager, self.conf).run()
 
     def refresh(self, index_name: str, mode: str = "full") -> None:
+        """mode 'incremental' dispatches on the index KIND recorded in
+        the op log: covering indexes take the bucketed-delta path
+        (RefreshIncrementalAction), data-skipping indexes the per-file
+        sketch-append path (RefreshSkippingAppendAction) — both
+        append-only streaming refreshes through the same FSM."""
         log_manager, data_manager = self._managers(index_name)
         if mode == "full":
             RefreshAction(log_manager, data_manager, self.conf).run()
         elif mode == "incremental":
+            from hyperspace_tpu.index.log_entry import DataSkippingIndex
+            latest = log_manager.get_latest_log()
+            if isinstance(latest, IndexLogEntry) and \
+                    isinstance(latest.derived_dataset, DataSkippingIndex):
+                from hyperspace_tpu.actions.skipping import (
+                    RefreshSkippingAppendAction)
+                RefreshSkippingAppendAction(log_manager, data_manager,
+                                            self.conf).run()
+                return
             from hyperspace_tpu.actions.refresh_incremental import (
                 RefreshIncrementalAction)
             RefreshIncrementalAction(log_manager, data_manager,

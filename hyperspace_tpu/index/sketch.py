@@ -324,3 +324,60 @@ def load_sketches(version_dir: str) -> SketchSet:
             _cache.clear()
         _cache[key] = out
     return out
+
+
+# ---------------------------------------------------------------------------
+# Delta build (append-only streaming refresh)
+# ---------------------------------------------------------------------------
+
+
+def append_file_sketches(prev_version_dir: str, files: Sequence[str],
+                         names: Sequence[str], schema, conf):
+    """Delta-sketch build for an append-mostly source: carry forward the
+    previous version's per-file rows whose (size, stamp) identity still
+    matches the live file, re-sketch only new or rewritten files, and
+    drop rows for files that vanished. Returns `(sketches, detail)` —
+    the merged list in current-listing order plus a report dict with
+    carried/sketched/dropped counts.
+
+    Lives here (not in the refresh action) because `load_sketches` is
+    seam-linted to this module and `plan/rules/`: all blob IO stays in
+    one file. Safety: `plan/rules/skipping.prune_files` revalidates
+    (size, stamp) per file at query time and KEEPS any unknown or
+    changed file, so even a stale carried row can only under-prune,
+    never wrongly drop a file. An unreadable previous blob degrades to
+    a full re-sketch of every file (counted in the detail) rather than
+    failing the refresh.
+    """
+    from hyperspace_tpu.index.signature import file_stamp
+
+    prev_files: Dict[str, FileSketch] = {}
+    prev_unreadable = False
+    try:
+        prev_files = dict(load_sketches(prev_version_dir).files)
+    except HyperspaceException:
+        prev_unreadable = True
+
+    carried: Dict[str, FileSketch] = {}
+    to_sketch: List[str] = []
+    for path in files:
+        prev = prev_files.get(path)
+        stamp = file_stamp(path) if prev is not None else None
+        if prev is not None and stamp is not None \
+                and prev.size == int(stamp[0]) \
+                and prev.stamp == str(stamp[1]):
+            carried[path] = prev
+        else:
+            to_sketch.append(path)
+    fresh = {s.path: s for s in
+             build_file_sketches(to_sketch, names, schema, conf)}
+    merged = [carried.get(p, fresh.get(p)) for p in files]
+    live = set(files)
+    detail = {
+        "files_carried": len(carried),
+        "files_sketched": len(fresh),
+        "files_dropped": sum(1 for p in prev_files if p not in live),
+    }
+    if prev_unreadable:
+        detail["prev_blob_unreadable"] = True
+    return merged, detail

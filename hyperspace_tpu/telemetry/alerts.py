@@ -164,6 +164,12 @@ DEFAULT_RULES: List[AlertRule] = [
         capacity_of=_queue_bound,
         description="wait queue above 90% of its bound — next "
                     "arrivals will be rejected"),
+    AlertRule(
+        "ingest_staleness", "gauge", "ingest.staleness.seconds",
+        threshold=30.0, clear=10.0, sustain_s=5.0,
+        description="index staleness above 30 s sustained — appends "
+                    "outrunning incremental refresh (coordinator "
+                    "deferred, conceding, or failing)"),
 ]
 
 

@@ -369,6 +369,92 @@ def compare_advisor(old: dict, new: dict, threshold: float):
     return rows
 
 
+# --ingest gate bounds. Staleness at the committed append rate must
+# stay under the alert rule's firing threshold (an artifact that ships
+# already-alerting staleness is a regression by definition), and the
+# ingest lap's p99 may cost at most this multiple of the quiet lap.
+# The degradation cap is a coarse backstop, not a target: in a
+# single-process GIL-bound engine the refresh's sketch/bucket work
+# inevitably stalls concurrent clients (measured ~25-35x at the
+# committed rate), so the cap only catches runaway regressions —
+# the old-vs-new p99_degradation_x ratio row is the tight gate.
+INGEST_STALENESS_MAX_S = 30.0
+INGEST_P99_DEGRADATION_MAX = 60.0
+INGEST_WARM_HIT_RATE_FLOOR = 0.5
+
+
+def compare_ingest(old: dict, new: dict, threshold: float):
+    """Continuous-ingest gate rows (PR 19): the staleness-vs-p99
+    frontier must not regress, and the chaos/warm-set ABSOLUTE wins the
+    plane exists for stay won:
+
+    - `p99_degradation_x` — ingest-lap p99 over quiet-lap p99, ratio
+      vs the previous artifact plus an absolute ceiling;
+    - `staleness_max_s` — worst staleness at the committed append
+      rate, ratio when history is nonzero plus the absolute alert
+      bound (nothing ratio-gates against zero);
+    - `chaos_{mismatches,stuck,stranded}` — crash + transient
+      injection mid-refresh under load: zero wrong answers, zero stuck
+      clients, zero non-ACTIVE op-log leftovers after recovery;
+    - `warm_hit_rate` / `segments_rekeyed` — sustained append must not
+      collapse the segment cache: hit rate holds the floor and version
+      rekeying actually ran (rekeyed == 0 means every flip dumped the
+      warm set)."""
+    o = old.get("ingest") or {}
+    n = new.get("ingest") or {}
+    rows = []
+
+    def add(name, old_v, new_v, lower_is_better=False):
+        if not (isinstance(old_v, (int, float))
+                and isinstance(new_v, (int, float)) and old_v > 0):
+            return
+        change = new_v / old_v - 1.0
+        gated = (change > threshold if lower_is_better
+                 else change < -threshold)
+        rows.append((name, old_v, new_v, change, gated))
+
+    add("p99_degradation_x", o.get("p99_degradation_x"),
+        n.get("p99_degradation_x"), lower_is_better=True)
+    add("quiet_p99_s", (o.get("quiet") or {}).get("p99_s"),
+        (n.get("quiet") or {}).get("p99_s"), lower_is_better=True)
+    add("staleness_max_s",
+        (o.get("committed_rate") or {}).get("staleness_max_s"),
+        (n.get("committed_rate") or {}).get("staleness_max_s"),
+        lower_is_better=True)
+
+    chaos = n.get("chaos") or {}
+    for key, label in (("mismatches", "chaos_mismatches"),
+                       ("stuck_threads", "chaos_stuck"),
+                       ("stranded_entries", "chaos_stranded")):
+        v = chaos.get(key)
+        if isinstance(v, (int, float)):
+            rows.append((label, 0.0, float(v), float(v), v > 0))
+
+    seg = n.get("segcache") or {}
+    hit_rate = seg.get("warm_hit_rate")
+    if isinstance(hit_rate, (int, float)):
+        rows.append(("warm_hit_rate", INGEST_WARM_HIT_RATE_FLOOR,
+                     float(hit_rate),
+                     float(hit_rate) - INGEST_WARM_HIT_RATE_FLOOR,
+                     hit_rate < INGEST_WARM_HIT_RATE_FLOOR))
+    rekeyed = seg.get("rekeyed")
+    if isinstance(rekeyed, (int, float)):
+        rows.append(("segments_rekeyed", 1.0, float(rekeyed),
+                     float(rekeyed), rekeyed <= 0))
+
+    staleness = (n.get("committed_rate") or {}).get("staleness_max_s")
+    if isinstance(staleness, (int, float)):
+        rows.append(("staleness_abs_s", INGEST_STALENESS_MAX_S,
+                     float(staleness), float(staleness),
+                     staleness > INGEST_STALENESS_MAX_S))
+    degradation = n.get("p99_degradation_x")
+    if isinstance(degradation, (int, float)):
+        rows.append(("p99_degradation_abs", INGEST_P99_DEGRADATION_MAX,
+                     float(degradation), float(degradation),
+                     degradation > INGEST_P99_DEGRADATION_MAX))
+    return rows
+
+
 def compare_serve(old: dict, new: dict, threshold: float):
     """Serving-artifact gate rows (same row shape as `compare`):
     scaling ratio + QPS drop >threshold, p50/p99 growth >threshold,
@@ -619,6 +705,11 @@ def main() -> int:
                          "(BENCH_ADVISOR_r*.json): at least one "
                          "auto-built index, scanned-bytes reduction, "
                          "index-served repeats, bit-identity")
+    ap.add_argument("--ingest", action="store_true",
+                    help="gate the continuous-ingest family "
+                         "(BENCH_INGEST_r*.json): staleness-vs-p99 "
+                         "frontier, chaos zeros, warm hit-rate floor, "
+                         "p99 degradation vs the quiet lap")
     ap.add_argument("--multichip", action="store_true",
                     help="gate the multi-chip scaling family "
                          "(MULTICHIP_r*.json): 8-device SMJ speedup, "
@@ -634,6 +725,8 @@ def main() -> int:
         pattern = args.glob or ("MULTICHIP_r*.json" if args.multichip
                                 else "BENCH_ADVISOR_r*.json"
                                 if args.advisor
+                                else "BENCH_INGEST_r*.json"
+                                if args.ingest
                                 else "BENCH_SERVE_r*.json" if args.serve
                                 else "BENCH_TPCDS_r*.json" if args.tpcds
                                 else "BENCH_r*.json")
@@ -648,9 +741,12 @@ def main() -> int:
     serve_mode = args.serve or ("serve" in old and "serve" in new)
     multichip_mode = args.multichip or "multichip" in new
     advisor_mode = args.advisor or "advisor" in new
+    ingest_mode = args.ingest or "ingest" in new
     rows = (compare_multichip(old, new, args.threshold) if multichip_mode
             else compare_advisor(old, new, args.threshold)
             if advisor_mode
+            else compare_ingest(old, new, args.threshold)
+            if ingest_mode
             else compare_serve(old, new, args.threshold) if serve_mode
             else compare(old, new, args.threshold))
 

@@ -433,6 +433,36 @@ def check_advisor_build_seam(package_dir: str):
     return failures
 
 
+def check_ingest_build_seam(package_dir: str):
+    """Source lint: no Action construction / actions import anywhere
+    under engine/ — in particular the ingest coordinator
+    (engine/ingest.py) must drive every refresh through the collection
+    manager's lease-gated path (stale-writer recovery, OCC one-winner),
+    never by constructing a maintenance verb directly. There is NO
+    allowed file: the engine executes queries; the actions package owns
+    writes."""
+    failures = []
+    engine_dir = os.path.join(package_dir, "engine")
+    for root, _dirs, files in os.walk(engine_dir):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_dir)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _RAW_ADVISOR_BUILD_RE.search(line):
+                        failures.append(
+                            f"hyperspace_tpu/{rel}:{lineno}: Action "
+                            "construction inside engine/ — refresh and "
+                            "every other maintenance verb must go "
+                            "through the collection manager's "
+                            "lease-gated path (see engine/ingest.py)")
+    return failures
+
+
 # The ONE sanctioned batched-execution point: the stacked-predicate
 # program (`parallel/spmd.batched_predicate_masks`, the serve.batch jit
 # entry) may only be invoked by the batching lane in engine/batcher.py.
@@ -948,6 +978,8 @@ def main() -> int:
     failures.extend(check_sharding_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_advisor_build_seam(
+        os.path.dirname(hyperspace_tpu.__file__)))
+    failures.extend(check_ingest_build_seam(
         os.path.dirname(hyperspace_tpu.__file__)))
     failures.extend(check_batch_seam(
         os.path.dirname(hyperspace_tpu.__file__)))

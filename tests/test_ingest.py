@@ -1,0 +1,526 @@
+"""Continuous-ingest plane: the delta-sketch append path, kind
+dispatch for mode='incremental', the `IngestCoordinator` tick (append /
+pressure gate / lease-path refresh / staleness accounting), typed
+conflict concession against a manual refresher, the crash-point matrix
+for both incremental refresh actions under concurrent serving, the
+segment-cache warm-set story under sustained append, vacuum-vs-pin
+safety, and the default staleness alert rule."""
+
+import os
+import shutil
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from chaos import canonical, run_chaos
+from hyperspace_tpu import telemetry
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.constants import States
+from hyperspace_tpu.engine.session import HyperspaceSession
+from hyperspace_tpu.exceptions import HyperspaceException
+from hyperspace_tpu.facade import Hyperspace
+from hyperspace_tpu.index import pins
+from hyperspace_tpu.index.index_config import (DataSkippingIndexConfig,
+                                               IndexConfig)
+from hyperspace_tpu.index.log_entry import IndexLogEntry
+from hyperspace_tpu.index.sketch import clear_sketch_cache, load_sketches
+from hyperspace_tpu.plan.expr import col, lit
+from hyperspace_tpu.utils.faults import FaultRule, InjectedCrash
+
+
+def _reg(name):
+    return telemetry.get_registry().counters_dict().get(name, 0)
+
+
+def _gauge(name):
+    return telemetry.get_registry().gauge(name).value
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sketch_cache():
+    clear_sketch_cache()
+    yield
+    clear_sketch_cache()
+
+
+def _write_facts(directory, name, lo, n=80, g=None):
+    """One facts file: k sequential from `lo`, g = k % 4 (or pinned to
+    a single value so a refresh touches at most one bucket)."""
+    k = np.arange(lo, lo + n, dtype=np.int64)
+    gv = (k % 4) if g is None else np.full(n, g, dtype=np.int64)
+    path = os.path.join(directory, name)
+    pq.write_table(pa.table({
+        "k": k, "g": gv,
+        "v": np.linspace(0.0, 1.0, n)}), path)
+    return path
+
+
+def _session(tmp_path, **extra):
+    conf = {"hyperspace.warehouse.dir": str(tmp_path / "wh"),
+            "spark.hyperspace.index.num.buckets": "4",
+            "spark.hyperspace.index.hybridscan.enabled": "true",
+            "spark.hyperspace.io.retry.base.ms": "1",
+            "spark.hyperspace.io.retry.max.ms": "4"}
+    conf.update(extra)
+    return HyperspaceSession(HyperspaceConf(conf))
+
+
+@pytest.fixture
+def env(tmp_path):
+    """(session, hs, facts_dir): 4-file facts source, hybrid scan on."""
+    facts = tmp_path / "facts"
+    facts.mkdir()
+    for i in range(4):
+        _write_facts(str(facts), f"f{i}.parquet", i * 80)
+    sess = _session(tmp_path)
+    return sess, Hyperspace(sess), str(facts)
+
+
+def _managers(sess, name):
+    mgr = Hyperspace.get_context(sess).index_collection_manager
+    return mgr._managers(name)
+
+
+def _latest_version_dir(sess, name):
+    _, dm = _managers(sess, name)
+    return dm.get_path(dm.get_latest_version_id())
+
+
+# -- delta-sketch append path ----------------------------------------------
+
+
+def test_incremental_refresh_dispatches_sketch_append(env):
+    """mode='incremental' on a data-skipping index takes the
+    sketch-append path: the new version's blob covers appended files,
+    and every pre-existing file's row is CARRIED (bit-identical),
+    not re-sketched."""
+    sess, hs, facts = env
+    hs.create_index(sess.read_parquet(facts),
+                    DataSkippingIndexConfig("sk", ["k"]))
+    before = dict(load_sketches(_latest_version_dir(sess, "sk")).files)
+    _write_facts(facts, "a0.parquet", 10_000)
+    hs.refresh_index("sk", mode="incremental")
+    after_dir = _latest_version_dir(sess, "sk")
+    after = dict(load_sketches(after_dir).files)
+    assert len(after) == len(before) + 1
+    for path, prev in before.items():
+        got = after[path]
+        assert (got.size, got.stamp, got.rows) == (
+            prev.size, prev.stamp, prev.rows), (
+            f"carried sketch row for {path} changed across append")
+        for name, prev_col in prev.columns.items():
+            got_col = got.columns[name]
+            assert (got_col.min, got_col.max, got_col.ok) == (
+                prev_col.min, prev_col.max, prev_col.ok)
+    appended = [p for p in after if p not in before]
+    assert len(appended) == 1 and appended[0].endswith("a0.parquet")
+
+
+def test_sketch_append_unit_carry_resketch_drop(env):
+    """`append_file_sketches` unit semantics: unchanged files carry,
+    rewritten files re-sketch, vanished files drop — and the detail
+    counts say exactly which happened."""
+    from hyperspace_tpu.index import sketch as sketch_io
+
+    sess, hs, facts = env
+    df = sess.read_parquet(facts)
+    hs.create_index(df, DataSkippingIndexConfig("sk", ["k"]))
+    v0 = _latest_version_dir(sess, "sk")
+    files = sorted(os.path.join(facts, f) for f in os.listdir(facts))
+
+    new = _write_facts(facts, "a0.parquet", 20_000)
+    merged, detail = sketch_io.append_file_sketches(
+        v0, files + [new], ["k"], df.schema, sess.conf)
+    assert [detail["files_carried"], detail["files_sketched"],
+            detail["files_dropped"]] == [4, 1, 0]
+    assert len(merged) == 5
+
+    _write_facts(facts, "f0.parquet", 30_000)  # rewrite: stamp changes
+    merged, detail = sketch_io.append_file_sketches(
+        v0, files, ["k"], df.schema, sess.conf)
+    assert [detail["files_carried"], detail["files_sketched"],
+            detail["files_dropped"]] == [3, 1, 0]
+
+    merged, detail = sketch_io.append_file_sketches(
+        v0, files[:2], ["k"], df.schema, sess.conf)
+    assert detail["files_dropped"] == 2
+    assert len(merged) == 2
+
+
+def test_zorder_skipping_declines_incremental(env):
+    """Z-ordered skipping indexes decline the append path with a typed
+    error naming the remedy — the clustered copy needs a full
+    re-cluster, not a carry."""
+    sess, hs, facts = env
+    hs.create_index(sess.read_parquet(facts),
+                    DataSkippingIndexConfig("zk", ["k"], zorder_by=["k"]))
+    _write_facts(facts, "a0.parquet", 10_000)
+    with pytest.raises(HyperspaceException, match="mode='full'"):
+        hs.refresh_index("zk", mode="incremental")
+
+
+# -- coordinator tick ------------------------------------------------------
+
+
+def test_tick_appends_refreshes_both_kinds_and_staleness_drains(env):
+    """One tick lands the producer's micro-batch and refreshes both
+    index kinds through the lease path; afterwards staleness is 0 and a
+    fresh query sees the appended rows."""
+    sess, hs, facts = env
+    hs.create_index(sess.read_parquet(facts),
+                    IndexConfig("cov", ["g"], ["k", "v"]))
+    hs.create_index(sess.read_parquet(facts),
+                    DataSkippingIndexConfig("sk", ["k"]))
+    appended = []
+
+    def producer():
+        appended.append(_write_facts(facts, f"a{len(appended)}.parquet",
+                                     10_000 + 100 * len(appended)))
+        return appended[-1:]
+
+    coord = hs.ingest(producer=producer, indexes=["cov", "sk"])
+    t0 = {n: _reg(n) for n in ("ingest.ticks", "ingest.appends",
+                               "ingest.refreshes", "ingest.failures")}
+    decision = coord.run_once()
+    assert decision["action"] == "refreshed"
+    assert decision["appended"] == 1
+    assert [r["action"] for r in decision["refreshes"]] == [
+        "refreshed", "refreshed"]
+    assert _reg("ingest.ticks") == t0["ingest.ticks"] + 1
+    assert _reg("ingest.appends") == t0["ingest.appends"] + 1
+    assert _reg("ingest.refreshes") == t0["ingest.refreshes"] + 2
+    assert _reg("ingest.failures") == t0["ingest.failures"]
+    assert coord.staleness_s() == 0.0
+    assert _gauge("ingest.staleness.seconds") == 0.0
+    # The appended file is in the new skipping blob, and a fresh reader
+    # sees its rows.
+    blob = set(load_sketches(_latest_version_dir(sess, "sk")).files)
+    assert appended[0] in blob
+    got = sess.read_parquet(facts).filter(
+        col("k") >= lit(10_000)).collect()
+    assert got.num_rows == 80
+
+
+def test_staleness_tracks_uncovered_appends(env):
+    """`ingest.staleness.seconds` = now − newest UNcovered append: it
+    ages while no refresh lands, and a successful tick (refresh started
+    after the append) drains it to 0."""
+    sess, hs, facts = env
+    hs.create_index(sess.read_parquet(facts),
+                    IndexConfig("cov", ["g"], ["k", "v"]))
+    coord = hs.ingest(indexes=["cov"])
+    path = _write_facts(facts, "a0.parquet", 10_000)
+    coord.record_append([path], at=time.time() - 7.0)
+    assert 6.5 <= coord.staleness_s() <= 30.0
+    assert _gauge("ingest.staleness.seconds") >= 6.5
+    decision = coord.run_once()
+    assert decision["refreshes"][0]["action"] == "refreshed"
+    assert coord.staleness_s() == 0.0
+    assert _gauge("ingest.staleness.seconds") == 0.0
+
+
+def test_serve_pressure_defers_refresh_not_appends(env):
+    """Under queue pressure the tick still lands appends (staleness
+    accounting stays truthful) but defers the refresh with a reason."""
+    from hyperspace_tpu.engine import scheduler as sched_mod
+
+    sess, hs, facts = env
+    hs.create_index(sess.read_parquet(facts),
+                    IndexConfig("cov", ["g"], ["k", "v"]))
+
+    class _Pressured:
+        def pressure(self):
+            return {"queue_depth": 3, "admitted_bytes": 0}
+
+    coord = hs.ingest(
+        producer=lambda: [_write_facts(facts, "a0.parquet", 10_000)],
+        indexes=["cov"])
+    d0, r0 = _reg("ingest.deferred"), _reg("ingest.refreshes")
+    prev = sched_mod.get_scheduler()
+    sched_mod.set_scheduler(_Pressured())
+    try:
+        decision = coord.run_once()
+    finally:
+        sched_mod.set_scheduler(prev)
+    assert decision["action"] == "deferred"
+    assert "3 queries waiting" in decision["reason"]
+    assert decision["appended"] == 1
+    assert _reg("ingest.deferred") == d0 + 1
+    assert _reg("ingest.refreshes") == r0
+    assert coord.staleness_s() > 0.0  # the un-refreshed append ages
+    # Quiet again: the next tick picks the backlog up.
+    assert coord.run_once()["refreshes"][0]["action"] == "refreshed"
+    assert coord.staleness_s() == 0.0
+
+
+def test_producer_failure_is_contained(env):
+    """A producer exception fails the TICK (typed, counted), never
+    crashes the owner or half-refreshes."""
+    sess, hs, facts = env
+    hs.create_index(sess.read_parquet(facts),
+                    IndexConfig("cov", ["g"], ["k", "v"]))
+
+    def bad_producer():
+        raise OSError("source landing zone unreachable")
+
+    coord = hs.ingest(producer=bad_producer, indexes=["cov"])
+    f0, r0 = _reg("ingest.failures"), _reg("ingest.refreshes")
+    decision = coord.run_once()
+    assert decision["action"] == "failed"
+    assert "landing zone" in decision["reason"]
+    assert _reg("ingest.failures") == f0 + 1
+    assert _reg("ingest.refreshes") == r0
+
+
+# -- conflict concession ---------------------------------------------------
+
+
+def test_conflict_concession_exactly_one_winner(env):
+    """Racing a manual refresher: the op-log slot has one winner. The
+    coordinator retries under the shared backoff policy and CONCEDES
+    (typed decision + `ingest.conflicts`), then wins cleanly next tick
+    once the manual writer committed."""
+    sess, hs, facts = env
+    hs.create_index(sess.read_parquet(facts),
+                    IndexConfig("cov", ["g"], ["k", "v"]))
+    lm, _ = _managers(sess, "cov")
+    base = lm.get_latest_log()
+    # A fresh transient entry = a LIVE manual refresher mid-flight (too
+    # young for lease recovery to touch).
+    rival = IndexLogEntry.from_dict(base.to_dict())
+    rival.state = States.REFRESHING
+    assert lm.write_log(base.id + 1, rival)
+
+    coord = hs.ingest(indexes=["cov"])
+    c0, f0, retries0 = (_reg("ingest.conflicts"), _reg("ingest.failures"),
+                        _reg("io.retries"))
+    decision = coord.run_once()
+    assert decision["refreshes"][0]["action"] == "conceded"
+    assert _reg("ingest.conflicts") == c0 + 1
+    assert _reg("ingest.failures") == f0  # a concession is NOT a failure
+    assert _reg("io.retries") > retries0  # bounded backoff, not a spin
+
+    # The manual writer commits; the next tick wins the slot.
+    winner = IndexLogEntry.from_dict(base.to_dict())
+    winner.state = States.ACTIVE
+    assert lm.write_log(base.id + 2, winner)
+    assert coord.run_once()["refreshes"][0]["action"] == "refreshed"
+    assert lm.get_latest_log().state == States.ACTIVE
+
+
+# -- crash-point matrix under concurrent serving ---------------------------
+
+
+@pytest.mark.parametrize("kind,phase", [
+    ("covering", "begin"), ("covering", "op"), ("covering", "end"),
+    ("skipping", "begin"), ("skipping", "op"), ("skipping", "end"),
+])
+def test_crash_matrix_refresh_recovers_next_tick(tmp_path, fault_injector,
+                                                 kind, phase):
+    """Crash the incremental refresh at each phase boundary. The torn
+    op-log entry must not corrupt concurrent serving (chaos lap against
+    the serial oracle), and the NEXT tick's lease recovery heals the
+    log and completes the refresh."""
+    facts = tmp_path / "facts"
+    facts.mkdir()
+    for i in range(4):
+        _write_facts(str(facts), f"f{i}.parquet", i * 80)
+    sess = _session(tmp_path,
+                    **{"spark.hyperspace.maintenance.lease.seconds": "0"})
+    hs = Hyperspace(sess)
+    if kind == "covering":
+        hs.create_index(sess.read_parquet(str(facts)),
+                        IndexConfig("cov", ["g"], ["k", "v"]))
+        action = "RefreshIncrementalAction"
+    else:
+        hs.create_index(sess.read_parquet(str(facts)),
+                        DataSkippingIndexConfig("sk", ["k"]))
+        action = "RefreshSkippingAppendAction"
+    name = "cov" if kind == "covering" else "sk"
+    _write_facts(str(facts), "a0.parquet", 10_000)
+
+    coord = hs.ingest(indexes=[name])
+    inj = fault_injector(
+        FaultRule(f"action.{action}.{phase}", kind="crash", times=1))
+    with pytest.raises(InjectedCrash):
+        coord.run_once()
+    assert inj.fired("action.*") == 1
+    lm, _ = _managers(sess, name)
+    # The fault fires BEFORE the phase runs: a crash at `begin` dies
+    # before the transient entry is written (log untouched); `op`/`end`
+    # crashes leave the torn REFRESHING entry recovery must heal.
+    if phase == "begin":
+        assert lm.get_latest_log().state == States.ACTIVE
+    else:
+        assert lm.get_latest_log().state != States.ACTIVE
+
+    # Concurrent serving against the torn log: correctness holds (the
+    # planner uses the last COMMITTED version or falls back).
+    sess.enable_hyperspace()
+    try:
+        workload, expected = [], {}
+        for g in range(4):
+            df = sess.read_parquet(str(facts)).filter(
+                col("g") == lit(g)).select("k", "g", "v")
+            workload.append((f"g{g}", df))
+            expected[f"g{g}"] = canonical(df.collect())
+        report = run_chaos(workload, expected, clients=4,
+                           total_queries=16)
+    finally:
+        sess.disable_hyperspace()
+    assert report.mismatches == []
+    assert report.stuck_threads == []
+    assert report.outcomes["error"] == 0
+
+    # Next tick: lease recovery (Cancel FSM) + the refresh completes.
+    fault_injector()  # disarm
+    rec0 = _reg("resilience.recoveries")
+    decision = coord.run_once()
+    assert decision["refreshes"][0]["action"] == "refreshed"
+    if phase != "begin":  # begin crash left nothing to recover
+        assert _reg("resilience.recoveries") >= rec0 + 1
+    assert lm.get_latest_log().state == States.ACTIVE
+    assert coord.staleness_s() == 0.0
+    got = sess.read_parquet(str(facts)).filter(
+        col("k") >= lit(10_000)).collect()
+    assert got.num_rows == 80
+
+
+# -- segment-cache warm set under sustained append -------------------------
+
+
+def test_warm_hit_rate_held_under_append(tmp_path):
+    """Bucket-scoped incremental commit REKEYS warm untouched-bucket
+    segments to the new version instead of dumping them: after an
+    append+refresh, repeat queries keep a warm hit rate above the floor
+    and `cache.segments.rekeyed` moves."""
+    facts = tmp_path / "facts"
+    facts.mkdir()
+    for i in range(4):
+        _write_facts(str(facts), f"f{i}.parquet", i * 80)
+    sess = _session(
+        tmp_path,
+        **{"spark.hyperspace.execution.min.device.rows": "0",
+           "spark.hyperspace.distribution.enabled": "false"})
+    hs = Hyperspace(sess)
+    hs.create_index(sess.read_parquet(str(facts)),
+                    IndexConfig("cov", ["g"], ["k", "v"]))
+
+    def run_lap():
+        out = {}
+        for g in range(4):
+            out[g] = canonical(
+                sess.read_parquet(str(facts))
+                .filter(col("g") == lit(g)).select("k", "g", "v")
+                .collect())
+        return out
+
+    sess.enable_hyperspace()
+    try:
+        before = run_lap()
+        run_lap()  # warm the segment cache
+        # Appended file pins a single OUT-OF-WORKLOAD g value: the
+        # refresh touches at most one bucket, answers stay invariant.
+        coord = hs.ingest(
+            producer=lambda: [_write_facts(str(facts), "a0.parquet",
+                                           10_000, g=7)],
+            indexes=["cov"])
+        rekeyed0 = _reg("cache.segments.rekeyed")
+        assert coord.run_once()["action"] == "refreshed"
+        assert _reg("cache.segments.rekeyed") > rekeyed0
+
+        h0, m0 = _reg("cache.segments.hits"), _reg("cache.segments.misses")
+        after = run_lap()
+        hits = _reg("cache.segments.hits") - h0
+        misses = _reg("cache.segments.misses") - m0
+    finally:
+        sess.disable_hyperspace()
+    for g in range(4):
+        assert after[g].equals(before[g])
+    assert hits + misses > 0
+    assert hits / (hits + misses) >= 0.5, (
+        f"warm set collapsed across the version flip: "
+        f"{hits} hits / {misses} misses")
+
+
+# -- vacuum vs pinned reads ------------------------------------------------
+
+
+def test_vacuum_defers_behind_pin_then_collects(env):
+    """A vacuum racing a pinned in-flight read backs off and SKIPS the
+    pinned version (counted deferral) — the reader finishes unharmed;
+    an unpinned retry collects the garbage."""
+    sess, hs, facts = env
+    hs.create_index(sess.read_parquet(facts),
+                    IndexConfig("cov", ["g"], ["k", "v"]))
+    hs.create_index(sess.read_parquet(facts),
+                    IndexConfig("cov2", ["g"], ["k"]))
+    vdir = _latest_version_dir(sess, "cov")
+    hs.delete_index("cov")
+    d0 = _reg("resilience.vacuum.deferred")
+    with pins.pinned([vdir]):
+        hs.vacuum_index("cov")
+        assert os.path.isdir(vdir), "vacuum deleted a pinned version"
+    assert _reg("resilience.vacuum.deferred") == d0 + 1
+    assert not pins.is_pinned(vdir)
+    # The skipped version is orphaned garbage — recoverable, unlike a
+    # reader crashed mid-file; the vacuum itself still completed.
+    assert os.path.isdir(vdir)
+    # Control: with no pin held, vacuum hard-deletes the version dir.
+    vdir2 = _latest_version_dir(sess, "cov2")
+    hs.delete_index("cov2")
+    hs.vacuum_index("cov2")
+    assert not os.path.isdir(vdir2)
+    assert _reg("resilience.vacuum.deferred") == d0 + 1
+
+
+def test_lost_version_surfaces_typed_fallback_not_file_error(env):
+    """If a delete wins anyway (other-process vacuum), the in-flight
+    read surfaces as the typed unavailable→fallback path and the query
+    still answers from source — never a raw FileNotFoundError."""
+    sess, hs, facts = env
+    hs.create_index(sess.read_parquet(facts),
+                    IndexConfig("cov", ["g"], ["k", "v"]))
+    truth = canonical(sess.read_parquet(facts)
+                      .filter(col("g") == lit(2)).select("k", "g", "v")
+                      .collect())
+    shutil.rmtree(_latest_version_dir(sess, "cov"))
+    f0 = _reg("resilience.fallbacks")
+    sess.enable_hyperspace()
+    try:
+        got = (sess.read_parquet(facts)
+               .filter(col("g") == lit(2)).select("k", "g", "v")
+               .collect())
+    finally:
+        sess.disable_hyperspace()
+    assert canonical(got).equals(truth)
+    assert _reg("resilience.fallbacks") == f0 + 1
+
+
+# -- staleness alert rule --------------------------------------------------
+
+
+def test_ingest_staleness_default_rule_fires_and_resolves():
+    """The shipped `ingest_staleness` rule: gauge > 30 sustained 5 s
+    fires, hysteresis holds until < 10."""
+    from hyperspace_tpu.telemetry.alerts import (DEFAULT_RULES,
+                                                 AlertManager)
+
+    rule = next(r for r in DEFAULT_RULES if r.name == "ingest_staleness")
+    assert rule.series == "ingest.staleness.seconds"
+    g = telemetry.get_registry().gauge("ingest.staleness.seconds")
+    m = AlertManager(rules=[rule])
+    g.set(45.0)
+    assert m.evaluate(now=100.0) == []          # not yet sustained
+    fired = m.evaluate(now=105.1)
+    assert len(fired) == 1 and fired[0]["rule"] == "ingest_staleness"
+    g.set(20.0)                                  # hysteresis band
+    assert m.evaluate(now=106.0) == []
+    assert m.active_count() == 1
+    g.set(0.0)
+    resolved = m.evaluate(now=107.0)
+    assert len(resolved) == 1 and resolved[0]["state"] == "resolved"
+    assert m.active_count() == 0

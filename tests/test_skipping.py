@@ -329,14 +329,20 @@ def test_refresh_resketches_appended_files(env):
     assert _sorted(on).equals(_sorted(off)) and on.num_rows == 1
 
 
-def test_incremental_refresh_and_optimize_decline(env):
+def test_incremental_refresh_dispatches_and_optimize_declines(env):
+    """mode='incremental' on a skipping index now takes the
+    sketch-append delta path (tests/test_ingest.py covers its
+    semantics); Z-ordered configs and optimize still decline typed."""
     sess, hs, df, _src = env
     hs.create_index(df, DataSkippingIndexConfig("sk", ["key"]))
-    with pytest.raises(HyperspaceException, match="full"):
-        hs.refresh_index("sk", mode="incremental")
+    hs.refresh_index("sk", mode="incremental")  # no-op append: succeeds
     with pytest.raises(HyperspaceException, match="skipping"):
         hs.optimize_index("sk")
-    assert list(hs.indexes()["state"]) == ["ACTIVE"]
+    hs.create_index(df, DataSkippingIndexConfig(
+        "zk", ["key"], zorder_by=["key"]))
+    with pytest.raises(HyperspaceException, match="full"):
+        hs.refresh_index("zk", mode="incremental")
+    assert sorted(hs.indexes()["state"]) == ["ACTIVE", "ACTIVE"]
 
 
 def test_lifecycle_round_trip_with_crash_recovery(env, fault_injector):
